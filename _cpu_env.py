@@ -1,0 +1,23 @@
+"""Dev helper: `python -c "import _cpu_env; ..."` for CPU-only runs.
+
+Same axon-bypass as tests/conftest.py (see there for why), without the
+8-device assertion so it works for quick single-device experiments too.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as xb  # noqa: E402
+
+# the axon register hook may have set jax_platforms via config (which
+# overrides the env var) — force it back
+jax.config.update("jax_platforms", "cpu")
+for reg in ("_backend_factories", "backend_factories"):
+    d = getattr(xb, reg, None)
+    if isinstance(d, dict):
+        d.pop("axon", None)

@@ -1,0 +1,49 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A ground-up rebuild of the reference framework (diyun0916/Paddle /
+PaddlePaddle) for TPU: jax/XLA is the compiler+runtime for compute, Pallas
+for custom kernels, jax.sharding for the Fleet-style distributed stack, and
+a C++ runtime for host-side IO. The public API mirrors `import paddle` so
+reference training scripts port by changing the import.
+"""
+from __future__ import annotations
+
+from . import framework
+from .framework import (  # noqa: F401
+    bfloat16, bool_, complex128, complex64, float16, float32, float64, int8,
+    int16, int32, int64, uint8, uint16, uint32, uint64,
+    CPUPlace, CUDAPlace, Place, TPUPlace,
+    get_default_dtype, set_default_dtype, seed, get_flags, set_flags,
+    get_device, set_device, device_count, is_compiled_with_cuda,
+    is_compiled_with_tpu, in_dynamic_mode, rng_scope,
+)
+from .autograd import no_grad, enable_grad, is_grad_enabled, set_grad_enabled, grad  # noqa: F401
+from .tensor import Tensor, to_tensor  # noqa: F401
+from .tensor_ops import *  # noqa: F401,F403
+from .tensor_ops import linalg  # noqa: F401
+from . import autograd  # noqa: F401
+
+# dtype alias matching `paddle.bool`
+bool = bool_  # noqa: A001
+
+__version__ = "0.1.0"
+
+
+def _lazy_import():
+    # Heavier subpackages import on first access to keep `import paddle_tpu`
+    # fast for array-only users.
+    pass
+
+
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
+from .hapi.model import Model  # noqa: E402,F401
+from .hapi.summary import summary, flops  # noqa: E402,F401
+from .serialization import save, load  # noqa: E402,F401
+from .functional_transforms import value_and_grad, functional_grad, vmap, checkpoint  # noqa: E402,F401

@@ -1,0 +1,222 @@
+"""AMP (ref: python/paddle/amp/*).
+
+TPU-first AMP is bf16: no loss scaling needed, auto_cast simply runs
+white-listed ops in bfloat16 (level O1) or casts whole models (O2 via
+`decorate`). The fp16 GradScaler semantics (dynamic loss scaling with
+inf-skip, growth/backoff) are kept for parity and are implemented
+functionally so they can live inside the jitted train step.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .. import framework
+from ..tensor import Tensor
+
+__all__ = ["auto_cast", "autocast", "amp_guard", "GradScaler", "decorate",
+           "is_auto_cast_enabled", "get_amp_dtype"]
+
+_state = threading.local()
+
+# ops that are numerically safe in low precision (ref: white/black lists in
+# python/paddle/amp/amp_lists.py)
+WHITE_LIST = {"matmul", "conv2d", "linear", "einsum", "bmm"}
+BLACK_LIST = {"log", "exp", "softmax", "cross_entropy", "mean", "sum",
+              "layer_norm", "batch_norm"}
+
+
+def is_auto_cast_enabled():
+    return getattr(_state, "enabled", False)
+
+
+def get_amp_dtype():
+    return getattr(_state, "dtype", "bfloat16")
+
+
+def get_amp_level():
+    return getattr(_state, "level", "O1")
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (getattr(_state, "enabled", False), getattr(_state, "dtype", None),
+            getattr(_state, "level", None))
+    _state.enabled = enable
+    _state.dtype = dtype
+    _state.level = level
+    try:
+        yield
+    finally:
+        _state.enabled, _state.dtype, _state.level = prev
+
+
+autocast = auto_cast
+amp_guard = auto_cast
+
+
+def amp_dtype_of(x):
+    return framework.convert_dtype(get_amp_dtype())
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """ref: paddle.amp.decorate — O2 casts model params to the amp dtype;
+    optimizers get multi_precision master weights."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is not None:
+        opt_single = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if opt_single else list(optimizers)
+        for o in opt_list:
+            o._multi_precision = True
+        if opt_single:
+            optimizers = opt_list[0]
+        ret_models = model_list[0] if single else model_list
+        return ret_models, optimizers
+    return model_list[0] if single else model_list
+
+
+class GradScaler:
+    """ref: paddle.amp.GradScaler — dynamic loss scaling.
+
+    Eager API: scale()/unscale_()/step()/update() or minimize(). The
+    functional core (scaler_state / scaled_step semantics) is used by the
+    Engine so the skip-on-inf logic compiles into the train step via
+    lax.cond-free arithmetic (weights update is masked by the finite flag).
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=65536.0,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good = 0
+        self._bad = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p._grad_value is not None:
+                g = p._grad_value * inv
+                finite = bool(jnp.all(jnp.isfinite(g)))
+                found = found or not finite
+                p._grad_value = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not getattr(self, "_unscaled", False):
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad = 0
+        else:
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        inv = 1.0 / self._scale if self._enable else 1.0
+        for p in optimizer._parameter_list or []:
+            if p._grad_value is not None and self._enable:
+                p._grad_value = p._grad_value * inv
+        self.unscale_guarded_step(optimizer)
+        self.update()
+        optimizer.clear_grad()
+
+    def unscale_guarded_step(self, optimizer):
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p._grad_value is not None:
+                if not bool(jnp.all(jnp.isfinite(p._grad_value))):
+                    found = True
+                    break
+        self._found_inf = found
+        if not found:
+            optimizer.step()
+
+    # -- functional core for the jitted path --------------------------------
+    @staticmethod
+    def functional_init(init_scale=65536.0):
+        return {"scale": jnp.float32(init_scale),
+                "good": jnp.int32(0), "bad": jnp.int32(0)}
+
+    @staticmethod
+    def functional_update(state, found_inf, incr_ratio=2.0, decr_ratio=0.5,
+                          incr_every=2000, decr_every=1):
+        good = jnp.where(found_inf, 0, state["good"] + 1)
+        bad = jnp.where(found_inf, state["bad"] + 1, 0)
+        scale = state["scale"]
+        scale = jnp.where(bad >= decr_every,
+                          jnp.maximum(scale * decr_ratio, 1.0), scale)
+        bad = jnp.where(bad >= decr_every, 0, bad)
+        scale = jnp.where(good >= incr_every, scale * incr_ratio, scale)
+        good = jnp.where(good >= incr_every, 0, good)
+        return {"scale": scale, "good": good, "bad": bad}
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good": self._good,
+                "bad": self._bad}
+
+    def load_state_dict(self, state):
+        self._scale = state["scale"]
+        self._good = state.get("good", 0)
+        self._bad = state.get("bad", 0)
+
+
+class debugging:
+    @staticmethod
+    def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+        arr = tensor._value if isinstance(tensor, Tensor) else tensor
+        finite = bool(jnp.all(jnp.isfinite(arr)))
+        if not finite:
+            raise RuntimeError(
+                f"check_numerics: non-finite values in {op_type}:{var_name}")
+        return tensor

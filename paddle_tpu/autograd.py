@@ -1,0 +1,253 @@
+"""Eager autograd engine.
+
+The reference implements reverse-mode autodiff with a C++ tape over PHI
+kernels (ref: paddle/fluid/eager/, imperative::Tracer). TPU-native rebuild:
+every differentiable op is dispatched through :func:`apply_op`, which — when
+gradients are required — runs the op under ``jax.vjp`` and links the pullback
+into a graph *owned by the output tensors* (entries hold inputs strongly and
+outputs weakly, so the graph is freed by normal GC when outputs are dropped —
+an eval loop without no_grad() cannot leak, matching the reference's
+refcounted autograd graph). ``Tensor.backward()`` walks the reachable graph
+in reverse topological order and accumulates cotangents into ``.grad``.
+
+This graph exists for *API parity* with eager training loops
+(``loss.backward(); opt.step()``). The performance path (``hapi.Model`` /
+``Engine``) never uses it: there, the whole train step is a pure function
+differentiated with ``jax.grad`` and compiled once with ``jax.jit``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad():
+    """ref: paddle.no_grad (decorator/context)."""
+    prev = is_grad_enabled()
+    set_grad_enabled(False)
+    try:
+        yield
+    finally:
+        set_grad_enabled(prev)
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = is_grad_enabled()
+    set_grad_enabled(True)
+    try:
+        yield
+    finally:
+        set_grad_enabled(prev)
+
+
+class GradNode:
+    """One recorded op: pullback + its tensor inputs (strong) and outputs
+    (weak)."""
+    __slots__ = ("inputs", "out_refs", "vjp_fn", "n_outs", "__weakref__")
+
+    def __init__(self, inputs, outputs, vjp_fn):
+        self.inputs = inputs                       # list[Tensor]
+        self.out_refs = [weakref.ref(o) for o in outputs]
+        self.n_outs = len(outputs)
+        self.vjp_fn = vjp_fn
+
+
+def _is_tensor(x) -> bool:
+    from .tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+def _float_like(arr) -> bool:
+    return jnp.issubdtype(jnp.asarray(arr).dtype, jnp.inexact)
+
+
+def apply_op(fn: Callable, *args, differentiable: bool = True, **kwargs):
+    """Dispatch `fn` (a jnp-level function) over Tensor/array args.
+
+    Tensors are unwrapped to jax arrays; if grad mode is on, any input has
+    stop_gradient=False, and the op is differentiable, the call is run under
+    jax.vjp and linked into the autograd graph. Returns Tensors mirroring
+    fn's output structure.
+    """
+    from .tensor import Tensor
+
+    flat, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=_is_tensor)
+    t_idx = [i for i, x in enumerate(flat) if _is_tensor(x)]
+    tensors = [flat[i] for i in t_idx]
+
+    def run(arrs):
+        buf = list(flat)
+        for i, a in zip(t_idx, arrs):
+            buf[i] = a
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, buf)
+        return fn(*a2, **k2)
+
+    needs_grad = (
+        differentiable
+        and is_grad_enabled()
+        and any(not t.stop_gradient for t in tensors)
+    )
+
+    arrs = [t._value for t in tensors]
+    if not needs_grad:
+        out = run(arrs)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a, stop_gradient=True), out)
+
+    diff_pos = [i for i, t in enumerate(tensors)
+                if not t.stop_gradient and _float_like(t._value)]
+    if not diff_pos:
+        out = run(arrs)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a, stop_gradient=True), out)
+
+    def run_diff(*darrs):
+        buf = list(arrs)
+        for i, a in zip(diff_pos, darrs):
+            buf[i] = a
+        return run(buf)
+
+    out_arrs, vjp_fn = jax.vjp(run_diff, *(arrs[i] for i in diff_pos))
+    out_tensors = jax.tree_util.tree_map(
+        lambda a: Tensor(a, stop_gradient=False), out_arrs)
+    flat_outs = [t for t in jax.tree_util.tree_leaves(
+        out_tensors, is_leaf=_is_tensor) if _is_tensor(t)]
+    node = GradNode(inputs=[tensors[i] for i in diff_pos],
+                    outputs=flat_outs, vjp_fn=vjp_fn)
+    for t in flat_outs:
+        t._grad_node = node
+    return out_tensors
+
+
+def _toposort(roots):
+    """Nodes reachable from roots' grad nodes, outputs-before-inputs."""
+    order, seen = [], set()
+    stack = []
+    for r in roots:
+        n = getattr(r, "_grad_node", None)
+        if n is not None and id(n) not in seen:
+            stack.append((n, False))
+            seen.add(id(n))
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
+        stack.append((node, True))
+        for t in node.inputs:
+            child = getattr(t, "_grad_node", None)
+            if child is not None and id(child) not in seen:
+                seen.add(id(child))
+                stack.append((child, False))
+    order.reverse()  # outputs first
+    return order
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+    """ref: paddle.autograd.backward / Tensor.backward."""
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    cot = {}
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            g_arr = jnp.ones_like(t._value)
+        else:
+            g_arr = g._value if _is_tensor(g) else jnp.asarray(g)
+        cot[id(t)] = cot.get(id(t), 0) + g_arr
+
+    order = _toposort(tensors)
+    for node in order:
+        out_cots = []
+        has_any = False
+        for ref in node.out_refs:
+            o = ref()
+            c = cot.get(id(o)) if o is not None else None
+            if c is None:
+                shape_src = o._value if o is not None else None
+                c = jnp.zeros_like(shape_src) if shape_src is not None else None
+                if c is None:
+                    # output tensor was GC'd and nothing flowed into it
+                    out_cots = None
+                    break
+            else:
+                has_any = True
+            out_cots.append(c)
+        if not has_any or out_cots is None:
+            continue
+        seed = out_cots[0] if node.n_outs == 1 else tuple(out_cots)
+        in_cots = node.vjp_fn(seed)
+        for t, c in zip(node.inputs, in_cots):
+            cot[id(t)] = cot.get(id(t), 0) + c
+            is_leaf = getattr(t, "_grad_node", None) is None
+            if not t.stop_gradient and (is_leaf or t._retain_grads):
+                prev = t._grad_value
+                t._grad_value = c if prev is None else prev + c
+
+    if not retain_graph:
+        # sever links so the graph (and its vjp residuals) frees now
+        for node in order:
+            for ref in node.out_refs:
+                o = ref()
+                if o is not None:
+                    o._grad_node = None
+            node.vjp_fn = None
+            node.inputs = []
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """ref: paddle.grad — gradients of outputs w.r.t. inputs via the eager
+    graph. create_graph (double grad) is not supported here; use
+    paddle_tpu.functional_grad (jax.grad composition) instead.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: compose paddle_tpu.value_and_grad / jax.grad "
+            "for higher-order gradients (functional path).")
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    keep = {id(t): t._grad_value for t in inputs}
+    retain = [t._retain_grads for t in inputs]
+    for t in inputs:
+        t._grad_value = None
+        t._retain_grads = True
+    backward(outputs, grad_outputs,
+             retain_graph=bool(retain_graph))
+    res = []
+    for t, r in zip(inputs, retain):
+        g = t._grad_value
+        if g is None and not allow_unused:
+            g = jnp.zeros_like(t._value)
+        res.append(Tensor(g, stop_gradient=True) if g is not None else None)
+        t._grad_value = keep[id(t)]
+        t._retain_grads = r
+    return res

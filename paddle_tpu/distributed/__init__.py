@@ -1,0 +1,27 @@
+"""paddle_tpu.distributed (ref: python/paddle/distributed/*).
+
+The reference's distributed stack is NCCL/Gloo process groups driven by
+c_allreduce/c_broadcast ops. TPU-native design: ONE jax.sharding.Mesh per
+process describes the whole chip topology; parallelism is expressed as
+NamedSharding placements + shard_map programs, and XLA inserts the ICI
+collectives. The `collective` module exposes the reference's eager
+collective API (all_reduce, all_gather, ...) implemented over shard_map for
+script parity and tests.
+"""
+from .env import (  # noqa: F401
+    get_rank, get_world_size, init_parallel_env, is_initialized, ParallelEnv,
+)
+from .mesh import (  # noqa: F401
+    DeviceMesh, get_mesh, set_mesh, ProcessMesh,
+)
+from .collective import (  # noqa: F401
+    all_gather, all_reduce, alltoall, alltoall_single, barrier, broadcast,
+    new_group, recv, reduce, reduce_scatter, scatter, send, split_group,
+    ReduceOp, wait,
+)
+from .sharding_api import (  # noqa: F401
+    shard_tensor, shard_layer, Shard, Replicate, Partial, reshard,
+)
+from . import fleet  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from .launch_mod import launch, spawn  # noqa: F401

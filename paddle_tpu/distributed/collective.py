@@ -1,0 +1,266 @@
+"""Collective communication API (ref: python/paddle/distributed/communication/*).
+
+Design: the reference issues eager NCCL ops per rank. In single-controller
+JAX there is no per-rank eager execution — collectives are *program* ops
+that XLA lowers onto ICI. So:
+
+- Inside a shard_map/pjit program (our pipeline/tensor/ring-parallel
+  kernels, and anything the user writes with shard_map), these functions
+  emit jax.lax collectives over the mesh axis carried by `group`.
+- Eagerly, with world_size==1 (single host driving all chips), they are the
+  identity — exactly the reference's behavior on a single rank.
+
+Groups name mesh axes rather than rank lists: new_group on the reference
+carves NCCL communicators; here it binds an axis name of the active Mesh.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .env import get_world_size
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+@dataclass
+class Group:
+    """A communication group == a mesh axis (or all axes)."""
+    axis_name: Optional[str] = None
+    ranks: Optional[Sequence[int]] = None
+
+    @property
+    def nranks(self):
+        if self.axis_name is None:
+            return get_world_size()
+        from .mesh import get_mesh
+        return get_mesh().shape[self.axis_name]
+
+    def get_group_rank(self, rank):
+        return rank
+
+    @property
+    def process_ids(self):
+        return list(self.ranks or range(self.nranks))
+
+
+_default_group = Group()
+
+
+def new_group(ranks=None, backend=None, axis_name=None, timeout=None):
+    return Group(axis_name=axis_name, ranks=ranks)
+
+
+def split_group(parent=None, split_sizes=None):
+    return Group()
+
+
+def _in_trace():
+    try:
+        from jax.core import trace_state_clean
+        return not trace_state_clean()
+    except Exception:
+        return False
+
+
+def _axis(group):
+    if group is not None and group.axis_name is not None:
+        return group.axis_name
+    return None
+
+
+def _apply(x, fn):
+    if isinstance(x, Tensor):
+        out = fn(x._value)
+        x._value = out
+        return x
+    return fn(x)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis(group)
+
+    def fn(a):
+        if ax is not None:
+            red = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+                   ReduceOp.MIN: jax.lax.pmin,
+                   ReduceOp.AVG: jax.lax.pmean}.get(op)
+            if red is None:  # PROD via exp/sum-log not safe; use all_gather
+                g = jax.lax.all_gather(a, ax)
+                return jnp.prod(g, axis=0)
+            return red(a, ax)
+        return a  # world_size==1 eager
+
+    return _apply(tensor, fn)
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True, axis=0):
+    """Reference form: all_gather(out_list, tensor). Inside a program with a
+    group axis, returns the gathered array stacked on axis 0."""
+    if tensor is None:  # functional form: all_gather(tensor, group=...)
+        tensor, tensor_list = tensor_list, None
+    ax = _axis(group)
+
+    def fn(a):
+        if ax is not None:
+            return jax.lax.all_gather(a, ax, axis=0)
+        return a[None] if tensor_list is not None else a
+
+    arr = fn(tensor._value if isinstance(tensor, Tensor) else tensor)
+    if tensor_list is not None:
+        del tensor_list[:]
+        n = arr.shape[0] if ax is not None else 1
+        for i in range(n):
+            tensor_list.append(Tensor(arr[i]))
+        return tensor_list
+    return Tensor(arr) if isinstance(tensor, Tensor) else arr
+
+
+def all_gather_object(object_list, obj, group=None):
+    del object_list[:]
+    object_list.append(obj)
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    ax = _axis(group)
+
+    def fn(a):
+        if ax is not None:
+            return jax.lax.psum_scatter(a, ax, scatter_dimension=0, tiled=True)
+        return a
+
+    if tensor_list is not None:
+        stacked = jnp.concatenate(
+            [t._value if isinstance(t, Tensor) else t for t in tensor_list], axis=0)
+        out = fn(stacked)
+        return _apply(tensor, lambda a: out)
+    return _apply(tensor, fn)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+
+    def fn(a):
+        if ax is not None:
+            # take src's value on every member of the axis
+            idx = jax.lax.axis_index(ax)
+            g = jax.lax.all_gather(a, ax)
+            return g[src]
+        return a
+
+    return _apply(tensor, fn)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if tensor_list is not None and ax is not None:
+        stacked = jnp.stack([t._value if isinstance(t, Tensor) else t
+                             for t in tensor_list])
+
+        def fn(a):
+            idx = jax.lax.axis_index(ax)
+            return stacked[idx]
+
+        return _apply(tensor, fn)
+    if tensor_list is not None:
+        return _apply(tensor, lambda a: (
+            tensor_list[0]._value if isinstance(tensor_list[0], Tensor)
+            else tensor_list[0]))
+    return tensor
+
+
+def alltoall(out_tensor_list, in_tensor_list=None, group=None, sync_op=True):
+    ax = _axis(group)
+    if in_tensor_list is None:
+        # functional: alltoall(x) with leading axis == group size
+        def fn(a):
+            if ax is not None:
+                return jax.lax.all_to_all(a, ax, split_axis=0, concat_axis=0)
+            return a
+        return _apply(out_tensor_list, fn)
+    if ax is None:
+        del out_tensor_list[:]
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    stacked = jnp.stack([t._value if isinstance(t, Tensor) else t
+                         for t in in_tensor_list])
+    out = jax.lax.all_to_all(stacked, ax, split_axis=0, concat_axis=0)
+    del out_tensor_list[:]
+    for i in range(out.shape[0]):
+        out_tensor_list.append(Tensor(out[i]))
+    return out_tensor_list
+
+
+def alltoall_single(out_tensor, in_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    ax = _axis(group)
+    if in_tensor is None:
+        in_tensor, out_tensor = out_tensor, None
+
+    def fn(a):
+        if ax is not None:
+            return jax.lax.all_to_all(
+                a.reshape((Group(ax).nranks, -1) + a.shape[1:]),
+                ax, split_axis=0, concat_axis=0).reshape(a.shape)
+        return a
+
+    arr = fn(in_tensor._value if isinstance(in_tensor, Tensor) else in_tensor)
+    if out_tensor is not None:
+        return _apply(out_tensor, lambda _: arr)
+    return Tensor(arr) if isinstance(in_tensor, Tensor) else arr
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point on TPU == collective_permute; expressible only inside a
+    program (see pipeline.py's ppermute schedule). Eager p2p on one rank is
+    a no-op, matching world_size==1."""
+    if _in_trace():
+        raise RuntimeError("use distributed.p2p.ppermute inside programs")
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    if _in_trace():
+        raise RuntimeError("use distributed.p2p.ppermute inside programs")
+    return tensor
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+def barrier(group=None):
+    # eager: sync all pending device work (the reference's stream sync)
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    arr = tensor._value if isinstance(tensor, Tensor) else tensor
+    if hasattr(arr, "block_until_ready"):
+        arr.block_until_ready()
+    return tensor
+
+
+def ppermute(x, axis_name, perm):
+    """collective_permute (TPU's p2p primitive), usable in shard_map."""
+    return jax.lax.ppermute(x, axis_name, perm)

@@ -1,0 +1,66 @@
+"""Process/env management (ref: python/paddle/distributed/parallel.py).
+
+Single-controller JAX model: one Python process per host drives all local
+chips; `rank` maps to jax.process_index() (multi-host) and world size to
+process_count — NOT one process per device like the reference's NCCL
+launcher. Collectives tests emulate N ranks with a virtual CPU mesh.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env():
+    """ref: paddle.distributed.init_parallel_env. Multi-host initialization
+    (jax.distributed) happens via launch(); single-host this is a no-op."""
+    global _initialized
+    coord = os.environ.get("PADDLE_TPU_COORDINATOR")
+    if coord and not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0")))
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    return jax.process_count()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()

@@ -1,0 +1,23 @@
+"""Fleet API (ref: python/paddle/distributed/fleet/__init__.py).
+
+fleet.init(strategy) builds the hybrid mesh (dp × pp × mp [× sp]) from
+DistributedStrategy.hybrid_configs; distributed_model / distributed_optimizer
+wrap the user's model/optimizer so existing Fleet training scripts run
+unchanged — the parallelism itself is NamedSharding + shard_map under the
+hood (see paddle_tpu/distributed/hybrid.py).
+"""
+from .base import (  # noqa: F401
+    DistributedStrategy, Fleet, PaddleCloudRoleMaker, UserDefinedRoleMaker,
+)
+from .base import _fleet_singleton as fleet_obj
+from ..mesh import get_mesh, set_mesh  # noqa: F401
+from . import utils  # noqa: F401
+
+init = fleet_obj.init
+is_first_worker = fleet_obj.is_first_worker
+worker_index = fleet_obj.worker_index
+worker_num = fleet_obj.worker_num
+get_hybrid_communicate_group = fleet_obj.get_hybrid_communicate_group
+distributed_model = fleet_obj.distributed_model
+distributed_optimizer = fleet_obj.distributed_optimizer
+distributed_scaler = fleet_obj.distributed_scaler

@@ -1,0 +1,187 @@
+"""Fleet core (ref: python/paddle/distributed/fleet/base/*).
+
+DistributedStrategy carries the same knobs as the reference
+(hybrid_configs dp/mp/pp degrees, sharding stage, amp, recompute); fleet.init
+turns them into a named jax Mesh. HybridCommunicateGroup answers the same
+topology queries the reference's does, backed by mesh axes instead of NCCL
+communicators.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from ..env import get_rank, get_world_size, init_parallel_env
+from ..mesh import build_mesh, get_mesh, set_mesh
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 65536.0, "use_pure_bf16": False}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "schedule_mode": "1F1B"}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.lamb = False
+        self.dgc = False
+        self.find_unused_parameters = False
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class HybridCommunicateGroup:
+    """ref: python/paddle/distributed/fleet/base/topology.py — answers
+    'which dp/mp/pp rank am I' from the mesh shape. Single-controller JAX:
+    per-chip ranks exist inside programs (axis_index); host-level queries
+    return the process view."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+        self._shape = dict(mesh.shape)
+
+    # degrees
+    def get_data_parallel_world_size(self):
+        return self._shape.get("dp", 1)
+
+    def get_model_parallel_world_size(self):
+        return self._shape.get("mp", 1)
+
+    def get_pipe_parallel_world_size(self):
+        return self._shape.get("pp", 1)
+
+    def get_sharding_parallel_world_size(self):
+        return self._shape.get("sharding", self._shape.get("dp", 1))
+
+    def get_sep_parallel_world_size(self):
+        return self._shape.get("sp", 1)
+
+    # ranks (host view: single controller drives all, rank 0 semantics)
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    # groups == axes
+    def get_data_parallel_group(self):
+        from ..collective import Group
+        return Group(axis_name="dp")
+
+    def get_model_parallel_group(self):
+        from ..collective import Group
+        return Group(axis_name="mp")
+
+    def get_pipe_parallel_group(self):
+        from ..collective import Group
+        return Group(axis_name="pp")
+
+    def get_sharding_parallel_group(self):
+        from ..collective import Group
+        return Group(axis_name="dp")
+
+    def get_check_parallel_group(self, *a):
+        from ..collective import Group
+        return Group()
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def topology(self):
+        return self._shape
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None,
+             log_level="INFO"):
+        init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        shape = {}
+        for axis, key in (("pp", "pp_degree"), ("dp", "dp_degree"),
+                          ("sp", "sep_degree"), ("mp", "mp_degree")):
+            deg = int(hc.get(key, 1) or 1)
+            if deg != 1 or axis in ("dp", "mp", "pp"):
+                shape[axis] = deg
+        n_dev = len(jax.devices())
+        declared = int(np.prod([max(v, 1) for v in shape.values()]))
+        if declared != n_dev:
+            # absorb the remainder into dp like the reference's default
+            rest = n_dev // max(declared // max(shape.get("dp", 1), 1), 1)
+            shape["dp"] = max(n_dev // max(
+                int(np.prod([v for k, v in shape.items() if k != "dp"])), 1), 1)
+        mesh = build_mesh(shape)
+        set_mesh(mesh)
+        self._hcg = HybridCommunicateGroup(mesh)
+        self._is_initialized = True
+        return self
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def get_hybrid_communicate_group(self):
+        if self._hcg is None:
+            self._hcg = HybridCommunicateGroup(get_mesh())
+        return self._hcg
+
+    def distributed_model(self, model):
+        """Places params on the mesh (replicated over dp, tensor-parallel
+        layers already carry their mp shardings from hybrid.py)."""
+        from ..hybrid import place_model_on_mesh
+        return place_model_on_mesh(model, get_mesh())
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        optimizer._fleet_strategy = strategy or self._strategy
+        return optimizer
+
+    def distributed_scaler(self, scaler):
+        return scaler
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    pass
+
+
+_fleet_singleton = Fleet()

@@ -1,0 +1,32 @@
+"""fleet.utils (ref: python/paddle/distributed/fleet/utils/__init__.py)."""
+from __future__ import annotations
+
+import jax
+
+
+def recompute(function, *args, **kwargs):
+    """ref: fleet.utils.recompute — activation rematerialization. Under the
+    functional/jit path this is jax.checkpoint; called eagerly it just runs
+    the function (nothing to save eagerly)."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    from ...tensor import Tensor
+
+    def unwrapped(*arrs):
+        from ...nn.layer import Layer
+        wrapped = [Tensor(a) if not isinstance(a, Tensor) else a for a in arrs]
+        out = function(*wrapped, **kwargs)
+        return jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    try:
+        from jax.core import trace_state_clean
+        tracing = not trace_state_clean()
+    except Exception:
+        tracing = False
+    if tracing:
+        arrs = [a._value if isinstance(a, Tensor) else a for a in args]
+        out = jax.checkpoint(unwrapped)(*arrs)
+        return jax.tree_util.tree_map(Tensor, out)
+    return function(*args, **kwargs)

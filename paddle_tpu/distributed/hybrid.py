@@ -1,0 +1,46 @@
+"""Hybrid-parallel placement helpers.
+
+ref: the reference's fleet.meta_parallel.* (ColumnParallelLinear etc.)
+allreduce activations per layer. TPU-native: parameters carry NamedShardings
+and XLA GSPMD inserts the collectives — a Column/RowParallelLinear is a
+Linear whose weight is sharded on the right axis.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..nn.layer import Layer
+
+
+# attribute name used to tag a parameter with its PartitionSpec
+SPEC_ATTR = "_mesh_spec"
+
+
+def annotate_param(param, spec: PartitionSpec):
+    setattr(param, "name", param.name)  # keep slots happy
+    param.optimize_attr[SPEC_ATTR] = spec
+    return param
+
+
+def param_spec(param) -> PartitionSpec:
+    return param.optimize_attr.get(SPEC_ATTR, PartitionSpec())
+
+
+def place_model_on_mesh(model: Layer, mesh):
+    """device_put every param/buffer with its annotated (or replicated)
+    sharding over `mesh`."""
+    for _, p in model.named_parameters():
+        spec = param_spec(p)
+        p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+    for _, b in model.named_buffers():
+        b._value = jax.device_put(b._value, NamedSharding(mesh, PartitionSpec()))
+    return model
+
+
+def state_shardings(model: Layer, mesh):
+    """name -> NamedSharding for the functional train step's in_shardings."""
+    out = {}
+    for n, p in model.named_parameters():
+        out[n] = NamedSharding(mesh, param_spec(p))
+    return out

@@ -1,0 +1,31 @@
+"""Multi-host launch (ref: python/paddle/distributed/launch).
+
+The reference spawns one worker per GPU. JAX is single-controller per host:
+launch() initializes jax.distributed across hosts from env vars
+(PADDLE_TPU_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID or TPU pod metadata)
+then runs the training function once per host.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def launch(fn=None, args=()):
+    coord = os.environ.get("PADDLE_TPU_COORDINATOR")
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0")))
+    elif os.environ.get("TPU_WORKER_HOSTNAMES"):
+        jax.distributed.initialize()  # auto-detect on TPU pods
+    if fn is not None:
+        return fn(*args)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """ref: paddle.distributed.spawn. Single-controller: run once; the mesh
+    covers all local devices, so there is nothing to fork."""
+    return func(*args)

@@ -1,0 +1,39 @@
+"""DataParallel wrapper (ref: python/paddle/distributed/parallel.py).
+
+On the reference, DataParallel registers allreduce hooks per grad bucket.
+TPU-native: data parallelism is a sharding, not a wrapper — Engine shards
+the batch over the 'dp' mesh axis and XLA psums grads. This class keeps
+script parity (model = paddle.DataParallel(model)) and marks the layer so
+Engine knows the intent.
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers  # registered as sublayer via __setattr__
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    @property
+    def _inner(self):
+        return self._layers

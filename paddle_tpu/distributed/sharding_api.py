@@ -1,0 +1,100 @@
+"""Tensor sharding placement API (ref: paddle.distributed.shard_tensor /
+dtensor-style Placements in python/paddle/distributed/auto_parallel).
+
+Maps 1:1 onto jax NamedSharding: Shard(d) -> PartitionSpec entry at dim d,
+Replicate() -> None. Because jax arrays are global-view (like the
+reference's dist_tensor with global shape), shard_tensor is just a
+device_put with a NamedSharding.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..tensor import Tensor
+from .mesh import DeviceMesh, get_mesh
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+
+class Partial(Placement):
+    """Pending-reduction placement; materialised as replicate after psum."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def __eq__(self, o):
+        return isinstance(o, Partial)
+
+
+def _placements_to_spec(ndim, mesh, placements):
+    spec = [None] * ndim
+    for axis_name, p in zip(mesh.axis_names, placements):
+        if isinstance(p, Shard):
+            if spec[p.dim] is None:
+                spec[p.dim] = axis_name
+            elif isinstance(spec[p.dim], tuple):
+                spec[p.dim] = spec[p.dim] + (axis_name,)
+            else:
+                spec[p.dim] = (spec[p.dim], axis_name)
+    return PartitionSpec(*spec)
+
+
+def shard_tensor(data, mesh=None, placements=None, dtype=None,
+                 stop_gradient=None):
+    """ref: paddle.distributed.shard_tensor(data, mesh, placements)."""
+    t = data if isinstance(data, Tensor) else Tensor(data)
+    m = mesh.mesh if isinstance(mesh, DeviceMesh) else (mesh or get_mesh())
+    placements = placements or [Replicate()] * len(m.axis_names)
+    spec = _placements_to_spec(t._value.ndim, m, placements)
+    sharding = NamedSharding(m, spec)
+    out = Tensor(jax.device_put(t._value, sharding),
+                 stop_gradient=t.stop_gradient if stop_gradient is None
+                 else stop_gradient)
+    return out
+
+
+def reshard(x, mesh=None, placements=None):
+    return shard_tensor(x, mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """ref: paddle.distributed.shard_layer — places every parameter of the
+    layer onto the mesh (replicated unless shard_fn says otherwise)."""
+    m = process_mesh.mesh if isinstance(process_mesh, DeviceMesh) else process_mesh
+    for name, sub in layer.named_sublayers(include_self=True):
+        if shard_fn is not None:
+            shard_fn(name, sub, process_mesh)
+        else:
+            for pname, p in sub._parameters.items():
+                if p is None:
+                    continue
+                sharding = NamedSharding(m, PartitionSpec())
+                p._value = jax.device_put(p._value, sharding)
+    return layer
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)

@@ -1,0 +1,54 @@
+"""Functional transforms over Layers/Tensors — the TPU-native power tools.
+
+The reference has no direct equivalent (its autograd is tape-only); these
+wrap jax transforms so framework users get grad/vmap/checkpoint over the
+Tensor/Layer types.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+__all__ = ["value_and_grad", "functional_grad", "vmap", "checkpoint"]
+
+
+def _unwrap(x):
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, x,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _wrap(x):
+    return jax.tree_util.tree_map(lambda a: Tensor(a), x)
+
+
+def value_and_grad(fn, argnums=0, has_aux=False):
+    """jax.value_and_grad over Tensor pytrees."""
+    vg = jax.value_and_grad(fn, argnums=argnums, has_aux=has_aux)
+
+    def wrapped(*args, **kwargs):
+        return vg(*args, **kwargs)
+
+    return wrapped
+
+
+def functional_grad(fn, argnums=0, has_aux=False):
+    return jax.grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+def vmap(fn, in_axes=0, out_axes=0):
+    return jax.vmap(fn, in_axes=in_axes, out_axes=out_axes)
+
+
+def checkpoint(fn, policy=None, prevent_cse=True):
+    """ref: paddle.distributed.fleet.utils.recompute — rematerialization."""
+    pol = None
+    if policy == "dots_saveable":
+        pol = jax.checkpoint_policies.dots_saveable
+    elif policy == "nothing_saveable":
+        pol = jax.checkpoint_policies.nothing_saveable
+    elif policy == "dots_with_no_batch_dims_saveable":
+        pol = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return jax.checkpoint(fn, policy=pol, prevent_cse=prevent_cse)

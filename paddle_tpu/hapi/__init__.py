@@ -1,0 +1,3 @@
+from .engine import Engine  # noqa: F401
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401

@@ -1,0 +1,193 @@
+"""Engine: compiles (model, loss, optimizer) into ONE jitted train step.
+
+ref: the reference's Model.fit dispatches per-op through the dygraph tracer
+(or builds a static Program under @to_static). TPU-native: the entire
+step — forward, loss, backward, grad clip, optimizer update, running-stat
+updates — is a single pure function of (params, buffers, opt_state, lr,
+rng, batch), compiled once by XLA with buffer donation so parameter update
+is in-place in HBM. Data parallelism: pass a Mesh and the batch is sharded
+over 'dp' while params follow their annotated shardings (GSPMD inserts the
+grad psum — the moral equivalent of fleet's allreduce hooks).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer, functional_call
+from ..optimizer.lr import LRScheduler
+from ..tensor import Tensor
+
+
+def _unwrap(x):
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else (
+            jnp.asarray(t) if isinstance(t, np.ndarray) else t), x,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+class Engine:
+    def __init__(self, network: Layer, loss=None, optimizer=None,
+                 metrics=None, amp_dtype=None, mesh=None,
+                 donate_params=True):
+        self.network = network
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics or []
+        self.amp_dtype = amp_dtype
+        self.mesh = mesh
+        self.donate = donate_params
+        self._params, self._buffers = network.raw_state()
+        self._opt_state = None
+        self._step = 0
+        self._train_fn = None
+        self._eval_fn = None
+        self._pred_fn = None
+        self._rng_key = jax.random.PRNGKey(0)
+
+    # ------------------------------------------------------------------
+    def sync_from_layer(self):
+        self._params, self._buffers = self.network.raw_state()
+
+    def sync_to_layer(self):
+        self.network.load_raw_state(self._params, self._buffers)
+
+    def _split_key(self):
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+    def _shard_batch(self, arrs):
+        if self.mesh is None or "dp" not in self.mesh.axis_names:
+            return arrs
+        from jax.sharding import NamedSharding, PartitionSpec
+        sh = NamedSharding(self.mesh, PartitionSpec("dp"))
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sh) if hasattr(a, "ndim") and a.ndim >= 1
+            else a, arrs)
+
+    # ------------------------------------------------------------------
+    def _build_train_fn(self):
+        network = self.network
+        loss_layer = self.loss
+        opt = self.optimizer
+        clip = getattr(opt, "_grad_clip", None)
+        amp_dt = self.amp_dtype
+
+        # frozen (trainable=False) params are closed over as constants of
+        # the step — they get no grads and no optimizer update (parity with
+        # the eager Optimizer.step's p.trainable filter)
+        trainable_keys = {n for n, p in network.named_parameters()
+                          if p.trainable}
+
+        def train_step(params, buffers, opt_state, lr, step_i, rng, inputs,
+                       labels):
+            frozen = {k: v for k, v in params.items()
+                      if k not in trainable_keys}
+            live = {k: v for k, v in params.items() if k in trainable_keys}
+
+            def loss_fn(p):
+                run_p = {**frozen, **p}
+                if amp_dt is not None:
+                    run_p = jax.tree_util.tree_map(
+                        lambda a: a.astype(amp_dt)
+                        if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                        run_p)
+                outs, new_buf = functional_call(
+                    network, run_p, buffers, *inputs, rng=rng, mutable=True)
+                outs_t = outs if isinstance(outs, (list, tuple)) else [outs]
+                if loss_layer is not None:
+                    l = loss_layer(*outs_t, *labels)
+                else:
+                    l = outs_t[0]
+                l_arr = l._value if isinstance(l, Tensor) else l
+                return l_arr.astype(jnp.float32), (_unwrap(outs), new_buf)
+
+            (loss_v, (outs, new_buf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(live)
+            if clip is not None:
+                grads = clip.apply(grads)
+            new_live, new_opt = opt.update(live, grads, opt_state,
+                                           lr, step_i)
+            return {**frozen, **new_live}, new_buf, new_opt, loss_v, outs
+
+        donate = (0, 1, 2) if self.donate else ()
+        return jax.jit(train_step, donate_argnums=donate)
+
+    def _build_eval_fn(self):
+        network = self.network
+        loss_layer = self.loss
+
+        def eval_step(params, buffers, inputs, labels):
+            outs = functional_call(network, params, buffers, *inputs)
+            outs_t = outs if isinstance(outs, (list, tuple)) else [outs]
+            l_arr = None
+            if loss_layer is not None and labels:
+                l = loss_layer(*outs_t, *labels)
+                l_arr = (l._value if isinstance(l, Tensor) else l).astype(jnp.float32)
+            return _unwrap(outs), l_arr
+
+        return jax.jit(eval_step)
+
+    # ------------------------------------------------------------------
+    def _lr_now(self):
+        opt = self.optimizer
+        if opt is None:
+            return 0.0
+        lr = opt._lr
+        if isinstance(lr, LRScheduler):
+            return float(lr())
+        return float(lr)
+
+    def train_batch(self, inputs, labels):
+        """One optimizer step. inputs/labels: lists of Tensors/arrays."""
+        if self.network.training is False:
+            self.network.train()
+        if self._train_fn is None:
+            self._train_fn = self._build_train_fn()
+        if self._opt_state is None:
+            trainable = {n: self._params[n]
+                         for n, p in self.network.named_parameters()
+                         if p.trainable and n in self._params}
+            self._opt_state = self.optimizer.init_state(trainable)
+            pending = getattr(self.optimizer, "_pending_state_leaves", None)
+            if pending is not None:
+                leaves, treedef = jax.tree_util.tree_flatten(self._opt_state)
+                if len(pending) == len(leaves):
+                    self._opt_state = jax.tree_util.tree_unflatten(
+                        treedef, pending)
+                self.optimizer._pending_state_leaves = None
+        in_arrs = self._shard_batch(_unwrap(list(inputs)))
+        lab_arrs = self._shard_batch(_unwrap(list(labels)))
+        lr = jnp.float32(self._lr_now())
+        self._step += 1
+        (self._params, self._buffers, self._opt_state, loss_v,
+         outs) = self._train_fn(self._params, self._buffers, self._opt_state,
+                                lr, jnp.int32(self._step), self._split_key(),
+                                in_arrs, lab_arrs)
+        return loss_v, outs
+
+    def eval_batch(self, inputs, labels=()):
+        if self.network.training:
+            self.network.eval()
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval_fn()
+        outs, loss_v = self._eval_fn(self._params, self._buffers,
+                                     _unwrap(list(inputs)),
+                                     _unwrap(list(labels)))
+        return loss_v, outs
+
+    def predict_batch(self, inputs):
+        _, outs = self.eval_batch(inputs, ())
+        return outs
+
+    # state ------------------------------------------------------------
+    def opt_state_dict(self):
+        return {"state": self._opt_state, "step": self._step}
+
+    def load_opt_state_dict(self, d):
+        self._opt_state = d["state"]
+        self._step = d["step"]

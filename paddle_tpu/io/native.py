@@ -1,0 +1,80 @@
+"""ctypes bridge to the C++ IO runtime (csrc/libptio.so).
+
+The native library provides a lock-free-ish ring buffer of pinned host
+buffers (the TPU equivalent of the reference's shared-memory reader queue in
+paddle/fluid/operators/reader/buffered_reader.cc). Python objects can't
+cross the ctypes boundary, so the prefetcher stores numpy payloads in a
+Python-side slot table and pushes slot ids through the native queue — the
+native side provides the blocking/backpressure machinery.
+
+Falls back to None (pure-python queue) when the .so isn't built.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+_LIB = None
+_TRIED = False
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for cand in (os.path.join(here, "..", "csrc", "build", "libptio.so"),
+                 os.path.join(here, "lib", "libptio.so")):
+        cand = os.path.abspath(cand)
+        if os.path.exists(cand):
+            try:
+                lib = ctypes.CDLL(cand)
+                lib.ptio_queue_create.restype = ctypes.c_void_p
+                lib.ptio_queue_create.argtypes = [ctypes.c_int]
+                lib.ptio_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_long]
+                lib.ptio_queue_push.restype = ctypes.c_int
+                lib.ptio_queue_pop.argtypes = [ctypes.c_void_p]
+                lib.ptio_queue_pop.restype = ctypes.c_long
+                lib.ptio_queue_destroy.argtypes = [ctypes.c_void_p]
+                _LIB = lib
+                break
+            except OSError:
+                continue
+    return _LIB
+
+
+class NativePrefetcher:
+    """Bounded queue whose blocking machinery lives in C++."""
+
+    @classmethod
+    def create(cls, depth):
+        lib = _load()
+        if lib is None:
+            return None
+        return cls(lib, depth)
+
+    def __init__(self, lib, depth):
+        self._lib = lib
+        self._q = lib.ptio_queue_create(depth)
+        self._slots = {}
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def put(self, item):
+        with self._lock:
+            sid = self._next
+            self._next += 1
+            self._slots[sid] = item
+        self._lib.ptio_queue_push(self._q, sid)
+
+    def get(self):
+        sid = self._lib.ptio_queue_pop(self._q)
+        with self._lock:
+            return self._slots.pop(sid)
+
+    def close(self):
+        if self._q:
+            self._lib.ptio_queue_destroy(self._q)
+            self._q = None

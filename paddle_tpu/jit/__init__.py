@@ -1,0 +1,185 @@
+"""paddle.jit parity (ref: python/paddle/jit/*).
+
+@to_static: the reference rewrites Python AST into a static Program; here
+the same contract (trace once, run compiled) is jax.jit. A Layer's forward
+becomes a pure function of (state_dict, inputs) via nn.functional_call, so
+the compiled artifact is a real program, not a Python closure.
+
+jit.save/load: exports StableHLO via jax.export + the state dict — the
+moral equivalent of __model__ + .pdiparams; reloadable and runnable without
+the model class.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer, functional_call
+from ..tensor import Tensor
+
+__all__ = ["to_static", "save", "load", "InputSpec", "not_to_static",
+           "TranslatedLayer"]
+
+
+class InputSpec:
+    """ref: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_shape_struct(self):
+        from .. import framework
+        shape = tuple(1 if (s is None or s < 0) else int(s) for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, framework.convert_dtype(self.dtype))
+
+    @classmethod
+    def from_tensor(cls, t, name=None):
+        return cls(tuple(t.shape), str(t.dtype), name)
+
+
+def _unwrap(x):
+    return jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, x,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+class StaticFunction:
+    """Callable wrapper produced by @to_static."""
+
+    def __init__(self, fn, input_spec=None, layer=None, full_graph=True):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._compiled = {}
+
+    def __call__(self, *args, **kwargs):
+        layer = self._layer
+        if layer is not None:
+            params, buffers = layer.raw_state()
+            training = layer.training
+
+            def pure(p, b, key, *a):
+                from .. import framework
+                out, new_b = functional_call(layer, p, b, *a, rng=key,
+                                             mutable=True)
+                return _unwrap(out), new_b
+
+            jitted = self._compiled.get(("layer", training))
+            if jitted is None:
+                jitted = jax.jit(pure)
+                self._compiled[("layer", training)] = jitted
+            from ..framework import next_rng_key
+            arr_args = _unwrap(args)
+            out, new_b = jitted(params, buffers, next_rng_key(), *arr_args)
+            layer.load_raw_state(buffers=new_b)
+            return jax.tree_util.tree_map(Tensor, out)
+        jitted = self._compiled.get("fn")
+        if jitted is None:
+            def pure(*a, **kw):
+                return _unwrap(self._fn(*a, **kw))
+            jitted = jax.jit(pure)
+            self._compiled["fn"] = jitted
+        out = jitted(*_unwrap(args), **_unwrap(kwargs))
+        return jax.tree_util.tree_map(Tensor, out)
+
+    @property
+    def forward_fn(self):
+        return self._fn
+
+    def concrete_program(self, *args):
+        return self
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    def deco(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(layer.forward, input_spec, layer=layer)
+            layer.forward = sf  # bound replacement; layer(x) now runs jitted
+            layer._to_static_spec = input_spec
+            return layer
+        import functools
+        sf = StaticFunction(fn, input_spec)
+        functools.update_wrapper(sf, fn)
+        return sf
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export layer -> {path}.stablehlo + {path}.pdiparams-style state."""
+    from jax import export as jax_export
+
+    if input_spec is None:
+        input_spec = getattr(layer, "_to_static_spec", None)
+    if input_spec is None:
+        raise ValueError("jit.save needs input_spec (list of InputSpec)")
+    specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+             for s in input_spec]
+    params, buffers = layer.raw_state()
+    was_training = layer.training
+    layer.eval()
+
+    def pure(p, b, *a):
+        out = functional_call(layer, p, b, *a)
+        return _unwrap(out)
+
+    shape_args = [s.to_shape_struct() for s in specs]
+    exp = jax_export.export(jax.jit(pure))(
+        jax.tree_util.tree_map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
+        jax.tree_util.tree_map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers),
+        *shape_args)
+    blob = exp.serialize()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(blob)
+    from ..serialization import save as _save
+    _save({"params": {k: Tensor(v) for k, v in params.items()},
+           "buffers": {k: Tensor(v) for k, v in buffers.items()},
+           "specs": [(s.shape, str(s.dtype)) for s in specs]},
+          path + ".pdiparams")
+    if was_training:
+        layer.train()
+
+
+class TranslatedLayer(Layer):
+    """A reloaded exported program (ref: paddle.jit.TranslatedLayer)."""
+
+    def __init__(self, exported, params, buffers):
+        super().__init__()
+        self._exported = exported
+        self._params = params
+        self._buffers_v = buffers
+
+    def forward(self, *args):
+        arr_args = _unwrap(args)
+        out = self._exported.call(self._params, self._buffers_v, *arr_args)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only")
+
+
+def load(path, **configs):
+    from jax import export as jax_export
+    with open(path + ".stablehlo", "rb") as f:
+        exp = jax_export.deserialize(f.read())
+    from ..serialization import load as _load
+    blob = _load(path + ".pdiparams")
+    params = {k: v._value for k, v in blob["params"].items()}
+    buffers = {k: v._value for k, v in blob["buffers"].items()}
+    return TranslatedLayer(exp, params, buffers)

@@ -1,0 +1,70 @@
+"""Gradient clipping strategies (ref: python/paddle/nn/clip.py).
+
+Used two ways: eagerly over Parameter.grad (API parity) and functionally
+over a grad pytree inside the jitted train step (Engine/optimizer path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """Eager form: list[(param, grad_tensor)] -> same with clipped grads."""
+        arrs = {i: g._value if isinstance(g, Tensor) else g
+                for i, (p, g) in enumerate(params_grads) if g is not None}
+        clipped = self.apply(arrs)
+        out = []
+        for i, (p, g) in enumerate(params_grads):
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(clipped[i])))
+        return out
+
+    def apply(self, grads):
+        """Functional form over any pytree of jax arrays."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def apply(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor L2 norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, grads):
+        def clip(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g)))
+            return g * jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-6), 1.0)
+        return jax.tree_util.tree_map(clip, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global L2 norm clip (the Fleet default for LM training)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        if not leaves:
+            return grads
+        total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                             for g in leaves))
+        coef = jnp.minimum(self.clip_norm / jnp.maximum(total, 1e-6), 1.0)
+        return jax.tree_util.tree_map(lambda g: (g * coef).astype(g.dtype), grads)

@@ -1,0 +1,1382 @@
+"""nn.functional (ref: python/paddle/nn/functional/*).
+
+All ops are jnp/lax-level functions dispatched through the autograd tape via
+apply_op, so they work both eagerly and under jit. Convolutions and pooling
+lower to lax.conv_general_dilated / lax.reduce_window which XLA maps onto the
+TPU MXU / vector unit.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..autograd import apply_op
+from ..framework import next_rng_key
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    # activations
+    "relu", "relu6", "relu_", "gelu", "silu", "swish", "sigmoid", "tanh",
+    "softmax", "log_softmax", "leaky_relu", "prelu", "elu", "selu", "celu",
+    "glu", "hardswish", "hardsigmoid", "hardtanh", "hardshrink", "mish",
+    "softplus", "softshrink", "softsign", "tanhshrink", "thresholded_relu",
+    "maxout", "rrelu", "gumbel_softmax",
+    # linear/conv
+    "linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+    "conv2d_transpose", "conv3d_transpose", "embedding",
+    # pooling
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "lp_pool1d", "lp_pool2d",
+    # norm
+    "batch_norm", "layer_norm", "group_norm", "instance_norm", "rms_norm",
+    "local_response_norm", "normalize",
+    # dropout & regularization
+    "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    # vision
+    "interpolate", "upsample", "pixel_shuffle", "pixel_unshuffle",
+    "channel_shuffle", "pad", "unfold", "fold", "affine_grid", "grid_sample",
+    # loss
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "nll_loss", "kl_div", "margin_ranking_loss",
+    "cosine_embedding_loss", "hinge_embedding_loss", "triplet_margin_loss",
+    "poisson_nll_loss", "huber_loss", "sigmoid_focal_loss", "dice_loss",
+    "log_loss", "square_error_cost", "ctc_loss", "label_smooth",
+    # attention & misc
+    "scaled_dot_product_attention", "one_hot", "cosine_similarity",
+    "pairwise_distance", "linear_dtype_guard", "sequence_mask", "temporal_shift",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+def relu(x, name=None):
+    return apply_op(jax.nn.relu, _t(x))
+
+
+def relu_(x, name=None):
+    return x._inplace(relu(x))
+
+
+def relu6(x, name=None):
+    return apply_op(jax.nn.relu6, _t(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda a: jax.nn.gelu(a, approximate=approximate), _t(x))
+
+
+def silu(x, name=None):
+    return apply_op(jax.nn.silu, _t(x))
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def sigmoid(x, name=None):
+    return apply_op(jax.nn.sigmoid, _t(x))
+
+
+def tanh(x, name=None):
+    return apply_op(jnp.tanh, _t(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    dt = framework.convert_dtype(dtype)
+    def f(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=axis)
+    return apply_op(f, _t(x))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    dt = framework.convert_dtype(dtype)
+    def f(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply_op(f, _t(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda a: jax.nn.leaky_relu(a, negative_slope), _t(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a >= 0, a, w * a)
+    return apply_op(f, _t(x), _t(weight))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.elu(a, alpha), _t(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), _t(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda a: jax.nn.celu(a, alpha), _t(x))
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op(lambda a: jax.nn.glu(a, axis=axis), _t(x))
+
+
+def hardswish(x, name=None):
+    return apply_op(jax.nn.hard_swish, _t(x))
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return apply_op(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda a: jnp.clip(a, min, max), _t(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), _t(x))
+
+
+def mish(x, name=None):
+    return apply_op(lambda a: a * jnp.tanh(jax.nn.softplus(a)), _t(x))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        lambda a: jnp.where(beta * a > threshold, a,
+                            jax.nn.softplus(beta * a) / beta), _t(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)), _t(x))
+
+
+def softsign(x, name=None):
+    return apply_op(jax.nn.soft_sign, _t(x))
+
+
+def tanhshrink(x, name=None):
+    return apply_op(lambda a: a - jnp.tanh(a), _t(x))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(lambda a: jnp.where(a > threshold, a, value), _t(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply_op(f, _t(x))
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True, name=None):
+    if training:
+        key = next_rng_key()
+        def f(a):
+            slope = jax.random.uniform(key, a.shape, minval=lower, maxval=upper,
+                                       dtype=jnp.float32).astype(a.dtype)
+            return jnp.where(a >= 0, a, slope * a)
+        return apply_op(f, _t(x))
+    mid = (lower + upper) / 2
+    return leaky_relu(x, mid)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    key = next_rng_key()
+    def f(a):
+        g = jax.random.gumbel(key, a.shape, dtype=a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+    return apply_op(f, _t(x))
+
+
+# ---------------------------------------------------------------------------
+# linear / conv / embedding
+# ---------------------------------------------------------------------------
+def linear(x, weight, bias=None, name=None):
+    """Reference weight layout: [in_features, out_features]."""
+    if bias is None:
+        return apply_op(lambda a, w: a @ w, _t(x), _t(weight))
+    return apply_op(lambda a, w, b: a @ w + b, _t(x), _t(weight), _t(bias))
+
+
+def linear_dtype_guard(x):
+    return x
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(i) for i in v)
+    return v if len(v) == n else v * n
+
+
+def _conv_padding(padding, n, kernel, dilation):
+    """Paddle padding spec -> lax padding list of (lo, hi) per spatial dim."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' | 'VALID'
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # list of pairs
+    return [tuple(int(q) for q in p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format, transpose=False, output_padding=0):
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    channel_last = data_format.endswith("C")
+    spatial = "DHW"[-n:] if n > 1 else "W"
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, out_spec))
+    kernel = tuple(weight.shape[2:])
+    pad = _conv_padding(padding, n, kernel, dilation)
+
+    def f(a, w, *b):
+        if not transpose:
+            out = jax.lax.conv_general_dilated(
+                a, w, window_strides=stride, padding=pad,
+                rhs_dilation=dilation, dimension_numbers=dn,
+                feature_group_count=groups,
+                preferred_element_type=jnp.float32 if a.dtype == jnp.float32 else None)
+        else:
+            # conv_transpose: gradient of conv w.r.t. input. weight layout in
+            # the reference is [in_c, out_c/groups, *k].
+            opad = _norm_tuple(output_padding, n)
+            pads = pad
+            if isinstance(pads, str):
+                raise ValueError("string padding unsupported for transpose conv")
+            k_eff = [(kernel[i] - 1) * dilation[i] + 1 for i in range(n)]
+            tpad = [(k_eff[i] - 1 - pads[i][0],
+                     k_eff[i] - 1 - pads[i][1] + opad[i]) for i in range(n)]
+            w_t = jnp.swapaxes(w, 0, 1)  # [out_c/g, in_c, *k]
+            w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + n)))
+            if groups > 1:
+                # [in_c, out_c/g, *k] -> grouped: in_c = g * (in_c/g)
+                icg = a.shape[1 if not channel_last else -1] // groups
+                ws = w.reshape((groups, icg) + w.shape[1:])
+                w_t = jnp.concatenate(
+                    [jnp.flip(jnp.swapaxes(ws[g], 0, 1), axis=tuple(range(2, 2 + n)))
+                     for g in range(groups)], axis=0)
+            out = jax.lax.conv_general_dilated(
+                a, w_t, window_strides=(1,) * n, padding=tpad,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn, feature_group_count=groups)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[1 if not channel_last else -1] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    args = (_t(x), _t(weight)) + ((_t(bias),) if bias is not None else ())
+    return apply_op(f, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format, transpose=True, output_padding=output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, transpose=True, output_padding=output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, transpose=True, output_padding=output_padding)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(i, w):
+        out = jnp.take(w, i, axis=0)
+        if padding_idx is not None:
+            mask = (i == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_op(f, _t(x), _t(weight))
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+def _pool(x, kernel_size, stride, padding, n, reducer, init, data_format,
+          ceil_mode=False, exclusive=True, count_include_pad=False,
+          return_mask=False):
+    k = _norm_tuple(kernel_size, n)
+    s = _norm_tuple(stride if stride is not None else kernel_size, n)
+    channel_last = data_format.endswith("C")
+    sp_off = 1 if channel_last else 2
+    pad = _conv_padding(padding, n, k, (1,) * n)
+    if not isinstance(pad, str) and ceil_mode:
+        # extend the high pad so the last partial window is kept
+        pad = list(pad)
+        for d in range(n):
+            size = x.shape[sp_off + d] + pad[d][0] + pad[d][1]
+            rem = (size - k[d]) % s[d]
+            if rem:
+                pad[d] = (pad[d][0], pad[d][1] + (s[d] - rem))
+    if channel_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        if channel_last:
+            pad_cfg = [(0, 0)] + list(pad) + [(0, 0)]
+        else:
+            pad_cfg = [(0, 0), (0, 0)] + list(pad)
+
+    def f(a):
+        if reducer == "max":
+            neg = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else \
+                jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, neg, jax.lax.max, dims, strides,
+                                         pad_cfg)
+        ones = jnp.ones_like(a)
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides, pad_cfg)
+        if count_include_pad:
+            denom = float(np.prod(k))
+            return summed / denom
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pad_cfg)
+        return summed / counts
+
+    out = apply_op(f, _t(x))
+    if not return_mask:
+        return out
+
+    # argmax indices (flattened over the window's spatial positions, like
+    # the reference's mask output). reduce_window over a packed value+index
+    # monoid: encode index in the fractional ordering by a lexicographic max
+    # on (value, -index) pairs via two passes.
+    def idx_f(a):
+        flat_sp = [a.shape[sp_off + d] for d in range(n)]
+        # linear index of each element within its spatial volume
+        lin = jnp.arange(int(np.prod(flat_sp)), dtype=jnp.int32).reshape(flat_sp)
+        shape = [1] * a.ndim
+        for d in range(n):
+            shape[sp_off + d] = flat_sp[d]
+        lin = jnp.broadcast_to(lin.reshape(shape), a.shape)
+        neg = -jnp.inf
+        def reducer2(p, c):
+            pv, pi = p
+            cv, ci = c
+            take_c = (cv > pv) | ((cv == pv) & (ci < pi))
+            return (jnp.where(take_c, cv, pv), jnp.where(take_c, ci, pi))
+        vals, idxs = jax.lax.reduce_window(
+            (a.astype(jnp.float32), lin), (jnp.float32(neg), jnp.int32(-1)),
+            reducer2, dims, strides, pad_cfg)
+        return idxs.astype(jnp.int64)
+    mask = apply_op(idx_f, _t(x), differentiable=False)
+    return out, mask
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "max", None, data_format,
+                 ceil_mode=ceil_mode, return_mask=return_mask)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "max", None, data_format,
+                 ceil_mode=ceil_mode, return_mask=return_mask)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max", None, data_format,
+                 ceil_mode=ceil_mode, return_mask=return_mask)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", None, data_format,
+                 ceil_mode=ceil_mode, count_include_pad=not exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", None, data_format,
+                 ceil_mode=ceil_mode, count_include_pad=not exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", None, data_format,
+                 ceil_mode=ceil_mode, count_include_pad=not exclusive)
+
+
+def _adaptive_pool(x, output_size, n, mode, data_format):
+    out_sz = _norm_tuple(output_size, n)
+
+    def f(a):
+        channel_last = data_format.endswith("C")
+        sp_off = 1 if channel_last else 2
+        out = a
+        for d in range(n):
+            axis = sp_off + d
+            in_len = out.shape[axis]
+            o = out_sz[d]
+            if o is None:
+                continue
+            if in_len % o == 0:
+                k = in_len // o
+                shape = out.shape[:axis] + (o, k) + out.shape[axis + 1:]
+                r = out.reshape(shape)
+                out = jnp.max(r, axis=axis + 1) if mode == "max" else jnp.mean(r, axis=axis + 1)
+            else:
+                # generic: gather windows with per-output start/end
+                starts = (np.arange(o) * in_len) // o
+                ends = ((np.arange(o) + 1) * in_len + o - 1) // o
+                pieces = []
+                for s_, e_ in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(s_), int(e_), axis=axis)
+                    red = jnp.max(seg, axis=axis, keepdims=True) if mode == "max" \
+                        else jnp.mean(seg, axis=axis, keepdims=True)
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=axis)
+        return out
+
+    return apply_op(f, _t(x))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", "NCL")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max", "NCHW")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    p = float(norm_type)
+    xp = apply_op(lambda a: jnp.abs(a) ** p, _t(x))
+    pooled = _pool(xp, kernel_size, stride, padding, 1, "avg", None,
+                   data_format, count_include_pad=True)
+    k = _norm_tuple(kernel_size, 1)
+    return apply_op(lambda a: (a * float(np.prod(k))) ** (1.0 / p), pooled)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    xp = apply_op(lambda a: jnp.abs(a) ** p, _t(x))
+    pooled = _pool(xp, kernel_size, stride, padding, 2, "avg", None,
+                   data_format, count_include_pad=True)
+    k = _norm_tuple(kernel_size, 2)
+    return apply_op(lambda a: (a * float(np.prod(k))) ** (1.0 / p), pooled)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Functional batchnorm. When training, returns output computed with
+    batch stats and *updates the running stat tensors in place* (so the
+    functional_call buffer collection picks the new values up)."""
+    ch_axis = 1 if not data_format.endswith("C") else -1
+
+    rm, rv = _t(running_mean), _t(running_var)
+    use_batch = training and not use_global_stats
+
+    x_t = _t(x)
+    reduce_axes = tuple(i for i in range(x_t.ndim) if i != ch_axis % x_t.ndim)
+
+    if use_batch:
+        mean = apply_op(lambda a: jnp.mean(a, axis=reduce_axes), x_t)
+        var = apply_op(lambda a: jnp.var(a, axis=reduce_axes), x_t)
+        # running stat update (reference: momentum * running + (1-m) * batch)
+        n = float(np.prod([x_t.shape[i] for i in reduce_axes]))
+        unbiased = var * (n / max(n - 1.0, 1.0))
+        rm._inplace(rm * momentum + mean.detach() * (1.0 - momentum))
+        rv._inplace(rv * momentum + unbiased.detach() * (1.0 - momentum))
+    else:
+        mean, var = rm, rv
+
+    def f(a, m, v, *wb):
+        shape = [1] * a.ndim
+        shape[ch_axis] = a.shape[ch_axis]
+        inv = jax.lax.rsqrt(v.reshape(shape) + epsilon)
+        out = (a - m.reshape(shape)) * inv
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x_t, mean, var]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op(f, *args)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, (int, np.integer)):
+        normalized_shape = (int(normalized_shape),)
+    nd = len(tuple(normalized_shape))
+
+    def f(a, *wb):
+        axes = tuple(range(a.ndim - nd, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]; i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op(f, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
+    def f(a, *w):
+        ms = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=axis, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if w:
+            out = out * w[0]
+        return out
+    args = [_t(x)] + ([_t(weight)] if weight is not None else [])
+    return apply_op(f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = data_format.endswith("C")
+
+    def f(a, *wb):
+        if channel_last:
+            a_m = jnp.moveaxis(a, -1, 1)
+        else:
+            a_m = a
+        n, c = a_m.shape[0], a_m.shape[1]
+        g = num_groups
+        r = a_m.reshape((n, g, c // g) + a_m.shape[2:])
+        axes = tuple(range(2, r.ndim))
+        mean = jnp.mean(r, axis=axes, keepdims=True)
+        var = jnp.var(r, axis=axes, keepdims=True)
+        out = ((r - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a_m.shape)
+        shape = [1] * a_m.ndim
+        shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op(f, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def f(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape); i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op(f, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        sq = jnp.square(a)
+        ch_axis = 1 if not data_format.endswith("C") else a.ndim - 1
+        half = size // 2
+        pad_width = [(0, 0)] * a.ndim
+        pad_width[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad_width)
+        windows = [jax.lax.slice_in_dim(padded, i, i + a.shape[ch_axis], axis=ch_axis)
+                   for i in range(size)]
+        s = sum(windows)
+        return a / (k + alpha / size * s) ** beta
+    return apply_op(f, _t(x))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return apply_op(f, _t(x))
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if p != 0.0 and mode == "downscale_in_infer":
+            # ref semantics: no upscale in train => scale by keep-prob at infer
+            return apply_op(lambda a: a * (1.0 - p), _t(x))
+        return _t(x)
+    key = next_rng_key()
+
+    def f(a):
+        if axis is None:
+            shape = a.shape
+        else:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = tuple(a.shape[i] if i in [ax % a.ndim for ax in axes] else 1
+                          for i in range(a.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply_op(f, _t(x))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if not data_format.endswith("C") else [0, 3]
+    return dropout(x, p=p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if not data_format.endswith("C") else [0, 4]
+    return dropout(x, p=p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    key = next_rng_key()
+
+    def f(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+
+    return apply_op(f, _t(x))
+
+
+# ---------------------------------------------------------------------------
+# vision ops
+# ---------------------------------------------------------------------------
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    channel_last = data_format.endswith("C")
+    x_t = _t(x)
+    nsp = x_t.ndim - 2
+    sp_shape = x_t.shape[1:-1] if channel_last else x_t.shape[2:]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in np.asarray(size._value)]
+        out_sp = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size] * nsp))
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nsp
+        out_sp = tuple(int(math.floor(s * f)) for s, f in zip(sp_shape, sf))
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def f(a):
+        if channel_last:
+            out_shape = (a.shape[0],) + out_sp + (a.shape[-1],)
+        else:
+            out_shape = a.shape[:2] + out_sp
+        if mode == "nearest":
+            # jax.image nearest matches align_corners=False reference behavior
+            return jax.image.resize(a, out_shape, method="nearest")
+        if align_corners:
+            # build index grid with corner alignment
+            sp_axes = list(range(1, 1 + nsp)) if channel_last else list(range(2, 2 + nsp))
+            out = a
+            for d, ax in enumerate(sp_axes):
+                i_len, o_len = a.shape[ax], out_sp[d]
+                if o_len == 1:
+                    idx = jnp.zeros((1,))
+                else:
+                    idx = jnp.linspace(0.0, i_len - 1, o_len)
+                lo = jnp.floor(idx).astype(jnp.int32)
+                hi = jnp.clip(lo + 1, 0, i_len - 1)
+                w = (idx - lo)[(None,) * ax + (...,) + (None,) * (out.ndim - ax - 1)]
+                lo_v = jnp.take(out, lo, axis=ax)
+                hi_v = jnp.take(out, hi, axis=ax)
+                out = lo_v * (1 - w) + hi_v * w
+            return out.astype(a.dtype)
+        return jax.image.resize(a, out_shape, method=jmode).astype(a.dtype)
+
+    return apply_op(f, x_t)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply_op(f, _t(x))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+
+    return apply_op(f, _t(x))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            a = a.transpose(0, 2, 1, 3, 4)
+            return a.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        a = a.transpose(0, 1, 2, 4, 3)
+        return a.reshape(n, h, w, c)
+    return apply_op(f, _t(x))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..tensor_ops.manip import pad as _pad
+    return _pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (ref: F.unfold). x: [N, C, H, W] -> [N, C*kh*kw, L]."""
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    p = _conv_padding(paddings, 2, k, d)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, [(0, 0), (0, 0), p[0], p[1]])
+        hp, wp = a_p.shape[2], a_p.shape[3]
+        oh = (hp - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (wp - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                sub = a_p[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                          j * d[1]: j * d[1] + ow * s[1]: s[1]]
+                patches.append(sub)
+        out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+
+    return apply_op(f, _t(x))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im (ref: F.fold)."""
+    out_sz = _norm_tuple(output_sizes, 2)
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    d = _norm_tuple(dilations, 2)
+    p = _conv_padding(paddings, 2, k, d)
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        hp = out_sz[0] + p[0][0] + p[0][1]
+        wp = out_sz[1] + p[1][0] + p[1][1]
+        oh = (hp - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (wp - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        a_r = a.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, hp, wp), dtype=a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                             j * d[1]: j * d[1] + ow * s[1]: s[1]].add(a_r[:, :, i, j])
+        return out[:, :, p[0][0]: p[0][0] + out_sz[0], p[1][0]: p[1][0] + out_sz[1]]
+
+    return apply_op(f, _t(x))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def f(th):
+        n, _, h, w = [int(v) for v in
+                      (out_shape.tolist() if isinstance(out_shape, Tensor) else out_shape)]
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
+        return jnp.einsum("hwk,nck->nhwc", base, th)
+    return apply_op(f, _t(theta))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            valid = (ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1)
+            vals = a[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [n, gh, gw, c]
+            if padding_mode == "zeros":
+                vals = jnp.where(valid[..., None], vals, 0.0)
+            return vals
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx).astype(jnp.int32),
+                         jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = fx - x0
+            wy = fy - y0
+            v00 = sample(x0, y0)
+            v01 = sample(x1, y0)
+            v10 = sample(x0, y1)
+            v11 = sample(x1, y1)
+            out = (v00 * ((1 - wx) * (1 - wy))[..., None]
+                   + v01 * (wx * (1 - wy))[..., None]
+                   + v10 * ((1 - wx) * wy)[..., None]
+                   + v11 * (wx * wy)[..., None])
+        return jnp.moveaxis(out, -1, 1)
+
+    return apply_op(f, _t(x), _t(grid))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        r = a.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        left = jnp.concatenate([r[:, 1:, :fold_c], jnp.zeros_like(r[:, :1, :fold_c])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(r[:, :1, fold_c:2 * fold_c]),
+                                 r[:, :-1, fold_c:2 * fold_c]], axis=1)
+        rest = r[:, :, 2 * fold_c:]
+        return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+    return apply_op(f, _t(x))
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """ref: F.cross_entropy (python/paddle/nn/functional/loss.py)."""
+    def f(logits, lab, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        n_cls = logits.shape[axis]
+        if soft_label:
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_cls
+            loss = -jnp.sum(soft * logp, axis=axis)
+            if w:
+                cls_w = jnp.sum(soft * w[0], axis=axis)
+                loss = loss * cls_w
+            return _reduce(loss, reduction)
+        lab_i = lab.astype(jnp.int32)
+        squeeze = False
+        if lab_i.ndim == logp.ndim and lab_i.shape[axis] == 1:
+            lab_i = jnp.squeeze(lab_i, axis=axis)
+            squeeze = True
+        valid = lab_i != ignore_index
+        lab_safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(lab_safe, axis), axis=axis)
+        picked = jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0:
+            smooth_loss = -jnp.mean(logp, axis=axis)
+            loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+        else:
+            loss = -picked
+        if w:
+            loss = loss * jnp.take(w[0], lab_safe)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            if w:
+                denom = jnp.sum(jnp.where(valid, jnp.take(w[0], lab_safe), 0.0))
+            else:
+                denom = jnp.sum(valid.astype(loss.dtype))
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        return _reduce(loss, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply_op(f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = loss.unsqueeze(axis) if not soft_label else loss
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply_op(f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def f(z, y, *rest):
+        i = 0
+        w = pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [_t(logit), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    return apply_op(f, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                    _t(input), _t(label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    _t(input), _t(label))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply_op(f, _t(input), _t(label))
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply_op(f, _t(input), _t(label))
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def f(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        lab_safe = jnp.where(valid, lab_i, 0)
+        if logp.ndim > 2:
+            # [N, C, d1...] -> move C last
+            lp = jnp.moveaxis(logp, 1, -1)
+        else:
+            lp = logp
+        picked = jnp.take_along_axis(lp, lab_safe[..., None], axis=-1)[..., 0]
+        loss = -picked
+        if w:
+            loss = loss * jnp.take(w[0], lab_safe)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(
+                valid, jnp.take(w[0], lab_safe) if w else 1.0, 0.0))
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        return _reduce(loss, reduction)
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return apply_op(f, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - lp)
+        else:
+            loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op(f, _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply_op(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        _t(input), _t(other), _t(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply_op(f, _t(input1), _t(input2), _t(label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply_op(f, _t(input), _t(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.sum(jnp.abs(a - pos) ** p, axis=-1) ** (1 / p)
+        dn = jnp.sum(jnp.abs(a - neg) ** p, axis=-1) ** (1 / p)
+        if swap:
+            dsw = jnp.sum(jnp.abs(pos - neg) ** p, axis=-1) ** (1 / p)
+            dn = jnp.minimum(dn, dsw)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return apply_op(f, _t(input), _t(positive), _t(negative))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(a, y):
+        if log_input:
+            loss = jnp.exp(a) - y * a
+        else:
+            loss = a - y * jnp.log(a + epsilon)
+        if full:
+            stirling = y * jnp.log(jnp.maximum(y, 1.0)) - y + \
+                0.5 * jnp.log(2 * jnp.pi * jnp.maximum(y, 1.0))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply_op(f, _t(input), _t(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *nrm):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if nrm:
+            loss = loss / nrm[0]
+        return _reduce(loss, reduction)
+    args = [_t(logit), _t(label)]
+    if normalizer is not None:
+        args.append(_t(normalizer))
+    return apply_op(f, *args)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, y):
+        yoh = jax.nn.one_hot(y.astype(jnp.int32)[..., 0], p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * yoh, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(yoh, axis=reduce_dims)
+        dice = (2 * inter + epsilon) / (union + epsilon)
+        return jnp.mean(1 - dice)
+    return apply_op(f, _t(input), _t(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        lambda p, y: -(y * jnp.log(p + epsilon) + (1 - y) * jnp.log(1 - p + epsilon)),
+        _t(input), _t(label))
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: jnp.square(a - b), _t(input), _t(label))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax's implementation (log-domain forward algorithm)."""
+    import optax
+    def f(lp, lab, il, ll):
+        # optax expects [B, T, C] logits and paddings
+        logits = jnp.transpose(lp, (1, 0, 2)) if lp.ndim == 3 else lp
+        b, t, _ = logits.shape
+        logit_pad = (jnp.arange(t)[None, :] >= il[:, None]).astype(jnp.float32)
+        lab_pad = (jnp.arange(lab.shape[1])[None, :] >= ll[:, None]).astype(jnp.float32)
+        per_seq = optax.ctc_loss(logits, logit_pad, lab.astype(jnp.int32),
+                                 lab_pad, blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(per_seq / jnp.maximum(ll.astype(per_seq.dtype), 1.0))
+        return _reduce(per_seq, reduction)
+    return apply_op(f, _t(log_probs), _t(labels), _t(input_lengths), _t(label_lengths))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(y, *pd):
+        n = y.shape[-1]
+        if pd:
+            return (1 - epsilon) * y + epsilon * pd[0]
+        return (1 - epsilon) * y + epsilon / n
+    args = [_t(label)]
+    if prior_dist is not None:
+        args.append(_t(prior_dist))
+    return apply_op(f, *args)
+
+
+# ---------------------------------------------------------------------------
+# attention & misc
+# ---------------------------------------------------------------------------
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """ref: F.scaled_dot_product_attention — [B, S, H, D] layout.
+
+    Routes to the Pallas TPU flash-attention kernel when shapes allow;
+    otherwise the jnp reference path (still XLA-fused on TPU).
+    """
+    from ..ops import flash_attention_available, flash_attention
+    q, k, v = _t(query), _t(key), _t(value)
+    if (flash_attention_available(q.shape, k.shape, attn_mask, dropout_p)
+            and training is not None):
+        return apply_op(
+            lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=is_causal),
+            q, k, v)
+
+    drop_key = next_rng_key() if (dropout_p > 0 and training) else None
+
+    def f(qq, kk, vv, *m):
+        # [B, S, H, D] -> [B, H, S, D]
+        qq, kk, vv = (jnp.swapaxes(a, 1, 2) for a in (qq, kk, vv))
+        scale = 1.0 / math.sqrt(qq.shape[-1])
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qq, kk) * scale
+        if is_causal:
+            s_q, s_k = logits.shape[-2], logits.shape[-1]
+            mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+            logits = jnp.where(mask, logits, -jnp.inf)
+        if m:
+            mm = m[0]
+            if mm.dtype == jnp.bool_:
+                logits = jnp.where(mm, logits, -jnp.inf)
+            else:
+                logits = logits + mm
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(qq.dtype)
+        if drop_key is not None:
+            keep = jax.random.bernoulli(drop_key, 1 - dropout_p, probs.shape)
+            probs = jnp.where(keep, probs / (1 - dropout_p), 0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vv)
+        return jnp.swapaxes(out, 1, 2)
+
+    args = [q, k, v]
+    if attn_mask is not None:
+        args.append(_t(attn_mask))
+    return apply_op(f, *args)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(
+        lambda i: jax.nn.one_hot(i.astype(jnp.int32), num_classes,
+                                 dtype=framework.get_default_dtype()),
+        _t(x), differentiable=False)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return apply_op(f, _t(x1), _t(x2))
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return apply_op(f, _t(x), _t(y))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    def f(lens):
+        m = maxlen if maxlen is not None else int(jnp.max(lens))
+        return (jnp.arange(m)[None, :] < lens[..., None]).astype(
+            framework.convert_dtype(dtype))
+    return apply_op(f, _t(x), differentiable=False)

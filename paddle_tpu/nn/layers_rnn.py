@@ -1,0 +1,363 @@
+"""Recurrent layers (ref: python/paddle/nn/layer/rnn.py).
+
+The recurrence runs under jax.lax.scan so the whole sequence compiles to one
+fused XLA while-loop instead of a Python loop of kernel launches (the
+reference relies on cuDNN RNN kernels for the same reason).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import apply_op
+from ..tensor import Tensor
+from . import functional as F
+from .initializer import Uniform
+from .layer import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        from ..tensor_ops.creation import full
+        return full([b, self.hidden_size], init_value)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+        h = apply_op(f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((4 * hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def f(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fgt = jax.nn.sigmoid(fgt)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = fgt * cc + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h_new, c_new = apply_op(f, inputs, h, c, self.weight_ih,
+                                self.weight_hh, self.bias_ih, self.bias_hh)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((3 * hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            return (1 - z) * n + z * h
+        h = apply_op(f, inputs, states, self.weight_ih, self.weight_hh,
+                     self.bias_ih, self.bias_hh)
+        return h, h
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional RNN driven by lax.scan."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+        self.num_directions = num_dir
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        for layer in range(num_layers):
+            for d in range(num_dir):
+                in_sz = input_size if layer == 0 else hidden_size * num_dir
+                sfx = f"_reverse" if d == 1 else ""
+                self.add_parameter(
+                    f"weight_ih_l{layer}{sfx}",
+                    self.create_parameter((gate_mult * hidden_size, in_sz),
+                                          weight_ih_attr, default_initializer=init))
+                self.add_parameter(
+                    f"weight_hh_l{layer}{sfx}",
+                    self.create_parameter((gate_mult * hidden_size, hidden_size),
+                                          weight_hh_attr, default_initializer=init))
+                self.add_parameter(
+                    f"bias_ih_l{layer}{sfx}",
+                    self.create_parameter((gate_mult * hidden_size,),
+                                          bias_ih_attr, is_bias=True,
+                                          default_initializer=init))
+                self.add_parameter(
+                    f"bias_hh_l{layer}{sfx}",
+                    self.create_parameter((gate_mult * hidden_size,),
+                                          bias_hh_attr, is_bias=True,
+                                          default_initializer=init))
+
+    def _cell_step(self, mode):
+        if mode == "LSTM":
+            def step(carry, x, wi, wh, bi, bh):
+                h, c = carry
+                gates = x @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                c2 = f * c + i * g
+                h2 = o * jnp.tanh(c2)
+                return (h2, c2), h2
+        elif mode == "GRU":
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry
+                gi = x @ wi.T + bi
+                gh = h @ wh.T + bh
+                i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+                h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+                r = jax.nn.sigmoid(i_r + h_r)
+                z = jax.nn.sigmoid(i_z + h_z)
+                n = jnp.tanh(i_n + r * h_n)
+                h2 = (1 - z) * n + z * h
+                return h2, h2
+        else:
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry
+                h2 = act(x @ wi.T + bi + h @ wh.T + bh)
+                return h2, h2
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.mode
+        nl, nd, hs = self.num_layers, self.num_directions, self.hidden_size
+        time_major = self.time_major
+        is_lstm = mode == "LSTM"
+        step = self._cell_step(mode)
+
+        weights = []
+        for layer in range(nl):
+            for d in range(nd):
+                sfx = "_reverse" if d == 1 else ""
+                weights += [getattr(self, f"weight_ih_l{layer}{sfx}"),
+                            getattr(self, f"weight_hh_l{layer}{sfx}"),
+                            getattr(self, f"bias_ih_l{layer}{sfx}"),
+                            getattr(self, f"bias_hh_l{layer}{sfx}")]
+
+        init_args = []
+        if initial_states is not None:
+            if is_lstm:
+                init_args = [initial_states[0], initial_states[1]]
+            else:
+                init_args = [initial_states]
+
+        def f(x, *flat):
+            if initial_states is not None:
+                if is_lstm:
+                    h0, c0, flat = flat[0], flat[1], flat[2:]
+                else:
+                    h0, flat = flat[0], flat[1:]
+            else:
+                h0 = c0 = None
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, I]
+            b = x.shape[1]
+            out = x
+            last_h, last_c = [], []
+            wi_idx = 0
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    wi, wh, bi, bh = flat[wi_idx:wi_idx + 4]
+                    wi_idx += 4
+                    sl = layer * nd + d
+                    if h0 is not None:
+                        hh = h0[sl]
+                        cc = c0[sl] if is_lstm else None
+                    else:
+                        hh = jnp.zeros((b, hs), dtype=x.dtype)
+                        cc = jnp.zeros((b, hs), dtype=x.dtype) if is_lstm else None
+                    carry = (hh, cc) if is_lstm else hh
+                    seq = out if d == 0 else jnp.flip(out, axis=0)
+
+                    def scan_fn(c, xt, wi=wi, wh=wh, bi=bi, bh=bh):
+                        return step(c, xt, wi, wh, bi, bh)
+
+                    carry, ys = jax.lax.scan(scan_fn, carry, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, axis=0)
+                    dir_outs.append(ys)
+                    if is_lstm:
+                        last_h.append(carry[0])
+                        last_c.append(carry[1])
+                    else:
+                        last_h.append(carry)
+                out = dir_outs[0] if nd == 1 else jnp.concatenate(dir_outs, axis=-1)
+            final_h = jnp.stack(last_h, axis=0)
+            if not time_major:
+                out = jnp.swapaxes(out, 0, 1)
+            if is_lstm:
+                return out, final_h, jnp.stack(last_c, axis=0)
+            return out, final_h
+
+        outs = apply_op(f, inputs, *init_args, *weights)
+        if is_lstm:
+            out, h, c = outs
+            return out, (h, c)
+        out, h = outs
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, proj_size=0, name=None):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (ref: nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # Python loop (eager clarity); _RNNBase is the compiled path.
+        t_axis = 0 if self.time_major else 1
+        steps = inputs.shape[t_axis]
+        rng = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        outs = []
+        from ..tensor_ops.manip import stack
+        for ti in rng:
+            xt = inputs[ti] if self.time_major else inputs[:, ti]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=t_axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        from ..tensor_ops.manip import concat
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

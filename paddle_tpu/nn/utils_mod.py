@@ -1,0 +1,137 @@
+"""nn.utils (ref: python/paddle/nn/utils/*)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import no_grad
+from ..tensor import Tensor
+
+__all__ = ["clip_grad_norm_", "clip_grad_value_", "parameters_to_vector",
+           "vector_to_parameters", "weight_norm", "remove_weight_norm",
+           "spectral_norm"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p._grad_value for p in parameters if p._grad_value is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("grad norm is non-finite")
+    coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in parameters:
+        if p._grad_value is not None:
+            p._grad_value = p._grad_value * coef
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p._grad_value is not None:
+            p._grad_value = jnp.clip(p._grad_value, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate([p._value.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    off = 0
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = int(np.prod(p._value.shape)) if p._value.shape else 1
+        p._value = v[off:off + n].reshape(p._value.shape).astype(p._value.dtype)
+        off += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v / ||v|| via a forward-pre hook."""
+    from .layer import Parameter
+    w = getattr(layer, name)
+    arr = w._value
+    if dim is None:
+        norm = jnp.linalg.norm(arr)
+    else:
+        axes = tuple(i for i in range(arr.ndim) if i != dim)
+        norm = jnp.sqrt(jnp.sum(jnp.square(arr), axis=axes, keepdims=True))
+    g = Parameter(norm.reshape([arr.shape[dim] if dim is not None else 1]))
+    v = Parameter(arr)
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+
+    def hook(lyr, inputs):
+        vv = getattr(lyr, name + "_v")
+        gg = getattr(lyr, name + "_g")
+        if dim is None:
+            w_new = vv * (gg / jnp.linalg.norm(vv._value))
+        else:
+            axes2 = tuple(i for i in range(vv._value.ndim) if i != dim)
+            from ..autograd import apply_op
+            def f(vv_a, gg_a):
+                n = jnp.sqrt(jnp.sum(jnp.square(vv_a), axis=axes2, keepdims=True))
+                shape = [1] * vv_a.ndim
+                shape[dim] = vv_a.shape[dim]
+                return vv_a / n * gg_a.reshape(shape)
+            w_new = apply_op(f, vv, gg)
+        object.__setattr__(lyr, "_wn_cache", w_new)
+        lyr._parameters.pop(name, None)
+        lyr.__dict__[name] = w_new
+        return None
+
+    h = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_hook = h
+    layer._weight_norm_name = name
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    from .layer import Parameter
+    hook = getattr(layer, "_weight_norm_hook", None)
+    if hook is not None:
+        hook.remove()
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    dim_guess = 0
+    axes = tuple(i for i in range(v._value.ndim) if i != dim_guess)
+    n = jnp.sqrt(jnp.sum(jnp.square(v._value), axis=axes, keepdims=True))
+    shape = [1] * v._value.ndim
+    shape[dim_guess] = v._value.shape[dim_guess]
+    w = v._value / n * g._value.reshape(shape)
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    from .layers_norm import SpectralNorm
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(tuple(w.shape), dim=dim, power_iters=n_power_iterations,
+                      eps=eps)
+    layer.add_sublayer(name + "_sn", sn)
+    orig = layer._parameters[name]
+    del layer._parameters[name]
+    layer.add_parameter(name + "_orig", orig)
+
+    def hook(lyr, inputs):
+        w_new = sn(getattr(lyr, name + "_orig"))
+        lyr.__dict__[name] = w_new
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    return layer

@@ -1,0 +1,13 @@
+"""Custom TPU kernels (Pallas) + availability gates.
+
+The reference ships hand-written CUDA kernels for its hot ops
+(paddle/phi/kernels/gpu/flash_attn_*); here the equivalents are Pallas TPU
+kernels with jnp fallbacks so every op also runs on CPU (interpret mode) for
+tests.
+"""
+from __future__ import annotations
+
+import jax
+
+from .attention import flash_attention, flash_attention_available  # noqa: F401
+from .fused import fused_rms_norm, fused_softmax_cross_entropy  # noqa: F401

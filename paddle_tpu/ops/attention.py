@@ -1,0 +1,64 @@
+"""Flash attention for TPU.
+
+ref parity: paddle.nn.functional.flash_attention (CUDA flash-attn v2 in the
+reference). Here: a Pallas TPU kernel (ops/pallas/flash_attention.py) tiled
+for the MXU, with an XLA-fusable jnp fallback. The public entry keeps the
+reference's [batch, seq, heads, head_dim] layout.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_PALLAS_MIN_SEQ = 128
+_PALLAS_HEAD_DIMS = (64, 128, 256)
+
+
+def _platform():
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        return "cpu"
+
+
+def flash_attention_available(q_shape, k_shape, attn_mask, dropout_p) -> bool:
+    """Pallas kernel handles: TPU, no explicit mask, no dropout, seq multiple
+    of block, supported head dims."""
+    if attn_mask is not None or dropout_p:
+        return False
+    if _platform() != "tpu":
+        return False
+    if len(q_shape) != 4:
+        return False
+    b, sq, h, d = q_shape
+    sk = k_shape[1]
+    return (d in _PALLAS_HEAD_DIMS and sq % _PALLAS_MIN_SEQ == 0
+            and sk % _PALLAS_MIN_SEQ == 0)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None):
+    """[B, S, H, D] flash attention. Uses the Pallas kernel on TPU, jnp
+    reference otherwise."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if flash_attention_available(q.shape, k.shape, None, 0.0):
+        from .pallas.flash_attention import flash_attention_fwd
+        return flash_attention_fwd(q, k, v, causal=causal, sm_scale=sm_scale)
+    return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+def reference_attention(q, k, v, causal=False, sm_scale=None):
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    qh, kh, vh = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * sm_scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,19 @@
+"""Fused ops (XLA fuses these already; kept as named entry points so models
+and benchmarks can opt into Pallas variants when they land)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_rms_norm(x, weight, eps=1e-6):
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(ms + eps)).astype(x.dtype) * weight
+
+
+def fused_softmax_cross_entropy(logits, labels):
+    """Per-example CE over int labels without materialising log-probs twice."""
+    m = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logits.astype(jnp.float32), labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    return m - picked

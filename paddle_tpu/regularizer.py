@@ -1,0 +1,14 @@
+"""Regularizers (ref: python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+        self.coeff = coeff
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+        self.coeff = coeff

@@ -1,0 +1,81 @@
+"""paddle.save / paddle.load parity (ref: python/paddle/framework/io.py).
+
+State dicts are stored as an .npz (arrays) plus a pickled structure skeleton
+— same role as .pdparams. Nested dicts/lists, Tensors, scalars and LR
+scheduler states round-trip.
+"""
+from __future__ import annotations
+
+import io
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor
+
+_MAGIC = b"PTPU1\n"
+
+
+def _pack(obj, arrays, path=""):
+    if isinstance(obj, Tensor):
+        key = f"t{len(arrays)}"
+        arrays[key] = np.asarray(obj._value)
+        return {"__tensor__": key,
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, (np.ndarray, jnp.ndarray)):
+        key = f"t{len(arrays)}"
+        arrays[key] = np.asarray(obj)
+        return {"__ndarray__": key}
+    if isinstance(obj, dict):
+        return {"__dict__": {k: _pack(v, arrays) for k, v in obj.items()}}
+    if isinstance(obj, (list, tuple)):
+        return {"__seq__": [_pack(v, arrays) for v in obj],
+                "tuple": isinstance(obj, tuple)}
+    return {"__leaf__": obj}
+
+
+def _unpack(spec, arrays, return_numpy=False):
+    if "__tensor__" in spec:
+        arr = arrays[spec["__tensor__"]]
+        if return_numpy:
+            return arr
+        return Tensor(jnp.asarray(arr), stop_gradient=spec.get("stop_gradient", True))
+    if "__ndarray__" in spec:
+        return arrays[spec["__ndarray__"]]
+    if "__dict__" in spec:
+        return {k: _unpack(v, arrays, return_numpy)
+                for k, v in spec["__dict__"].items()}
+    if "__seq__" in spec:
+        seq = [_unpack(v, arrays, return_numpy) for v in spec["__seq__"]]
+        return tuple(seq) if spec.get("tuple") else seq
+    return spec["__leaf__"]
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    arrays = {}
+    spec = _pack(obj, arrays)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(pickle.dumps(spec, protocol=protocol))
+        f.write(b"\n__NPZ__\n")
+        f.write(buf.getvalue())
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        data = f.read()
+    if not data.startswith(_MAGIC):
+        raise ValueError(f"{path} is not a paddle_tpu checkpoint")
+    body = data[len(_MAGIC):]
+    sep = b"\n__NPZ__\n"
+    idx = body.index(sep)
+    spec = pickle.loads(body[:idx])
+    arrays = dict(np.load(io.BytesIO(body[idx + len(sep):]), allow_pickle=False))
+    return _unpack(spec, arrays, return_numpy=return_numpy)

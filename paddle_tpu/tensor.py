@@ -1,0 +1,368 @@
+"""`Tensor`: the user-facing array type.
+
+The reference's Tensor is an eager VarBase over device memory with a C++
+autograd tape (ref: paddle/fluid/eager/eager_tensor.h, python/paddle/tensor).
+Here a Tensor wraps a `jax.Array` (already asynchronous / device-resident),
+carries `stop_gradient` + `.grad` for eager-tape parity, and is registered as
+a pytree node so whole models/state-dicts flow through jit/grad/pjit
+transparently.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import framework
+from .autograd import apply_op, backward as _backward
+
+_tensor_method_registry = {}
+
+
+def register_tensor_method(name, fn=None):
+    """Attach `fn` as Tensor.<name> (used by the ops modules)."""
+    def deco(f):
+        setattr(Tensor, name, f)
+        _tensor_method_registry[name] = f
+        return f
+    return deco(fn) if fn is not None else deco
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "_grad_value", "_retain_grads",
+                 "_grad_node", "name", "__weakref__")
+    __array_priority__ = 100  # numpy defers binary ops to us
+
+    def __init__(self, value, stop_gradient: bool = True, name: str = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad_value = None
+        self._retain_grads = False
+        self._grad_node = None
+        self.name = name
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        try:
+            d = list(self._value.devices())[0]
+            return framework.Place(d.platform, d.id)
+        except Exception:
+            return framework.CPUPlace()
+
+    @property
+    def is_leaf(self):
+        return True
+
+    def numel(self):
+        return Tensor(jnp.asarray(self.size))
+
+    # -- host interop -------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *idx):
+        a = self._value
+        return a[idx].item() if idx else a.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._value
+
+    # -- grad ---------------------------------------------------------------
+    @property
+    def grad(self):
+        if self._grad_value is None:
+            return None
+        return Tensor(self._grad_value, stop_gradient=True)
+
+    @grad.setter
+    def grad(self, g):
+        self._grad_value = None if g is None else (
+            g._value if isinstance(g, Tensor) else jnp.asarray(g))
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        _backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad_value = None
+
+    clear_gradient = clear_grad
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def detach(self):
+        return Tensor(self._value, stop_gradient=True, name=self.name)
+
+    def detach_(self):
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return apply_op(lambda x: x + 0, self)
+
+    # -- dtype / device -----------------------------------------------------
+    def astype(self, dtype):
+        dt = framework.convert_dtype(dtype)
+        return apply_op(lambda x: x.astype(dt), self)
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        t = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (str, np.dtype)) and str(a) in \
+                    framework._DTYPE_ALIASES or isinstance(a, type):
+                t = t.astype(a)
+        return t
+
+    def cpu(self):
+        cpu = jax.devices("cpu")[0]
+        return Tensor(jax.device_put(self._value, cpu),
+                      stop_gradient=self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    # -- python protocol ----------------------------------------------------
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __index__(self):
+        return int(self._value)
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        grad_txt = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_txt},\n       {np.asarray(self._value)!r})")
+
+    def __format__(self, spec):
+        return format(self.item() if self.size == 1 else np.asarray(self._value), spec)
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        return apply_op(lambda x, i: x[i], self, idx)
+
+    def __setitem__(self, idx, value):
+        # In-place scatter; a stop-gradient barrier (ref allows grad through
+        # setitem, functional users should use put_along_axis / scatter).
+        if isinstance(value, Tensor):
+            value = value._value
+        idx = jax.tree_util.tree_map(
+            lambda x: x._value if isinstance(x, Tensor) else x, idx,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        self._value = self._value.at[idx].set(value)
+
+    # -- arithmetic operators (tape-aware via apply_op) ---------------------
+    def __add__(self, o):
+        return apply_op(jnp.add, self, o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return apply_op(jnp.subtract, self, o)
+
+    def __rsub__(self, o):
+        return apply_op(lambda x, y: jnp.subtract(y, x), self, o)
+
+    def __mul__(self, o):
+        return apply_op(jnp.multiply, self, o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return apply_op(jnp.true_divide, self, o)
+
+    def __rtruediv__(self, o):
+        return apply_op(lambda x, y: jnp.true_divide(y, x), self, o)
+
+    def __floordiv__(self, o):
+        return apply_op(jnp.floor_divide, self, o, differentiable=False)
+
+    def __rfloordiv__(self, o):
+        return apply_op(lambda x, y: jnp.floor_divide(y, x), self, o,
+                        differentiable=False)
+
+    def __mod__(self, o):
+        return apply_op(jnp.mod, self, o)
+
+    def __rmod__(self, o):
+        return apply_op(lambda x, y: jnp.mod(y, x), self, o)
+
+    def __pow__(self, o):
+        return apply_op(jnp.power, self, o)
+
+    def __rpow__(self, o):
+        return apply_op(lambda x, y: jnp.power(y, x), self, o)
+
+    def __matmul__(self, o):
+        return apply_op(jnp.matmul, self, o)
+
+    def __rmatmul__(self, o):
+        return apply_op(lambda x, y: jnp.matmul(y, x), self, o)
+
+    def __neg__(self):
+        return apply_op(jnp.negative, self)
+
+    def __abs__(self):
+        return apply_op(jnp.abs, self)
+
+    def __invert__(self):
+        return apply_op(jnp.logical_not, self, differentiable=False)
+
+    # comparisons (non-differentiable)
+    def __eq__(self, o):
+        return apply_op(jnp.equal, self, o, differentiable=False)
+
+    def __ne__(self, o):
+        return apply_op(jnp.not_equal, self, o, differentiable=False)
+
+    def __lt__(self, o):
+        return apply_op(jnp.less, self, o, differentiable=False)
+
+    def __le__(self, o):
+        return apply_op(jnp.less_equal, self, o, differentiable=False)
+
+    def __gt__(self, o):
+        return apply_op(jnp.greater, self, o, differentiable=False)
+
+    def __ge__(self, o):
+        return apply_op(jnp.greater_equal, self, o, differentiable=False)
+
+    def __and__(self, o):
+        return apply_op(jnp.logical_and, self, o, differentiable=False)
+
+    def __or__(self, o):
+        return apply_op(jnp.logical_or, self, o, differentiable=False)
+
+    def __xor__(self, o):
+        return apply_op(jnp.logical_xor, self, o, differentiable=False)
+
+    # -- in-place (eager convenience; rebinds the buffer) -------------------
+    def _inplace(self, new):
+        self._value = new._value if isinstance(new, Tensor) else jnp.asarray(new)
+        return self
+
+    def add_(self, o):
+        return self._inplace(self + o)
+
+    def subtract_(self, o):
+        return self._inplace(self - o)
+
+    def multiply_(self, o):
+        return self._inplace(self * o)
+
+    def scale_(self, s, bias=0.0):
+        return self._inplace(self * s + bias)
+
+    def zero_(self):
+        return self._inplace(jnp.zeros_like(self._value))
+
+    def fill_(self, v):
+        return self._inplace(jnp.full_like(self._value, v))
+
+    def copy_(self, src):
+        return self._inplace(src)
+
+    set_value = copy_
+
+    def get_tensor(self):
+        return self
+
+    def __deepcopy__(self, memo):
+        cls = type(self)
+        obj = cls.__new__(cls)
+        memo[id(self)] = obj
+        for klass in cls.__mro__:
+            for slot in getattr(klass, "__slots__", ()):
+                if slot == "__weakref__":
+                    continue
+                try:
+                    # jax arrays are immutable; share them
+                    object.__setattr__(obj, slot, getattr(self, slot))
+                except AttributeError:
+                    pass
+        return obj
+
+
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient,)
+
+
+def _tensor_unflatten(aux, children):
+    return Tensor(children[0], stop_gradient=aux[0])
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """ref: paddle.to_tensor. Python ints -> int64, floats -> default float
+    dtype (float32), matching the reference's promotion rules."""
+    if isinstance(data, Tensor):
+        t = data.astype(dtype) if dtype is not None else Tensor(data._value)
+        t.stop_gradient = stop_gradient
+        return t
+    dt = framework.convert_dtype(dtype)
+    if dt is None:
+        if isinstance(data, bool):
+            dt = np.dtype("bool")
+        elif isinstance(data, int):
+            dt = np.dtype("int64")
+        elif isinstance(data, float):
+            dt = framework.get_default_dtype()
+        elif isinstance(data, (list, tuple)):
+            probe = np.asarray(data)
+            if probe.dtype == np.float64:
+                dt = framework.get_default_dtype()
+            else:
+                dt = probe.dtype
+        elif isinstance(data, np.ndarray) and data.dtype == np.float64:
+            dt = data.dtype  # keep f64 for explicit numpy input
+    arr = jnp.asarray(data, dtype=dt)
+    return Tensor(arr, stop_gradient=stop_gradient)

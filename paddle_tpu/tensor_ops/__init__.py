@@ -1,0 +1,19 @@
+"""Functional tensor op surface (ref: python/paddle/tensor/*).
+
+Every public op both lives at paddle_tpu.<op> and is bound as a Tensor
+method where the reference has one. All ops dispatch through
+autograd.apply_op so the eager tape sees them; under jit they trace straight
+to jnp/lax.
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manip import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from . import linalg  # noqa: F401
+from .linalg import matmul, dot, t, bmm, dist  # noqa: F401
+from ._bind import bind_tensor_methods
+
+bind_tensor_methods()

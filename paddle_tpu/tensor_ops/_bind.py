@@ -1,0 +1,34 @@
+"""Bind the functional op surface onto Tensor as methods (ref: the
+monkey-patching in python/paddle/tensor/__init__.py: Tensor methods are the
+same kernels as the free functions)."""
+from __future__ import annotations
+
+from ..tensor import Tensor
+
+
+def bind_tensor_methods():
+    from . import creation, linalg, logic, manip, math, random, search, stat
+
+    def bind(mod, names):
+        for n in names:
+            fn = getattr(mod, n)
+            if not hasattr(Tensor, n):
+                setattr(Tensor, n, fn)
+
+    bind(math, [n for n in math.__all__ if n not in (
+        "einsum", "broadcast_shape", "log_normal")])
+    bind(manip, [n for n in manip.__all__ if n not in ("tolist",)])
+    bind(logic, [n for n in logic.__all__ if n not in ("is_tensor", "where")])
+    bind(stat, stat.__all__)
+    bind(search, ["argmax", "argmin"])
+    bind(linalg, ["matmul", "bmm", "dot", "norm", "dist", "t", "inv", "det",
+                  "cholesky", "matrix_power", "pinv", "cond"])
+    bind(creation, ["tril", "triu", "diag"])
+    bind(random, ["uniform_", "normal_", "exponential_"])
+
+    # mT / T properties
+    if not hasattr(Tensor, "T"):
+        Tensor.T = property(lambda self: manip.transpose(
+            self, list(reversed(range(self.ndim)))))
+    if not hasattr(Tensor, "mT"):
+        Tensor.mT = property(lambda self: manip.swapaxes(self, -1, -2))

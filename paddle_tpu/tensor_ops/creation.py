@@ -1,0 +1,163 @@
+"""Creation ops (ref: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..autograd import apply_op
+from ..tensor import Tensor, to_tensor  # noqa: F401
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
+    "tril_indices", "triu_indices", "complex", "polar",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    dt = framework.convert_dtype(dtype)
+    if dt is None:
+        dt = default or framework.get_default_dtype()
+    return dt
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None and isinstance(fill_value, bool):
+        dtype = "bool"
+    elif dtype is None and isinstance(fill_value, int):
+        dtype = "int64"
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply_op(lambda a: jnp.zeros_like(a, dtype=framework.convert_dtype(dtype)),
+                    x, differentiable=False)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply_op(lambda a: jnp.ones_like(a, dtype=framework.convert_dtype(dtype)),
+                    x, differentiable=False)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply_op(
+        lambda a: jnp.full_like(a, fill_value, dtype=framework.convert_dtype(dtype)),
+        x, differentiable=False)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, float):
+            dtype = dtype or framework.get_default_dtype()
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    dt = framework.convert_dtype(dtype) if dtype is not None else np.dtype("int64")
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(
+        float(start), float(stop), int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(
+        float(start), float(stop), int(num), base=float(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    if isinstance(x, (list, tuple, np.ndarray)):
+        x = to_tensor(x)
+    if padding_value != 0 and x.ndim == 1:
+        def g(a):
+            n = a.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, dtype=a.dtype)
+            idx = jnp.arange(a.shape[0])
+            r, c = (idx, idx + offset) if offset >= 0 else (idx - offset, idx)
+            return out.at[r, c].set(a)
+        return apply_op(g, x)
+    return apply_op(lambda a: jnp.diag(a, k=offset), x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col or row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=framework.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col or row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=framework.convert_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return apply_op(lambda *xs: jnp.meshgrid(*xs, indexing="ij"), *args)
+
+
+def assign(x, output=None):
+    t = apply_op(lambda a: jnp.asarray(a) + 0,
+                 x if isinstance(x, Tensor) else to_tensor(np.asarray(x)))
+    if output is not None:
+        output._inplace(t)
+        return output
+    return t
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return apply_op(lambda r, i: r + 1j * i, real, imag)
+
+
+def polar(abs_t, angle, name=None):
+    return apply_op(lambda a, th: a * jnp.exp(1j * th), abs_t, angle)

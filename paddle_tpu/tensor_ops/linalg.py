@@ -1,0 +1,254 @@
+"""Linear algebra (ref: python/paddle/tensor/linalg.py, paddle.linalg).
+
+Dense linalg lowers to jax.numpy.linalg / lax.linalg; on TPU the
+decompositions run via XLA's QR/SVD/eigh custom calls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import apply_op
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "matmul", "bmm", "dot", "t", "norm", "vector_norm", "matrix_norm",
+    "dist", "cond", "inv", "det", "slogdet", "svd", "svdvals", "qr", "eig",
+    "eigh", "eigvals", "eigvalsh", "cholesky", "cholesky_solve",
+    "cholesky_inverse", "lstsq", "lu", "lu_unpack", "matrix_power",
+    "matrix_rank", "pinv", "solve", "triangular_solve", "multi_dot",
+    "householder_product", "matrix_exp", "ormqr", "corrcoef_alias",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_op(f, _t(x), _t(y))
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, _t(x), _t(y))
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        if a.ndim == 1:
+            return jnp.dot(a, b)
+        return jnp.sum(a * b, axis=-1)
+    return apply_op(f, _t(x), _t(y))
+
+
+def t(x, name=None):
+    def f(a):
+        return a if a.ndim < 2 else a.T
+    return apply_op(f, _t(x))
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if axis is None and (p is None or p == "fro" or p == 2):
+            return jnp.sqrt(jnp.sum(jnp.square(a)))
+        pp = 2 if p is None else p
+        if isinstance(axis, (list, tuple)) and len(axis) == 2:
+            return jnp.linalg.norm(a, ord="fro" if pp in ("fro", None, 2) else pp,
+                                   axis=tuple(axis), keepdims=keepdim)
+        ax = axis if axis is None else int(axis)
+        if pp == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pp == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** pp, axis=ax, keepdims=keepdim) ** (1.0 / pp)
+    return apply_op(f, _t(x))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply_op(lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis),
+                                              keepdims=keepdim), _t(x))
+
+
+def dist(x, y, p=2, name=None):
+    return norm(x - y if isinstance(x, Tensor) else _t(x) - y, p=float(p))
+
+
+def cond(x, p=None, name=None):
+    return apply_op(lambda a: jnp.linalg.cond(a, p=p), _t(x))
+
+
+def inv(x, name=None):
+    return apply_op(jnp.linalg.inv, _t(x))
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, _t(x))
+
+
+def slogdet(x, name=None):
+    def f(a):
+        s, l = jnp.linalg.slogdet(a)
+        return jnp.stack([s, l])
+    return apply_op(f, _t(x))
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op(lambda a: jnp.linalg.svd(a, full_matrices=full_matrices), _t(x))
+
+
+def svdvals(x, name=None):
+    return apply_op(lambda a: jnp.linalg.svd(a, compute_uv=False), _t(x))
+
+
+def qr(x, mode="reduced", name=None):
+    return apply_op(lambda a: jnp.linalg.qr(a, mode=mode), _t(x))
+
+
+def eig(x, name=None):
+    import numpy as np
+    a = np.asarray(_t(x)._value)
+    w, v = np.linalg.eig(a)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    a = np.asarray(_t(x)._value)
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op(lambda a: jnp.linalg.eigh(a, UPLO=UPLO), _t(x))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), _t(x))
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply_op(f, _t(x))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return apply_op(f, _t(x), _t(y))
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    def f(l):
+        n = l.shape[-1]
+        eye = jnp.eye(n, dtype=l.dtype)
+        return jax.scipy.linalg.cho_solve((l, not upper), eye)
+    return apply_op(f, _t(x))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return apply_op(f, _t(x), _t(y))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32) + 1  # 1-based pivots like the reference
+    outs = apply_op(f, _t(x))
+    if get_infos:
+        info = Tensor(jnp.zeros((), dtype=jnp.int32))
+        return outs[0], outs[1], info
+    return outs
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    def f(lu_, piv):
+        n = lu_.shape[-2]
+        l = jnp.tril(lu_, -1) + jnp.eye(n, lu_.shape[-1], dtype=lu_.dtype)
+        u = jnp.triu(lu_)
+        perm = jnp.arange(n)
+        def body(i, p):
+            j = piv[i] - 1
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        pmat = jnp.eye(n, dtype=lu_.dtype)[perm].T
+        return pmat, l[..., :n, :builtins_min(lu_.shape[-2:])], u
+    import builtins
+    builtins_min = builtins.min
+    return apply_op(f, _t(lu_data), _t(lu_pivots))
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda a: jnp.linalg.matrix_power(a, int(n)), _t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(lambda a: jnp.linalg.matrix_rank(a, tol=tol),
+                    _t(x), differentiable=False)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), _t(x))
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, _t(x), _t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply_op(f, _t(x), _t(y))
+
+
+def multi_dot(x, name=None):
+    xs = [_t(v) for v in x]
+    return apply_op(lambda *arrs: jnp.linalg.multi_dot(arrs), *xs)
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) > i, a[:, i], 0.0).at[i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t_[i] * jnp.outer(v, v)
+            return q @ h
+        q = jax.lax.fori_loop(0, n, body, q)
+        return q[:, :n]
+    return apply_op(f, _t(x), _t(tau))
+
+
+def matrix_exp(x, name=None):
+    return apply_op(jax.scipy.linalg.expm, _t(x))
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    q = householder_product(x, tau)
+    def f(qm, o):
+        qq = jnp.swapaxes(qm, -1, -2) if transpose else qm
+        return qq @ o if left else o @ qq
+    return apply_op(f, q, _t(other))
+
+
+def corrcoef_alias(x, rowvar=True, name=None):
+    from .stat import corrcoef
+    return corrcoef(x, rowvar=rowvar)

@@ -1,0 +1,82 @@
+"""Comparison / logical ops (ref: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd import apply_op
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "equal_all", "allclose", "isclose", "logical_and",
+    "logical_or", "logical_xor", "logical_not", "is_empty", "is_tensor",
+    "where", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _cmp(fn):
+    def op(x, y, name=None):
+        return apply_op(fn, _t(x), y, differentiable=False)
+    return op
+
+
+equal = _cmp(jnp.equal)
+not_equal = _cmp(jnp.not_equal)
+less_than = _cmp(jnp.less)
+less_equal = _cmp(jnp.less_equal)
+greater_than = _cmp(jnp.greater)
+greater_equal = _cmp(jnp.greater_equal)
+logical_and = _cmp(jnp.logical_and)
+logical_or = _cmp(jnp.logical_or)
+logical_xor = _cmp(jnp.logical_xor)
+bitwise_and = _cmp(jnp.bitwise_and)
+bitwise_or = _cmp(jnp.bitwise_or)
+bitwise_xor = _cmp(jnp.bitwise_xor)
+bitwise_left_shift = _cmp(jnp.left_shift)
+bitwise_right_shift = _cmp(jnp.right_shift)
+
+
+def logical_not(x, name=None):
+    return apply_op(jnp.logical_not, _t(x), differentiable=False)
+
+
+def bitwise_not(x, name=None):
+    return apply_op(jnp.bitwise_not, _t(x), differentiable=False)
+
+
+def equal_all(x, y, name=None):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), _t(x), _t(y),
+                    differentiable=False)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _t(x), _t(y), differentiable=False)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _t(x), _t(y), differentiable=False)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .manip import nonzero
+        return nonzero(condition, as_tuple=True)
+    return apply_op(lambda c, a, b: jnp.where(c.astype(bool), a, b),
+                    _t(condition), x, y)

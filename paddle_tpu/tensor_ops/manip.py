@@ -1,0 +1,562 @@
+"""Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import apply_op
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "concat", "stack", "split", "chunk",
+    "squeeze", "unsqueeze", "flatten", "tile", "expand", "expand_as",
+    "broadcast_to", "broadcast_tensors", "gather", "gather_nd", "scatter",
+    "scatter_nd", "scatter_nd_add", "index_select", "index_sample",
+    "index_add", "index_put", "masked_select", "masked_fill",
+    "masked_scatter", "flip", "roll", "unbind", "repeat_interleave",
+    "take_along_axis", "put_along_axis", "slice", "strided_slice", "unique",
+    "unique_consecutive", "sort", "argsort", "topk", "searchsorted",
+    "bucketize", "nonzero", "rot90", "moveaxis", "swapaxes", "as_strided",
+    "view", "view_as", "unfold", "pad", "take", "tensordot", "tolist",
+    "crop", "shard_index", "unstack", "as_complex", "as_real", "atleast_1d",
+    "atleast_2d", "atleast_3d", "select_scatter", "diagonal",
+    "diagonal_scatter", "fill_diagonal_", "block_diag", "flatten_",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    s = _shape_arg(shape)
+    return apply_op(lambda a: jnp.reshape(a, s), _t(x))
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace(reshape(x, shape))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm=None, name=None):
+    return apply_op(lambda a: jnp.transpose(a, axes=perm), _t(x))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(lambda a: jnp.moveaxis(a, source, destination), _t(x))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, axis0, axis1), _t(x))
+
+
+def concat(x, axis=0, name=None):
+    xs = [_t(v) for v in x]
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(lambda *arrs: jnp.concatenate(arrs, axis=ax), *xs)
+
+
+def stack(x, axis=0, name=None):
+    xs = [_t(v) for v in x]
+    return apply_op(lambda *arrs: jnp.stack(arrs, axis=axis), *xs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    n = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if n % num_or_sections != 0:
+            raise ValueError(
+                f"split: dim {ax} size {n} not divisible by {num_or_sections}")
+        sizes = [n // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if any(s == -1 for s in sizes):
+            rest = n - sum(s for s in sizes if s != -1)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes)[:-1]
+    outs = []
+    for off, sz in zip(offsets, sizes):
+        outs.append(apply_op(
+            lambda a, off=int(off), sz=int(sz): jax.lax.slice_in_dim(a, off, off + sz, axis=ax),
+            x))
+    return outs
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    outs = split(x, x.shape[axis], axis)
+    return [squeeze(o, axis=axis) for o in outs]
+
+
+unstack = unbind
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply_op(f, _t(x))
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a.item()) if isinstance(a, Tensor) else int(a) for a in axes]
+    def f(a):
+        for ax in sorted(axes):
+            a = jnp.expand_dims(a, ax)
+        return a
+    return apply_op(f, _t(x))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply_op(f, _t(x))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._inplace(flatten(x, start_axis, stop_axis))
+
+
+def tile(x, repeat_times, name=None):
+    r = _shape_arg(repeat_times)
+    return apply_op(lambda a: jnp.tile(a, r), _t(x))
+
+
+def expand(x, shape, name=None):
+    s = _shape_arg(shape)
+    def f(a):
+        tgt = list(s)
+        # -1 keeps the original dim
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(tgt))
+    return apply_op(f, _t(x))
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    xs = [_t(v) for v in inputs]
+    return apply_op(lambda *arrs: jnp.broadcast_arrays(*arrs), *xs)
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_op(lambda a, i: jnp.take(a, i.reshape(-1), axis=ax), _t(x), _t(index))
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return apply_op(f, _t(x), _t(index))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        return a.at[i].add(u)
+    return apply_op(f, _t(x), _t(index), _t(updates))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, i, u):
+        return a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply_op(f, _t(x), _t(index), _t(updates))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    z = to_tensor(jnp.zeros(_shape_arg(shape),
+                            dtype=_t(updates)._value.dtype))
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op(lambda a, i: jnp.take(a, i.reshape(-1), axis=axis),
+                    _t(x), _t(index))
+
+
+def index_sample(x, index, name=None):
+    return apply_op(lambda a, i: jnp.take_along_axis(a, i, axis=1),
+                    _t(x), _t(index))
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v, axis, 0)
+        out = a_m.at[i.reshape(-1)].add(v_m)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op(f, _t(x), _t(index), _t(value))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(_t(i) for i in indices)
+    def f(a, v, *iarrs):
+        if accumulate:
+            return a.at[iarrs].add(v)
+        return a.at[iarrs].set(v)
+    return apply_op(f, _t(x), _t(value), *idx)
+
+
+def masked_select(x, mask, name=None):
+    return apply_op(lambda a, m: a[m.astype(bool)], _t(x), _t(mask))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value.item() if isinstance(value, Tensor) and value.size == 1 else value
+    if isinstance(v, Tensor):
+        return apply_op(lambda a, m, vv: jnp.where(m.astype(bool), vv, a),
+                        _t(x), _t(mask), v)
+    return apply_op(lambda a, m: jnp.where(m.astype(bool), v, a), _t(x), _t(mask))
+
+
+def masked_scatter(x, mask, value, name=None):
+    def f(a, m, v):
+        m = m.astype(bool)
+        mb = jnp.broadcast_to(m, a.shape)
+        cnt = jnp.cumsum(mb.reshape(-1)) - 1
+        vflat = v.reshape(-1)
+        return jnp.where(mb, vflat[jnp.clip(cnt, 0, vflat.shape[0] - 1)].reshape(a.shape), a)
+    return apply_op(f, _t(x), _t(mask), _t(value))
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op(lambda a: jnp.flip(a, axis=tuple(axes)), _t(x))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), _t(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op(lambda a: jnp.roll(a, shifts, axis=axis), _t(x))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return apply_op(
+            lambda a, r: jnp.repeat(a, r, axis=axis,
+                                    total_repeat_length=int(np.asarray(repeats._value).sum())),
+            _t(x), repeats)
+    return apply_op(lambda a: jnp.repeat(a, repeats, axis=axis), _t(x))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op(lambda a, i: jnp.take_along_axis(a, i, axis=axis),
+                    _t(arr), _t(indices))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape) if not hasattr(v, "shape") or v.shape != i.shape else v
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        mode = {"add": "add", "multiply": "multiply", "mul": "multiply",
+                "amax": "max", "amin": "min"}[reduce]
+        am = jnp.moveaxis(a, axis, -1)
+        im = jnp.moveaxis(i, axis, -1)
+        vm = jnp.moveaxis(jnp.broadcast_to(v, i.shape), axis, -1)
+        lead = am.shape[:-1]
+        gi = jnp.indices(lead + (im.shape[-1],), sparse=False)
+        idx = tuple(gi[k] for k in range(len(lead))) + (im,)
+        at = am.at[idx]
+        out = {"add": at.add, "multiply": at.multiply, "max": at.max,
+               "min": at.min}[mode](vm)
+        return jnp.moveaxis(out, -1, axis)
+    if not isinstance(values, Tensor):
+        values = to_tensor(values)
+    return apply_op(f, _t(arr), _t(indices), values)
+
+
+def take(x, index, mode="raise", name=None):
+    md = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return apply_op(lambda a, i: jnp.take(a.reshape(-1), i.reshape(-1) if i.ndim else i,
+                                          mode=md).reshape(i.shape),
+                    _t(x), _t(index))
+
+
+def slice(x, axes, starts, ends, name=None):
+    def f(a):
+        out = a
+        for ax, s, e in zip(axes, starts, ends):
+            s = int(s.item()) if isinstance(s, Tensor) else int(s)
+            e = int(e.item()) if isinstance(e, Tensor) else int(e)
+            n = out.shape[ax]
+            s = max(s + n, 0) if s < 0 else min(s, n)
+            e = max(e + n, 0) if e < 0 else min(e, n)
+            out = jax.lax.slice_in_dim(out, s, e, axis=ax)
+        return out
+    return apply_op(f, _t(x))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        idx = [jnp.s_[:]] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = jnp.s_[s:e:st]
+        return a[tuple(idx)]
+    return apply_op(f, _t(x))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = _shape_arg(shape)
+    off = [0] * len(s) if offsets is None else [
+        int(o.item()) if isinstance(o, Tensor) else int(o) for o in offsets]
+    def f(a):
+        sl = tuple(jnp.s_[o:o + (dim if dim != -1 else a.shape[i] - o)]
+                   for i, (o, dim) in enumerate(zip(off, s)))
+        return a[sl]
+    return apply_op(f, _t(x))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = np.asarray(_t(x)._value)
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    a = np.asarray(_t(x)._value)
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.concatenate([[True], a[1:] != a[:-1]])
+    else:
+        diff = (np.diff(a, axis=axis) != 0).any(
+            axis=tuple(i for i in range(a.ndim) if i != axis))
+        keep = np.concatenate([[True], diff])
+    vals = np.compress(keep, a, axis=axis or 0)
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, a.shape[axis or 0]))
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis, stable=stable)
+        return jnp.flip(s, axis=axis) if descending else s
+    return apply_op(f, _t(x))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        i = jnp.argsort(a, axis=axis, stable=stable)
+        return jnp.flip(i, axis=axis).astype(jnp.int64) if descending else i.astype(jnp.int64)
+    return apply_op(f, _t(x), differentiable=False)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    kk = int(k.item()) if isinstance(k, Tensor) else int(k)
+    def f(a):
+        ax = axis % a.ndim
+        am = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(am, kk)
+        else:
+            v, i = jax.lax.top_k(-am, kk)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i.astype(jnp.int64), -1, ax)
+    return apply_op(f, _t(x))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+    def f(seq, v):
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, v, side=side).astype(dt)
+        return jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+            seq.reshape(-1, seq.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape).astype(dt)
+    return apply_op(f, _t(sorted_sequence), _t(values), differentiable=False)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def nonzero(x, as_tuple=False):
+    a = np.asarray(_t(x)._value)
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    a = np.asarray(_t(x)._value)
+    out = np.lib.stride_tricks.as_strided(
+        a.reshape(-1)[offset:], shape=shape,
+        strides=[s * a.itemsize for s in stride])
+    return Tensor(jnp.asarray(out.copy()))
+
+
+def unfold(x, axis, size, step, name=None):
+    def f(a):
+        n = (a.shape[axis] - size) // step + 1
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        am = jnp.moveaxis(a, axis, 0)
+        out = am[idx]  # [n, size, ...rest]
+        out = jnp.moveaxis(out, 0, axis)
+        return jnp.moveaxis(out, axis + 1 if axis >= 0 else axis, -1)
+    return apply_op(f, _t(x))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    def f(a):
+        p = [int(v.item()) if isinstance(v, Tensor) else int(v) for v in pad]
+        nd = a.ndim
+        if len(p) == 2 * nd:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle convention: pad applies to the last len(p)//2 spatial dims
+            # in (left, right, top, bottom, front, back) order, innermost first
+            npairs = len(p) // 2
+            width = [(0, 0)] * (nd - npairs)
+            pairs = [(p[2 * i], p[2 * i + 1]) for i in range(npairs)]
+            width += list(reversed(pairs))
+        if mode == "constant":
+            return jnp.pad(a, width, mode="constant", constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        return jnp.pad(a, width, mode=jmode)
+    return apply_op(f, _t(x))
+
+
+def tensordot(x, y, axes=2, name=None):
+    def norm_axes(ax):
+        if isinstance(ax, Tensor):
+            ax = ax.tolist()
+        if isinstance(ax, (list, tuple)):
+            return tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in ax)
+        return ax
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=norm_axes(axes)),
+                    _t(x), _t(y))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(i):
+        size = index_num // nshards
+        lo, hi = shard_id * size, (shard_id + 1) * size
+        inside = (i >= lo) & (i < hi)
+        return jnp.where(inside, i - lo, ignore_value)
+    return apply_op(f, _t(input), differentiable=False)
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), _t(x))
+
+
+def as_real(x, name=None):
+    return apply_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), _t(x))
+
+
+def atleast_1d(*xs, name=None):
+    outs = [apply_op(jnp.atleast_1d, _t(x)) for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*xs, name=None):
+    outs = [apply_op(jnp.atleast_2d, _t(x)) for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*xs, name=None):
+    outs = [apply_op(jnp.atleast_3d, _t(x)) for x in xs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+                    _t(x))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, b):
+        n = builtins_min(a.shape[axis1], a.shape[axis2])
+        i = jnp.arange(n - builtins_abs(offset) if offset else n)
+        r = i if offset >= 0 else i - offset
+        c = i + offset if offset >= 0 else i
+        am = jnp.moveaxis(jnp.moveaxis(a, axis1, 0), axis2 if axis2 > axis1 else axis2 + 1, 1)
+        am = am.at[r, c].set(jnp.moveaxis(b, -1, 0))
+        return jnp.moveaxis(jnp.moveaxis(am, 1, axis2 if axis2 > axis1 else axis2 + 1), 0, axis1)
+    return apply_op(f, _t(x), _t(y))
+
+
+import builtins as _builtins
+builtins_min = _builtins.min
+builtins_abs = _builtins.abs
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        am = jnp.moveaxis(a, axis, 0)
+        am = am.at[index].set(v)
+        return jnp.moveaxis(am, 0, axis)
+    return apply_op(f, _t(x), _t(values))
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    n = builtins_min(x.shape[0], x.shape[1]) if x.ndim >= 2 else 0
+    i = jnp.arange(n - builtins_abs(offset) if offset else n)
+    r = i if offset >= 0 else i - offset
+    c = i + offset if offset >= 0 else i
+    x._value = x._value.at[r, c].set(value)
+    return x
+
+
+def block_diag(inputs, name=None):
+    xs = [_t(v) for v in inputs]
+    return apply_op(lambda *arrs: jax.scipy.linalg.block_diag(*arrs), *xs)
+
+
+def tolist(x):
+    return x.tolist()

@@ -1,0 +1,368 @@
+"""Elementwise & reduction math ops (ref: python/paddle/tensor/math.py)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import apply_op
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "float_power", "scale", "abs", "neg",
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "square", "reciprocal", "sign", "floor", "ceil", "round", "trunc",
+    "frac", "sin", "cos", "tan", "asin", "acos", "atan", "atan2", "sinh",
+    "cosh", "tanh", "asinh", "acosh", "atanh", "erf", "erfinv", "sigmoid",
+    "logit", "logaddexp", "clip", "maximum", "minimum", "fmax", "fmin",
+    "sum", "nansum", "mean", "nanmean", "prod", "max", "min", "amax",
+    "amin", "logsumexp", "cumsum", "cumprod", "cummax", "cummin",
+    "logcumsumexp", "isnan", "isinf", "isfinite", "isposinf", "isneginf",
+    "lerp", "addmm", "inner", "outer", "cross", "trace", "kron", "gcd",
+    "lcm", "diff", "angle", "conj", "real", "imag", "deg2rad", "rad2deg",
+    "heaviside", "nan_to_num", "ldexp", "frexp", "copysign", "hypot",
+    "einsum", "increment", "stanh", "softplus_raw",
+    "count_nonzero", "broadcast_shape", "cumulative_trapezoid", "trapezoid",
+    "vander", "i0", "i1", "sgn", "digamma", "lgamma",
+    "gammaln", "polygamma", "multigammaln", "sinc", "exp2", "log_normal",
+]
+
+
+def _u(fn, differentiable=True):
+    def op(x, name=None):
+        if not isinstance(x, Tensor):
+            x = to_tensor(x)
+        return apply_op(fn, x, differentiable=differentiable)
+    return op
+
+
+def _b(fn, differentiable=True):
+    def op(x, y, name=None):
+        if not isinstance(x, Tensor):
+            x = to_tensor(x)
+        return apply_op(fn, x, y, differentiable=differentiable)
+    return op
+
+
+add = _b(jnp.add)
+subtract = _b(jnp.subtract)
+multiply = _b(jnp.multiply)
+divide = _b(jnp.true_divide)
+floor_divide = _b(jnp.floor_divide, differentiable=False)
+remainder = _b(jnp.remainder)
+mod = remainder
+pow = _b(jnp.power)
+float_power = _b(lambda x, y: jnp.power(x.astype(jnp.float64), y))
+maximum = _b(jnp.maximum)
+minimum = _b(jnp.minimum)
+fmax = _b(jnp.fmax)
+fmin = _b(jnp.fmin)
+atan2 = _b(jnp.arctan2)
+logaddexp = _b(jnp.logaddexp)
+gcd = _b(jnp.gcd, differentiable=False)
+lcm = _b(jnp.lcm, differentiable=False)
+heaviside = _b(jnp.heaviside)
+copysign = _b(jnp.copysign)
+hypot = _b(jnp.hypot)
+ldexp = _b(lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)))
+kron = _b(jnp.kron)
+
+abs = _u(jnp.abs)
+neg = _u(jnp.negative)
+exp = _u(jnp.exp)
+exp2 = _u(jnp.exp2)
+expm1 = _u(jnp.expm1)
+log = _u(jnp.log)
+log2 = _u(jnp.log2)
+log10 = _u(jnp.log10)
+log1p = _u(jnp.log1p)
+sqrt = _u(jnp.sqrt)
+rsqrt = _u(lambda x: jax.lax.rsqrt(x))
+square = _u(jnp.square)
+reciprocal = _u(jnp.reciprocal)
+sign = _u(jnp.sign, differentiable=False)
+sgn = sign
+floor = _u(jnp.floor, differentiable=False)
+ceil = _u(jnp.ceil, differentiable=False)
+round = _u(jnp.round, differentiable=False)
+trunc = _u(jnp.trunc, differentiable=False)
+frac = _u(lambda x: x - jnp.trunc(x))
+sin = _u(jnp.sin)
+cos = _u(jnp.cos)
+tan = _u(jnp.tan)
+asin = _u(jnp.arcsin)
+acos = _u(jnp.arccos)
+atan = _u(jnp.arctan)
+sinh = _u(jnp.sinh)
+cosh = _u(jnp.cosh)
+tanh = _u(jnp.tanh)
+asinh = _u(jnp.arcsinh)
+acosh = _u(jnp.arccosh)
+atanh = _u(jnp.arctanh)
+erf = _u(jax.scipy.special.erf)
+erfinv = _u(jax.scipy.special.erfinv)
+sigmoid = _u(jax.nn.sigmoid)
+logit = _u(lambda x: jnp.log(x / (1 - x)))
+isnan = _u(jnp.isnan, differentiable=False)
+isinf = _u(jnp.isinf, differentiable=False)
+isfinite = _u(jnp.isfinite, differentiable=False)
+isposinf = _u(jnp.isposinf, differentiable=False)
+isneginf = _u(jnp.isneginf, differentiable=False)
+angle = _u(jnp.angle)
+conj = _u(jnp.conj)
+real = _u(jnp.real)
+imag = _u(jnp.imag)
+deg2rad = _u(jnp.deg2rad)
+rad2deg = _u(jnp.rad2deg)
+sinc = _u(jnp.sinc)
+i0 = _u(jax.scipy.special.i0)
+i1 = _u(jax.scipy.special.i1)
+digamma = _u(jax.scipy.special.digamma)
+lgamma = _u(jax.scipy.special.gammaln)
+gammaln = lgamma
+
+
+def polygamma(x, n, name=None):
+    return apply_op(lambda a: jax.scipy.special.polygamma(n, a), x)
+
+
+def multigammaln(x, p, name=None):
+    return apply_op(lambda a: jax.scipy.special.multigammaln(a, p), x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op(lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def softplus_raw(x):
+    return apply_op(jax.nn.softplus, x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    if bias_after_scale:
+        out = apply_op(lambda a: a * scale + bias, x)
+    else:
+        out = apply_op(lambda a: (a + bias) * scale, x)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    return x._inplace(x + value)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return apply_op(lambda a: jnp.clip(a, lo, hi), x)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..framework import convert_dtype
+    dt = convert_dtype(dtype)
+    return apply_op(
+        lambda a: jnp.sum(a, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..framework import convert_dtype
+    dt = convert_dtype(dtype)
+    return apply_op(
+        lambda a: jnp.nansum(a, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ..framework import convert_dtype
+    dt = convert_dtype(dtype)
+    return apply_op(
+        lambda a: jnp.prod(a, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+amax = max
+amin = min
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    from ..framework import convert_dtype
+    dt = convert_dtype(dtype)
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=dt)
+        return jnp.cumsum(a, axis=int(axis), dtype=dt)
+    return apply_op(f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    from ..framework import convert_dtype
+    dt = convert_dtype(dtype)
+    return apply_op(lambda a: jnp.cumprod(a, axis=dim, dtype=dt), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        aa = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else int(axis)
+        vals = jax.lax.associative_scan(jnp.maximum, aa, axis=ax)
+        n = aa.shape[ax]
+        shape = [1] * aa.ndim
+        shape[ax] = n
+        ar = jnp.arange(n).reshape(shape)
+        is_new = aa == vals
+        idx = jax.lax.associative_scan(
+            lambda p, c: jnp.where(c >= 0, jnp.maximum(p, c), p),
+            jnp.where(is_new, jnp.broadcast_to(ar, aa.shape), -1), axis=ax)
+        return vals, idx.astype(jnp.int64)
+    return apply_op(f, x, differentiable=False)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        aa = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else int(axis)
+        vals = jax.lax.associative_scan(jnp.minimum, aa, axis=ax)
+        n = aa.shape[ax]
+        shape = [1] * aa.ndim
+        shape[ax] = n
+        ar = jnp.arange(n).reshape(shape)
+        is_new = aa == vals
+        idx = jax.lax.associative_scan(
+            lambda p, c: jnp.where(c >= 0, jnp.maximum(p, c), p),
+            jnp.where(is_new, jnp.broadcast_to(ar, aa.shape), -1), axis=ax)
+        return vals, idx.astype(jnp.int64)
+    return apply_op(f, x, differentiable=False)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = int(axis)
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
+    return apply_op(f, x)
+
+
+def lerp(x, y, weight, name=None):
+    return apply_op(lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def inner(x, y, name=None):
+    return apply_op(jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return apply_op(lambda a, b: jnp.outer(a, b), x, y)
+
+
+def cross(x, y, axis=None, name=None):
+    ax = -1 if axis is None else int(axis)
+    return apply_op(lambda a, b: jnp.cross(a, b, axis=ax), x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    args = [a for a in (prepend, append) if a is not None]
+    def f(a, *extra):
+        kw = {}
+        i = 0
+        if prepend is not None:
+            kw["prepend"] = extra[i]; i += 1
+        if append is not None:
+            kw["append"] = extra[i]
+        return jnp.diff(a, n=n, axis=axis, **kw)
+    return apply_op(f, x, *args)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def frexp(x, name=None):
+    return apply_op(jnp.frexp, x, differentiable=False)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim).astype(jnp.int64),
+        x, differentiable=False)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply_op(lambda yy, xx: jnp.trapezoid(yy, x=xx, axis=axis), y, x)
+    return apply_op(lambda yy: jnp.trapezoid(yy, dx=dx or 1.0, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    import jax.scipy.integrate as jsi  # may lack cumulative; manual impl
+    def f(yy, *rest):
+        xx = rest[0] if rest else None
+        d = jnp.diff(xx, axis=axis) if xx is not None else (dx or 1.0)
+        y0 = jnp.take(yy, jnp.arange(0, yy.shape[axis] - 1), axis=axis)
+        y1 = jnp.take(yy, jnp.arange(1, yy.shape[axis]), axis=axis)
+        return jnp.cumsum((y0 + y1) / 2.0 * d, axis=axis)
+    return apply_op(f, y, *( [x] if x is not None else [] ))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op(lambda a: jnp.vander(a, N=n, increasing=increasing), x)
+
+
+def einsum(equation, *operands):
+    """ref: paddle.einsum."""
+    ops = [to_tensor(o) if not isinstance(o, Tensor) else o for o in operands]
+    return apply_op(lambda *arrs: jnp.einsum(equation, *arrs), *ops)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    from .random import gaussian
+    g = gaussian(shape or [1], mean=0.0, std=1.0)
+    return apply_op(lambda a: jnp.exp(mean + std * a), g)
+
+

@@ -1,0 +1,140 @@
+"""Random ops (ref: python/paddle/tensor/random.py).
+
+Eager calls draw keys from the global generator (framework.next_rng_key);
+inside an rng_scope (e.g. a traced train step) keys come from the scope so
+randomness is a pure function of the scope key.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..framework import convert_dtype, next_rng_key
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "normal", "standard_normal", "gaussian", "multinomial", "bernoulli",
+    "poisson", "exponential_", "uniform_", "normal_", "binomial",
+    "standard_gamma",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype):
+    d = convert_dtype(dtype)
+    return d if d is not None else framework.get_default_dtype()
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_rng_key(), _shape(shape), dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_rng_key(), _shape(shape), dtype=_dt(dtype)))
+
+
+standard_normal = randn
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = jax.random.PRNGKey(seed) if seed else next_rng_key()
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape), dtype=_dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(next_rng_key(), shp,
+                                                dtype=framework.get_default_dtype()))
+    return gaussian(shape or [1], mean, std)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else next_rng_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=_dt(dtype),
+                                     minval=float(min), maxval=float(max)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_rng_key(), _shape(shape),
+                                     int(low), int(high),
+                                     dtype=convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    dt = convert_dtype(dtype) or x.dtype
+    if high is None:
+        low, high = 0, low
+    out = jax.random.randint(next_rng_key(), tuple(x.shape), int(low), int(high),
+                             dtype=jnp.int64)
+    return Tensor(out.astype(dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_rng_key(), int(n)).astype(convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = next_rng_key()
+    def draw(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(key, logits, shape=(num_samples,) + p.shape[:-1]
+                                          ).T if p.ndim > 1 else \
+                jax.random.categorical(key, logits, shape=(num_samples,))
+        # without replacement: Gumbel top-k
+        g = jax.random.gumbel(key, p.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(draw(arr).astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    u = jax.random.uniform(next_rng_key(), arr.shape)
+    return Tensor((u < arr).astype(arr.dtype))
+
+
+def binomial(count, prob, name=None):
+    c = count._value if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._value if isinstance(prob, Tensor) else jnp.asarray(prob)
+    out = jax.random.binomial(next_rng_key(), c.astype(jnp.float32), p)
+    return Tensor(out.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(next_rng_key(), arr).astype(arr.dtype))
+
+
+def standard_gamma(x, name=None):
+    arr = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.gamma(next_rng_key(), arr).astype(arr.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.uniform(next_rng_key(), tuple(x.shape), dtype=x._value.dtype)
+    return x._inplace(Tensor(-jnp.log(1 - u) / lam))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    return x._inplace(uniform(x.shape, dtype=x.dtype, min=min, max=max, seed=seed))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    return x._inplace(gaussian(x.shape, mean, std, dtype=x.dtype))

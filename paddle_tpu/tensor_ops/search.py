@@ -1,0 +1,39 @@
+"""Search ops: argmax/argmin/argwhere (ref: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd import apply_op
+from ..framework import convert_dtype
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["argmax", "argmin", "argwhere"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+    def f(a):
+        if axis is None:
+            return jnp.argmax(a.reshape(-1)).astype(dt)
+        out = jnp.argmax(a, axis=int(axis)).astype(dt)
+        return jnp.expand_dims(out, int(axis)) if keepdim else out
+    return apply_op(f, _t(x), differentiable=False)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+    def f(a):
+        if axis is None:
+            return jnp.argmin(a.reshape(-1)).astype(dt)
+        out = jnp.argmin(a, axis=int(axis)).astype(dt)
+        return jnp.expand_dims(out, int(axis)) if keepdim else out
+    return apply_op(f, _t(x), differentiable=False)
+
+
+def argwhere(x, name=None):
+    from .manip import nonzero
+    return nonzero(x)

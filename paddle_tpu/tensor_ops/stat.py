@@ -1,0 +1,166 @@
+"""Statistics ops (ref: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import apply_op
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "std", "var", "median", "nanmedian", "quantile", "nanquantile",
+    "kthvalue", "mode", "histogram", "histogramdd", "bincount", "corrcoef",
+    "cov",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0,
+                          keepdims=keepdim), _t(x))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(
+        lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0,
+                          keepdims=keepdim), _t(x))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_axis(axis), keepdims=keepdim)
+        # 'min' mode: lower of the two middle values + its index
+        ax = _axis(axis)
+        if ax is None:
+            flat = a.reshape(-1)
+            n = flat.shape[0]
+            s = jnp.sort(flat)
+            v = s[(n - 1) // 2]
+            i = jnp.argsort(flat, stable=True)[(n - 1) // 2]
+            return (v, i.astype(jnp.int64))
+        n = a.shape[ax]
+        s = jnp.sort(a, axis=ax)
+        si = jnp.argsort(a, axis=ax)
+        v = jnp.take(s, (n - 1) // 2, axis=ax)
+        i = jnp.take(si, (n - 1) // 2, axis=ax)
+        if keepdim:
+            v = jnp.expand_dims(v, ax)
+            i = jnp.expand_dims(i, ax)
+        return (v, i.astype(jnp.int64))
+    return apply_op(f, _t(x), differentiable=(mode == "avg"))
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply_op(
+        lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim), _t(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = jnp.asarray(q, dtype=jnp.float64 if _t(x).dtype == jnp.float64 else jnp.float32)
+    return apply_op(
+        lambda a: jnp.quantile(a.astype(qq.dtype), qq, axis=_axis(axis),
+                               keepdims=keepdim, method=interpolation), _t(x))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = jnp.asarray(q, dtype=jnp.float32)
+    return apply_op(
+        lambda a: jnp.nanquantile(a.astype(jnp.float32), qq, axis=_axis(axis),
+                                  keepdims=keepdim, method=interpolation), _t(x))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        s = jnp.sort(a, axis=ax)
+        si = jnp.argsort(a, axis=ax)
+        v = jnp.take(s, k - 1, axis=ax)
+        i = jnp.take(si, k - 1, axis=ax)
+        if keepdim:
+            v = jnp.expand_dims(v, ax)
+            i = jnp.expand_dims(i, ax)
+        return v, i.astype(jnp.int64)
+    return apply_op(f, _t(x))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    a = np.asarray(_t(x)._value)
+    ax = axis % a.ndim
+    moved = np.moveaxis(a, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=a.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for r in range(flat.shape[0]):
+        u, c = np.unique(flat[r], return_counts=True)
+        best = u[np.argmax(c)]
+        vals[r] = best
+        idxs[r] = np.where(flat[r] == best)[0][-1]
+    shp = moved.shape[:-1]
+    v = vals.reshape(shp)
+    i = idxs.reshape(shp)
+    if keepdim:
+        v = np.expand_dims(v, ax)
+        i = np.expand_dims(i, ax)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(i))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    def f(a, *w):
+        lo, hi = float(min), float(max)
+        if lo == 0 and hi == 0:
+            lo, hi = jnp.min(a).astype(jnp.float32), jnp.max(a).astype(jnp.float32)
+        h, _ = jnp.histogram(a.astype(jnp.float32), bins=bins,
+                             range=(lo, hi),
+                             weights=w[0] if w else None, density=density)
+        return h if (density or w) else h.astype(jnp.int64)
+    args = [weight] if weight is not None else []
+    return apply_op(f, _t(input), *args, differentiable=False)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    a = np.asarray(_t(x)._value)
+    w = np.asarray(weights._value) if isinstance(weights, Tensor) else weights
+    h, edges = np.histogramdd(a, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = np.asarray(_t(x)._value)
+    length = max(int(a.max()) + 1 if a.size else 0, minlength)
+    def f(arr, *w):
+        return jnp.bincount(arr, weights=w[0] if w else None, length=length)
+    args = [weights] if weights is not None else []
+    out = apply_op(f, _t(x), *args, differentiable=False)
+    return out if weights is not None else out.astype("int64")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda a: jnp.corrcoef(a, rowvar=rowvar), _t(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def f(a, *rest):
+        fw = aw = None
+        i = 0
+        if fweights is not None:
+            fw = rest[i]; i += 1
+        if aweights is not None:
+            aw = rest[i]
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+    args = [w for w in (fweights, aweights) if w is not None]
+    return apply_op(f, _t(x), *args)

@@ -1,0 +1,141 @@
+"""Vision datasets (ref: python/paddle/vision/datasets/*).
+
+This environment has no network egress, so datasets parse local files when
+present (MNIST idx / CIFAR pickle formats, identical parsers to the
+reference) and otherwise fall back to a deterministic synthetic set with the
+same shapes/dtypes — enough for pipelines, tests and benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "SyntheticImageNet"]
+
+
+def _synthetic_images(n, shape, n_classes, seed):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, n_classes, size=n).astype(np.int64)
+    # class-dependent means so models can actually learn
+    imgs = (rng.rand(n, *shape) * 64 +
+            labels[:, None, None].reshape(n, *([1] * len(shape))) *
+            (192.0 / max(n_classes - 1, 1))).astype(np.uint8)
+    return imgs, labels
+
+
+class MNIST(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        images = labels = None
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                images = np.frombuffer(f.read(), dtype=np.uint8
+                                       ).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                labels = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+        if images is None:
+            n = 6000 if mode == "train" else 1000
+            images, labels = _synthetic_images(
+                n, (28, 28), 10, seed=0 if mode == "train" else 1)
+        self.images = images
+        self.labels = labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)[None] / 255.0
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.transform = transform
+        images = labels = None
+        if data_file and os.path.exists(data_file):
+            batches = ([f"data_batch_{i}" for i in range(1, 6)]
+                       if mode == "train" else ["test_batch"])
+            imgs, labs = [], []
+            with tarfile.open(data_file) as tf:
+                for m in tf.getmembers():
+                    base = os.path.basename(m.name)
+                    if base in batches:
+                        d = pickle.load(tf.extractfile(m), encoding="bytes")
+                        imgs.append(d[b"data"].reshape(-1, 3, 32, 32))
+                        labs.extend(d.get(b"labels", d.get(b"fine_labels")))
+            if imgs:
+                images = np.concatenate(imgs).transpose(0, 2, 3, 1)
+                labels = np.asarray(labs, dtype=np.int64)
+        if images is None:
+            n = 5000 if mode == "train" else 1000
+            images, labels = _synthetic_images(
+                n, (32, 32, 3), self.NUM_CLASSES,
+                seed=2 if mode == "train" else 3)
+        self.images = images
+        self.labels = labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class SyntheticImageNet(Dataset):
+    """Deterministic fake ImageNet for throughput benchmarking (the
+    reference benchmarks use DALI/file pipelines; perf here is bounded by
+    device compute, which is what bench.py measures)."""
+
+    def __init__(self, n=1280, image_size=224, num_classes=1000,
+                 transform=None, dtype=np.float32):
+        rng = np.random.RandomState(42)
+        self.labels = rng.randint(0, num_classes, size=n).astype(np.int64)
+        self.n = n
+        self.image_size = image_size
+        self.transform = transform
+        self.dtype = dtype
+        self._cache = (rng.rand(64, 3, image_size, image_size) * 2 - 1).astype(dtype)
+
+    def __getitem__(self, idx):
+        img = self._cache[idx % len(self._cache)]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return self.n

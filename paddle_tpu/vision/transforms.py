@@ -1,0 +1,283 @@
+"""Vision transforms (ref: python/paddle/vision/transforms/transforms.py).
+
+Numpy-based (host-side, feeds the DataLoader); HWC uint8 in, CHW float out
+via ToTensor, matching the reference's conventions.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["Compose", "ToTensor", "Resize", "RandomHorizontalFlip",
+           "RandomVerticalFlip", "Normalize", "Transpose", "CenterCrop",
+           "RandomCrop", "RandomResizedCrop", "Pad", "BrightnessTransform",
+           "ContrastTransform", "to_tensor", "normalize", "resize",
+           "hflip", "vflip", "center_crop", "crop", "pad"]
+
+
+def _size2(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+def resize(img, size, interpolation="bilinear"):
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = _size2(size)
+    ys = (np.arange(oh) + 0.5) * h / oh - 0.5
+    xs = (np.arange(ow) + 0.5) * w / ow - 0.5
+    if interpolation == "nearest":
+        yi = np.clip(np.round(ys).astype(int), 0, h - 1)
+        xi = np.clip(np.round(xs).astype(int), 0, w - 1)
+        return img[yi][:, xi]
+    y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :]
+    im = img.astype(np.float32)
+    if im.ndim == 2:
+        im = im[..., None]
+        squeeze = True
+    else:
+        squeeze = False
+    top = im[y0][:, x0] * (1 - wx[..., None]) + im[y0][:, x1] * wx[..., None]
+    bot = im[y1][:, x0] * (1 - wx[..., None]) + im[y1][:, x1] * wx[..., None]
+    out = top * (1 - wy[..., None]) + bot * wy[..., None]
+    if squeeze:
+        out = out[..., 0]
+    if img.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+def hflip(img):
+    return img[:, ::-1].copy()
+
+
+def vflip(img):
+    return img[::-1].copy()
+
+
+def crop(img, top, left, height, width):
+    return img[top:top + height, left:left + width].copy()
+
+
+def center_crop(img, output_size):
+    th, tw = _size2(output_size)
+    h, w = img.shape[:2]
+    i = max((h - th) // 2, 0)
+    j = max((w - tw) // 2, 0)
+    return crop(img, i, j, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    if isinstance(padding, int):
+        padding = (padding,) * 4
+    l, t, r, b = padding if len(padding) == 4 else \
+        (padding[0], padding[1], padding[0], padding[1])
+    width = [(t, b), (l, r)] + [(0, 0)] * (img.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(img, width, mode="constant", constant_values=fill)
+    mode = {"reflect": "reflect", "edge": "edge", "symmetric": "symmetric"}[padding_mode]
+    return np.pad(img, width, mode=mode)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        return (img - mean[:, None, None]) / std[:, None, None]
+    return (img - mean) / std
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = np.asarray(pic)
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    arr = arr.astype(np.float32)
+    if np.asarray(pic).dtype == np.uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return hflip(img)
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return vflip(img)
+        return img
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.transpose(self.order)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = _size2(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill, self.padding_mode)
+        th, tw = self.size
+        h, w = img.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            img = pad(img, (0, max(th - h, 0), 0, max(tw - w, 0)), self.fill,
+                      self.padding_mode)
+            h, w = img.shape[:2]
+        i = random.randint(0, max(h - th, 0))
+        j = random.randint(0, max(w - tw, 0))
+        return crop(img, i, j, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = _size2(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = random.randint(0, h - ch)
+                j = random.randint(0, w - cw)
+                return resize(crop(img, i, j, ch, cw), self.size,
+                              self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        out = img.astype(np.float32) * f
+        return np.clip(out, 0, 255).astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = img.mean()
+        out = (img.astype(np.float32) - mean) * f + mean
+        return np.clip(out, 0, 255).astype(img.dtype) if img.dtype == np.uint8 else out

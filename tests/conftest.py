@@ -1,0 +1,28 @@
+"""Test harness config: force a virtual 8-device CPU mesh.
+
+Distributed tests run on 8 virtual CPU devices
+(xla_force_host_platform_device_count) per SURVEY.md §4. The environment's
+sitecustomize registers a remote-TPU ("axon") PJRT backend whose lazy client
+connect can stall CPU-only test runs — deregister it before the first jax
+op so tests never touch the tunnel.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as xb  # noqa: E402
+
+# the axon register hook sets jax_platforms via config (overrides env)
+jax.config.update("jax_platforms", "cpu")
+for reg in ("_backend_factories", "backend_factories"):
+    d = getattr(xb, reg, None)
+    if isinstance(d, dict):
+        d.pop("axon", None)
+
+assert jax.devices()[0].platform == "cpu"
+assert jax.device_count() == 8, jax.devices()
