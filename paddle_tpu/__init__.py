@@ -26,6 +26,11 @@ from . import autograd  # noqa: F401
 # dtype alias matching `paddle.bool`
 bool = bool_  # noqa: A001
 
+
+def cast(x, dtype):
+    """ref: paddle.cast."""
+    return x.astype(dtype)
+
 __version__ = "0.1.0"
 
 

@@ -138,26 +138,41 @@ def apply_op(fn: Callable, *args, differentiable: bool = True, **kwargs):
 
 
 def _toposort(roots):
-    """Nodes reachable from roots' grad nodes, outputs-before-inputs."""
-    order, seen = [], set()
+    """Nodes reachable from roots' grad nodes, consumers-before-producers
+    (Kahn's algorithm on consumer->producer edges, so every node is
+    processed only after ALL its consumers contributed cotangents —
+    correct for diamond graphs like loss = a + f(a))."""
+    nodes = {}
     stack = []
     for r in roots:
-        n = getattr(r, "_grad_node", None)
-        if n is not None and id(n) not in seen:
-            stack.append((n, False))
-            seen.add(id(n))
+        node = getattr(r, "_grad_node", None)
+        if node is not None and id(node) not in nodes:
+            nodes[id(node)] = node
+            stack.append(node)
     while stack:
-        node, expanded = stack.pop()
-        if expanded:
-            order.append(node)
-            continue
-        stack.append((node, True))
+        node = stack.pop()
         for t in node.inputs:
             child = getattr(t, "_grad_node", None)
-            if child is not None and id(child) not in seen:
-                seen.add(id(child))
-                stack.append((child, False))
-    order.reverse()  # outputs first
+            if child is not None and id(child) not in nodes:
+                nodes[id(child)] = child
+                stack.append(child)
+    indeg = {nid: 0 for nid in nodes}
+    for node in nodes.values():
+        for t in node.inputs:
+            child = getattr(t, "_grad_node", None)
+            if child is not None and id(child) in nodes:
+                indeg[id(child)] += 1
+    order = []
+    ready = [n for nid, n in nodes.items() if indeg[nid] == 0]
+    while ready:
+        node = ready.pop()
+        order.append(node)
+        for t in node.inputs:
+            child = getattr(t, "_grad_node", None)
+            if child is not None and id(child) in nodes:
+                indeg[id(child)] -= 1
+                if indeg[id(child)] == 0:
+                    ready.append(child)
     return order
 
 
@@ -246,7 +261,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     for t, r in zip(inputs, retain):
         g = t._grad_value
         if g is None and not allow_unused:
-            g = jnp.zeros_like(t._value)
+            raise ValueError(
+                "paddle_tpu.grad: an input is not reachable from outputs; "
+                "pass allow_unused=True to get None for it instead")
         res.append(Tensor(g, stop_gradient=True) if g is not None else None)
         t._grad_value = keep[id(t)]
         t._retain_grads = r

@@ -168,6 +168,11 @@ class Engine:
          outs) = self._train_fn(self._params, self._buffers, self._opt_state,
                                 lr, jnp.int32(self._step), self._split_key(),
                                 in_arrs, lab_arrs)
+        # donation deleted the old param/buffer jax arrays: rebind the live
+        # Parameter tensors to the new ones so direct network access (eager
+        # forward, state_dict, .numpy()) stays valid mid-fit
+        if self.donate:
+            self.network.load_raw_state(self._params, self._buffers)
         return loss_v, outs
 
     def eval_batch(self, inputs, labels=()):

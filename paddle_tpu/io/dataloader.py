@@ -91,45 +91,49 @@ class DataLoader:
         from .native import NativePrefetcher
         depth = max(2, self.num_workers * self.prefetch_factor)
         native = NativePrefetcher.create(depth)
+        done = object()
+
+        def producer(put):
+            try:
+                for item in gen:
+                    put(item)
+                put(done)
+            except BaseException as e:  # propagate worker errors to consumer
+                put(_WorkerError(e))
+
         if native is not None:
-            done = object()
-
-            def producer():
-                try:
-                    for item in gen:
-                        native.put(item)
-                finally:
-                    native.put(done)
-
-            t = threading.Thread(target=producer, daemon=True)
+            t = threading.Thread(target=producer, args=(native.put,),
+                                 daemon=True)
             t.start()
             while True:
                 item = native.get()
                 if item is done:
                     break
+                if isinstance(item, _WorkerError):
+                    raise item.exc
                 yield _to_tensors(item)
             t.join()
             native.close()
             return
         # pure-python fallback
         q = _queue.Queue(maxsize=depth)
-        done = object()
-
-        def producer():
-            try:
-                for item in gen:
-                    q.put(item)
-            finally:
-                q.put(done)
-
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, args=(q.put,), daemon=True)
         t.start()
         while True:
             item = q.get()
             if item is done:
                 break
+            if isinstance(item, _WorkerError):
+                raise item.exc
             yield _to_tensors(item)
         t.join()
+
+
+class _WorkerError:
+    """Carries a worker exception across the prefetch queue."""
+
+    def __init__(self, exc):
+        self.exc = exc
 
 
 def _to_tensors(batch):

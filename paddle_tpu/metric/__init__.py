@@ -152,9 +152,9 @@ class Auc(Metric):
         tot_neg = self._stat_neg.sum()
         if not tot_pos or not tot_neg:
             return 0.0
-        # trapezoidal AUC over thresholds (descending)
-        pos = self._stat_pos[::-1].cumsum()
-        neg = self._stat_neg[::-1].cumsum()
+        # trapezoidal AUC over thresholds (descending), anchored at (0,0)
+        pos = np.concatenate([[0.0], self._stat_pos[::-1].cumsum()])
+        neg = np.concatenate([[0.0], self._stat_neg[::-1].cumsum()])
         tpr = pos / tot_pos
         fpr = neg / tot_neg
         return float(np.trapezoid(tpr, fpr))

@@ -233,13 +233,21 @@ class SpectralNorm(Layer):
         dim, eps, iters = self._dim, self._eps, self._power_iters
 
         def f(w, u, v):
+            import jax as _jax
             w_m = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
-            import jax
             for _ in range(iters):
-                v = w_m.T @ u
+                v = _jax.lax.stop_gradient(w_m).T @ u
                 v = v / (jnp.linalg.norm(v) + eps)
-                u = w_m @ v
+                u = _jax.lax.stop_gradient(w_m) @ v
                 u = u / (jnp.linalg.norm(u) + eps)
+            # u, v are constants of the grad (reference detaches them)
+            u = _jax.lax.stop_gradient(u)
+            v = _jax.lax.stop_gradient(v)
             sigma = u @ w_m @ v
-            return w / sigma
-        return apply_op(f, weight, u0, v0)
+            return w / sigma, u, v
+        out, u_new, v_new = apply_op(f, weight, u0, v0)
+        # persist the power-iteration state so sigma sharpens across steps
+        # (buffers: picked up by functional_call's mutable collection too)
+        u0._value = u_new.detach()._value
+        v0._value = v_new.detach()._value
+        return out

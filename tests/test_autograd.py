@@ -1,0 +1,127 @@
+"""Eager autograd graph semantics (SURVEY §2.1 autograd surface)."""
+import gc
+import weakref
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_and_accumulate():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 3
+    z = y * y  # dz/dx = 18x = 18
+    z.backward()
+    assert np.allclose(x.grad.numpy(), [18.0])
+    # second backward accumulates into .grad
+    z2 = (x * 2).sum()
+    z2.backward()
+    assert np.allclose(x.grad.numpy(), [20.0])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    d = (x * 5).detach()
+    out = (d * x).sum()
+    out.backward()
+    # only the direct x factor contributes: grad = d = 5
+    assert np.allclose(x.grad.numpy(), [5.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    out = (a * b).sum()  # 12x^2 -> d/dx = 24x = 48
+    out.backward()
+    assert np.allclose(x.grad.numpy(), [48.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x ** 3
+    (g,) = paddle.grad(y, x)
+    assert np.allclose(g.numpy(), [27.0])
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_matmul_grad():
+    a = paddle.to_tensor(np.eye(2, dtype="float32"), stop_gradient=False)
+    b = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    # d/dA sum(AB) = B^T summed over output = ones @ B^T
+    assert np.allclose(a.grad.numpy(), np.ones((2, 2)) @ b.numpy().T)
+
+
+def test_graph_freed_without_backward():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    out = (x * 2).sum()
+    ref = weakref.ref(out._grad_node)
+    del out
+    gc.collect()
+    assert ref() is None, "graph must be GC-freed when outputs are dropped"
+
+
+def test_backward_frees_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    out = (x * 2).sum()
+    out.backward()
+    assert out._grad_node is None  # severed after backward
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    out = (x * 2).sum()
+    out.backward(retain_graph=True)
+    x.clear_grad()
+    out.backward()
+    assert np.allclose(x.grad.numpy(), [2.0])
+
+
+def test_nondiff_int_inputs():
+    x = paddle.to_tensor([1, 2, 3])
+    y = x + 1  # int op: no graph
+    assert y._grad_node is None
+
+
+def test_diamond_graph_grad():
+    # loss = a + f(a): consumer ordering must be respected (regression)
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 2
+    b = paddle.exp(a)
+    (a + b).sum().backward()
+    expect = 2 + 2 * np.exp(4.0)
+    assert np.allclose(x.grad.numpy(), [expect], rtol=1e-5)
+    x2 = paddle.to_tensor([2.0], stop_gradient=False)
+    a2 = x2 * 2
+    (paddle.exp(a2) + a2).sum().backward()
+    assert np.allclose(x2.grad.numpy(), [expect], rtol=1e-5)
+
+
+def test_grad_unreachable_raises():
+    from paddle_tpu import nn
+    w = nn.Parameter(paddle.ones([2])._value)
+    loss = paddle.ones([2]).sum()
+    try:
+        paddle.grad(loss, w)
+        assert False, "expected ValueError"
+    except ValueError:
+        pass
+    (g,) = paddle.grad(loss, w, allow_unused=True)
+    assert g is None

@@ -1,0 +1,270 @@
+"""Layer behavior: shapes, modes, state_dict round trips (SURVEY §4)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def n(t):
+    return np.asarray(t.numpy())
+
+
+class TestLinear:
+    def test_forward_layout(self):
+        # reference layout: weight [in, out]
+        l = nn.Linear(4, 3)
+        assert l.weight.shape == [4, 3]
+        x = paddle.ones([2, 4])
+        out = l(x)
+        expect = np.ones((2, 4)) @ n(l.weight) + n(l.bias)
+        assert np.allclose(n(out), expect, rtol=1e-5)
+
+    def test_no_bias(self):
+        l = nn.Linear(4, 3, bias_attr=False)
+        assert l.bias is None
+        assert len(l.parameters()) == 1
+
+
+class TestConv:
+    def test_conv2d_shape_and_value(self):
+        c = nn.Conv2D(2, 4, 3, padding=1)
+        assert c.weight.shape == [4, 2, 3, 3]
+        x = paddle.randn([1, 2, 8, 8])
+        assert c(x).shape == [1, 4, 8, 8]
+        # identity kernel check
+        c2 = nn.Conv2D(1, 1, 1, bias_attr=False)
+        c2.weight._value = c2.weight._value * 0 + 1
+        xx = paddle.randn([1, 1, 5, 5])
+        assert np.allclose(n(c2(xx)), n(xx))
+
+    def test_stride_groups_dilation(self):
+        c = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
+        x = paddle.randn([2, 4, 16, 16])
+        assert c(x).shape == [2, 8, 8, 8]
+        c2 = nn.Conv2D(1, 1, 3, dilation=2)
+        assert c2(paddle.randn([1, 1, 9, 9])).shape == [1, 1, 5, 5]
+
+    def test_conv_transpose(self):
+        ct = nn.Conv2DTranspose(3, 2, 4, stride=2, padding=1)
+        x = paddle.randn([1, 3, 8, 8])
+        assert ct(x).shape == [1, 2, 16, 16]
+
+    def test_conv1d_3d(self):
+        assert nn.Conv1D(2, 3, 3, padding=1)(
+            paddle.randn([1, 2, 10])).shape == [1, 3, 10]
+        assert nn.Conv3D(1, 2, 3, padding=1)(
+            paddle.randn([1, 1, 4, 4, 4])).shape == [1, 2, 4, 4, 4]
+
+
+class TestNorm:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.randn([4, 3, 5, 5]) * 2 + 1
+        bn.train()
+        out = bn(x)
+        # normalized over N,H,W
+        assert abs(float(out.mean())) < 1e-5
+        assert 0.8 < float(out.std()) < 1.2
+        # running stats moved toward batch stats
+        assert not np.allclose(n(bn._mean), 0)
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [4, 3, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = paddle.randn([2, 4, 8]) * 3 + 5
+        out = n(ln(x))
+        assert np.allclose(out.mean(-1), 0, atol=1e-5)
+        assert np.allclose(out.std(-1), 1, atol=2e-2)
+
+    def test_groupnorm_instancenorm_rmsnorm(self):
+        assert nn.GroupNorm(2, 4)(paddle.randn([2, 4, 3, 3])).shape == [2, 4, 3, 3]
+        assert nn.InstanceNorm2D(3)(paddle.randn([2, 3, 4, 4])).shape == [2, 3, 4, 4]
+        rms = nn.RMSNorm(8)
+        out = rms(paddle.randn([2, 8]))
+        assert out.shape == [2, 8]
+
+
+class TestPoolingActivation:
+    def test_pools(self):
+        x = paddle.randn([1, 2, 8, 8])
+        assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AdaptiveAvgPool2D((1, 1))(x).shape == [1, 2, 1, 1]
+        # adaptive avg == mean
+        assert np.allclose(n(nn.AdaptiveAvgPool2D(1)(x))[0, 0, 0, 0],
+                           n(x)[0, 0].mean(), rtol=1e-5)
+
+    def test_activations(self):
+        x = paddle.to_tensor([-1.0, 0.0, 2.0])
+        assert n(nn.ReLU()(x)).tolist() == [0.0, 0.0, 2.0]
+        assert np.allclose(n(nn.Sigmoid()(x)), 1 / (1 + np.exp([1, 0, -2])),
+                           rtol=1e-5)
+        assert np.allclose(n(F.softmax(x)).sum(), 1.0, rtol=1e-6)
+        assert np.allclose(n(F.gelu(paddle.to_tensor([1.0]))), 0.8413, atol=1e-3)
+        assert n(F.relu6(paddle.to_tensor([8.0]))).tolist() == [6.0]
+
+
+class TestEmbeddingDropout:
+    def test_embedding(self):
+        e = nn.Embedding(10, 4, padding_idx=0)
+        idx = paddle.to_tensor([[1, 0, 2]])
+        out = e(idx)
+        assert out.shape == [1, 3, 4]
+        assert np.allclose(n(out)[0, 1], 0)  # padding idx -> zeros
+
+    def test_dropout_modes(self):
+        paddle.seed(0)
+        d = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        d.train()
+        t = n(d(x))
+        kept = t[t != 0]
+        assert np.allclose(kept, 2.0)  # upscale_in_train
+        d.eval()
+        assert np.allclose(n(d(x)), 1.0)
+
+
+class TestContainers:
+    def test_sequential_layerlist_dict(self):
+        s = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+        assert s(paddle.ones([1, 2])).shape == [1, 1]
+        assert len(s) == 3
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(list(ll.parameters())) == 6
+        ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+        assert "a" in ld
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Sequential(nn.Linear(3, 4), nn.BatchNorm1D(4, data_format="NCL"))
+        m2 = nn.Sequential(nn.Linear(3, 4), nn.BatchNorm1D(4, data_format="NCL"))
+        sd = m1.state_dict()
+        assert any("weight" in k for k in sd)
+        assert any("_mean" in k for k in sd)  # buffers included
+        m2.set_state_dict(sd)
+        for (k1, v1), (k2, v2) in zip(m1.state_dict().items(),
+                                      m2.state_dict().items()):
+            assert k1 == k2 and np.allclose(n(v1), n(v2))
+
+    def test_named_parameters_names(self):
+        m = nn.Sequential(nn.Linear(2, 2))
+        names = [k for k, _ in m.named_parameters()]
+        assert names == ["0.weight", "0.bias"]
+        # names assigned onto the params
+        assert m[0].weight.name == "0.weight"
+
+    def test_train_eval_recursive(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = paddle.to_tensor([[10.0, 0.0, 0.0], [0.0, 10.0, 0.0]])
+        labels = paddle.to_tensor([0, 1])
+        l = F.cross_entropy(logits, labels)
+        assert float(l) < 1e-3
+        # soft label
+        soft = paddle.to_tensor([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]])
+        l2 = F.cross_entropy(logits, soft, soft_label=True)
+        assert float(l2) < 1e-3
+        # ignore index
+        labels3 = paddle.to_tensor([0, -100])
+        l3 = F.cross_entropy(logits, labels3, ignore_index=-100)
+        assert float(l3) < 1e-3
+
+    def test_mse_l1_bce(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([2.0, 4.0])
+        assert float(F.mse_loss(a, b)) == 2.5
+        assert float(F.l1_loss(a, b)) == 1.5
+        p = paddle.to_tensor([0.5, 0.5])
+        y = paddle.to_tensor([1.0, 0.0])
+        assert np.allclose(float(F.binary_cross_entropy(p, y)),
+                           -np.log(0.5), rtol=1e-4)
+        z = paddle.to_tensor([0.0, 0.0])
+        assert np.allclose(float(F.binary_cross_entropy_with_logits(z, y)),
+                           -np.log(0.5), rtol=1e-4)
+
+    def test_kl_smooth_l1(self):
+        lp = F.log_softmax(paddle.to_tensor([[1.0, 2.0]]))
+        tgt = F.softmax(paddle.to_tensor([[1.0, 2.0]]))
+        assert abs(float(F.kl_div(lp, tgt))) < 1e-6
+        assert float(F.smooth_l1_loss(paddle.to_tensor([0.0]),
+                                      paddle.to_tensor([0.25]))) < 0.05
+
+
+class TestTransformer:
+    def test_mha_shapes(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 5, 16])
+        assert mha(x, x, x).shape == [2, 5, 16]
+
+    def test_encoder_decoder(self):
+        enc_l = nn.TransformerEncoderLayer(16, 4, 32)
+        enc = nn.TransformerEncoder(enc_l, 2)
+        src = paddle.randn([2, 6, 16])
+        mem = enc(src)
+        assert mem.shape == [2, 6, 16]
+        dec_l = nn.TransformerDecoderLayer(16, 4, 32)
+        dec = nn.TransformerDecoder(dec_l, 2)
+        tgt = paddle.randn([2, 3, 16])
+        assert dec(tgt, mem).shape == [2, 3, 16]
+
+    def test_causal_mask_effect(self):
+        # with causal sdp attention, output at position 0 ignores future
+        q = paddle.randn([1, 4, 2, 8])
+        out_causal = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        assert out_causal.shape == [1, 4, 2, 8]
+
+
+class TestRNN:
+    def test_lstm_gru_shapes(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = paddle.randn([4, 5, 8])
+        out, (h, c) = lstm(x)
+        assert out.shape == [4, 5, 16]
+        assert h.shape == [2, 4, 16] and c.shape == [2, 4, 16]
+        gru = nn.GRU(8, 16, direction="bidirect")
+        out2, h2 = gru(x)
+        assert out2.shape == [4, 5, 32]
+
+    def test_cells(self):
+        cell = nn.LSTMCell(4, 8)
+        h, (hh, cc) = cell(paddle.randn([2, 4]))
+        assert h.shape == [2, 8]
+
+
+class TestInitializers:
+    def test_initializers(self):
+        from paddle_tpu.nn import initializer as I
+        w = nn.Linear(100, 50,
+                      weight_attr=paddle.nn.ParamAttr(
+                          initializer=I.Constant(3.0))).weight
+        assert np.allclose(n(w), 3.0)
+        paddle.seed(1)
+        k = I.KaimingNormal()((1000,), np.float32)
+        assert 0.02 < float(np.asarray(k).std()) < 0.05
+        o = I.Orthogonal()((8, 8), np.float32)
+        assert np.allclose(np.asarray(o) @ np.asarray(o).T, np.eye(8),
+                           atol=1e-5)
+
+
+class TestClip:
+    def test_global_norm_clip(self):
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        grads = {"a": np.full((4,), 3.0, dtype="float32")}
+        out = clip.apply({k: paddle.to_tensor(v)._value
+                          for k, v in grads.items()})
+        assert np.allclose(np.linalg.norm(np.asarray(out["a"])), 1.0,
+                           rtol=1e-4)
+
+    def test_clip_value(self):
+        clip = nn.ClipGradByValue(0.5)
+        out = clip.apply({"a": paddle.to_tensor([2.0, -2.0])._value})
+        assert np.asarray(out["a"]).tolist() == [0.5, -0.5]

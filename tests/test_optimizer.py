@@ -1,0 +1,163 @@
+"""Optimizer numerics: hand-computed updates + convergence (SURVEY §4)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.optimizer import lr as lr_mod
+
+
+def quad_param(v=None):
+    p = nn.Parameter(paddle.to_tensor(v if v is not None else [2.0, -3.0])._value)
+    return p
+
+
+def test_sgd_exact():
+    w = quad_param()
+    opt = paddle.optimizer.SGD(0.1, parameters=[w])
+    ((w * w).sum()).backward()
+    opt.step()
+    assert np.allclose(w.numpy(), [1.6, -2.4])
+
+
+def test_momentum_exact():
+    w = quad_param([1.0])
+    opt = paddle.optimizer.Momentum(0.1, momentum=0.9, parameters=[w])
+    (w * w).sum().backward()
+    opt.step()            # v=2, w=1-0.2=0.8
+    opt.clear_grad()
+    assert np.allclose(w.numpy(), [0.8])
+    (w * w).sum().backward()
+    opt.step()            # v=0.9*2+1.6=3.4, w=0.8-0.34=0.46
+    assert np.allclose(w.numpy(), [0.46], atol=1e-6)
+
+
+def test_adam_exact_first_step():
+    w = quad_param([1.0])
+    opt = paddle.optimizer.Adam(0.001, parameters=[w])
+    (w * w).sum().backward()
+    opt.step()
+    # first Adam step magnitude ~ lr regardless of grad scale
+    assert np.allclose(w.numpy(), [1.0 - 0.001], atol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    w = quad_param([1.0])
+    opt = paddle.optimizer.AdamW(0.001, weight_decay=0.5, parameters=[w])
+    (w * 0).sum().backward()  # zero grad -> update is pure decay
+    opt.step()
+    assert np.allclose(w.numpy(), [1.0 - 0.001 * 0.5 * 1.0], atol=1e-6)
+
+
+def test_convergence_all():
+    for cls, kw, lr in [
+        (paddle.optimizer.SGD, {}, 0.1),
+        (paddle.optimizer.Momentum, {"momentum": 0.9}, 0.1),
+        (paddle.optimizer.Adam, {}, 0.1),
+        (paddle.optimizer.AdamW, {"weight_decay": 0.0}, 0.1),
+        (paddle.optimizer.RMSProp, {}, 0.1),
+        (paddle.optimizer.Adagrad, {}, 1.0),  # 1/sqrt(t) steps need big lr
+        (paddle.optimizer.Adamax, {}, 0.1),
+        (paddle.optimizer.Lamb, {"lamb_weight_decay": 0.0}, 0.1),
+    ]:
+        w = quad_param([5.0, -5.0])
+        opt = cls(lr, parameters=[w], **kw)
+        for _ in range(100):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float((w * w).sum()) < 0.3, f"{cls.__name__} did not converge"
+
+
+def test_grad_clip_in_optimizer():
+    w = quad_param([100.0])
+    opt = paddle.optimizer.SGD(
+        0.1, parameters=[w], grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    (w * w).sum().backward()
+    opt.step()
+    # grad 200 clipped to norm 1 -> step 0.1
+    assert np.allclose(w.numpy(), [99.9], atol=1e-4)
+
+
+def test_param_groups_lr_mult():
+    w1 = quad_param([1.0])
+    w2 = quad_param([1.0])
+    opt = paddle.optimizer.SGD(0.1, parameters=[
+        {"params": [w1]},
+        {"params": [w2], "learning_rate": 0.1},  # 10x smaller
+    ])
+    ((w1 * w1).sum() + (w2 * w2).sum()).backward()
+    opt.step()
+    assert np.allclose(w1.numpy(), [0.8])
+    assert np.allclose(w2.numpy(), [0.98])
+
+
+def test_state_dict_roundtrip():
+    w = quad_param([1.0])
+    opt = paddle.optimizer.Adam(0.01, parameters=[w])
+    for _ in range(3):
+        (w * w).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+    w2 = quad_param([float(w.numpy()[0])])
+    w2.name = w.name
+    opt2 = paddle.optimizer.Adam(0.01, parameters=[w2])
+    opt2.set_state_dict(sd)
+    (w * w).sum().backward()
+    opt.step()
+    (w2 * w2).sum().backward()
+    opt2.step()
+    assert np.allclose(w.numpy(), w2.numpy(), atol=1e-7)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = lr_mod.StepDecay(0.1, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(5):
+            vals.append(round(s(), 5))
+            s.step()
+        assert vals == [0.1, 0.1, 0.05, 0.05, 0.025]
+
+    def test_warmup(self):
+        s = lr_mod.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+        first = s()
+        for _ in range(6):
+            s.step()
+        assert first < 0.1 and abs(s() - 0.1) < 1e-9
+
+    def test_cosine(self):
+        s = lr_mod.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(s() - 1.0) < 1e-9
+        for _ in range(10):
+            s.step()
+        assert s() < 1e-6
+
+    def test_noam(self):
+        s = lr_mod.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+        vals = [s()]
+        for _ in range(20):
+            s.step()
+            vals.append(s())
+        peak = max(vals)
+        assert vals.index(peak) in (9, 10, 11)
+
+    def test_reduce_on_plateau(self):
+        s = lr_mod.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+        s.step(1.0)
+        s.step(1.0)
+        s.step(1.0)  # no improvement for > patience
+        assert s() == 0.05
+
+    def test_scheduler_in_optimizer(self):
+        w = quad_param([1.0])
+        sched = lr_mod.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = paddle.optimizer.SGD(sched, parameters=[w])
+        (w * w).sum().backward()
+        opt.step()        # lr=0.1: 1 - 0.1*2 = 0.8
+        opt.clear_grad()
+        sched.step()
+        (w * w).sum().backward()
+        opt.step()        # lr=0.01: 0.8 - 0.01*1.6
+        assert np.allclose(w.numpy(), [0.8 - 0.016], atol=1e-6)

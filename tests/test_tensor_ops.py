@@ -1,0 +1,249 @@
+"""Tensor op numerics vs numpy golden values (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def n(t):
+    return np.asarray(t.numpy())
+
+
+class TestCreation:
+    def test_to_tensor_dtypes(self):
+        assert paddle.to_tensor(1).dtype == np.int64
+        assert paddle.to_tensor(1.5).dtype == np.float32
+        assert paddle.to_tensor(True).dtype == np.bool_
+        assert paddle.to_tensor([1, 2]).dtype == np.int64
+        assert paddle.to_tensor(np.zeros(3)).dtype == np.float64
+
+    def test_creation_ops(self):
+        assert n(paddle.zeros([2, 3])).shape == (2, 3)
+        assert n(paddle.ones([2])).tolist() == [1, 1]
+        assert n(paddle.full([2], 7)).tolist() == [7, 7]
+        assert n(paddle.arange(5)).tolist() == [0, 1, 2, 3, 4]
+        assert np.allclose(n(paddle.linspace(0, 1, 5)), np.linspace(0, 1, 5))
+        assert np.allclose(n(paddle.eye(3)), np.eye(3))
+        assert np.allclose(n(paddle.diag(paddle.to_tensor([1., 2.]))),
+                           np.diag([1., 2.]))
+        assert np.allclose(n(paddle.tril(paddle.ones([3, 3]))),
+                           np.tril(np.ones((3, 3))))
+
+    def test_like_ops(self):
+        x = paddle.ones([2, 2])
+        assert n(paddle.zeros_like(x)).sum() == 0
+        assert n(paddle.full_like(x, 3)).sum() == 12
+
+
+class TestMath:
+    def setup_method(self, _):
+        self.a = np.random.RandomState(0).randn(3, 4).astype("float32")
+        self.b = np.abs(np.random.RandomState(1).randn(3, 4)
+                        ).astype("float32") + 0.5
+        self.ta = paddle.to_tensor(self.a)
+        self.tb = paddle.to_tensor(self.b)
+
+    def test_binary(self):
+        assert np.allclose(n(self.ta + self.tb), self.a + self.b)
+        assert np.allclose(n(self.ta - self.tb), self.a - self.b)
+        assert np.allclose(n(self.ta * self.tb), self.a * self.b)
+        assert np.allclose(n(self.ta / self.tb), self.a / self.b, rtol=1e-5)
+        assert np.allclose(n(paddle.maximum(self.ta, self.tb)),
+                           np.maximum(self.a, self.b))
+        assert np.allclose(n(paddle.pow(self.tb, 2.0)), self.b ** 2, rtol=1e-5)
+
+    def test_unary(self):
+        assert np.allclose(n(paddle.exp(self.ta)), np.exp(self.a), rtol=1e-5)
+        assert np.allclose(n(paddle.log(self.tb)), np.log(self.b), rtol=1e-5)
+        assert np.allclose(n(paddle.sqrt(self.tb)), np.sqrt(self.b), rtol=1e-5)
+        assert np.allclose(n(paddle.tanh(self.ta)), np.tanh(self.a), rtol=1e-5)
+        assert np.allclose(n(paddle.abs(self.ta)), np.abs(self.a))
+        assert np.allclose(n(paddle.floor(self.ta)), np.floor(self.a))
+        assert np.allclose(n(paddle.sign(self.ta)), np.sign(self.a))
+
+    def test_reductions(self):
+        assert np.allclose(n(paddle.sum(self.ta)), self.a.sum(), rtol=1e-5)
+        assert np.allclose(n(paddle.mean(self.ta, axis=1)),
+                           self.a.mean(1), rtol=1e-5)
+        assert np.allclose(n(paddle.max(self.ta, axis=0)), self.a.max(0))
+        assert np.allclose(n(paddle.prod(self.tb, axis=1, keepdim=True)),
+                           self.b.prod(1, keepdims=True), rtol=1e-4)
+        assert np.allclose(n(paddle.logsumexp(self.ta)),
+                           np.log(np.exp(self.a).sum()), rtol=1e-5)
+
+    def test_cumulative(self):
+        assert np.allclose(n(paddle.cumsum(self.ta, axis=1)),
+                           self.a.cumsum(1), rtol=1e-5)
+        v, i = paddle.cummax(paddle.to_tensor([1., 3., 2., 5., 4.]))
+        assert n(v).tolist() == [1., 3., 3., 5., 5.]
+        assert n(i).tolist() == [0, 1, 1, 3, 3]
+
+    def test_clip_lerp(self):
+        assert np.allclose(n(paddle.clip(self.ta, -0.5, 0.5)),
+                           np.clip(self.a, -0.5, 0.5))
+        x = paddle.to_tensor([0.0, 1.0])
+        y = paddle.to_tensor([10.0, 11.0])
+        assert n(paddle.lerp(x, y, 0.5)).tolist() == [5.0, 6.0]
+
+    def test_einsum(self):
+        out = paddle.einsum("ij,kj->ik", self.ta, self.tb)
+        assert np.allclose(n(out), self.a @ self.b.T, rtol=1e-4)
+
+
+class TestManip:
+    def setup_method(self, _):
+        self.a = np.arange(24, dtype="float32").reshape(2, 3, 4)
+        self.t = paddle.to_tensor(self.a)
+
+    def test_reshape_transpose(self):
+        assert n(paddle.reshape(self.t, [6, 4])).shape == (6, 4)
+        assert n(paddle.transpose(self.t, [2, 0, 1])).shape == (4, 2, 3)
+        assert n(paddle.flatten(self.t, 1)).shape == (2, 12)
+        assert n(self.t.T).shape == (4, 3, 2)
+
+    def test_concat_split_stack(self):
+        c = paddle.concat([self.t, self.t], axis=1)
+        assert n(c).shape == (2, 6, 4)
+        parts = paddle.split(c, 2, axis=1)
+        assert len(parts) == 2 and np.allclose(n(parts[0]), self.a)
+        s = paddle.stack([self.t, self.t], axis=0)
+        assert n(s).shape == (2, 2, 3, 4)
+        parts = paddle.split(self.t, [1, -1], axis=1)
+        assert n(parts[1]).shape == (2, 2, 4)
+
+    def test_squeeze_unsqueeze_tile(self):
+        u = paddle.unsqueeze(self.t, [0, 2])
+        assert n(u).shape == (1, 2, 1, 3, 4)
+        assert n(paddle.squeeze(u)).shape == (2, 3, 4)
+        assert n(paddle.tile(paddle.ones([2]), [3])).shape == (6,)
+        assert n(paddle.expand(paddle.ones([1, 2]), [3, 2])).shape == (3, 2)
+
+    def test_gather_scatter(self):
+        idx = paddle.to_tensor([0, 1, 1])
+        g = paddle.gather(self.t, idx, axis=1)
+        assert np.allclose(n(g), self.a[:, [0, 1, 1]])
+        x = paddle.zeros([4, 2])
+        upd = paddle.ones([2, 2])
+        out = paddle.scatter(x, paddle.to_tensor([1, 3]), upd)
+        assert n(out)[1].tolist() == [1, 1] and n(out)[0].tolist() == [0, 0]
+        tk = paddle.take_along_axis(
+            paddle.to_tensor([[1., 2., 3.]]), paddle.to_tensor([[2, 0]]), 1)
+        assert n(tk).tolist() == [[3., 1.]]
+
+    def test_sort_topk_search(self):
+        x = paddle.to_tensor([3., 1., 2.])
+        assert n(paddle.sort(x)).tolist() == [1., 2., 3.]
+        assert n(paddle.argsort(x)).tolist() == [1, 2, 0]
+        v, i = paddle.topk(x, 2)
+        assert n(v).tolist() == [3., 2.] and n(i).tolist() == [0, 2]
+        ss = paddle.searchsorted(paddle.to_tensor([1., 3., 5.]),
+                                 paddle.to_tensor([2., 5.]))
+        assert n(ss).tolist() == [1, 2]
+
+    def test_masked_flip_roll(self):
+        m = self.t > 11
+        sel = paddle.masked_select(self.t, m)
+        assert n(sel).tolist() == list(range(12, 24))
+        mf = paddle.masked_fill(self.t, m, -1.0)
+        assert n(mf).max() == 11
+        assert np.allclose(n(paddle.flip(self.t, [0])), self.a[::-1])
+        assert np.allclose(n(paddle.roll(self.t, 1, axis=0)),
+                           np.roll(self.a, 1, axis=0))
+
+    def test_unique_nonzero(self):
+        x = paddle.to_tensor([3, 1, 3, 2, 1])
+        u = paddle.unique(x)
+        assert n(u).tolist() == [1, 2, 3]
+        nz = paddle.nonzero(paddle.to_tensor([0, 5, 0, 7]))
+        assert n(nz).reshape(-1).tolist() == [1, 3]
+
+    def test_pad(self):
+        p = paddle.nn.functional.pad(paddle.ones([1, 1, 2, 2]), [1, 1, 0, 0])
+        assert n(p).shape == (1, 1, 2, 4)
+
+    def test_getitem(self):
+        assert np.allclose(n(self.t[0]), self.a[0])
+        assert np.allclose(n(self.t[:, 1:3]), self.a[:, 1:3])
+        assert np.allclose(n(self.t[..., -1]), self.a[..., -1])
+
+
+class TestLinalg:
+    def test_basic(self):
+        a = np.random.RandomState(0).randn(3, 3).astype("float64")
+        a = a @ a.T + 3 * np.eye(3)  # SPD
+        t = paddle.to_tensor(a)
+        assert np.allclose(n(paddle.linalg.inv(t)) @ a, np.eye(3), atol=1e-8)
+        assert np.allclose(n(paddle.linalg.det(t)), np.linalg.det(a))
+        l = paddle.linalg.cholesky(t)
+        assert np.allclose(n(l) @ n(l).T, a, atol=1e-8)
+        w = paddle.linalg.eigvalsh(t)
+        assert np.allclose(np.sort(n(w)), np.sort(np.linalg.eigvalsh(a)))
+        u, s, vt = paddle.linalg.svd(t)
+        assert np.allclose(n(u) * n(s) @ n(vt), a, atol=1e-8)
+        sol = paddle.linalg.solve(t, paddle.ones([3]))
+        assert np.allclose(a @ n(sol), np.ones(3), atol=1e-8)
+
+    def test_norm_matmul(self):
+        a = np.random.RandomState(0).randn(4, 3).astype("float32")
+        t = paddle.to_tensor(a)
+        assert np.allclose(n(paddle.linalg.norm(t)),
+                           np.linalg.norm(a), rtol=1e-5)
+        assert np.allclose(
+            n(paddle.matmul(t, t, transpose_x=True)), a.T @ a, rtol=1e-4)
+
+
+class TestLogicStat:
+    def test_compare(self):
+        x = paddle.to_tensor([1, 2, 3])
+        y = paddle.to_tensor([2, 2, 2])
+        assert n(paddle.equal(x, y)).tolist() == [False, True, False]
+        assert n(paddle.less_than(x, y)).tolist() == [True, False, False]
+        assert bool(paddle.allclose(x.astype("float32"),
+                                    x.astype("float32")))
+        w = paddle.where(x > 2, x, y)
+        assert n(w).tolist() == [2, 2, 3]
+
+    def test_stats(self):
+        a = np.random.RandomState(0).randn(5, 6).astype("float32")
+        t = paddle.to_tensor(a)
+        assert np.allclose(n(paddle.std(t)), a.std(ddof=1), rtol=1e-5)
+        assert np.allclose(n(paddle.var(t, axis=0)),
+                           a.var(0, ddof=1), rtol=1e-5)
+        assert np.allclose(n(paddle.median(t)), np.median(a))
+        assert n(paddle.bincount(paddle.to_tensor([0, 1, 1, 3]))).tolist() \
+            == [1, 2, 0, 1]
+        h = paddle.histogram(t, bins=4, min=-2, max=2)
+        assert int(n(h).sum()) <= a.size
+
+    def test_argmax(self):
+        x = paddle.to_tensor([[1., 5., 3.], [9., 2., 4.]])
+        assert n(paddle.argmax(x, axis=1)).tolist() == [1, 0]
+        assert int(paddle.argmax(x)) == 3
+        assert n(paddle.argmin(x, axis=0)).tolist() == [0, 1, 0]
+
+
+class TestRandom:
+    def test_seeded_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4]).numpy()
+        assert np.allclose(a, b)
+
+    def test_shapes_ranges(self):
+        r = paddle.rand([100])
+        assert n(r).min() >= 0 and n(r).max() < 1
+        ri = paddle.randint(0, 5, [100])
+        assert n(ri).min() >= 0 and n(ri).max() < 5
+        perm = paddle.randperm(10)
+        assert sorted(n(perm).tolist()) == list(range(10))
+        b = paddle.bernoulli(paddle.full([1000], 0.3))
+        assert 0.1 < n(b).mean() < 0.5
+
+
+class TestDtype:
+    def test_astype_cast(self):
+        x = paddle.to_tensor([1.7, 2.3])
+        assert x.astype("int32").dtype == np.int32
+        assert paddle.cast(x, "float64").dtype == np.float64
+        assert x.astype(paddle.bfloat16).dtype.name == "bfloat16"
