@@ -12,6 +12,19 @@ from .base import (  # noqa: F401
 from .base import _fleet_singleton as fleet_obj
 from ..mesh import get_mesh, set_mesh  # noqa: F401
 from . import utils  # noqa: F401
+from . import mpu  # noqa: F401
+from .mpu import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, shard_model, param_specs, get_rng_state_tracker,
+)
+
+# reference exposes these under fleet.meta_parallel
+class meta_parallel:
+    ColumnParallelLinear = ColumnParallelLinear
+    RowParallelLinear = RowParallelLinear
+    VocabParallelEmbedding = VocabParallelEmbedding
+    ParallelCrossEntropy = ParallelCrossEntropy
+    get_rng_state_tracker = staticmethod(get_rng_state_tracker)
 
 init = fleet_obj.init
 is_first_worker = fleet_obj.is_first_worker

@@ -1,0 +1,291 @@
+"""Tensor-parallel (model-parallel) layers.
+
+ref parity: python/paddle/distributed/fleet/layers/mpu/mp_layers.py
+(ColumnParallelLinear / RowParallelLinear / VocabParallelEmbedding) and
+mp_ops.py (ParallelCrossEntropy). The reference shards weights across an
+NCCL mp group and calls c_allreduce/c_concat by hand.
+
+TPU-native design — the same layer works in BOTH partitioning regimes:
+
+- **GSPMD (primary)**: the layer holds the full logical weight whose
+  Parameter carries a `sharding_spec` over the `mp` mesh axis.
+  `shard_model(model, mesh)` places the weights; inside `jit` the matmul is
+  partitioned by XLA, which inserts the all-reduce / all-gather over ICI
+  itself (the compiler plays the role of the reference's hand-written
+  c_ops). Activations are pinned with `with_sharding_constraint` so the
+  compiler cannot undo the intended layout.
+- **shard_map (explicit)**: when the surrounding program entered
+  `shard_map` over the mp axis (pipeline stages, custom kernels), each
+  device sees the *local* weight shard; the layers then emit `lax.psum`
+  exactly where the reference emits c_allreduce.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...nn import functional as F
+from ...nn.initializer import XavierUniform, Normal
+from ...nn.layer import Layer
+from ...tensor import Tensor
+from ...autograd import apply_op
+from ..mesh import get_mesh, set_mesh
+
+
+def axis_bound(name: str) -> bool:
+    """True iff `name` is a bound mesh axis here (i.e. we are inside a
+    shard_map/pmap program over that axis)."""
+    try:
+        jax.lax.axis_index(name)
+        return True
+    except (NameError, Exception):
+        return False
+
+
+def _mesh_has(axis: str) -> bool:
+    try:
+        return axis in get_mesh().axis_names
+    except Exception:
+        return False
+
+
+def annotate(x, *spec):
+    """with_sharding_constraint against the global mesh (no-op when the
+    axis isn't in the mesh or we're inside shard_map)."""
+    names = [s for s in spec if s is not None]
+    if names and not all(_mesh_has(s) for s in names):
+        return x
+    if any(axis_bound(s) for s in names):
+        return x  # inside shard_map: arrays are already local shards
+    try:
+        sharding = NamedSharding(get_mesh(), P(*spec))
+    except Exception:
+        return x
+    if isinstance(x, Tensor):
+        return apply_op(
+            lambda a: jax.lax.with_sharding_constraint(a, sharding), x)
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
+def shard_model(model: Layer, mesh=None):
+    """Place every parameter on `mesh` per its `sharding_spec` (replicated
+    when unset). The GSPMD analogue of fleet.distributed_model()."""
+    mesh = mesh or get_mesh()
+    set_mesh(mesh)
+    for _, p in model.named_parameters():
+        spec = getattr(p, "sharding_spec", None) or P()
+        spec = P(*[s if (s is None or s in mesh.axis_names) else None
+                   for s in spec])
+        p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+    for _, b in model.named_buffers():
+        b._value = jax.device_put(b._value, NamedSharding(mesh, P()))
+    return model
+
+
+def param_specs(model: Layer):
+    """name -> PartitionSpec pytree for Engine/pjit in_shardings."""
+    return {n: (getattr(p, "sharding_spec", None) or P())
+            for n, p in model.named_parameters()}
+
+
+class ColumnParallelLinear(Layer):
+    """Linear whose OUTPUT dim is split over the mp axis.
+
+    ref: fleet/layers/mpu/mp_layers.py ColumnParallelLinear — weight
+    [in, out/mp] per rank, optional all-gather of the output. Here the
+    logical weight is [in, out] with spec P(None, 'mp').
+    """
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None, mp_axis="mp"):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.mp_axis = mp_axis
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=None if weight_attr else XavierUniform())
+        self.weight.sharding_spec = P(None, mp_axis)
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias.sharding_spec = P(mp_axis)
+        else:
+            self.bias = None
+            self._parameters["bias"] = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if axis_bound(self.mp_axis):
+            if self.gather_output:
+                y = apply_op(lambda a: jax.lax.all_gather(
+                    a, self.mp_axis, axis=a.ndim - 1, tiled=True), y)
+            return y
+        if self.gather_output:
+            return annotate(y, *([None] * (len(y.shape) - 1)), None)
+        return annotate(y, *([None] * (len(y.shape) - 1)), self.mp_axis)
+
+
+class RowParallelLinear(Layer):
+    """Linear whose INPUT dim is split over the mp axis; output needs a
+    sum-reduce across mp (ref: RowParallelLinear's c_allreduce_sum — GSPMD
+    derives the same psum from the contraction over a sharded dim)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None,
+                 mp_axis="mp"):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.mp_axis = mp_axis
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=None if weight_attr else XavierUniform())
+        self.weight.sharding_spec = P(mp_axis, None)
+        if has_bias:
+            # bias is added AFTER the reduce -> replicated
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+        else:
+            self.bias = None
+            self._parameters["bias"] = None
+
+    def forward(self, x):
+        if axis_bound(self.mp_axis):
+            y = F.linear(x, self.weight, None)
+            y = apply_op(lambda a: jax.lax.psum(a, self.mp_axis), y)
+            if self.bias is not None:
+                y = y + self.bias
+            return y
+        if not self.input_is_parallel:
+            x = annotate(x, *([None] * (len(x.shape) - 1)), self.mp_axis)
+        y = F.linear(x, self.weight, self.bias)
+        return annotate(y, *([None] * (len(y.shape) - 1)), None)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim split over mp.
+
+    ref: VocabParallelEmbedding masks out-of-range ids, gathers locally and
+    all-reduces. GSPMD: gather from a vocab-sharded table lowers to the
+    same collective pattern automatically.
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None, mp_axis="mp"):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.mp_axis = mp_axis
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=None if weight_attr else Normal(0.0, 0.02))
+        self.weight.sharding_spec = P(mp_axis, None)
+
+    def forward(self, x):
+        if axis_bound(self.mp_axis):
+            # explicit Megatron-style local gather + psum
+            def local_embed(ids, w):
+                size = jax.lax.psum(1, self.mp_axis)
+                rank = jax.lax.axis_index(self.mp_axis)
+                per = self._num_embeddings // size
+                start = rank * per
+                local = ids - start
+                ok = (local >= 0) & (local < per)
+                safe = jnp.clip(local, 0, per - 1)
+                out = w[safe]
+                out = jnp.where(ok[..., None], out, 0.0)
+                return jax.lax.psum(out, self.mp_axis)
+            return apply_op(local_embed, x, self.weight)
+        out = F.embedding(x, self.weight)
+        return annotate(out, *([None] * (len(out.shape) - 1)), None)
+
+
+def parallel_matmul(x, weight, transpose_y=False, mp_axis="mp"):
+    """Logits projection against a vocab-parallel table (lm head weight
+    tying). ref: fleet.layers.mpu.mp_ops._c_lookup/_Linear paths."""
+    def fn(a, w):
+        wt = w.T if transpose_y else w
+        return jnp.matmul(a, wt)
+    y = apply_op(fn, x, weight)
+    if axis_bound(mp_axis):
+        return apply_op(lambda a: jax.lax.all_gather(
+            a, mp_axis, axis=a.ndim - 1, tiled=True), y)
+    return annotate(y, *([None] * (len(y.shape) - 1)), mp_axis)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax CE over mp-sharded logits.
+
+    ref: mp_ops.ParallelCrossEntropy (c_softmax_with_cross_entropy): local
+    max -> pmax, local sum-exp -> psum, local target logit -> psum. Under
+    GSPMD the plain stable CE compiles to the same pattern, so the explicit
+    path is only taken inside shard_map.
+    """
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100,
+                 mp_axis="mp"):
+        super().__init__()
+        self.mp_axis = mp_axis
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, label):
+        ax = self.mp_axis
+        ii = self.ignore_index
+
+        if axis_bound(ax):
+            def ce(lg, lb):
+                size = jax.lax.psum(1, ax)
+                rank = jax.lax.axis_index(ax)
+                v_local = lg.shape[-1]
+                start = rank * v_local
+                m = jax.lax.pmax(jnp.max(lg, axis=-1), ax)
+                z = jax.lax.psum(
+                    jnp.sum(jnp.exp(lg - m[..., None]), axis=-1), ax)
+                local = lb - start
+                ok = (local >= 0) & (local < v_local)
+                safe = jnp.clip(local, 0, v_local - 1)
+                tgt = jnp.take_along_axis(lg, safe[..., None], axis=-1)[..., 0]
+                tgt = jnp.where(ok, tgt - m, 0.0)
+                tgt = jax.lax.psum(tgt, ax)
+                loss = jnp.log(z) - tgt
+                return jnp.where(lb == ii, 0.0, loss)
+            return apply_op(ce, logits, label)
+
+        def ce_full(lg, lb):
+            lg32 = lg.astype(jnp.float32)
+            m = jnp.max(lg32, axis=-1, keepdims=True)
+            lse = jnp.log(jnp.sum(jnp.exp(lg32 - m), axis=-1)) + m[..., 0]
+            safe = jnp.clip(lb, 0, lg.shape[-1] - 1)
+            tgt = jnp.take_along_axis(
+                lg32, safe[..., None], axis=-1)[..., 0]
+            loss = lse - tgt
+            return jnp.where(lb == ii, 0.0, loss)
+        return apply_op(ce_full, logits, label)
+
+
+def get_rng_state_tracker():
+    """ref: fleet.meta_parallel.get_rng_state_tracker — per-mp-rank dropout
+    RNG. TPU-native: fold the mp axis index into the traced PRNG key, so
+    each mp shard sees decorrelated dropout inside shard_map, identical
+    keys under GSPMD (where XLA partitions a single logical dropout)."""
+    class _Tracker:
+        def rng_state(self, name="local_seed"):
+            import contextlib
+
+            @contextlib.contextmanager
+            def _cm():
+                yield
+            return _cm()
+
+        def add(self, name, seed):
+            pass
+
+        def fold_axis(self, key, axis="mp"):
+            if axis_bound(axis):
+                return jax.random.fold_in(key, jax.lax.axis_index(axis))
+            return key
+    return _Tracker()

@@ -26,8 +26,14 @@ from ..tensor import Tensor
 
 
 class Parameter(Tensor):
-    """Trainable tensor (ref: paddle.base.framework.EagerParamBase)."""
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+    """Trainable tensor (ref: paddle.base.framework.EagerParamBase).
+
+    `sharding_spec` carries an optional jax PartitionSpec placement
+    (ref: the reference's DistAttr on a dist tensor) consumed by
+    `paddle_tpu.distributed.shard_model`.
+    """
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "sharding_spec")
 
     def __init__(self, value, trainable=True, name=None):
         super().__init__(value, stop_gradient=not trainable, name=name)
@@ -35,6 +41,7 @@ class Parameter(Tensor):
         self.optimize_attr = {"learning_rate": 1.0}
         self.regularizer = None
         self.need_clip = True
+        self.sharding_spec = None
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()
