@@ -43,25 +43,35 @@ def axis_bound(name: str) -> bool:
         return False
 
 
-def _mesh_has(axis: str) -> bool:
-    try:
-        return axis in get_mesh().axis_names
-    except Exception:
-        return False
+def _explicit_mesh():
+    """The global mesh, only if one was explicitly set (never the implicit
+    single-axis default — annotating against that would constrain plain
+    single-chip runs)."""
+    from .. import mesh as mesh_mod
+    return mesh_mod._global_mesh
 
 
 def annotate(x, *spec):
-    """with_sharding_constraint against the global mesh (no-op when the
-    axis isn't in the mesh or we're inside shard_map)."""
+    """with_sharding_constraint against the global mesh. `None` dims are
+    left UNCONSTRAINED (GSPMD chooses) — pinning them replicated would
+    force an all-gather of e.g. the dp-sharded batch dim at every tp layer.
+    No-op when no mesh was set, no named axis survives, the axis isn't in
+    the mesh, we're inside shard_map (arrays are local shards there), or a
+    dim isn't divisible by its axis size."""
+    mesh = _explicit_mesh()
+    if mesh is None:
+        return x
     names = [s for s in spec if s is not None]
-    if names and not all(_mesh_has(s) for s in names):
+    if not names or any(s not in mesh.axis_names for s in names):
         return x
     if any(axis_bound(s) for s in names):
-        return x  # inside shard_map: arrays are already local shards
-    try:
-        sharding = NamedSharding(get_mesh(), P(*spec))
-    except Exception:
         return x
+    shape = x.shape
+    for dim, s in zip(shape, spec):
+        if s is not None and int(dim) % mesh.shape[s] != 0:
+            return x
+    spec = [P.UNCONSTRAINED if s is None else s for s in spec]
+    sharding = NamedSharding(mesh, P(*spec))
     if isinstance(x, Tensor):
         return apply_op(
             lambda a: jax.lax.with_sharding_constraint(a, sharding), x)
@@ -75,8 +85,10 @@ def shard_model(model: Layer, mesh=None):
     set_mesh(mesh)
     for _, p in model.named_parameters():
         spec = getattr(p, "sharding_spec", None) or P()
-        spec = P(*[s if (s is None or s in mesh.axis_names) else None
-                   for s in spec])
+        spec = P(*[s if (s is None or (s in mesh.axis_names
+                                       and dim % mesh.shape[s] == 0))
+                   else None
+                   for dim, s in zip(p._value.shape, spec)])
         p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
     for _, b in model.named_buffers():
         b._value = jax.device_put(b._value, NamedSharding(mesh, P()))
@@ -204,16 +216,24 @@ class VocabParallelEmbedding(Layer):
         return annotate(out, *([None] * (len(out.shape) - 1)), None)
 
 
-def parallel_matmul(x, weight, transpose_y=False, mp_axis="mp"):
+def parallel_matmul(x, weight, transpose_y=False, mp_axis="mp",
+                    gather_output=True):
     """Logits projection against a vocab-parallel table (lm head weight
-    tying). ref: fleet.layers.mpu.mp_ops._c_lookup/_Linear paths."""
+    tying). ref: fleet.layers.mpu.mp_ops._c_lookup/_Linear paths.
+
+    gather_output=False keeps the vocab dim sharded under shard_map —
+    required when the result feeds ParallelCrossEntropy, which expects
+    vocab-LOCAL logits (gathering first would double-count the partition
+    function mp_size times)."""
     def fn(a, w):
         wt = w.T if transpose_y else w
         return jnp.matmul(a, wt)
     y = apply_op(fn, x, weight)
     if axis_bound(mp_axis):
-        return apply_op(lambda a: jax.lax.all_gather(
-            a, mp_axis, axis=a.ndim - 1, tiled=True), y)
+        if gather_output:
+            return apply_op(lambda a: jax.lax.all_gather(
+                a, mp_axis, axis=a.ndim - 1, tiled=True), y)
+        return y
     return annotate(y, *([None] * (len(y.shape) - 1)), mp_axis)
 
 
@@ -238,11 +258,15 @@ class ParallelCrossEntropy(Layer):
 
         if axis_bound(ax):
             def ce(lg, lb):
+                lg = lg.astype(jnp.float32)  # fp32-stable partition function
                 size = jax.lax.psum(1, ax)
                 rank = jax.lax.axis_index(ax)
                 v_local = lg.shape[-1]
                 start = rank * v_local
-                m = jax.lax.pmax(jnp.max(lg, axis=-1), ax)
+                # max shift cancels in the lse; stop_gradient keeps the
+                # (non-differentiable) pmax out of the vjp
+                m = jax.lax.pmax(
+                    jax.lax.stop_gradient(jnp.max(lg, axis=-1)), ax)
                 z = jax.lax.psum(
                     jnp.sum(jnp.exp(lg - m[..., None]), axis=-1), ax)
                 local = lb - start

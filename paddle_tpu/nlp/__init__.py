@@ -1,0 +1,11 @@
+"""NLP model zoo (PaddleNLP parity subset).
+
+ref: PaddleNLP paddlenlp/transformers/{gpt,bert,ernie}/modeling.py and
+tokenizer_utils.py. TPU-native: every model is built from mesh-aware
+layers (mpu Column/Row parallel linears, vocab-parallel embedding) so the
+same module runs dense on one chip and tensor-parallel under a Mesh.
+"""
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, GPTLMHeadModel,
+    GPTPretrainingCriterion, GPT_CONFIGS,
+)

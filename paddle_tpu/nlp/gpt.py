@@ -1,0 +1,324 @@
+"""GPT — the flagship decoder-only LM.
+
+ref parity: PaddleNLP paddlenlp/transformers/gpt/modeling.py (GPTModel,
+GPTForCausalLM/GPTLMHeadModel, GPTPretrainingCriterion) and the fleet GPT-3
+pretrain configs (hidden 2048 x 24 layers = 1.3B).
+
+TPU-native design:
+- attention/MLP projections are mpu Column/RowParallelLinear: dense on one
+  chip, tensor-parallel (GSPMD or shard_map) under a Mesh with an 'mp' axis.
+- word embedding is VocabParallelEmbedding; the LM head ties its weight via
+  parallel_matmul (ref: GPTForCausalLM's shared word_embeddings).
+- attention core routes through F.scaled_dot_product_attention -> Pallas
+  flash attention on TPU; causal masking via is_causal (no materialised
+  [S,S] mask in the hot path).
+- pre-LayerNorm residual blocks (the reference GPT's normalize_before=True).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import apply_op
+from ..nn import functional as F
+from ..nn.initializer import Normal, ParamAttr
+from ..nn.layer import Layer
+from ..nn.layers_common import Dropout, Embedding, LayerList, Linear
+from ..nn.layers_norm import LayerNorm
+from ..tensor import Tensor
+from ..distributed.fleet.mpu import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, parallel_matmul, annotate)
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 0  # 0 -> 4*hidden
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 1024
+    initializer_range: float = 0.02
+    layer_norm_epsilon: float = 1e-5
+    use_flash_attention: bool = True
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+# ref: PaddleNLP gpt/configuration.py pretrained init configurations +
+# fleet gpt-3 1.3B yaml (hidden 2048, 24L, 16 heads, seq 2048/1024 pos 1024*2)
+GPT_CONFIGS = {
+    "gpt3-1.3B": dict(vocab_size=50304, hidden_size=2048,
+                      num_hidden_layers=24, num_attention_heads=16,
+                      max_position_embeddings=2048),
+    "gpt3-345M": dict(vocab_size=50304, hidden_size=1024,
+                      num_hidden_layers=24, num_attention_heads=16,
+                      max_position_embeddings=1024),
+    "gpt2-en": dict(vocab_size=50304, hidden_size=768,
+                    num_hidden_layers=12, num_attention_heads=12,
+                    max_position_embeddings=1024),
+    "gpt-tiny": dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=128,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0),
+}
+
+
+def _init_attr(cfg):
+    return ParamAttr(initializer=Normal(mean=0.0, std=cfg.initializer_range))
+
+
+class GPTAttention(Layer):
+    """Causal self-attention. Separate q/k/v column-parallel projections
+    (head dim sharded over mp) + row-parallel output projection — the
+    layout of the reference's fused_attention mp path."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.cfg = config
+        h = config.hidden_size
+        wa = _init_attr(config)
+        self.q_proj = ColumnParallelLinear(h, h, weight_attr=wa,
+                                           gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, h, weight_attr=wa,
+                                           gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, h, weight_attr=wa,
+                                           gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, weight_attr=wa,
+                                          input_is_parallel=True)
+
+    def _heads(self, x):
+        b, s = x.shape[0], x.shape[1]
+        return x.reshape([b, s, -1, self.cfg.head_dim])
+
+    def forward(self, x, attn_mask=None, cache=None):
+        q = self._heads(self.q_proj(x))
+        k = self._heads(self.k_proj(x))
+        v = self._heads(self.v_proj(x))
+        if cache is not None:
+            from ..tensor_ops.manip import concat
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            cache = (k, v)
+        is_causal = attn_mask is None
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.cfg.attention_probs_dropout_prob
+            if self.training else 0.0,
+            is_causal=is_causal, training=self.training,
+            use_flash=self.cfg.use_flash_attention)
+        b, s = out.shape[0], out.shape[1]
+        out = self.out_proj(out.reshape([b, s, -1]))
+        return (out, cache) if cache is not None else out
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        wa = _init_attr(config)
+        self.fc1 = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, weight_attr=wa,
+            gather_output=False)
+        self.fc2 = RowParallelLinear(
+            config.intermediate_size, config.hidden_size, weight_attr=wa,
+            input_is_parallel=True)
+        self.act = getattr(F, config.hidden_act)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(self.act(self.fc1(x))))
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN block (ref: gpt/modeling.py TransformerDecoderLayer with
+    normalize_before=True)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        eps = config.layer_norm_epsilon
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=eps)
+        self.attn = GPTAttention(config)
+        self.dropout1 = Dropout(config.hidden_dropout_prob)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=eps)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        residual = x
+        h = self.ln_1(x)
+        if cache is not None:
+            h, cache = self.attn(h, attn_mask, cache)
+        else:
+            h = self.attn(h, attn_mask)
+        x = residual + self.dropout1(h)
+        x = x + self.mlp(self.ln_2(x))
+        return (x, cache) if cache is not None else x
+
+
+class GPTEmbeddings(Layer):
+    """word (vocab-parallel) + learned position embeddings."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=_init_attr(config))
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=_init_attr(config))
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, position_ids=None):
+        if position_ids is None:
+            s = input_ids.shape[1]
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        return self.dropout(self.word_embeddings(input_ids)
+                            + self.position_embeddings(position_ids))
+
+
+class GPTModel(Layer):
+    """ref: paddlenlp/transformers/gpt/modeling.py GPTModel."""
+
+    def __init__(self, config: GPTConfig = None, **kwargs):
+        super().__init__()
+        if config is None:
+            config = GPTConfig(**kwargs)
+        elif isinstance(config, dict):
+            config = GPTConfig(**config)
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.h = LayerList([GPTDecoderLayer(config)
+                            for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+
+    @classmethod
+    def from_config_name(cls, name, **overrides):
+        cfg = dict(GPT_CONFIGS[name])
+        cfg.update(overrides)
+        return cls(GPTConfig(**cfg))
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                use_cache=False, cache=None):
+        if position_ids is None and cache is not None:
+            # cached decode: positions continue after the cache length
+            # (ref: GPTModel.forward's past_length offset)
+            past = cache[0][0].shape[1]
+            s = input_ids.shape[1]
+            position_ids = Tensor(
+                (past + jnp.arange(s, dtype=jnp.int32))[None, :])
+        x = self.embeddings(input_ids, position_ids)
+        x = annotate(x, "dp", None, None)
+        new_caches = [] if (use_cache or cache is not None) else None
+        for i, blk in enumerate(self.h):
+            if new_caches is not None:
+                layer_cache = cache[i] if cache is not None else (
+                    Tensor(jnp.zeros((x.shape[0], 0,
+                                      self.config.num_attention_heads,
+                                      self.config.head_dim),
+                                     dtype=x.dtype)),) * 2
+                x, c = blk(x, attention_mask, layer_cache)
+                new_caches.append(c)
+            else:
+                x = blk(x, attention_mask)
+        x = self.ln_f(x)
+        if new_caches is not None:
+            return x, new_caches
+        return x
+
+
+class GPTForCausalLM(Layer):
+    """GPTModel + tied vocab-parallel LM head (ref: GPTForCausalLM /
+    GPTLMHeadModel in gpt/modeling.py)."""
+
+    def __init__(self, config: GPTConfig = None, **kwargs):
+        super().__init__()
+        self.gpt = GPTModel(config, **kwargs)
+        self.config = self.gpt.config
+
+    @classmethod
+    def from_config_name(cls, name, **overrides):
+        cfg = dict(GPT_CONFIGS[name])
+        cfg.update(overrides)
+        return cls(GPTConfig(**cfg))
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                use_cache=False, cache=None):
+        out = self.gpt(input_ids, position_ids, attention_mask,
+                       use_cache=use_cache, cache=cache)
+        if use_cache or cache is not None:
+            hidden, new_cache = out
+        else:
+            hidden, new_cache = out, None
+        # vocab stays sharded under shard_map: GPTPretrainingCriterion's
+        # ParallelCrossEntropy consumes vocab-LOCAL logits (Megatron-style)
+        logits = parallel_matmul(
+            hidden, self.gpt.embeddings.word_embeddings.weight,
+            transpose_y=True, gather_output=False)
+        if new_cache is not None:
+            return logits, new_cache
+        return logits
+
+    # -- generation ---------------------------------------------------------
+    def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
+                 top_k=0, seed=None):
+        """Greedy (temperature=0/top_k=0) or top-k sampled decode with a KV
+        cache. Eager loop (parity surface; the fast path is jit'd decode in
+        paddle_tpu.nlp.generation)."""
+        was_training = self.training
+        self.eval()
+        ids = input_ids if isinstance(input_ids, Tensor) else Tensor(input_ids)
+        key = jax.random.PRNGKey(0 if seed is None else seed)
+        logits, cache = self.forward(ids, use_cache=True)
+        out_ids = ids._value
+        for _ in range(max_new_tokens):
+            last = logits._value[:, -1, :].astype(jnp.float32)
+            if top_k and temperature > 0:
+                vals, idx = jax.lax.top_k(last / temperature, top_k)
+                key, sub = jax.random.split(key)
+                pick = jax.random.categorical(sub, vals)
+                nxt = jnp.take_along_axis(idx, pick[:, None], axis=-1)[:, 0]
+            else:
+                nxt = jnp.argmax(last, axis=-1)
+            nxt = nxt.astype(out_ids.dtype)
+            out_ids = jnp.concatenate([out_ids, nxt[:, None]], axis=1)
+            pos = Tensor(jnp.full((ids.shape[0], 1), out_ids.shape[1] - 1,
+                                  dtype=jnp.int32))
+            logits, cache = self.forward(
+                Tensor(nxt[:, None]), position_ids=pos, cache=cache)
+        if was_training:
+            self.train()
+        return Tensor(out_ids)
+
+
+GPTLMHeadModel = GPTForCausalLM
+
+
+class GPTPretrainingCriterion(Layer):
+    """Masked LM loss (ref: gpt/modeling.py GPTPretrainingCriterion):
+    mean of token CE where loss_mask==1, vocab-parallel safe."""
+
+    def __init__(self, config=None):
+        super().__init__()
+        self.ce = ParallelCrossEntropy()
+
+    def forward(self, prediction_scores, masked_lm_labels, loss_mask=None):
+        loss = self.ce(prediction_scores, masked_lm_labels)
+        if loss_mask is not None:
+            m = loss_mask if isinstance(loss_mask, Tensor) else Tensor(loss_mask)
+            num = (loss * m.astype(loss.dtype)).sum()
+            den = m.astype(loss.dtype).sum()
+            return num / den
+        return loss.mean()

@@ -1308,15 +1308,19 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
 # ---------------------------------------------------------------------------
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, name=None):
+                                 training=True, use_flash=True, name=None):
     """ref: F.scaled_dot_product_attention — [B, S, H, D] layout.
 
     Routes to the Pallas TPU flash-attention kernel when shapes allow;
     otherwise the jnp reference path (still XLA-fused on TPU).
+    `use_flash=False` forces the jnp path (tpu-native extension, consumed
+    by GPTConfig.use_flash_attention).
     """
     from ..ops import flash_attention_available, flash_attention
     q, k, v = _t(query), _t(key), _t(value)
-    if (flash_attention_available(q.shape, k.shape, attn_mask, dropout_p)
+    if (use_flash
+            and flash_attention_available(q.shape, k.shape, attn_mask,
+                                          dropout_p)
             and training is not None):
         return apply_op(
             lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=is_causal),

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops.
+
+ref parity: the reference's hand-written CUDA kernels
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu, fused softmax/layernorm).
+Here each kernel is written against the MXU/VPU with VMEM blocking and is
+validated in interpret mode on CPU (tests/test_pallas_*).
+"""
+from .flash_attention import flash_attention_fwd, flash_attention  # noqa: F401

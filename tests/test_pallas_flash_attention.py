@@ -1,0 +1,85 @@
+"""Pallas flash attention vs jnp reference, interpret mode on CPU
+(parity: the reference's test_flash_attention.py vs naive softmax)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.attention import reference_attention
+from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _rand(shape, seed):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape)
+                       .astype(np.float32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    b, s, h, d = 2, 256, 2, 64
+    q, k, v = (_rand((b, s, h, d), i) for i in range(3))
+    got = flash_attention(q, k, v, causal=causal, interpret=True)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_uneven_kv_len():
+    b, sq, sk, h, d = 1, 128, 384, 2, 64
+    q = _rand((b, sq, h, d), 0)
+    k = _rand((b, sk, h, d), 1)
+    v = _rand((b, sk, h, d), 2)
+    got = flash_attention(q, k, v, causal=False, interpret=True)
+    want = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads_match_reference(causal):
+    b, s, h, d = 1, 256, 2, 64
+    q, k, v = (_rand((b, s, h, d), 10 + i) for i in range(3))
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, interpret=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = reference_attention(q, k, v, causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_flash_bf16():
+    b, s, h, d = 1, 128, 2, 128
+    q, k, v = (_rand((b, s, h, d), 20 + i).astype(jnp.bfloat16)
+               for i in range(3))
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                               np.asarray(want, dtype=np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_flash_causal_uneven_matches_reference():
+    """bottom-right aligned causal mask when sq != sk (decode/chunked
+    prefill): must match the jnp reference's tril(k=sk-sq)."""
+    b, sq, sk, h, d = 1, 128, 384, 2, 64
+    q = _rand((b, sq, h, d), 30)
+    k = _rand((b, sk, h, d), 31)
+    v = _rand((b, sk, h, d), 32)
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_rejects_unaligned_seq():
+    q = _rand((1, 200, 2, 64), 40)
+    with pytest.raises(ValueError):
+        flash_attention(q, q, q, interpret=True)
