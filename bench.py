@@ -1,0 +1,106 @@
+"""Benchmark: flagship GPT pretrain throughput (tokens/sec/chip).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline: measured tokens/s/chip divided by the reference's per-GPU
+GPT-1.3B-class baseline share (SURVEY.md §6: ~3.5k tok/s per A100).
+
+Usage: python bench.py [--smoke] [--steps N] [--batch B] [--seq S]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+if "--smoke" in sys.argv:
+    import _cpu_env  # noqa: F401  (axon bypass; must precede jax import)
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_TOKENS_PER_SEC_PER_CHIP = 3500.0
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_engine(cfg_name, batch, seq, amp):
+    from paddle_tpu.nlp.gpt import (GPTForCausalLM, GPTConfig, GPT_CONFIGS,
+                                    GPTPretrainingCriterion)
+    from paddle_tpu.hapi.engine import Engine
+    from paddle_tpu.optimizer import AdamW
+
+    cfg = dict(GPT_CONFIGS[cfg_name])
+    cfg["max_position_embeddings"] = max(cfg["max_position_embeddings"], seq)
+    cfg["hidden_dropout_prob"] = 0.0
+    cfg["attention_probs_dropout_prob"] = 0.0
+    model = GPTForCausalLM(GPTConfig(**cfg))
+    model.train()
+    opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
+                parameters=model.parameters())
+    eng = Engine(model, loss=GPTPretrainingCriterion(), optimizer=opt,
+                 amp_dtype=jnp.bfloat16 if amp else None)
+    return eng
+
+
+def run(eng, batch, seq, steps, warmup):
+    import numpy as np
+    rng = np.random.default_rng(0)
+    vocab = eng.network.config.vocab_size
+    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), dtype=jnp.int32)
+    labels = jnp.asarray(rng.integers(0, vocab, (batch, seq)),
+                         dtype=jnp.int32)
+    log("compiling + warmup ...")
+    for i in range(warmup):
+        t = time.perf_counter()
+        loss, _ = eng.train_batch([ids], [labels])
+        jax.block_until_ready(loss)
+        log(f"  warmup step {i}: {time.perf_counter() - t:.2f}s")
+    log(f"warmup done, loss={float(loss):.4f}")
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss, _ = eng.train_batch([ids], [labels])
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return batch * seq * steps / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--config", default=None)
+    args = ap.parse_args()
+
+    on_tpu = jax.default_backend() == "tpu"
+    if args.smoke or not on_tpu:
+        cfg, batch, seq, steps, warmup, amp = "gpt-tiny", 4, 64, 4, 2, False
+    else:
+        cfg, batch, seq, steps, warmup, amp = "gpt3-345M", 8, 1024, 20, 3, True
+    cfg = args.config or cfg
+    batch = args.batch or batch
+    seq = args.seq or seq
+    steps = args.steps or steps
+
+    log(f"bench: {cfg} batch={batch} seq={seq} steps={steps} "
+        f"backend={jax.default_backend()} amp={amp}")
+    eng = build_engine(cfg, batch, seq, amp)
+    tput = run(eng, batch, seq, steps, warmup)
+    print(json.dumps({
+        "metric": "gpt_pretrain_tokens_per_sec_per_chip",
+        "value": round(tput, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tput / BASELINE_TOKENS_PER_SEC_PER_CHIP, 4),
+        "config": cfg, "batch": batch, "seq": seq,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -136,7 +136,9 @@ class ColumnParallelLinear(Layer):
                     a, self.mp_axis, axis=a.ndim - 1, tiled=True), y)
             return y
         if self.gather_output:
-            return annotate(y, *([None] * (len(y.shape) - 1)), None)
+            # all-None annotate is a no-op by design: GSPMD already keeps
+            # the gathered output unconstrained, no pin needed
+            return y
         return annotate(y, *([None] * (len(y.shape) - 1)), self.mp_axis)
 
 
@@ -174,8 +176,9 @@ class RowParallelLinear(Layer):
             return y
         if not self.input_is_parallel:
             x = annotate(x, *([None] * (len(x.shape) - 1)), self.mp_axis)
-        y = F.linear(x, self.weight, self.bias)
-        return annotate(y, *([None] * (len(y.shape) - 1)), None)
+        # output left unconstrained: GSPMD inserts the mp reduce itself
+        # (the Megatron c_allreduce equivalent) when x's last dim is sharded
+        return F.linear(x, self.weight, self.bias)
 
 
 class VocabParallelEmbedding(Layer):
@@ -212,8 +215,7 @@ class VocabParallelEmbedding(Layer):
                 out = jnp.where(ok[..., None], out, 0.0)
                 return jax.lax.psum(out, self.mp_axis)
             return apply_op(local_embed, x, self.weight)
-        out = F.embedding(x, self.weight)
-        return annotate(out, *([None] * (len(out.shape) - 1)), None)
+        return F.embedding(x, self.weight)
 
 
 def parallel_matmul(x, weight, transpose_y=False, mp_axis="mp",

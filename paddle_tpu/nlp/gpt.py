@@ -16,16 +16,15 @@ TPU-native design:
 """
 from __future__ import annotations
 
-from dataclasses import dataclass, field, asdict
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
 
-from ..autograd import apply_op
 from ..nn import functional as F
 from ..nn.initializer import Normal, ParamAttr
 from ..nn.layer import Layer
-from ..nn.layers_common import Dropout, Embedding, LayerList, Linear
+from ..nn.layers_common import Dropout, Embedding, LayerList
 from ..nn.layers_norm import LayerNorm
 from ..tensor import Tensor
 from ..distributed.fleet.mpu import (
@@ -109,16 +108,24 @@ class GPTAttention(Layer):
         k = self._heads(self.k_proj(x))
         v = self._heads(self.v_proj(x))
         if cache is not None:
-            from ..tensor_ops.manip import concat
-            k = concat([cache[0], k], axis=1)
-            v = concat([cache[1], v], axis=1)
+            # skip the concat for the zero-length initial cache: under
+            # shard_map tensor parallelism k/v carry num_heads/mp LOCAL
+            # heads while the pre-built empty cache has global heads
+            if cache[0].shape[1]:
+                from ..tensor_ops.manip import concat
+                k = concat([cache[0], k], axis=1)
+                v = concat([cache[1], v], axis=1)
             cache = (k, v)
-        is_causal = attn_mask is None
+        # causal ALWAYS applies (decoder-only LM): a user attention_mask is
+        # a padding mask combined ON TOP of the causal structure (ref:
+        # GPTModel builds causal&padding jointly in modeling.py's
+        # _prepare_decoder_attention_mask); SDPA's tril is bottom-right
+        # aligned so cached decode (sq < sk) stays correct
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask,
             dropout_p=self.cfg.attention_probs_dropout_prob
             if self.training else 0.0,
-            is_causal=is_causal, training=self.training,
+            is_causal=True, training=self.training,
             use_flash=self.cfg.use_flash_attention)
         b, s = out.shape[0], out.shape[1]
         out = self.out_proj(out.reshape([b, s, -1]))
@@ -188,6 +195,12 @@ class GPTEmbeddings(Layer):
                             + self.position_embeddings(position_ids))
 
 
+def _resolve_config(name, **overrides):
+    cfg = dict(GPT_CONFIGS[name])
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
 class GPTModel(Layer):
     """ref: paddlenlp/transformers/gpt/modeling.py GPTModel."""
 
@@ -206,9 +219,7 @@ class GPTModel(Layer):
 
     @classmethod
     def from_config_name(cls, name, **overrides):
-        cfg = dict(GPT_CONFIGS[name])
-        cfg.update(overrides)
-        return cls(GPTConfig(**cfg))
+        return cls(_resolve_config(name, **overrides))
 
     def forward(self, input_ids, position_ids=None, attention_mask=None,
                 use_cache=False, cache=None):
@@ -219,6 +230,17 @@ class GPTModel(Layer):
             s = input_ids.shape[1]
             position_ids = Tensor(
                 (past + jnp.arange(s, dtype=jnp.int32))[None, :])
+        if attention_mask is not None:
+            # normalise padding masks to [b, 1, sq|1, sk] so they broadcast
+            # against [b, heads, sq, sk] logits; causal structure is added
+            # by the attention op itself
+            m = attention_mask._value if isinstance(attention_mask, Tensor) \
+                else jnp.asarray(attention_mask)
+            if m.ndim == 2:
+                m = m[:, None, None, :]
+            elif m.ndim == 3:
+                m = m[:, None]
+            attention_mask = Tensor(m)
         x = self.embeddings(input_ids, position_ids)
         x = annotate(x, "dp", None, None)
         new_caches = [] if (use_cache or cache is not None) else None
@@ -250,9 +272,7 @@ class GPTForCausalLM(Layer):
 
     @classmethod
     def from_config_name(cls, name, **overrides):
-        cfg = dict(GPT_CONFIGS[name])
-        cfg.update(overrides)
-        return cls(GPTConfig(**cfg))
+        return cls(_resolve_config(name, **overrides))
 
     def forward(self, input_ids, position_ids=None, attention_mask=None,
                 use_cache=False, cache=None):

@@ -141,6 +141,7 @@ def test_param_specs_and_shard_model_placement(mp_mesh):
 
 def test_grad_through_tp_stack_matches_dense(mp_mesh):
     """value_and_grad through GSPMD tp layers == dense grads."""
+    paddle.seed(4)  # pin layer init: fd-vs-grad tolerance depends on it
     rng = np.random.RandomState(4)
     x = rng.randn(8, 16).astype(np.float32)
     col = ColumnParallelLinear(16, 32, gather_output=False)
@@ -164,11 +165,9 @@ def test_grad_through_tp_stack_matches_dense(mp_mesh):
     w1, b1 = np.asarray(col.weight), np.asarray(col.bias)
     w2, b2 = np.asarray(row.weight), np.asarray(row.bias)
 
-    def np_loss(w1, b1, w2, b2):
-        return (((x @ w1 + b1) @ w2 + b2) ** 2).mean()
+    def dense_loss(w1):
+        return jnp.mean(((x @ w1 + b1) @ w2 + b2) ** 2)
 
-    eps = 1e-4
-    w1p = w1.copy(); w1p[3, 7] += eps
-    w1m = w1.copy(); w1m[3, 7] -= eps
-    fd = (np_loss(w1p, b1, w2, b2) - np_loss(w1m, b1, w2, b2)) / (2 * eps)
-    np.testing.assert_allclose(np.asarray(g["cw"])[3, 7], fd, rtol=1e-2)
+    ref = jax.grad(dense_loss)(jnp.asarray(w1))
+    np.testing.assert_allclose(np.asarray(g["cw"]), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
