@@ -29,16 +29,15 @@ def log(*a):
 
 
 def build_engine(cfg_name, batch, seq, amp):
-    from paddle_tpu.nlp.gpt import (GPTForCausalLM, GPTConfig, GPT_CONFIGS,
-                                    GPTPretrainingCriterion)
+    from paddle_tpu.nlp.gpt import (GPTForCausalLM, GPT_CONFIGS,
+                                    GPTPretrainingCriterion, _resolve_config)
     from paddle_tpu.hapi.engine import Engine
     from paddle_tpu.optimizer import AdamW
 
-    cfg = dict(GPT_CONFIGS[cfg_name])
-    cfg["max_position_embeddings"] = max(cfg["max_position_embeddings"], seq)
-    cfg["hidden_dropout_prob"] = 0.0
-    cfg["attention_probs_dropout_prob"] = 0.0
-    model = GPTForCausalLM(GPTConfig(**cfg))
+    max_pos = max(GPT_CONFIGS[cfg_name]["max_position_embeddings"], seq)
+    model = GPTForCausalLM(_resolve_config(
+        cfg_name, max_position_embeddings=max_pos,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
     model.train()
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
                 parameters=model.parameters())

@@ -141,8 +141,21 @@ class PipelineLayer(Layer):
                  num_virtual_pipeline_stages=None, topology=None):
         super().__init__()
         from ...nn.layers_common import LayerList
-        built = [l.build() if isinstance(l, LayerDesc) else l
-                 for l in layers]
+        built, shared = [], {}
+        for l in layers:
+            layer = l.build() if isinstance(l, LayerDesc) else l
+            if isinstance(l, SharedLayerDesc):
+                # ref pp_layers.py: same key => physically tied weight.
+                # Later occurrences alias the first's parameter Tensor, so
+                # both stages' param trees hold the SAME object and eager
+                # backward accumulates both contributions onto it.
+                if l.key in shared:
+                    setattr(layer, l.shared_weight_attr,
+                            getattr(shared[l.key], l.shared_weight_attr))
+                else:
+                    shared[l.key] = layer
+            built.append(layer)
+        self.shared_layers = shared
         self.blocks = LayerList(built)
         self.num_stages = num_stages
         self.loss_fn = loss_fn
@@ -174,12 +187,15 @@ class PipelineLayer(Layer):
         slices = self._stage_slices(n_stages)
         per = len(slices[0])
 
-        # stack per-stage params: each stage holds `per` blocks' params
-        def stage_tree(idxs):
-            return [self.blocks[i].raw_state()[0] for i in idxs]
-
-        per_stage = [stage_tree(s) for s in slices]
-        stacked = stack_stage_params(per_stage)
+        # per-stage trees of the LIVE parameter Tensors — stacking happens
+        # inside `run` (jnp.stack is differentiable), so eager backward
+        # deposits grads on the blocks' own Parameters, and a weight
+        # shared across stages (SharedLayerDesc) appears as one repeated
+        # Tensor whose grads accumulate.
+        per_stage_t = [[dict(self.blocks[i].named_parameters()) for i in s]
+                       for s in slices]
+        leaves_t, treedef = jax.tree_util.tree_flatten(
+            per_stage_t, is_leaf=lambda t: isinstance(t, Tensor))
         blocks = self.blocks
 
         def stage_fn(params_list, act):
@@ -191,14 +207,12 @@ class PipelineLayer(Layer):
             return act
 
         def run(arr, *leaves):
-            treedef = jax.tree_util.tree_structure(stacked)
-            sp = jax.tree_util.tree_unflatten(treedef, leaves)
-            return pipeline_apply(mesh, sp, arr, stage_fn,
+            per_stage = jax.tree_util.tree_unflatten(treedef, leaves)
+            stacked = stack_stage_params(per_stage)
+            return pipeline_apply(mesh, stacked, arr, stage_fn,
                                   n_micro=n_micro or n_stages,
                                   remat=self.recompute)
 
-        leaves = jax.tree_util.tree_leaves(stacked)
         if isinstance(x, Tensor):
-            return apply_op(run, x, *[Tensor(l, stop_gradient=False)
-                                      for l in leaves])
-        return run(x, *leaves)
+            return apply_op(run, x, *leaves_t)
+        return run(x, *[t._value for t in leaves_t])

@@ -146,3 +146,28 @@ def test_shard_map_mp_loss_matches_dense():
                    out_specs=P(), check_rep=False)
     got = float(jax.jit(fn)(inp, lab, params))
     np.testing.assert_allclose(got, dense_loss, rtol=1e-4)
+
+
+def test_float_padding_mask_matches_bool_mask():
+    """Regression: 0/1 int/float padding masks (tokenizer convention) must
+    mask, not act as a +1 additive bias."""
+    import jax.numpy as jnp
+    from paddle_tpu.nlp.gpt import GPTModel, GPTConfig
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    m = GPTModel(GPTConfig(vocab_size=64, hidden_size=32,
+                           num_hidden_layers=1, num_attention_heads=2,
+                           max_position_embeddings=16,
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0))
+    m.eval()
+    ids = paddle.to_tensor(np.arange(8, dtype=np.int32)[None, :] % 64)
+    pad = np.array([[1, 1, 1, 1, 1, 0, 0, 0]])
+    out_bool = m(ids, attention_mask=paddle.to_tensor(pad.astype(bool)))
+    out_f32 = m(ids, attention_mask=paddle.to_tensor(pad.astype(np.float32)))
+    out_i64 = m(ids, attention_mask=paddle.to_tensor(pad.astype(np.int64)))
+    np.testing.assert_allclose(np.asarray(out_f32._value),
+                               np.asarray(out_bool._value), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out_i64._value),
+                               np.asarray(out_bool._value), atol=1e-6)

@@ -107,3 +107,46 @@ class TestPipelineLayer:
         pipe = PipelineLayer(layers=[Linear(4, 4) for _ in range(3)])
         with pytest.raises(ValueError):
             pipe._stage_slices(2)
+
+
+class TestPipelineLayerGrads:
+    def test_eager_backward_populates_block_grads(self):
+        """Regression: grads must land on the live block Parameters when
+        running pipelined under a pp mesh, matching the sequential path."""
+        from paddle_tpu.nn.layers_common import Linear
+        from paddle_tpu.tensor import Tensor
+        from paddle_tpu.distributed import mesh as mesh_mod
+
+        blocks = [Linear(8, 8) for _ in range(4)]
+        pipe = PipelineLayer(layers=blocks)
+        x = Tensor(jax.random.normal(jax.random.PRNGKey(7), (8, 8)))
+
+        old = mesh_mod._global_mesh
+        try:
+            mesh_mod._global_mesh = None
+            (pipe(x) ** 2).sum().backward()
+            ref_grads = [np.asarray(p.grad._value) for p in pipe.parameters()]
+            for p in pipe.parameters():
+                p.clear_grad()
+            mesh_mod._global_mesh = _mesh(pp=4, dp=2)
+            (pipe(x, n_micro=4) ** 2).sum().backward()
+        finally:
+            mesh_mod._global_mesh = old
+        for p, ref in zip(pipe.parameters(), ref_grads):
+            assert p.grad is not None, "grad missing on live block param"
+            np.testing.assert_allclose(np.asarray(p.grad._value), ref,
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_shared_layer_desc_ties_weights(self):
+        """SharedLayerDesc with the same key must alias the weight Tensor
+        (ref pp_layers.py shared embedding/lm-head tying)."""
+        from paddle_tpu.distributed.fleet.pipeline import SharedLayerDesc
+        from paddle_tpu.nn.layers_common import Linear
+
+        pipe = PipelineLayer(layers=[
+            SharedLayerDesc("tied", Linear, 4, 4),
+            LayerDesc(Linear, 4, 4),
+            SharedLayerDesc("tied", Linear, 4, 4),
+            LayerDesc(Linear, 4, 4),
+        ])
+        assert pipe.blocks[0].weight is pipe.blocks[2].weight
