@@ -34,3 +34,5 @@ get_hybrid_communicate_group = fleet_obj.get_hybrid_communicate_group
 distributed_model = fleet_obj.distributed_model
 distributed_optimizer = fleet_obj.distributed_optimizer
 distributed_scaler = fleet_obj.distributed_scaler
+from . import sequence_parallel  # noqa: F401
+from . import sharding as group_sharded  # noqa: F401

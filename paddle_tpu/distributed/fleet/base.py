@@ -168,7 +168,19 @@ class Fleet:
         return place_model_on_mesh(model, get_mesh())
 
     def distributed_optimizer(self, optimizer, strategy=None):
-        optimizer._fleet_strategy = strategy or self._strategy
+        strategy = strategy or self._strategy
+        optimizer._fleet_strategy = strategy
+        if strategy is not None and strategy.sharding:
+            # fleet sharding stage 1/2/3 → GroupSharded/ZeRO placement
+            # (ref: DygraphShardingOptimizer selection in fleet.init)
+            from .sharding import group_sharded_parallel
+            stage = int(strategy.sharding_configs.get("stage", 1))
+            level = {1: "os", 2: "os_g", 3: "p_g_os"}.get(stage)
+            if level is None:
+                raise ValueError(
+                    f"sharding_configs stage must be 1, 2 or 3, got {stage}")
+            group_sharded_parallel(None, optimizer, level=level,
+                                   mesh=get_mesh())
         return optimizer
 
     def distributed_scaler(self, scaler):

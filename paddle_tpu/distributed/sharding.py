@@ -1,0 +1,4 @@
+"""ref: paddle.distributed.sharding — GroupSharded (ZeRO) public API."""
+from .fleet.sharding import (  # noqa: F401
+    group_sharded_parallel, save_group_sharded_model, GroupShardedConfig,
+)

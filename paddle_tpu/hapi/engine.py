@@ -37,6 +37,9 @@ class Engine:
         self.network = network
         self.loss = loss
         self.optimizer = optimizer
+        if optimizer is not None:
+            import weakref
+            optimizer._engine_ref = weakref.ref(self)
         self.metrics = metrics or []
         self.amp_dtype = amp_dtype
         self.mesh = mesh
@@ -83,6 +86,19 @@ class Engine:
         trainable_keys = {n for n, p in network.named_parameters()
                           if p.trainable}
 
+        # GroupSharded/ZeRO stage 2+: constrain grads to their shard
+        # placement so XLA lowers the dp grad-sum to reduce-scatter
+        gs = getattr(opt, "_group_sharded", None)
+        grad_shardings = None
+        if gs is not None and gs.shard_grads:
+            from jax.sharding import NamedSharding
+            from ..distributed.fleet.sharding import constraint_specs
+            live_arrs = {k: v for k, v in self._params.items()
+                         if k in trainable_keys}
+            grad_shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(gs.mesh, s),
+                constraint_specs(live_arrs, gs.mesh, gs.axis))
+
         def train_step(params, buffers, opt_state, lr, step_i, rng, inputs,
                        labels):
             frozen = {k: v for k, v in params.items()
@@ -108,6 +124,9 @@ class Engine:
 
             (loss_v, (outs, new_buf)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(live)
+            if grad_shardings is not None:
+                grads = jax.lax.with_sharding_constraint(
+                    grads, grad_shardings)
             if clip is not None:
                 grads = clip.apply(grads)
             new_live, new_opt = opt.update(live, grads, opt_state,
@@ -146,8 +165,6 @@ class Engine:
         """One optimizer step. inputs/labels: lists of Tensors/arrays."""
         if self.network.training is False:
             self.network.train()
-        if self._train_fn is None:
-            self._train_fn = self._build_train_fn()
         if self._opt_state is None:
             trainable = {n: self._params[n]
                          for n, p in self.network.named_parameters()
@@ -160,6 +177,9 @@ class Engine:
                     self._opt_state = jax.tree_util.tree_unflatten(
                         treedef, pending)
                 self.optimizer._pending_state_leaves = None
+            self._apply_zero_placement()
+        if self._train_fn is None:
+            self._train_fn = self._build_train_fn()
         in_arrs = self._shard_batch(_unwrap(list(inputs)))
         lab_arrs = self._shard_batch(_unwrap(list(labels)))
         lr = jnp.float32(self._lr_now())
@@ -189,6 +209,19 @@ class Engine:
         _, outs = self.eval_batch(inputs, ())
         return outs
 
+    def _apply_zero_placement(self):
+        """GroupSharded/ZeRO placement (stage 1: opt state; stage 3: +
+        params). Must precede _build_train_fn so the grad sharding
+        constraints are computed from the placed params."""
+        gs = getattr(self.optimizer, "_group_sharded", None)
+        if gs is None or self._opt_state is None:
+            return
+        from ..distributed.fleet.sharding import shard_tree
+        self._opt_state = shard_tree(self._opt_state, gs.mesh, gs.axis)
+        if gs.shard_params:
+            self._params = shard_tree(self._params, gs.mesh, gs.axis)
+            self.network.load_raw_state(self._params, self._buffers)
+
     # state ------------------------------------------------------------
     def opt_state_dict(self):
         return {"state": self._opt_state, "step": self._step}
@@ -196,3 +229,8 @@ class Engine:
     def load_opt_state_dict(self, d):
         self._opt_state = d["state"]
         self._step = d["step"]
+        # resume path: re-apply ZeRO placement and rebuild the step so the
+        # baked-in grad constraints match the (re)placed params
+        if getattr(self.optimizer, "_group_sharded", None) is not None:
+            self._apply_zero_placement()
+            self._train_fn = None

@@ -133,6 +133,7 @@ class Optimizer:
         if self._func_state is None:
             self._func_state = self.init_state(full)
             self._apply_pending_state()
+            self._apply_group_sharded_placement(params)
         else:
             # init slots for params never seen before, keep existing moments
             new_keys = [k for k in full if k not in self._seen_keys]
@@ -161,6 +162,20 @@ class Optimizer:
             if k in new_p:
                 p._value = new_p[k]
         self._step_count += 1
+
+    def _apply_group_sharded_placement(self, params=None):
+        """GroupSharded/ZeRO in the eager loop (ref: the reference's primary
+        group_sharded_parallel usage is loss.backward(); opt.step()): place
+        optimizer state — and at stage 3 the live parameters — on their
+        dp-sharded layout the first time state is materialised."""
+        gs = getattr(self, "_group_sharded", None)
+        if gs is None or self._func_state is None:
+            return
+        from ..distributed.fleet.sharding import shard_tree
+        self._func_state = shard_tree(self._func_state, gs.mesh, gs.axis)
+        if gs.shard_params and params:
+            for p in params:
+                p._value = shard_tree([p._value], gs.mesh, gs.axis)[0]
 
     def _apply_pending_state(self):
         pending = getattr(self, "_pending_state_leaves", None)

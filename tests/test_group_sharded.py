@@ -1,0 +1,140 @@
+"""GroupSharded (ZeRO 1/2/3) on the virtual 8-device mesh: numerics match
+the unsharded engine; state is actually partitioned (SURVEY §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+from paddle_tpu.hapi.engine import Engine
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()), ("dp",))
+
+
+def _model():
+    paddle.seed(7)
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(16, 64), paddle.nn.ReLU(), paddle.nn.Linear(64, 8))
+
+
+def _data(steps=4, batch=16):
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((steps, batch, 16)).astype(np.float32)
+    ys = rng.integers(0, 8, (steps, batch)).astype(np.int64)
+    return xs, ys
+
+
+def _run(level, mesh):
+    net = _model()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    if level is not None:
+        net, opt, _ = group_sharded_parallel(net, opt, level=level,
+                                             mesh=mesh)
+    eng = Engine(net, loss=paddle.nn.CrossEntropyLoss(), optimizer=opt,
+                 mesh=mesh)
+    losses = []
+    for x, y in zip(*_data()):
+        loss, _ = eng.train_batch([jnp.asarray(x)], [jnp.asarray(y)])
+        losses.append(float(loss))
+    return losses, eng
+
+
+@pytest.mark.parametrize("level", ["os", "os_g", "p_g_os"])
+def test_zero_levels_match_unsharded(level):
+    mesh = _mesh()
+    ref_losses, _ = _run(None, mesh)
+    got_losses, eng = _run(level, mesh)
+    np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-4, atol=1e-4)
+    # opt state moments must actually be partitioned over dp
+    leaves = [l for l in jax.tree_util.tree_leaves(eng._opt_state)
+              if hasattr(l, "sharding") and l.ndim >= 1
+              and max(l.shape) % mesh.shape["dp"] == 0
+              and max(l.shape) >= mesh.shape["dp"]]
+    assert leaves, "no shardable opt-state leaves found"
+    assert any(
+        isinstance(l.sharding, NamedSharding)
+        and "dp" in jax.tree_util.tree_leaves(tuple(l.sharding.spec))
+        for l in leaves), "opt state not sharded over dp"
+
+
+def test_stage3_params_sharded():
+    mesh = _mesh()
+    _, eng = _run("p_g_os", mesh)
+    sharded = [k for k, v in eng._params.items()
+               if isinstance(getattr(v, "sharding", None), NamedSharding)
+               and "dp" in jax.tree_util.tree_leaves(tuple(v.sharding.spec))]
+    assert sharded, "no parameters sharded over dp at stage 3"
+
+
+def test_bad_level_raises():
+    with pytest.raises(ValueError):
+        group_sharded_parallel(_model(), paddle.optimizer.SGD(0.1),
+                               level="zero9", mesh=_mesh())
+
+
+def test_fleet_sharding_strategy_routes_to_group_sharded():
+    import paddle_tpu.distributed.fleet as fleet
+    strat = fleet.DistributedStrategy()
+    strat.sharding = True
+    strat.sharding_configs = {"stage": 2}
+    fleet.fleet_obj.init(is_collective=True, strategy=strat)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=_model().parameters())
+    opt = fleet.fleet_obj.distributed_optimizer(opt)
+    assert opt._group_sharded.level == "os_g"
+
+
+def test_eager_step_applies_sharding():
+    """group_sharded_parallel must shard even in the eager
+    loss.backward(); opt.step() flow (the reference's primary usage)."""
+    mesh = _mesh()
+    net = _model()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    net, opt, _ = group_sharded_parallel(net, opt, level="os_g", mesh=mesh)
+    x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+        (16, 16)).astype(np.float32))
+    y = paddle.to_tensor(np.arange(16) % 8)
+    loss = paddle.nn.CrossEntropyLoss()(net(x), y)
+    loss.backward()
+    opt.step()
+    leaves = [l for l in jax.tree_util.tree_leaves(opt._func_state)
+              if hasattr(l, "sharding") and l.ndim >= 1]
+    assert any(
+        isinstance(l.sharding, NamedSharding)
+        and "dp" in jax.tree_util.tree_leaves(tuple(l.sharding.spec))
+        for l in leaves), "eager opt state not sharded over dp"
+
+
+def test_resume_reapplies_sharding():
+    """load_opt_state_dict must re-apply ZeRO placement (resume path)."""
+    mesh = _mesh()
+    _, eng = _run("p_g_os", mesh)
+    saved = jax.device_get(eng.opt_state_dict())
+
+    net = _model()
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    net, opt, _ = group_sharded_parallel(net, opt, level="p_g_os", mesh=mesh)
+    eng2 = Engine(net, loss=paddle.nn.CrossEntropyLoss(), optimizer=opt,
+                  mesh=mesh)
+    eng2.load_opt_state_dict(saved)
+    x, y = (a[0] for a in _data())
+    eng2.train_batch([jnp.asarray(x)], [jnp.asarray(y)])
+    leaves = [l for l in jax.tree_util.tree_leaves(eng2._opt_state)
+              if hasattr(l, "sharding") and l.ndim >= 1]
+    assert any(
+        isinstance(l.sharding, NamedSharding)
+        and "dp" in jax.tree_util.tree_leaves(tuple(l.sharding.spec))
+        for l in leaves), "resumed opt state not sharded over dp"
+
+
+def test_save_group_sharded_model_writes_opt_state(tmp_path):
+    from paddle_tpu.distributed.sharding import save_group_sharded_model
+    mesh = _mesh()
+    _, eng = _run("os", mesh)
+    out = tmp_path / "ckpt"
+    save_group_sharded_model(eng.network, str(out), optimizer=eng.optimizer)
+    assert (tmp_path / "ckpt.pdparams").exists()
+    assert (tmp_path / "ckpt.pdopt").exists()
