@@ -9,3 +9,16 @@ from .gpt import (  # noqa: F401
     GPTConfig, GPTModel, GPTForCausalLM, GPTLMHeadModel,
     GPTPretrainingCriterion, GPT_CONFIGS,
 )
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForPretraining, BertPretrainingCriterion,
+    BertForMaskedLM, BertForSequenceClassification,
+    BertForTokenClassification, BertForQuestionAnswering, BERT_CONFIGS,
+)
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieModel, ErnieForPretraining, ErniePretrainingCriterion,
+    ErnieForMaskedLM, ErnieForSequenceClassification,
+    ErnieForTokenClassification, ErnieForQuestionAnswering, ERNIE_CONFIGS,
+)
+from .tokenizer import (  # noqa: F401
+    BasicTokenizer, WordpieceTokenizer, BertTokenizer, GPTTokenizer,
+)

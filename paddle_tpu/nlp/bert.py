@@ -1,0 +1,405 @@
+"""BERT encoder family.
+
+ref parity: PaddleNLP paddlenlp/transformers/bert/modeling.py (BertModel,
+BertForPretraining, BertPretrainingCriterion, BertForSequenceClassification,
+BertForTokenClassification, BertForQuestionAnswering, BertForMaskedLM) and
+bert/configuration.py pretrained configs.
+
+TPU-native design: same mesh-aware building blocks as gpt.py — mpu
+Column/RowParallelLinear projections, VocabParallelEmbedding, flash-capable
+scaled_dot_product_attention (bidirectional, is_causal=False), post-LN
+residual blocks (the reference BERT's normalize_before=False). The MLM head
+ties the word embedding via parallel_matmul and its loss is vocab-parallel
+safe through ParallelCrossEntropy.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.initializer import Normal, ParamAttr
+from ..nn.layer import Layer
+from ..nn.layers_common import Dropout, Embedding, LayerList, Linear
+from ..nn.layers_norm import LayerNorm
+from ..tensor import Tensor
+from ..distributed.fleet.mpu import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, parallel_matmul)
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 0  # 0 -> 4*hidden
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    pool_act: str = "tanh"
+    use_flash_attention: bool = True
+    num_labels: int = 2
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+# ref: bert/configuration.py BERT_PRETRAINED_INIT_CONFIGURATION
+BERT_CONFIGS = {
+    "bert-base-uncased": dict(vocab_size=30522, hidden_size=768,
+                              num_hidden_layers=12, num_attention_heads=12),
+    "bert-large-uncased": dict(vocab_size=30522, hidden_size=1024,
+                               num_hidden_layers=24, num_attention_heads=16),
+    "bert-base-chinese": dict(vocab_size=21128, hidden_size=768,
+                              num_hidden_layers=12, num_attention_heads=12),
+    "bert-tiny": dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, max_position_embeddings=128,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0),
+}
+
+
+def _resolve_config(name, **overrides):
+    cfg = dict(BERT_CONFIGS[name])
+    cfg.update(overrides)
+    return BertConfig(**cfg)
+
+
+def _init_attr(cfg):
+    return ParamAttr(initializer=Normal(mean=0.0, std=cfg.initializer_range))
+
+
+from .modeling_utils import normalize_attention_mask as _normalize_mask
+
+
+class BertSelfAttention(Layer):
+    """Bidirectional multi-head attention with mp-sharded heads (ref:
+    bert/modeling.py's nn.MultiHeadAttention usage)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.cfg = config
+        h = config.hidden_size
+        wa = _init_attr(config)
+        self.q_proj = ColumnParallelLinear(h, h, weight_attr=wa,
+                                           gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, h, weight_attr=wa,
+                                           gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, h, weight_attr=wa,
+                                           gather_output=False)
+        self.out_proj = RowParallelLinear(h, h, weight_attr=wa,
+                                          input_is_parallel=True)
+
+    def _heads(self, x):
+        b, s = x.shape[0], x.shape[1]
+        return x.reshape([b, s, -1, self.cfg.head_dim])
+
+    def forward(self, x, attn_mask=None):
+        q = self._heads(self.q_proj(x))
+        k = self._heads(self.k_proj(x))
+        v = self._heads(self.v_proj(x))
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.cfg.attention_probs_dropout_prob
+            if self.training else 0.0,
+            is_causal=False, training=self.training,
+            use_flash=self.cfg.use_flash_attention)
+        b, s = out.shape[0], out.shape[1]
+        return self.out_proj(out.reshape([b, s, -1]))
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (ref BERT normalize_before=False)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        eps = config.layer_norm_eps
+        wa = _init_attr(config)
+        self.attn = BertSelfAttention(config)
+        self.dropout1 = Dropout(config.hidden_dropout_prob)
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=eps)
+        self.fc1 = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, weight_attr=wa,
+            gather_output=False)
+        self.fc2 = RowParallelLinear(
+            config.intermediate_size, config.hidden_size, weight_attr=wa,
+            input_is_parallel=True)
+        self.act = getattr(F, config.hidden_act)
+        self.dropout2 = Dropout(config.hidden_dropout_prob)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=eps)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln_1(x + self.dropout1(self.attn(x, attn_mask)))
+        x = self.ln_2(x + self.dropout2(self.fc2(self.act(self.fc1(x)))))
+        return x
+
+
+class BertEmbeddings(Layer):
+    """word (vocab-parallel) + position + token-type embeddings with
+    post-sum LayerNorm (ref bert/modeling.py BertEmbeddings)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        wa = _init_attr(config)
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=wa)
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=wa)
+        self.token_type_embeddings = Embedding(
+            config.type_vocab_size, config.hidden_size, weight_attr=wa)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        if token_type_ids is None:
+            token_type_ids = Tensor(
+                jnp.zeros((input_ids.shape[0], s), dtype=jnp.int32))
+        e = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(e))
+
+
+class BertPooler(Layer):
+    """[CLS] token -> dense -> tanh (ref BertPooler)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size,
+                            weight_attr=_init_attr(config))
+        self.act = getattr(F, config.pool_act)
+
+    def forward(self, hidden):
+        return self.act(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    """ref: bert/modeling.py BertModel — returns (sequence_output,
+    pooled_output)."""
+
+    def __init__(self, config: BertConfig = None, **kwargs):
+        super().__init__()
+        if config is None:
+            config = BertConfig(**kwargs)
+        elif isinstance(config, dict):
+            config = BertConfig(**config)
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = LayerList([BertLayer(config)
+                                  for _ in range(config.num_hidden_layers)])
+        self.pooler = BertPooler(config)
+
+    @classmethod
+    def from_config_name(cls, name, **overrides):
+        return cls(_resolve_config(name, **overrides))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        mask = _normalize_mask(attention_mask)
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for blk in self.encoder:
+            x = blk(x, mask)
+        return x, self.pooler(x)
+
+
+class BertLMPredictionHead(Layer):
+    """MLM head: dense + act + LN, decode tied to the word embedding via
+    parallel_matmul (ref BertLMPredictionHead's decoder_weight tie)."""
+
+    def __init__(self, config: BertConfig, embedding_weights):
+        super().__init__()
+        self.transform = Linear(config.hidden_size, config.hidden_size,
+                                weight_attr=_init_attr(config))
+        self.act = getattr(F, config.hidden_act)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_eps)
+        self._tied = embedding_weights
+        from jax.sharding import PartitionSpec as P
+        from ..nn.initializer import Constant
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size], attr=ParamAttr(initializer=Constant(0.0)),
+            is_bias=True)
+        # logits from parallel_matmul(gather_output=False) are vocab-LOCAL
+        # under mp, so the bias must shard over the same axis
+        self.decoder_bias.sharding_spec = P("mp")
+
+    def forward(self, hidden):
+        h = self.layer_norm(self.act(self.transform(hidden)))
+        logits = parallel_matmul(h, self._tied, transpose_y=True,
+                                 gather_output=False)
+        return logits + self.decoder_bias
+
+
+class BertPretrainingHeads(Layer):
+    def __init__(self, config: BertConfig, embedding_weights):
+        super().__init__()
+        self.predictions = BertLMPredictionHead(config, embedding_weights)
+        self.seq_relationship = Linear(config.hidden_size, 2,
+                                       weight_attr=_init_attr(config))
+
+    def forward(self, sequence_output, pooled_output):
+        return (self.predictions(sequence_output),
+                self.seq_relationship(pooled_output))
+
+
+class BertForPretraining(Layer):
+    """ref: BertForPretraining — MLM + NSP."""
+
+    def __init__(self, config: BertConfig = None, **kwargs):
+        super().__init__()
+        self.bert = BertModel(config, **kwargs)
+        self.config = self.bert.config
+        self.cls = BertPretrainingHeads(
+            self.config, self.bert.embeddings.word_embeddings.weight)
+
+    @classmethod
+    def from_config_name(cls, name, **overrides):
+        return cls(_resolve_config(name, **overrides))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                attention_mask)
+        return self.cls(seq, pooled)
+
+
+class BertPretrainingCriterion(Layer):
+    """ref: BertPretrainingCriterion — summed MLM (masked mean) + NSP CE,
+    vocab-parallel safe."""
+
+    def __init__(self, config=None):
+        super().__init__()
+        self.ce = ParallelCrossEntropy()
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels=None,
+                masked_lm_weights=None):
+        mlm = self.ce(prediction_scores, masked_lm_labels)
+        if masked_lm_weights is not None:
+            w = masked_lm_weights if isinstance(masked_lm_weights, Tensor) \
+                else Tensor(masked_lm_weights)
+            w = w.astype(mlm.dtype)
+            mlm_loss = (mlm * w).sum() / w.sum().clip(min=1.0)
+        else:
+            # masked mean: ignore_index positions are zeroed by the CE, so
+            # normalise by the valid count, not b*s (ref criterion divides
+            # by the masked-token count)
+            labels = masked_lm_labels if isinstance(masked_lm_labels, Tensor)\
+                else Tensor(masked_lm_labels)
+            valid = Tensor(
+                (labels._value != self.ce.ignore_index)).astype(mlm.dtype)
+            mlm_loss = mlm.sum() / valid.sum().clip(min=1.0)
+        if next_sentence_labels is None:
+            return mlm_loss
+        nsp_loss = F.cross_entropy(seq_relationship_score,
+                                   next_sentence_labels)
+        return mlm_loss + nsp_loss
+
+
+class _TaskHead(Layer):
+    """Shared scaffolding for encoder task heads: builds the backbone under
+    the reference's attribute name (model.bert / model.ernie) so state-dict
+    keys match, and exposes it uniformly as `self.backbone`. ERNIE heads in
+    ernie.py subclass these with backbone_cls/backbone_attr/_resolve
+    swapped (same relationship the reference's ernie/modeling.py has to
+    bert/modeling.py)."""
+
+    backbone_cls = BertModel
+    backbone_attr = "bert"
+    _resolve = staticmethod(_resolve_config)
+
+    def __init__(self, config=None, **kwargs):
+        super().__init__()
+        backbone = self.backbone_cls(config, **kwargs)
+        setattr(self, self.backbone_attr, backbone)
+        self.config = backbone.config
+
+    @property
+    def backbone(self):
+        return getattr(self, self.backbone_attr)
+
+    @classmethod
+    def from_config_name(cls, name, **overrides):
+        num_labels = overrides.pop("num_labels", None)
+        kw = {} if num_labels is None else {"num_labels": num_labels}
+        return cls(cls._resolve(name, **overrides), **kw)
+
+
+class BertForMaskedLM(_TaskHead):
+    def __init__(self, config=None, **kwargs):
+        super().__init__(config, **kwargs)
+        self.cls = BertLMPredictionHead(
+            self.config, self.backbone.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, _ = self.backbone(input_ids, token_type_ids, position_ids,
+                               attention_mask)
+        return self.cls(seq)
+
+
+class BertForSequenceClassification(_TaskHead):
+    """ref: BertForSequenceClassification — pooled output -> dropout ->
+    num_labels logits."""
+
+    def __init__(self, config=None, num_labels=None, **kwargs):
+        super().__init__(config, **kwargs)
+        n = num_labels or self.config.num_labels
+        self.dropout = Dropout(self.config.hidden_dropout_prob)
+        self.classifier = Linear(self.config.hidden_size, n,
+                                 weight_attr=_init_attr(self.config))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.backbone(input_ids, token_type_ids, position_ids,
+                                  attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForTokenClassification(_TaskHead):
+    def __init__(self, config=None, num_labels=None, **kwargs):
+        super().__init__(config, **kwargs)
+        n = num_labels or self.config.num_labels
+        self.dropout = Dropout(self.config.hidden_dropout_prob)
+        self.classifier = Linear(self.config.hidden_size, n,
+                                 weight_attr=_init_attr(self.config))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, _ = self.backbone(input_ids, token_type_ids, position_ids,
+                               attention_mask)
+        return self.classifier(self.dropout(seq))
+
+
+class BertForQuestionAnswering(_TaskHead):
+    """ref: BertForQuestionAnswering — (start_logits, end_logits)."""
+
+    def __init__(self, config=None, **kwargs):
+        super().__init__(config, **kwargs)
+        self.classifier = Linear(self.config.hidden_size, 2,
+                                 weight_attr=_init_attr(self.config))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, _ = self.backbone(input_ids, token_type_ids, position_ids,
+                               attention_mask)
+        logits = self.classifier(seq)
+        return logits[:, :, 0], logits[:, :, 1]

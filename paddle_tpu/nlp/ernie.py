@@ -1,0 +1,170 @@
+"""ERNIE encoder family (Baidu's flagship pretrained LM).
+
+ref parity: PaddleNLP paddlenlp/transformers/ernie/modeling.py (ErnieModel,
+ErnieForSequenceClassification, ErnieForTokenClassification,
+ErnieForQuestionAnswering, ErnieForMaskedLM, ErnieForPretraining,
+ErniePretrainingCriterion) and ernie/configuration.py (ERNIE 3.0 configs).
+
+Architecturally ERNIE is a BERT-style post-LN encoder plus an optional
+task-type embedding (use_task_id, ERNIE 3.0); we reuse the mesh-aware BERT
+blocks and add the task embedding — same relationship the reference has
+(ernie/modeling.py mirrors bert/modeling.py with task_type_embeddings).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers_common import Dropout, Embedding, LayerList, Linear
+from ..tensor import Tensor
+from .bert import (BertConfig, BertEmbeddings, BertLayer,
+                   BertLMPredictionHead, BertPooler,
+                   BertForMaskedLM, BertForSequenceClassification,
+                   BertForTokenClassification, BertForQuestionAnswering,
+                   BertPretrainingCriterion, _init_attr, _normalize_mask)
+
+
+@dataclass
+class ErnieConfig(BertConfig):
+    vocab_size: int = 40000
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+    pool_act: str = "tanh"
+
+
+# ref: ernie/configuration.py ERNIE_PRETRAINED_INIT_CONFIGURATION
+# (ernie-3.0-base-zh: 12L x 768; ernie-3.0-medium-zh: 6L x 768)
+ERNIE_CONFIGS = {
+    "ernie-3.0-base-zh": dict(vocab_size=40000, hidden_size=768,
+                              num_hidden_layers=12, num_attention_heads=12,
+                              max_position_embeddings=2048),
+    "ernie-3.0-medium-zh": dict(vocab_size=40000, hidden_size=768,
+                                num_hidden_layers=6, num_attention_heads=12,
+                                max_position_embeddings=2048),
+    "ernie-3.0-mini-zh": dict(vocab_size=40000, hidden_size=384,
+                              num_hidden_layers=6, num_attention_heads=12,
+                              max_position_embeddings=2048),
+    "ernie-1.0": dict(vocab_size=18000, hidden_size=768,
+                      num_hidden_layers=12, num_attention_heads=12,
+                      max_position_embeddings=513, use_task_id=False),
+    "ernie-tiny": dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, max_position_embeddings=128,
+                       hidden_dropout_prob=0.0,
+                       attention_probs_dropout_prob=0.0),
+}
+
+
+def _resolve_config(name, **overrides):
+    cfg = dict(ERNIE_CONFIGS[name])
+    cfg.update(overrides)
+    return ErnieConfig(**cfg)
+
+
+class ErnieEmbeddings(BertEmbeddings):
+    """BertEmbeddings + task-type embedding (ref ErnieEmbeddings)."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__(config)
+        self.use_task_id = config.use_task_id
+        if config.use_task_id:
+            self.task_type_embeddings = Embedding(
+                config.task_type_vocab_size, config.hidden_size,
+                weight_attr=_init_attr(config))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32)[None, :])
+        if token_type_ids is None:
+            token_type_ids = Tensor(
+                jnp.zeros((input_ids.shape[0], s), dtype=jnp.int32))
+        e = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        if self.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = Tensor(
+                    jnp.zeros((input_ids.shape[0], s), dtype=jnp.int32))
+            e = e + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(e))
+
+
+class ErnieModel(Layer):
+    """ref: ernie/modeling.py ErnieModel — returns (sequence_output,
+    pooled_output)."""
+
+    def __init__(self, config: ErnieConfig = None, **kwargs):
+        super().__init__()
+        if config is None:
+            config = ErnieConfig(**kwargs)
+        elif isinstance(config, dict):
+            config = ErnieConfig(**config)
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+        self.encoder = LayerList([BertLayer(config)
+                                  for _ in range(config.num_hidden_layers)])
+        self.pooler = BertPooler(config)
+
+    @classmethod
+    def from_config_name(cls, name, **overrides):
+        return cls(_resolve_config(name, **overrides))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        mask = _normalize_mask(attention_mask)
+        x = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        for blk in self.encoder:
+            x = blk(x, mask)
+        return x, self.pooler(x)
+
+
+class ErnieForSequenceClassification(BertForSequenceClassification):
+    backbone_cls = ErnieModel
+    backbone_attr = "ernie"
+    _resolve = staticmethod(_resolve_config)
+
+
+class ErnieForTokenClassification(BertForTokenClassification):
+    backbone_cls = ErnieModel
+    backbone_attr = "ernie"
+    _resolve = staticmethod(_resolve_config)
+
+
+class ErnieForQuestionAnswering(BertForQuestionAnswering):
+    backbone_cls = ErnieModel
+    backbone_attr = "ernie"
+    _resolve = staticmethod(_resolve_config)
+
+
+class ErnieForMaskedLM(BertForMaskedLM):
+    backbone_cls = ErnieModel
+    backbone_attr = "ernie"
+    _resolve = staticmethod(_resolve_config)
+
+
+class ErnieForPretraining(Layer):
+    """ref: ErnieForPretraining — MLM + NSP heads."""
+
+    def __init__(self, config: ErnieConfig = None, **kwargs):
+        super().__init__()
+        self.ernie = ErnieModel(config, **kwargs)
+        self.config = self.ernie.config
+        self.cls = BertLMPredictionHead(
+            self.config, self.ernie.embeddings.word_embeddings.weight)
+        self.seq_relationship = Linear(self.config.hidden_size, 2,
+                                       weight_attr=_init_attr(self.config))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                                 attention_mask)
+        return self.cls(seq), self.seq_relationship(pooled)
+
+
+class ErniePretrainingCriterion(BertPretrainingCriterion):
+    """ref: ErniePretrainingCriterion — same contract as BERT's."""

@@ -230,24 +230,11 @@ class GPTModel(Layer):
             s = input_ids.shape[1]
             position_ids = Tensor(
                 (past + jnp.arange(s, dtype=jnp.int32))[None, :])
-        if attention_mask is not None:
-            # normalise padding masks to [b, 1, sq|1, sk] so they broadcast
-            # against [b, heads, sq, sk] logits; causal structure is added
-            # by the attention op itself. 0/1 padding masks (int or float,
-            # the tokenizer convention — ref paddlenlp GPTModel's
-            # _prepare_decoder_attention_mask turns them into additive -1e4)
-            # become bool keep-masks; 4D float masks pass through as
-            # additive biases (paddle.nn.functional sdpa semantics).
-            m = attention_mask._value if isinstance(attention_mask, Tensor) \
-                else jnp.asarray(attention_mask)
-            is_padding = m.ndim <= 3  # 2D/3D = keep/drop convention
-            if m.ndim == 2:
-                m = m[:, None, None, :]
-            elif m.ndim == 3:
-                m = m[:, None]
-            if m.dtype != jnp.bool_ and is_padding:
-                m = m != 0
-            attention_mask = Tensor(m)
+        # causal structure is added by the attention op itself; the user
+        # mask is padding-only (ref paddlenlp GPTModel's
+        # _prepare_decoder_attention_mask)
+        from .modeling_utils import normalize_attention_mask
+        attention_mask = normalize_attention_mask(attention_mask)
         x = self.embeddings(input_ids, position_ids)
         x = annotate(x, "dp", None, None)
         new_caches = [] if (use_cache or cache is not None) else None

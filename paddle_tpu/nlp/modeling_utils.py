@@ -1,0 +1,29 @@
+"""Shared transformer modeling helpers (BERT/ERNIE/GPT).
+
+ref: the mask preparation logic every PaddleNLP model repeats in
+modeling.py (_prepare_decoder_attention_mask / get_extended_attention_mask).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+def normalize_attention_mask(attention_mask):
+    """Normalise a user attention mask to [b, 1, sq|1, sk] broadcastable
+    form: 2D/3D 0/1 padding masks (int or float — the tokenizer
+    convention) become bool keep-masks; 4D float masks pass through as
+    additive biases (paddle.nn.functional sdpa semantics)."""
+    if attention_mask is None:
+        return None
+    m = attention_mask._value if isinstance(attention_mask, Tensor) \
+        else jnp.asarray(attention_mask)
+    is_padding = m.ndim <= 3
+    if m.ndim == 2:
+        m = m[:, None, None, :]
+    elif m.ndim == 3:
+        m = m[:, None]
+    if m.dtype != jnp.bool_ and is_padding:
+        m = m != 0
+    return Tensor(m)
