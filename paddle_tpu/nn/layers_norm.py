@@ -33,8 +33,16 @@ class _BatchNormBase(Layer):
         else:
             self.bias = self.create_parameter(
                 (num_features,), attr=bias_attr, is_bias=True)
-        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
-        self.register_buffer("_variance", Tensor(jnp.ones((num_features,))))
+        from ..framework import get_default_dtype, convert_dtype
+        dt = convert_dtype(get_default_dtype())
+        if dt in (jnp.float16, jnp.bfloat16):
+            # ref keeps BN running stats in fp32 under low-precision
+            # defaults: momentum-0.9 deltas underflow in 8-bit mantissas
+            dt = jnp.float32
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros((num_features,), dtype=dt)))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones((num_features,), dtype=dt)))
 
     def forward(self, x):
         return F.batch_norm(

@@ -1,0 +1,33 @@
+"""Shared vision-model building blocks."""
+from __future__ import annotations
+
+from ... import nn
+
+
+def check_pretrained(pretrained):
+    """ref: the load_dygraph_pretrain path — this offline environment ships
+    no weight files, so fail fast instead of silently returning random
+    init."""
+    if pretrained:
+        raise NotImplementedError("no pretrained weights in this environment")
+
+
+class ConvBNLayer(nn.Layer):
+    """Conv2D + BatchNorm2D + optional activation — the block every conv
+    net in the zoo repeats (ref: ConvBNLayer in each
+    python/paddle/vision/models/*.py)."""
+
+    _ACTS = {"relu": nn.ReLU, "relu6": nn.ReLU6, "hardswish": nn.Hardswish,
+             "swish": nn.Swish, None: None}
+
+    def __init__(self, in_c, out_c, k, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = self._ACTS[act]() if self._ACTS[act] else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act else x

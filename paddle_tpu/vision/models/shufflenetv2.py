@@ -1,0 +1,139 @@
+"""ShuffleNetV2 (ref: python/paddle/vision/models/shufflenetv2.py).
+
+channel_shuffle is a pure reshape/transpose — free under XLA fusion.
+"""
+from __future__ import annotations
+
+from ...tensor_ops.manip import concat
+from ... import nn
+from ._utils import check_pretrained
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048)}
+_STAGE_REPEATS = (4, 8, 4)
+
+
+def channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = x.reshape([b, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([b, c, h, w])
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = out_c // 2
+        if stride == 1:
+            self.branch2 = self._main(in_c // 2, branch, stride, act)
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1,
+                          groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch, 1, bias_attr=False),
+                nn.BatchNorm2D(branch), _act(act))
+            self.branch2 = self._main(in_c, branch, stride, act)
+
+    @staticmethod
+    def _main(in_c, out_c, stride, act):
+        return nn.Sequential(
+            nn.Conv2D(in_c, out_c, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c), _act(act),
+            nn.Conv2D(out_c, out_c, 3, stride=stride, padding=1,
+                      groups=out_c, bias_attr=False),
+            nn.BatchNorm2D(out_c),
+            nn.Conv2D(out_c, out_c, 1, bias_attr=False),
+            nn.BatchNorm2D(out_c), _act(act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            x1, x2 = x[:, :half], x[:, half:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        assert scale in _STAGE_OUT, f"supported scales: {sorted(_STAGE_OUT)}"
+        outs = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, outs[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(outs[0]), _act(act))
+        self.max_pool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = outs[0]
+        for out_c, repeats in zip(outs[1:4], _STAGE_REPEATS):
+            stages.append(InvertedResidual(in_c, out_c, 2, act))
+            for _ in range(repeats - 1):
+                stages.append(InvertedResidual(out_c, out_c, 1, act))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_c, outs[4], 1, bias_attr=False),
+            nn.BatchNorm2D(outs[4]), _act(act))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(outs[4], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.max_pool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(0.33, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(2.0, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    check_pretrained(pretrained)
+    return ShuffleNetV2(1.0, act="swish", **kw)

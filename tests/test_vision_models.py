@@ -1,0 +1,97 @@
+"""Vision model zoo forward shapes + trainability (SURVEY §2.9)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+from paddle_tpu.vision import models as M
+
+
+def _img(b=2, c=3, hw=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return Tensor(jnp.asarray(rng.standard_normal((b, c, hw, hw)),
+                              dtype=jnp.float32))
+
+
+# constructor, input size, kwargs — small classes to keep CPU time low
+_CASES = [
+    (M.vgg11, 64, {}),
+    (M.vgg16, 64, {"batch_norm": True}),
+    (M.alexnet, 96, {}),
+    (M.squeezenet1_0, 64, {}),
+    (M.squeezenet1_1, 64, {}),
+    (M.mobilenet_v1, 64, {"scale": 0.25}),
+    (M.mobilenet_v2, 64, {"scale": 0.25}),
+    (M.mobilenet_v3_small, 64, {"scale": 0.5}),
+    (M.mobilenet_v3_large, 64, {"scale": 0.5}),
+    (M.densenet121, 64, {}),
+    (M.shufflenet_v2_x0_25, 64, {}),
+    (M.shufflenet_v2_swish, 64, {}),
+    (M.inception_v3, 128, {}),
+]
+
+
+@pytest.mark.parametrize("ctor,hw,kw",
+                         _CASES, ids=[c[0].__name__ for c in _CASES])
+def test_forward_shape(ctor, hw, kw):
+    paddle.seed(0)
+    m = ctor(num_classes=10, **kw)
+    m.eval()
+    out = m(_img(hw=hw))
+    assert tuple(out.shape) == (2, 10)
+    assert bool(jnp.isfinite(out._value).all())
+
+
+def test_googlenet_aux_heads():
+    paddle.seed(0)
+    m = M.googlenet(num_classes=10)
+    m.train()
+    out, aux1, aux2 = m(_img(hw=96))
+    assert tuple(out.shape) == tuple(aux1.shape) == tuple(aux2.shape) \
+        == (2, 10)
+    m.eval()
+    out = m(_img(hw=96))
+    assert tuple(out.shape) == (2, 10)
+
+
+def test_mobilenet_trains():
+    """one of the new families must actually learn (grad path sound)."""
+    from paddle_tpu.hapi.engine import Engine
+    paddle.seed(0)
+    m = M.mobilenet_v2(scale=0.25, num_classes=2)
+    opt = paddle.optimizer.Adam(2e-3, parameters=m.parameters())
+    eng = Engine(m, loss=paddle.nn.CrossEntropyLoss(), optimizer=opt)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 3, 32, 32)).astype(np.float32)
+    x[:4] += 2.0
+    y = np.array([1] * 4 + [0] * 4)
+    losses = [float(eng.train_batch([jnp.asarray(x)], [jnp.asarray(y)])[0])
+              for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5, losses[::6]
+
+
+def test_state_dict_roundtrip():
+    paddle.seed(0)
+    m = M.shufflenet_v2_x0_25(num_classes=4)
+    m.eval()
+    x = _img(hw=32)
+    want = np.asarray(m(x)._value)
+    sd = {k: np.asarray(v._value) for k, v in m.state_dict().items()}
+    paddle.seed(123)
+    m2 = M.shufflenet_v2_x0_25(num_classes=4)
+    m2.eval()
+    m2.set_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(m2(x)._value), want, atol=1e-6)
+
+
+def test_pretrained_raises():
+    with pytest.raises(NotImplementedError):
+        M.vgg16(pretrained=True)
+    with pytest.raises(NotImplementedError):
+        M.mobilenet_v2(pretrained=True)
+
+
+def test_squeezenet_bad_version_raises():
+    with pytest.raises(ValueError):
+        M.SqueezeNet(version="1_0")
