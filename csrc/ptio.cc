@@ -1,0 +1,214 @@
+// paddle_tpu native IO runtime.
+//
+// ref parity: paddle/fluid/operators/reader/buffered_reader.cc (double
+// buffered reader), paddle/fluid/memory/allocation/buffered_allocator.cc
+// (buffer pool), and the shared-memory DataLoader queue in
+// paddle/fluid/dataloader — the reference moves sample batches between
+// worker processes and the trainer through C++ queues so Python never
+// blocks the pipeline.
+//
+// TPU-native design: JAX owns device transfer (device_put), so the native
+// layer's job is host-side: bounded blocking queues (backpressure without
+// the GIL), an aligned reusable buffer pool (stable staging addresses for
+// zero-realloc batch assembly), and GIL-free memcpy/gather for collation.
+// Python objects never cross this boundary — numpy payloads stay in a
+// Python slot table and only slot ids ride the queue (see
+// paddle_tpu/io/native.py).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Bounded blocking queue of int64 slot ids.
+// ---------------------------------------------------------------------------
+
+struct PtioQueue {
+  std::mutex mu;
+  std::condition_variable not_full;
+  std::condition_variable not_empty;
+  std::deque<int64_t> items;
+  size_t capacity;
+  bool closed = false;
+  std::atomic<int> active{0};  // callers inside push/pop; destroy waits
+};
+
+void* ptio_queue_create(int capacity) {
+  auto* q = new PtioQueue();
+  q->capacity = capacity > 0 ? static_cast<size_t>(capacity) : 1;
+  return q;
+}
+
+// Blocks while full. Returns 1 on success, 0 if the queue was closed.
+int ptio_queue_push(void* hq, long item) {
+  auto* q = static_cast<PtioQueue*>(hq);
+  q->active.fetch_add(1);
+  {
+    std::unique_lock<std::mutex> lk(q->mu);
+    q->not_full.wait(lk, [q] {
+      return q->closed || q->items.size() < q->capacity;
+    });
+    if (q->closed) {
+      q->active.fetch_sub(1);
+      return 0;
+    }
+    q->items.push_back(item);
+  }
+  q->not_empty.notify_one();
+  q->active.fetch_sub(1);
+  return 1;
+}
+
+// Blocks while empty. Returns the item, or -1 if closed and drained.
+long ptio_queue_pop(void* hq) {
+  auto* q = static_cast<PtioQueue*>(hq);
+  q->active.fetch_add(1);
+  int64_t out = -1;
+  {
+    std::unique_lock<std::mutex> lk(q->mu);
+    q->not_empty.wait(lk, [q] { return q->closed || !q->items.empty(); });
+    if (!q->items.empty()) {
+      out = q->items.front();
+      q->items.pop_front();
+    }
+  }
+  q->not_full.notify_one();
+  q->active.fetch_sub(1);
+  return out;
+}
+
+int ptio_queue_size(void* hq) {
+  auto* q = static_cast<PtioQueue*>(hq);
+  std::lock_guard<std::mutex> lk(q->mu);
+  return static_cast<int>(q->items.size());
+}
+
+// Wake every blocked producer/consumer; subsequent pushes fail, pops drain
+// then return -1.
+void ptio_queue_close(void* hq) {
+  auto* q = static_cast<PtioQueue*>(hq);
+  {
+    std::lock_guard<std::mutex> lk(q->mu);
+    q->closed = true;
+  }
+  q->not_full.notify_all();
+  q->not_empty.notify_all();
+}
+
+// CONTRACT: only call once no other thread can still enter push/pop on
+// this handle (the Python bridge closes, joins its producer thread, then
+// destroys). The active-counter wait below is a belt-and-braces guard for
+// callers already *inside* push/pop at close time; it cannot protect a
+// thread that holds the handle but hasn't entered yet.
+void ptio_queue_destroy(void* hq) {
+  auto* q = static_cast<PtioQueue*>(hq);
+  ptio_queue_close(hq);
+  while (q->active.load() != 0) {
+    std::this_thread::yield();
+  }
+  delete q;
+}
+
+// ---------------------------------------------------------------------------
+// Aligned host buffer pool: fixed-size reusable staging buffers so batch
+// assembly writes to stable addresses (the pinned-memory analogue; TPU
+// DMA from host prefers aligned, long-lived buffers).
+// ---------------------------------------------------------------------------
+
+struct PtioPool {
+  std::mutex mu;
+  std::condition_variable avail;
+  std::vector<void*> all;
+  std::deque<void*> free_list;
+  size_t buf_bytes;
+  bool closed = false;
+};
+
+void* ptio_pool_create(int n_buffers, size_t bytes) {
+  auto* p = new PtioPool();
+  p->buf_bytes = bytes;
+  for (int i = 0; i < n_buffers; ++i) {
+    void* b = nullptr;
+    if (posix_memalign(&b, 64, bytes) != 0) {
+      b = std::malloc(bytes);
+    }
+    p->all.push_back(b);
+    p->free_list.push_back(b);
+  }
+  return p;
+}
+
+// Blocks until a buffer is free. Returns nullptr if the pool was closed.
+void* ptio_pool_acquire(void* hp) {
+  auto* p = static_cast<PtioPool*>(hp);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->avail.wait(lk, [p] { return p->closed || !p->free_list.empty(); });
+  if (p->closed) return nullptr;
+  void* b = p->free_list.front();
+  p->free_list.pop_front();
+  return b;
+}
+
+int ptio_pool_release(void* hp, void* buf) {
+  auto* p = static_cast<PtioPool*>(hp);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->free_list.push_back(buf);
+  }
+  p->avail.notify_one();
+  return 1;
+}
+
+size_t ptio_pool_buffer_bytes(void* hp) {
+  return static_cast<PtioPool*>(hp)->buf_bytes;
+}
+
+// Wake blocked acquirers; subsequent acquires return nullptr. Frees
+// nothing — see ptio_pool_destroy's contract.
+void ptio_pool_close(void* hp) {
+  auto* p = static_cast<PtioPool*>(hp);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->closed = true;
+  }
+  p->avail.notify_all();
+}
+
+// CONTRACT: only call once no thread is blocked in acquire and no
+// acquired buffer is still in use (close first, then join users).
+void ptio_pool_destroy(void* hp) {
+  auto* p = static_cast<PtioPool*>(hp);
+  ptio_pool_close(hp);
+  for (void* b : p->all) std::free(b);
+  delete p;
+}
+
+// ---------------------------------------------------------------------------
+// GIL-free copies (ctypes releases the GIL around foreign calls, so these
+// overlap with Python-side work — the reference's memcpy-in-C++ reader
+// threads get the same effect).
+// ---------------------------------------------------------------------------
+
+void ptio_memcpy(void* dst, const void* src, size_t n) {
+  std::memcpy(dst, src, n);
+}
+
+// Gather n_rows row pointers into one contiguous staging buffer (batch
+// collation: list-of-sample-arrays -> [batch, ...] without Python loops).
+void ptio_gather_rows(void* dst, const void** srcs, int n_rows,
+                      size_t row_bytes) {
+  char* out = static_cast<char*>(dst);
+  for (int i = 0; i < n_rows; ++i) {
+    std::memcpy(out + static_cast<size_t>(i) * row_bytes, srcs[i], row_bytes);
+  }
+}
+
+}  // extern "C"

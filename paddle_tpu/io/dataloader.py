@@ -23,7 +23,13 @@ from .sampler import BatchSampler
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (np.ndarray, np.generic)):
-        return np.stack([np.asarray(b) for b in batch])
+        arrs = [np.asarray(b) for b in batch]
+        if (len(arrs) > 1 and arrs[0].ndim > 0
+                and all(a.shape == arrs[0].shape
+                        and a.dtype == arrs[0].dtype for a in arrs[1:])):
+            from .native import gather_rows
+            return gather_rows(arrs)  # one native memcpy sweep, no GIL
+        return np.stack(arrs)
     if isinstance(sample, Tensor):
         return np.stack([np.asarray(b._value) for b in batch])
     if isinstance(sample, (int, float)):
@@ -94,9 +100,12 @@ class DataLoader:
         done = object()
 
         def producer(put):
+            # put returns False once the consumer closed the queue — stop
+            # quietly instead of retrying into a dead queue
             try:
                 for item in gen:
-                    put(item)
+                    if not put(item):
+                        return
                 put(done)
             except BaseException as e:  # propagate worker errors to consumer
                 put(_WorkerError(e))
@@ -105,19 +114,29 @@ class DataLoader:
             t = threading.Thread(target=producer, args=(native.put,),
                                  daemon=True)
             t.start()
-            while True:
-                item = native.get()
-                if item is done:
-                    break
-                if isinstance(item, _WorkerError):
-                    raise item.exc
-                yield _to_tensors(item)
-            t.join()
-            native.close()
+            try:
+                while True:
+                    item = native.get()
+                    if item is done or item is native.CLOSED:
+                        break
+                    if isinstance(item, _WorkerError):
+                        raise item.exc
+                    yield _to_tensors(item)
+            finally:
+                # early exit included: wake the (possibly push-blocked)
+                # producer, join it, and only then free the native queue
+                native.close()
+                t.join(timeout=10)
+                native.destroy()
             return
         # pure-python fallback
         q = _queue.Queue(maxsize=depth)
-        t = threading.Thread(target=producer, args=(q.put,), daemon=True)
+
+        def py_put(item):
+            q.put(item)
+            return True
+
+        t = threading.Thread(target=producer, args=(py_put,), daemon=True)
         t.start()
         while True:
             item = q.get()
@@ -144,3 +163,40 @@ def _to_tensors(batch):
     if isinstance(batch, dict):
         return {k: _to_tensors(v) for k, v in batch.items()}
     return batch
+
+
+def device_prefetch(iterable, sharding=None, size=2):
+    """Double-buffered host->device feed (ref: buffered_reader.cc's
+    pinned-staging + async H2D copy pair).
+
+    jax.device_put is asynchronous: issuing batch N+1's transfer before
+    yielding batch N overlaps the copy with the running step. `size` is the
+    number of in-flight device batches (2 = classic double buffering);
+    `sharding` optionally places batches (e.g. NamedSharding over 'dp')."""
+    import collections
+    import jax
+
+    def put(batch):
+        def one(x):
+            if isinstance(x, Tensor):
+                x = x._value
+            if hasattr(x, "ndim"):
+                return jax.device_put(x, sharding)
+            return x
+        if isinstance(batch, (list, tuple)):
+            return type(batch)(one(b) for b in batch)
+        if isinstance(batch, dict):
+            return {k: one(v) for k, v in batch.items()}
+        return one(batch)
+
+    buf = collections.deque()
+    it = iter(iterable)
+    try:
+        for batch in it:
+            buf.append(put(batch))
+            if len(buf) >= size:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()
+    finally:
+        buf.clear()

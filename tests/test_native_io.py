@@ -1,0 +1,144 @@
+"""C++ IO runtime (csrc/libptio.so): queue, pool, gather (SURVEY §2.10)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import native
+
+
+pytestmark = pytest.mark.skipif(not native.native_available(),
+                                reason="native lib unavailable (no g++)")
+
+
+def test_queue_fifo_and_backpressure():
+    q = native.NativePrefetcher.create(2)
+    assert q is not None
+    order = []
+
+    def producer():
+        for i in range(10):
+            assert q.put(("item", i))
+        q.put("done")
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item == "done":
+            break
+        order.append(item[1])
+    t.join(timeout=5)
+    q.close()
+    q.destroy()
+    assert order == list(range(10))
+
+
+def test_queue_close_unblocks_producer():
+    q = native.NativePrefetcher.create(1)
+    assert q.put(1)  # fills the ring
+    results = []
+
+    def producer():
+        results.append(q.put(2))  # blocks until close
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    q.close()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert results == [False]
+    q.destroy()
+    assert q.put(3) is False  # safe after destroy, no crash
+
+
+def test_queue_close_unblocks_consumer():
+    q = native.NativePrefetcher.create(2)
+    got = []
+
+    def consumer():
+        got.append(q.get())
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    q.close()
+    t.join(timeout=5)
+    assert got == [native.NativePrefetcher.CLOSED]
+    q.destroy()
+    assert q.get() is native.NativePrefetcher.CLOSED  # safe after destroy
+
+
+def test_buffer_pool_cycle():
+    pool = native.BufferPool.create(2, 1024)
+    a = pool.acquire()
+    b = pool.acquire()
+    assert a and b and a[0] != b[0]
+    assert a[0] % 64 == 0  # aligned
+    pool.release(a[0])
+    c = pool.acquire()
+    assert c[0] == a[0]  # reused
+    pool.release(b[0])
+    pool.release(c[0])
+    pool.close()
+    assert pool.acquire() is None  # closed pool wakes with None
+    pool.destroy()
+
+
+def test_gather_rows_matches_stack():
+    rng = np.random.default_rng(0)
+    rows = [rng.standard_normal((4, 5)).astype(np.float32)
+            for _ in range(8)]
+    got = native.gather_rows(rows)
+    np.testing.assert_array_equal(got, np.stack(rows))
+
+
+def test_gather_rows_into_pool_buffer():
+    rng = np.random.default_rng(1)
+    rows = [rng.integers(0, 100, (16,)).astype(np.int32) for _ in range(4)]
+    pool = native.BufferPool.create(1, 4 * 16 * 4)
+    addr, _ = pool.acquire()
+    got = native.gather_rows(rows, pool_addr=addr)
+    np.testing.assert_array_equal(np.array(got), np.stack(rows))
+    pool.release(addr)
+    pool.destroy()
+
+
+def test_dataloader_uses_native_prefetch():
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    x = np.arange(64, dtype=np.float32).reshape(16, 4)
+    y = np.arange(16, dtype=np.int64)
+    ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+    dl = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False)
+    seen = [np.asarray(bx._value) for bx, _ in dl]
+    np.testing.assert_array_equal(np.concatenate(seen), x)
+
+
+def test_dataloader_early_exit_no_hang():
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    x = np.zeros((256, 8), np.float32)
+    ds = TensorDataset([paddle.to_tensor(x)])
+    dl = DataLoader(ds, batch_size=2, num_workers=2)
+    it = iter(dl)
+    next(it)
+    it.close()  # consumer leaves early; producer must not deadlock
+
+
+def test_device_prefetch_preserves_order_and_placement():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.io import device_prefetch
+
+    batches = [(np.full((2, 2), i, np.float32), np.array([i])) for i in range(6)]
+    out = list(device_prefetch(iter(batches), size=2))
+    assert len(out) == 6
+    for i, (bx, bi) in enumerate(out):
+        assert isinstance(bx, jax.Array)
+        np.testing.assert_array_equal(np.asarray(bx), np.full((2, 2), i))
+        assert int(bi[0]) == i
