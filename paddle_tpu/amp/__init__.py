@@ -211,12 +211,4 @@ class GradScaler:
         self._bad = state.get("bad", 0)
 
 
-class debugging:
-    @staticmethod
-    def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
-        arr = tensor._value if isinstance(tensor, Tensor) else tensor
-        finite = bool(jnp.all(jnp.isfinite(arr)))
-        if not finite:
-            raise RuntimeError(
-                f"check_numerics: non-finite values in {op_type}:{var_name}")
-        return tensor
+from . import debugging  # noqa: F401,E402

