@@ -68,6 +68,40 @@ def run(eng, batch, seq, steps, warmup):
     return batch * seq * steps / dt
 
 
+BASELINE_RESNET50_IMG_PER_SEC_PER_CHIP = 2900.0  # SURVEY §6: A100 fp16
+
+
+def build_resnet_engine(amp):
+    import paddle_tpu as paddle
+    from paddle_tpu.hapi.engine import Engine
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    model.train()
+    opt = paddle.optimizer.Momentum(0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    return Engine(model, loss=paddle.nn.CrossEntropyLoss(), optimizer=opt,
+                  amp_dtype=jnp.bfloat16 if amp else None)
+
+
+def run_resnet(eng, batch, steps, warmup, hw=224):
+    import numpy as np
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 3, hw, hw)),
+                    dtype=jnp.float32)
+    y = jnp.asarray(rng.integers(0, 1000, (batch,)))
+    log("compiling + warmup (resnet50) ...")
+    for i in range(warmup):
+        loss, _ = eng.train_batch([x], [y])
+        jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, _ = eng.train_batch([x], [y])
+    jax.block_until_ready(loss)
+    return batch * steps / (time.perf_counter() - t0)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
@@ -75,9 +109,33 @@ def main():
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=None)
     ap.add_argument("--config", default=None)
+    ap.add_argument("--model", choices=("gpt", "resnet50"), default="gpt")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
+
+    if args.model == "resnet50":
+        if args.smoke or not on_tpu:
+            batch, steps, warmup, amp, hw = 4, 3, 2, False, 64
+        else:
+            batch, steps, warmup, amp, hw = 256, 20, 3, True, 224
+        batch = args.batch or batch
+        steps = args.steps or steps
+        log(f"bench: resnet50 batch={batch} hw={hw} steps={steps} "
+            f"backend={jax.default_backend()} amp={amp}")
+        eng = build_resnet_engine(amp)
+        tput = run_resnet(eng, batch, steps, warmup, hw)
+        print(json.dumps({
+            "metric": "resnet50_train_images_per_sec_per_chip",
+            "value": round(tput, 1),
+            "unit": "images/s/chip",
+            "vs_baseline": round(
+                tput / BASELINE_RESNET50_IMG_PER_SEC_PER_CHIP, 4),
+            "batch": batch, "image": hw,
+            "backend": jax.default_backend(),
+        }))
+        return
+
     if args.smoke or not on_tpu:
         cfg, batch, seq, steps, warmup, amp = "gpt-tiny", 4, 64, 4, 2, False
     else:

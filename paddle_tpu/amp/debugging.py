@@ -72,9 +72,10 @@ def check_numerics(tensor, op_type="", var_name="", debug_mode=None,
         if isinstance(x, jax.Array):
             if not jnp.issubdtype(x.dtype, jnp.floating):
                 return
-            # count on device; only two scalars cross to host
-            n_nan = int(jnp.isnan(x).sum())
-            n_inf = int(jnp.isinf(x).sum())
+            # count on device; ONE two-scalar transfer to host
+            counts = np.asarray(jnp.stack([jnp.isnan(x).sum(),
+                                           jnp.isinf(x).sum()]))
+            n_nan, n_inf = int(counts[0]), int(counts[1])
             shape = x.shape
         else:
             try:
@@ -127,7 +128,8 @@ class GradNormSpikeDetector:
         records the observation."""
         norm = self.global_norm(grads)
         spike = False
-        if len(self._history) >= 8:
+        warmup = max(2, min(8, self.window))
+        if len(self._history) >= warmup:
             med = float(np.median(self._history))
             spike = med > 0 and norm > self.factor * med
         self._history.append(norm)

@@ -25,5 +25,6 @@ from .sharding_api import (  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from . import validate  # noqa: F401
+from . import auto_parallel  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from .launch_mod import launch, spawn  # noqa: F401

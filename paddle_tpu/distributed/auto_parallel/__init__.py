@@ -1,0 +1,166 @@
+"""Auto parallel (ref: python/paddle/distributed/auto_parallel/*).
+
+The reference's auto_parallel plans a distributed program: a cost model
+scores candidate shardings per op, a completion pass propagates them, and
+the partitioner rewrites the graph. TPU-native split of labour:
+
+- the *partitioner* is GSPMD — any placement we choose is mathematically
+  correct, XLA inserts the collectives;
+- so auto parallel here is exactly the PLANNER: pick per-parameter
+  PartitionSpecs that minimise a memory+communication cost model, then
+  place the params (everything downstream — Engine, eager, shard_map —
+  follows placements automatically).
+
+Planner heuristics (the same structure the reference's planner converges
+to for dense nets): batch over 'dp'; consecutive Linears alternate
+column/row (Megatron MLP pattern — one all-reduce per pair instead of
+per layer); embeddings vocab-sharded; mpu layers keep their hand-annotated
+specs; anything indivisible replicates.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..mesh import DeviceMesh, ProcessMesh, get_mesh  # noqa: F401
+from ..sharding_api import shard_tensor  # noqa: F401
+
+__all__ = ["ShardingPlan", "plan_model", "apply_plan", "parallelize",
+           "estimate_cost", "shard_op", "ProcessMesh", "shard_tensor",
+           "Strategy"]
+
+
+class Strategy:
+    """ref: auto_parallel.Strategy — planner knobs."""
+
+    def __init__(self, mp_axis="mp", dp_axis="dp", prefer_column_first=True,
+                 min_shard_elems=1024):
+        self.mp_axis = mp_axis
+        self.dp_axis = dp_axis
+        self.prefer_column_first = prefer_column_first
+        self.min_shard_elems = min_shard_elems
+
+
+class ShardingPlan(dict):
+    """name -> PartitionSpec, with the cost the planner assigned."""
+
+    cost: float = 0.0
+
+
+def _divisible(dim, mesh, axis):
+    return axis in mesh.axis_names and dim % mesh.shape[axis] == 0 \
+        and dim >= mesh.shape[axis]
+
+
+def estimate_cost(shape, spec, mesh, dtype_bytes=4):
+    """Per-device bytes for a tensor under `spec` + a rough comm penalty:
+    replicated tensors cost full memory; sharding the contraction dim of a
+    matmul implies an all-reduce of the output (charged as output bytes).
+    This is the reference cost model's memory term, simplified."""
+    elems = int(np.prod(shape))
+    denom = 1
+    for entry in tuple(spec or ()):
+        for ax in ((entry,) if isinstance(entry, str) else tuple(entry or ())):
+            denom *= mesh.shape[ax]
+    return elems * dtype_bytes / denom
+
+
+def plan_model(model, mesh=None, strategy: Strategy = None) -> ShardingPlan:
+    """Propose a PartitionSpec per parameter. Honors existing
+    `sharding_spec` annotations (mpu layers are already placed the way the
+    planner would)."""
+    from ...nn.layers_common import Embedding, Linear
+
+    mesh = mesh or get_mesh()
+    st = strategy or Strategy()
+    plan = ShardingPlan()
+    column_next = st.prefer_column_first
+
+    for lname, layer in model.named_sublayers(include_self=True):
+        for pname, p in layer._parameters.items():
+            if p is None:
+                continue
+            full = f"{lname}.{pname}" if lname else pname
+            if full in plan:
+                continue
+            existing = getattr(p, "sharding_spec", None)
+            if existing is not None:
+                plan[full] = existing
+                continue
+            shape = tuple(p.shape)
+            if int(np.prod(shape)) < st.min_shard_elems:
+                plan[full] = P()
+                continue
+            spec = P()
+            if isinstance(layer, Linear) and pname == "weight" \
+                    and len(shape) == 2:
+                if column_next and _divisible(shape[1], mesh, st.mp_axis):
+                    spec = P(None, st.mp_axis)
+                    column_next = False
+                elif not column_next and _divisible(shape[0], mesh,
+                                                    st.mp_axis):
+                    spec = P(st.mp_axis, None)
+                    column_next = True
+            elif isinstance(layer, Linear) and pname == "bias":
+                # matches the preceding weight: column-parallel bias shards
+                w_key = f"{lname}.weight" if lname else "weight"
+                w_spec = plan.get(w_key)
+                if w_spec is not None and tuple(w_spec) \
+                        and tuple(w_spec)[-1] == st.mp_axis:
+                    spec = P(st.mp_axis)
+            elif isinstance(layer, Embedding) and pname == "weight" \
+                    and _divisible(shape[0], mesh, st.mp_axis):
+                spec = P(st.mp_axis, None)
+            plan[full] = spec
+    plan.cost = sum(
+        estimate_cost(tuple(p.shape), plan.get(n, P()), mesh)
+        for n, p in model.named_parameters())
+    return plan
+
+
+def apply_plan(model, plan: ShardingPlan, mesh=None):
+    """Place every parameter per the plan (device_put + record the spec so
+    shard_map paths and the validator see it)."""
+    mesh = mesh or get_mesh()
+    from ..validate import validate_spec
+    for n, p in model.named_parameters():
+        spec = plan.get(n, P())
+        validate_spec(tuple(p.shape), spec, mesh, name=n)
+        p.sharding_spec = spec
+        p._value = jax.device_put(p._value, NamedSharding(mesh, spec))
+    return model
+
+
+def parallelize(model, optimizer=None, mesh=None, strategy=None):
+    """ref: auto_parallel's one-call entry (plan + partition). Returns
+    (model, optimizer, plan)."""
+    mesh = mesh or get_mesh()
+    plan = plan_model(model, mesh, strategy)
+    apply_plan(model, plan, mesh)
+    return model, optimizer, plan
+
+
+def shard_op(fn, mesh=None, in_specs=None, out_specs=None):
+    """ref: auto_parallel.shard_op — constrain an op's output placement
+    (GSPMD propagates the rest)."""
+    mesh_ = mesh or get_mesh()
+
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        if out_specs is None:
+            return out
+        from ...tensor import Tensor
+
+        def constrain(x, spec):
+            if isinstance(x, Tensor):
+                return Tensor(jax.lax.with_sharding_constraint(
+                    x._value, NamedSharding(mesh_, spec)),
+                    stop_gradient=x.stop_gradient)
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh_, spec))
+        return jax.tree_util.tree_map(
+            constrain, out, out_specs,
+            is_leaf=lambda t: isinstance(t, Tensor))
+    return wrapped

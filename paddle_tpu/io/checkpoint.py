@@ -172,6 +172,10 @@ class CheckpointManager:
         self.wait()
         if best:
             step = self.best_step()
+            if step is None:
+                raise ValueError(
+                    "restore(best=True) but no checkpoint was saved with a "
+                    "metric - pass metric= to save(), or restore latest")
         if step is None:
             step = self.latest_step()
         if step is None:

@@ -61,7 +61,13 @@ class Profiler:
 
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=True, trace_dir=None):
-        self.timer_only = timer_only and trace_dir is None
+        # timer_only=False (paddle parity: collect more than step timers)
+        # turns on the jax trace even without an explicit trace_dir
+        if not timer_only and trace_dir is None:
+            import tempfile
+            trace_dir = os.path.join(tempfile.gettempdir(),
+                                     "paddle_tpu_profile")
+        self.timer_only = trace_dir is None
         self.trace_dir = trace_dir
         self.on_trace_ready = on_trace_ready
         self._events: dict[str, _EventStat] = defaultdict(_EventStat)
@@ -110,7 +116,10 @@ class Profiler:
         """Time a region; sync drains each device's execution queue so the
         time covers the region's real compute, not just dispatch (TPU/CPU
         streams run FIFO, so a trailing no-op transfer completes only after
-        everything the region enqueued)."""
+        everything the region enqueued). Drains the queue BEFORE starting
+        too, so earlier async work isn't billed to this region."""
+        if sync:
+            _device_sync()
         t0 = time.perf_counter()
         yield
         if sync:

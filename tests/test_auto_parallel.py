@@ -1,0 +1,83 @@
+"""Auto-parallel planner: spec proposal, cost model, placement, GSPMD
+numerics (SURVEY §2.6)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_parallel import (
+    apply_plan, estimate_cost, parallelize, plan_model, Strategy)
+from paddle_tpu.tensor import Tensor
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "mp"))
+
+
+def _mlp():
+    paddle.seed(0)
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(16, 64), paddle.nn.GELU(),
+        paddle.nn.Linear(64, 16))
+
+
+def test_plan_alternates_column_row():
+    plan = plan_model(_mlp(), _mesh(), Strategy(min_shard_elems=1))
+    specs = [tuple(v) for k, v in plan.items() if k.endswith("weight")]
+    assert ("mp",) not in specs  # weights are 2D
+    assert (None, "mp") in specs and ("mp", None) in specs
+
+
+def test_plan_respects_existing_mpu_specs():
+    from paddle_tpu.distributed.fleet.mpu import ColumnParallelLinear
+    m = ColumnParallelLinear(8, 32)
+    plan = plan_model(m, _mesh())
+    assert tuple(plan["weight"]) == (None, "mp")
+
+
+def test_cost_model_prefers_sharded():
+    mesh = _mesh()
+    assert estimate_cost((64, 64), P(None, "mp"), mesh) \
+        < estimate_cost((64, 64), P(), mesh)
+
+
+def test_apply_plan_places_params():
+    mesh = _mesh()
+    m = _mlp()
+    plan = plan_model(m, mesh, Strategy(min_shard_elems=1))
+    apply_plan(m, plan, mesh)
+    w0 = m[0].weight
+    assert isinstance(w0._value.sharding, NamedSharding)
+    assert tuple(w0._value.sharding.spec) == tuple(plan["0.weight"])
+
+
+def test_parallelized_forward_matches_dense():
+    mesh = _mesh()
+    m = _mlp()
+    m.eval()
+    x = Tensor(jnp.asarray(
+        np.random.default_rng(0).standard_normal((8, 16)), jnp.float32))
+    want = np.asarray(m(x)._value)
+    parallelize(m, mesh=mesh, strategy=Strategy(min_shard_elems=1))
+    got = np.asarray(m(x)._value)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_parallelized_training_matches_dense():
+    from paddle_tpu.hapi.engine import Engine
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((16, 16)), jnp.float32)
+    Y = jnp.asarray(rng.standard_normal((16, 16)), jnp.float32)
+
+    def run(auto):
+        m = _mlp()
+        opt = paddle.optimizer.Adam(1e-2, parameters=m.parameters())
+        if auto:
+            parallelize(m, mesh=mesh, strategy=Strategy(min_shard_elems=1))
+        eng = Engine(m, loss=paddle.nn.MSELoss(), optimizer=opt,
+                     mesh=mesh if auto else None)
+        return [float(eng.train_batch([X], [Y])[0]) for _ in range(5)]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-4, atol=1e-5)

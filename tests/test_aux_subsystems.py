@@ -230,3 +230,43 @@ class TestReviewRegressions:
         for _ in range(100):
             det.check({"w": jnp.ones((2,))})
         assert len(det._history) <= 8
+
+
+class TestSecondReviewRegressions:
+    def test_spike_detector_small_window(self):
+        from paddle_tpu.amp.debugging import GradNormSpikeDetector
+        det = GradNormSpikeDetector(window=4, factor=5.0)
+        for _ in range(4):
+            det.check({"w": jnp.ones((2,))})
+        assert det.check({"w": jnp.full((2,), 1000.0)})
+
+    def test_restore_best_without_metric_raises(self, tmp_path):
+        from paddle_tpu.io import CheckpointManager
+        mgr = CheckpointManager(tmp_path / "ck")
+        mgr.save(1, {"x": 1})
+        with pytest.raises(ValueError, match="best=True"):
+            mgr.restore(best=True)
+
+    def test_record_event_excludes_prior_async_work(self):
+        from paddle_tpu.profiler import Profiler
+        p = Profiler().start()
+        f = jax.jit(lambda x: jnp.linalg.matrix_power(x, 128))
+        x = jnp.eye(256)
+        f(x).block_until_ready()  # compile
+        _ = f(x)  # async big work BEFORE the region
+        with p.record_event("small"):
+            pass
+        small = p._events["small"].total
+        with p.record_event("big"):
+            f(x)
+        big = p._events["big"].total
+        assert big > small
+
+    def test_root_linear_bias_spec_matches_weight(self):
+        from paddle_tpu.distributed.auto_parallel import (plan_model,
+                                                          Strategy)
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "mp"))
+        m = paddle.nn.Linear(16, 64)
+        plan = plan_model(m, mesh, Strategy(min_shard_elems=1))
+        assert tuple(plan["weight"]) == (None, "mp")
+        assert tuple(plan["bias"]) == ("mp",)
