@@ -54,3 +54,4 @@ from .serialization import save, load  # noqa: E402,F401
 from .functional_transforms import value_and_grad, functional_grad, vmap, checkpoint  # noqa: E402,F401
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
+from . import text  # noqa: F401

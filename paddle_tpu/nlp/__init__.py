@@ -22,3 +22,4 @@ from .ernie import (  # noqa: F401
 from .tokenizer import (  # noqa: F401
     BasicTokenizer, WordpieceTokenizer, BertTokenizer, GPTTokenizer,
 )
+from . import generation  # noqa: F401

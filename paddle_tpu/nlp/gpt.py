@@ -103,10 +103,16 @@ class GPTAttention(Layer):
         b, s = x.shape[0], x.shape[1]
         return x.reshape([b, s, -1, self.cfg.head_dim])
 
-    def forward(self, x, attn_mask=None, cache=None):
+    def forward(self, x, attn_mask=None, cache=None, cache_index=None):
         q = self._heads(self.q_proj(x))
         k = self._heads(self.k_proj(x))
         v = self._heads(self.v_proj(x))
+        if cache_index is not None:
+            # STATIC cache (jit decode fast path, nlp/generation.py):
+            # fixed [B, S_max, H, D] buffers written in place at
+            # cache_index — shapes never change across scan steps, so one
+            # compiled program decodes every token
+            return self._forward_static_cache(q, k, v, cache, cache_index)
         if cache is not None:
             # skip the concat for the zero-length initial cache: under
             # shard_map tensor parallelism k/v carry num_heads/mp LOCAL
@@ -130,6 +136,39 @@ class GPTAttention(Layer):
         b, s = out.shape[0], out.shape[1]
         out = self.out_proj(out.reshape([b, s, -1]))
         return (out, cache) if cache is not None else out
+
+    def _forward_static_cache(self, q, k, v, cache, cache_index):
+        from ..autograd import apply_op
+
+        import math as _math
+
+        def run(qv, kv, vv, kbuf, vbuf, idx):
+            idx = jnp.asarray(idx, jnp.int32)
+            zero = jnp.int32(0)
+            kbuf = jax.lax.dynamic_update_slice(
+                kbuf, kv.astype(kbuf.dtype), (zero, idx, zero, zero))
+            vbuf = jax.lax.dynamic_update_slice(
+                vbuf, vv.astype(vbuf.dtype), (zero, idx, zero, zero))
+            sq, s_max = qv.shape[1], kbuf.shape[1]
+            # causal validity against absolute positions: query row r sits
+            # at position idx+r and may attend keys at positions <= idx+r
+            kpos = jnp.arange(s_max)[None, :]
+            qpos = idx + jnp.arange(sq)[:, None]
+            mask = (kpos <= qpos)[None, None]        # [1, 1, sq, S_max]
+            qh, kh, vh = (jnp.swapaxes(a, 1, 2) for a in (qv, kbuf, vbuf))
+            scale = 1.0 / _math.sqrt(qh.shape[-1])
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+            logits = jnp.where(mask, logits, -jnp.inf)
+            probs = jax.nn.softmax(logits.astype(jnp.float32),
+                                   axis=-1).astype(qh.dtype)
+            out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+            return jnp.swapaxes(out, 1, 2), kbuf, vbuf
+
+        idx = cache_index._value if isinstance(cache_index, Tensor) \
+            else cache_index
+        out, kbuf, vbuf = apply_op(run, q, k, v, cache[0], cache[1], idx)
+        b, s = out.shape[0], out.shape[1]
+        return self.out_proj(out.reshape([b, s, -1])), (kbuf, vbuf)
 
 
 class GPTMLP(Layer):
@@ -162,11 +201,12 @@ class GPTDecoderLayer(Layer):
         self.ln_2 = LayerNorm(config.hidden_size, epsilon=eps)
         self.mlp = GPTMLP(config)
 
-    def forward(self, x, attn_mask=None, cache=None):
+    def forward(self, x, attn_mask=None, cache=None, cache_index=None):
         residual = x
         h = self.ln_1(x)
         if cache is not None:
-            h, cache = self.attn(h, attn_mask, cache)
+            h, cache = self.attn(h, attn_mask, cache,
+                                 cache_index=cache_index)
         else:
             h = self.attn(h, attn_mask)
         x = residual + self.dropout1(h)
@@ -222,8 +262,14 @@ class GPTModel(Layer):
         return cls(_resolve_config(name, **overrides))
 
     def forward(self, input_ids, position_ids=None, attention_mask=None,
-                use_cache=False, cache=None):
-        if position_ids is None and cache is not None:
+                use_cache=False, cache=None, cache_index=None):
+        if position_ids is None and cache_index is not None:
+            idx = cache_index._value if isinstance(cache_index, Tensor) \
+                else cache_index
+            s = input_ids.shape[1]
+            position_ids = Tensor(
+                (idx + jnp.arange(s, dtype=jnp.int32))[None, :])
+        elif position_ids is None and cache is not None:
             # cached decode: positions continue after the cache length
             # (ref: GPTModel.forward's past_length offset)
             past = cache[0][0].shape[1]
@@ -245,7 +291,8 @@ class GPTModel(Layer):
                                       self.config.num_attention_heads,
                                       self.config.head_dim),
                                      dtype=x.dtype)),) * 2
-                x, c = blk(x, attention_mask, layer_cache)
+                x, c = blk(x, attention_mask, layer_cache,
+                           cache_index=cache_index)
                 new_caches.append(c)
             else:
                 x = blk(x, attention_mask)
@@ -269,9 +316,10 @@ class GPTForCausalLM(Layer):
         return cls(_resolve_config(name, **overrides))
 
     def forward(self, input_ids, position_ids=None, attention_mask=None,
-                use_cache=False, cache=None):
+                use_cache=False, cache=None, cache_index=None):
         out = self.gpt(input_ids, position_ids, attention_mask,
-                       use_cache=use_cache, cache=cache)
+                       use_cache=use_cache, cache=cache,
+                       cache_index=cache_index)
         if use_cache or cache is not None:
             hidden, new_cache = out
         else:

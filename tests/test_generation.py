@@ -1,0 +1,62 @@
+"""Jit decode fast path == eager cached generate (SURVEY §3.7 decode)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp import GPTForCausalLM, GPTConfig
+from paddle_tpu.nlp.generation import generate, build_decode_fn
+from paddle_tpu.tensor import Tensor
+
+
+def _model():
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig(
+        vocab_size=97, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        use_flash_attention=False))
+    m.eval()
+    return m
+
+
+def test_jit_greedy_matches_eager_generate():
+    m = _model()
+    ids = Tensor(jnp.asarray([[5, 17, 3, 42], [9, 9, 1, 0]], jnp.int32))
+    want = m.generate(ids, max_new_tokens=8, temperature=0.0)
+    got = generate(m, ids, max_new_tokens=8, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(got._value),
+                                  np.asarray(want._value))
+
+
+def test_jit_decode_single_compile_reuse():
+    m = _model()
+    fn = build_decode_fn(m, max_new_tokens=4, temperature=0.0)
+    params, buffers = m.raw_state()
+    ids = jnp.asarray([[1, 2, 3]], jnp.int32)
+    out1 = fn(params, buffers, ids, jax.random.PRNGKey(0))
+    out2 = fn(params, buffers, jnp.asarray([[4, 5, 6]], jnp.int32),
+              jax.random.PRNGKey(1))
+    assert out1.shape == out2.shape == (1, 7)
+
+
+def test_sampled_decode_valid_tokens():
+    m = _model()
+    out = generate(m, jnp.asarray([[1, 2]], jnp.int32), max_new_tokens=6,
+                   temperature=1.0, top_k=5, seed=3)
+    arr = np.asarray(out._value)
+    assert arr.shape == (1, 8)
+    assert (arr >= 0).all() and (arr < 97).all()
+
+
+def test_static_cache_prefill_matches_full_forward():
+    """logits from the cache_index path must equal the plain forward."""
+    m = _model()
+    ids = Tensor(jnp.asarray([[7, 11, 13, 17, 19]], jnp.int32))
+    want = m(ids)  # plain causal forward
+    caches = [(Tensor(jnp.zeros((1, 5, 4, 8), jnp.float32)),) * 2
+              for _ in range(2)]
+    got, _ = m(ids, cache=caches, cache_index=0)
+    np.testing.assert_allclose(np.asarray(got._value),
+                               np.asarray(want._value),
+                               atol=1e-5, rtol=1e-5)

@@ -255,3 +255,28 @@ def test_auc_saturated():
     auc = Auc()
     auc.update(paddle.to_tensor([1.0, 1.0]), paddle.to_tensor([0, 1]))
     assert abs(auc.accumulate() - 0.5) < 1e-6
+
+
+def test_text_datasets_contract():
+    """paddle.text parity datasets load + batch (SURVEY §2.5)."""
+    from paddle_tpu.text import Imdb, Imikolov, UCIHousing, WMT14
+    from paddle_tpu.io import DataLoader
+
+    imdb = Imdb(mode="train", n_samples=64)
+    doc, label = imdb[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+
+    ng = Imikolov(n_samples=32)
+    ctx, nxt = ng[0]
+    assert len(ctx) == 4
+
+    uci = UCIHousing(n_samples=32)
+    x, y = uci[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+    for bx, by in DataLoader(UCIHousing(n_samples=32), batch_size=8):
+        assert tuple(bx.shape) == (8, 13)
+        break
+
+    src, trg, nxt = WMT14(n_samples=8)[0]
+    assert len(src) == 16 and len(trg) == len(nxt) == 15
