@@ -27,6 +27,29 @@ import jax.numpy as jnp
 
 _state = threading.local()
 
+try:  # jax >= 0.4.x keeps this in _src; public alias was removed in 0.9
+    from jax._src.core import trace_state_clean as _trace_state_clean
+except ImportError:  # pragma: no cover - future jax relocation
+    _trace_state_clean = None
+
+
+def in_jax_trace(arrs=()) -> bool:
+    """True when executing under an active jax trace (jit/grad/vmap/...).
+
+    Inside a trace the eager tape must NOT be built: the outer transform
+    already owns differentiation, and a nested ``jax.vjp`` both bloats the
+    jaxpr and breaks ``custom_vjp`` ops (Pallas kernels hit
+    ``_pallas_call_jvp_rule`` asserts when a vjp is opened inside another
+    vjp inside ``jax.grad``). Detection is two-tier: the global trace-state
+    flag, plus a Tracer scan of the inputs as a fallback.
+    """
+    if _trace_state_clean is not None:
+        try:
+            return not _trace_state_clean()
+        except Exception:  # pragma: no cover
+            pass
+    return any(isinstance(a, jax.core.Tracer) for a in arrs)
+
 
 def is_grad_enabled() -> bool:
     return getattr(_state, "grad_enabled", True)
@@ -107,6 +130,14 @@ def apply_op(fn: Callable, *args, differentiable: bool = True, **kwargs):
     )
 
     arrs = [t._value for t in tensors]
+    if in_jax_trace(arrs):
+        # Functional path (Engine/jit/grad/vmap): the outer transform owns
+        # differentiation — dispatch directly, no tape. Grads flow through
+        # the outer trace; building a nested vjp here is pure overhead and
+        # crashes custom_vjp kernels (Pallas flash attention).
+        out = run(arrs)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a, stop_gradient=not needs_grad), out)
     if not needs_grad:
         out = run(arrs)
         return jax.tree_util.tree_map(

@@ -21,9 +21,35 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+try:
+    from jax._src.config import enable_x64 as _enable_x64_ctx
+except ImportError:  # pragma: no cover
+    import contextlib
+    _enable_x64_ctx = lambda _on: contextlib.nullcontext()
+
+
+def _x32_traced(fn):
+    """Trace pallas kernels in x32 mode.
+
+    The framework enables jax_enable_x64 globally for paddle dtype parity
+    (framework.py), but under x64 Python int/float literals in index maps
+    and kernels trace as i64/f64, which Mosaic cannot legalize
+    ('failed to legalize tpu.truncf / func.return'). All kernel math here
+    is explicitly f32/i32, so tracing with x64 off is semantics-preserving.
+    """
+    @functools.wraps(fn)
+    def wrapped(*a, **k):
+        with _enable_x64_ctx(False):
+            return fn(*a, **k)
+    return wrapped
+
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
+# trailing lane dim for per-row stats (lse, delta): Mosaic requires the last
+# block dim to be 128-divisible or equal to the array dim, so per-row vectors
+# are carried as [bh, sq, 8] with the value replicated over the 8 lanes.
+_LSE_LANES = 8
 
 
 def _causal_mask(s, qi, ki, block_q, block_k, offset):
@@ -31,7 +57,8 @@ def _causal_mask(s, qi, ki, block_q, block_k, offset):
     query row i attends keys <= i + offset, offset = sk - sq."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(q_pos + offset >= k_pos, s, _NEG_INF)
+    return jnp.where(q_pos + offset >= k_pos, s,
+                     jnp.asarray(_NEG_INF, s.dtype))
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
@@ -76,7 +103,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = l_scr[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, 0] + jnp.log(safe_l[:, 0]))
+        # lse is stored [bh, sq, 8]: the trailing size-8 lane dim exists only
+        # to satisfy Mosaic's block-shape rules (a (1, block_q) block is not
+        # lowerable); the row value is replicated across it.
+        lse_ref[0] = jnp.broadcast_to(
+            m_scr[:, :1] + jnp.log(safe_l), (m_scr.shape[0], _LSE_LANES))
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -100,12 +131,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0][:, :1])
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0],
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0][:, :1])
         acc_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k,
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -139,7 +170,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32) * sm_scale
         if causal:
             s = _causal_mask(s, qi, ki, block_q, block_k, offset)
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0][:, :1])
         # dV += P^T dO
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0],
@@ -149,7 +180,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do_ref[0], v_ref[0],
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0][:, :1])
         # dK += dS^T Q * scale
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q,
@@ -162,6 +193,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+@_x32_traced
 def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -179,11 +211,11 @@ def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LSE_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, _LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -194,12 +226,14 @@ def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     )(q, k, v)
 
 
+@_x32_traced
 def _bwd_call(res, g, causal, sm_scale, block_q, block_k, interpret):
     q, k, v, o, lse = res
     do = g
     bh, sq, d = q.shape
     sk = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (bh, sq, _LSE_LANES))
 
     dq_kern = functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                                 block_q=block_q, block_k=block_k,
@@ -212,8 +246,8 @@ def _bwd_call(res, g, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LSE_LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LSE_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -232,8 +266,8 @@ def _bwd_call(res, g, causal, sm_scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, _LSE_LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LSE_LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -293,7 +327,3 @@ def flash_attention(q, k, v, causal=False, sm_scale=None,
     o = _flash_bhsd(fold(q), fold(k), fold(v), causal, sm_scale,
                     block_q, block_k, interpret)
     return jnp.swapaxes(o.reshape(b, h, sq, d), 1, 2)
-
-
-# the fwd-only entry used by ops/attention.py
-flash_attention_fwd = flash_attention

@@ -1,0 +1,77 @@
+"""Regression: the Pallas flash-attention kernel must be trainable through
+the PRODUCTION path — F.scaled_dot_product_attention -> apply_op -> Engine's
+jitted value_and_grad step.
+
+Round 1 shipped with apply_op building a nested jax.vjp tape inside the
+Engine's outer jax.grad trace; for jnp ops that was only compile bloat, but
+for the custom_vjp Pallas kernel it crashed (_pallas_call_jvp_rule assert),
+killing the TPU bench. On CPU the availability gate hid the bug because the
+Pallas route is TPU-only. This test forces the gate on (the kernel then runs
+in interpret mode on CPU, same trace/AD structure) and trains real Engine
+steps.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.hapi.engine import Engine
+
+
+@pytest.fixture
+def force_flash(monkeypatch):
+    import paddle_tpu.ops as ops_pkg
+    import paddle_tpu.ops.attention as att
+
+    def available(q_shape, k_shape, attn_mask, dropout_p):
+        return attn_mask is None and not dropout_p and len(q_shape) == 4
+
+    monkeypatch.setattr(att, "flash_attention_available", available)
+    monkeypatch.setattr(ops_pkg, "flash_attention_available", available)
+
+
+class TinyAttn(nn.Layer):
+    def __init__(self, d_model=64, n_heads=2, seq=128):
+        super().__init__()
+        self.n_heads = n_heads
+        self.qkv = nn.Linear(d_model, 3 * d_model)
+        self.out = nn.Linear(d_model, d_model)
+        self.head = nn.Linear(d_model, 1)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        b, s, d = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.n_heads,
+                                   d // self.n_heads])
+        q, k, v = (qkv[:, :, i] for i in range(3))
+        o = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        o = o.reshape([b, s, d])
+        return self.head(self.out(o)).mean(axis=[1, 2])
+
+
+def test_engine_train_step_through_pallas_flash(force_flash):
+    paddle.seed(0)
+    net = TinyAttn()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+    eng = Engine(net, loss=nn.MSELoss(), optimizer=opt)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 128, 64).astype("float32"))
+    y = paddle.to_tensor(rng.randn(2).astype("float32"))
+    losses = [float(eng.train_batch([x], [y])[0]) for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert min(losses[1:]) < losses[0]
+
+
+def test_eager_backward_through_pallas_flash(force_flash):
+    """The eager tape path (outside any jax trace) must also differentiate
+    the custom_vjp kernel."""
+    import jax.numpy as jnp
+    import paddle_tpu.nn.functional as F
+    q = paddle.to_tensor(
+        np.random.RandomState(1).randn(1, 128, 2, 64).astype("float32"),
+        stop_gradient=False)
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    out.sum().backward()
+    assert q.grad is not None
+    assert bool(jnp.isfinite(q.grad._value).all())
