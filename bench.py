@@ -23,12 +23,31 @@ import jax.numpy as jnp
 
 BASELINE_TOKENS_PER_SEC_PER_CHIP = 3500.0
 
+# bf16 matmul peak of one v5e chip (the bench target hardware). MFU is
+# reported against this regardless of the amp dtype actually used, so an
+# fp32 run shows honestly low MFU rather than flattering itself.
+TPU_PEAK_FLOPS = 197e12
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def build_engine(cfg_name, batch, seq, amp):
+def count_params(model):
+    import numpy as np
+    return int(sum(np.prod(p.shape) for p in model.parameters()))
+
+
+def gpt_flops_per_token(model, seq):
+    """Training FLOPs/token: 6*N for the dense matmuls (fwd+bwd) plus the
+    attention score/value matmuls 12*L*h*s (fwd+bwd, causal halving
+    ignored to stay comparable with the usual convention)."""
+    cfg = model.config
+    n = count_params(model)
+    return 6 * n + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+
+
+def build_engine(cfg_name, batch, seq, amp, use_flash=True):
     from paddle_tpu.nlp.gpt import (GPTForCausalLM, GPT_CONFIGS,
                                     GPTPretrainingCriterion, _resolve_config)
     from paddle_tpu.hapi.engine import Engine
@@ -37,7 +56,8 @@ def build_engine(cfg_name, batch, seq, amp):
     max_pos = max(GPT_CONFIGS[cfg_name]["max_position_embeddings"], seq)
     model = GPTForCausalLM(_resolve_config(
         cfg_name, max_position_embeddings=max_pos,
-        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        use_flash_attention=use_flash))
     model.train()
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
                 parameters=model.parameters())
@@ -57,13 +77,17 @@ def run(eng, batch, seq, steps, warmup):
     for i in range(warmup):
         t = time.perf_counter()
         loss, _ = eng.train_batch([ids], [labels])
-        jax.block_until_ready(loss)
+        # float() forces a device->host transfer: the only reliable sync on
+        # the axon remote backend, where block_until_ready returns early
+        float(loss)
         log(f"  warmup step {i}: {time.perf_counter() - t:.2f}s")
     log(f"warmup done, loss={float(loss):.4f}")
     t0 = time.perf_counter()
     for i in range(steps):
         loss, _ = eng.train_batch([ids], [labels])
-    jax.block_until_ready(loss)
+    # the param-donation chain makes the last loss depend on every step, so
+    # one final sync times the whole window
+    float(loss)
     dt = time.perf_counter() - t0
     return batch * seq * steps / dt
 
@@ -94,11 +118,11 @@ def run_resnet(eng, batch, steps, warmup, hw=224):
     log("compiling + warmup (resnet50) ...")
     for i in range(warmup):
         loss, _ = eng.train_batch([x], [y])
-        jax.block_until_ready(loss)
+        float(loss)  # real sync (see run())
     t0 = time.perf_counter()
     for _ in range(steps):
         loss, _ = eng.train_batch([x], [y])
-    jax.block_until_ready(loss)
+    float(loss)
     return batch * steps / (time.perf_counter() - t0)
 
 
@@ -110,6 +134,9 @@ def main():
     ap.add_argument("--seq", type=int, default=None)
     ap.add_argument("--config", default=None)
     ap.add_argument("--model", choices=("gpt", "resnet50"), default="gpt")
+    ap.add_argument("--no-flash", action="store_true",
+                    help="disable the Pallas flash-attention path (fallback "
+                         "number if the kernel regresses)")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
@@ -125,12 +152,20 @@ def main():
             f"backend={jax.default_backend()} amp={amp}")
         eng = build_resnet_engine(amp)
         tput = run_resnet(eng, batch, steps, warmup, hw)
+        # 4.1 GFLOP fwd inference at 224px, x3 for fwd+bwd; scaled for
+        # smaller images
+        flops_per_img = 3 * 4.1e9 * (hw / 224.0) ** 2
         print(json.dumps({
             "metric": "resnet50_train_images_per_sec_per_chip",
             "value": round(tput, 1),
             "unit": "images/s/chip",
+            # vs_baseline compares against an A100 number — meaningless for
+            # a CPU smoke run, so only reported on TPU
             "vs_baseline": round(
-                tput / BASELINE_RESNET50_IMG_PER_SEC_PER_CHIP, 4),
+                tput / BASELINE_RESNET50_IMG_PER_SEC_PER_CHIP, 4)
+            if on_tpu else None,
+            "mfu": round(tput * flops_per_img / TPU_PEAK_FLOPS, 4)
+            if on_tpu else None,
             "batch": batch, "image": hw,
             "backend": jax.default_backend(),
         }))
@@ -145,16 +180,22 @@ def main():
     seq = args.seq or seq
     steps = args.steps or steps
 
+    use_flash = not args.no_flash
     log(f"bench: {cfg} batch={batch} seq={seq} steps={steps} "
-        f"backend={jax.default_backend()} amp={amp}")
-    eng = build_engine(cfg, batch, seq, amp)
+        f"backend={jax.default_backend()} amp={amp} flash={use_flash}")
+    eng = build_engine(cfg, batch, seq, amp, use_flash=use_flash)
     tput = run(eng, batch, seq, steps, warmup)
     print(json.dumps({
         "metric": "gpt_pretrain_tokens_per_sec_per_chip",
         "value": round(tput, 1),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(tput / BASELINE_TOKENS_PER_SEC_PER_CHIP, 4),
-        "config": cfg, "batch": batch, "seq": seq,
+        # vs_baseline compares against an A100 number — only meaningful on
+        # the real chip
+        "vs_baseline": round(tput / BASELINE_TOKENS_PER_SEC_PER_CHIP, 4)
+        if on_tpu else None,
+        "mfu": round(tput * gpt_flops_per_token(eng.network, seq)
+                     / TPU_PEAK_FLOPS, 4) if on_tpu else None,
+        "config": cfg, "batch": batch, "seq": seq, "flash": use_flash,
         "backend": jax.default_backend(),
     }))
 

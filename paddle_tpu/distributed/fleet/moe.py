@@ -38,8 +38,13 @@ __all__ = ["MoELayer", "top_k_gating", "moe_apply_dense", "moe_apply_ep"]
 
 
 def top_k_gating(logits, k=2, capacity=None, capacity_factor=1.25):
-    """GShard top-k gating. logits [T, E] -> (dispatch [T, E, C] bool,
-    combine [T, E, C] float, aux_loss scalar)."""
+    """GShard top-k gating. logits [T, E] -> (dispatch [T, E, C] float32
+    0/1 indicator, combine [T, E, C] float32, aux_loss scalar).
+
+    Combine weights follow the GShard equation: the k selected gate values
+    are renormalized to sum to 1 per token (capacity-dropped selections
+    keep their share of the denominator, so a token that loses one of its
+    k experts is attenuated rather than re-amplified)."""
     t, e = logits.shape
     if capacity is None:
         capacity = max(1, int(math.ceil(t * capacity_factor * k / e)))
@@ -47,6 +52,7 @@ def top_k_gating(logits, k=2, capacity=None, capacity_factor=1.25):
 
     dispatch = jnp.zeros((t, e, capacity), dtype=jnp.float32)
     combine = jnp.zeros((t, e, capacity), dtype=jnp.float32)
+    gate_sum = jnp.zeros((t,), dtype=jnp.float32)
     remaining = probs
     # experts fill position counters across the k routing rounds so two
     # tokens never share a (expert, slot)
@@ -59,7 +65,9 @@ def top_k_gating(logits, k=2, capacity=None, capacity_factor=1.25):
             jnp.float32)
         pos_tok = jnp.sum(pos * onehot, axis=-1)             # [T]
         keep = pos_tok < capacity
-        gate = jnp.sum(probs * onehot, axis=-1) * keep       # [T]
+        gate_raw = jnp.sum(probs * onehot, axis=-1)          # [T]
+        gate_sum = gate_sum + gate_raw
+        gate = gate_raw * keep                               # [T]
         slot = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity,
                               dtype=jnp.float32)             # [T, C]
         dispatch = dispatch + onehot[:, :, None] * slot[:, None, :] \
@@ -69,6 +77,12 @@ def top_k_gating(logits, k=2, capacity=None, capacity_factor=1.25):
         fill = fill + jnp.sum(onehot * keep[:, None],
                               axis=0).astype(jnp.int32)
         remaining = remaining * (1.0 - onehot)
+
+    if k >= 2:
+        # GShard renormalization: the k selected gates sum to 1. For k=1
+        # (Switch) the raw prob must be kept — it is the router's main
+        # gradient path through the expert output (p/p == 1 would sever it).
+        combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
 
     # Switch load-balance loss: E * sum_e f_e * p_e
     me = probs.mean(axis=0)                      # mean router prob per expert

@@ -293,25 +293,114 @@ class ParallelCrossEntropy(Layer):
         return apply_op(ce_full, logits, label)
 
 
+def _stream_tag(name: str) -> int:
+    import zlib
+    return zlib.crc32(name.encode()) & 0x7FFFFFFF
+
+
+class _StreamScope:
+    """Wraps an active traced RNG scope: every key drawn inside a
+    tracker.rng_state(name) block is folded with the stream's tag and —
+    for non-global streams — with the index of any bound mp/sp axis, so
+    shard_map ranks draw decorrelated dropout masks."""
+
+    def __init__(self, inner, tag, fold_axes):
+        self._inner = inner
+        self._tag = tag
+        self._fold_axes = fold_axes
+
+    def next_key(self):
+        k = jax.random.fold_in(self._inner.next_key(), self._tag)
+        for ax in self._fold_axes:
+            if axis_bound(ax):
+                k = jax.random.fold_in(k, jax.lax.axis_index(ax))
+        return k
+
+
+class RNGStatesTracker:
+    """ref: fleet.meta_parallel rng_state tracker — named dropout RNG
+    streams so tensor-parallel ranks draw decorrelated local dropout while
+    sharing the global stream.
+
+    TPU-native semantics per execution regime:
+
+    - eager: each named stream is its own Generator (seed it with
+      ``add(name, seed)``; the reference seeds "local_seed" with
+      seed+mp_rank — here a per-name default derived from the name tag is
+      used if not added).
+    - traced (functional/jit path): keys keep flowing from the step's
+      rng_scope (so they remain proper jit inputs) but are folded with the
+      stream tag; non-"global_seed" streams additionally fold the bound
+      mp/sp axis index inside shard_map, which is the moment ranks actually
+      run distinct programs. Under pure GSPMD a single logical dropout mask
+      is partitioned by XLA, which already matches the reference's
+      semantics for sharded activations.
+    """
+
+    GLOBAL = "global_seed"
+
+    def __init__(self):
+        self._gens = {}
+
+    def add(self, name, seed):
+        from ... import framework
+        g = framework.Generator(int(seed))
+        g._tracker_stream = True
+        self._gens[name] = g
+
+    def reset(self):
+        self._gens.clear()
+        self._base_seed = None
+
+    def _eager_gen(self, name):
+        from ... import framework
+        if name not in self._gens:
+            # derive from the NON-stream global seed, never from a stream
+            # generator that happens to be swapped in (nested rng_state
+            # blocks must not change a lazily-created stream's sequence)
+            base = getattr(self, "_base_seed", None)
+            if base is None:
+                base = framework.default_generator().initial_seed()
+            g = framework.Generator(base ^ _stream_tag(name))
+            g._tracker_stream = True
+            self._gens[name] = g
+        return self._gens[name]
+
+    def rng_state(self, name="local_seed"):
+        import contextlib
+        from ... import framework
+
+        @contextlib.contextmanager
+        def _cm():
+            st = framework._state
+            scope = getattr(st, "rng_scope", None)
+            if scope is not None:
+                fold = () if name == self.GLOBAL else ("mp", "sp")
+                st.rng_scope = _StreamScope(scope, _stream_tag(name), fold)
+                try:
+                    yield
+                finally:
+                    st.rng_scope = scope
+            else:
+                prev = framework._default_generator
+                if not getattr(prev, "_tracker_stream", False):
+                    self._base_seed = prev.initial_seed()
+                gen = self._eager_gen(name)
+                framework._default_generator = gen
+                try:
+                    yield
+                finally:
+                    framework._default_generator = prev
+        return _cm()
+
+    def fold_axis(self, key, axis="mp"):
+        if axis_bound(axis):
+            return jax.random.fold_in(key, jax.lax.axis_index(axis))
+        return key
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
 def get_rng_state_tracker():
-    """ref: fleet.meta_parallel.get_rng_state_tracker — per-mp-rank dropout
-    RNG. TPU-native: fold the mp axis index into the traced PRNG key, so
-    each mp shard sees decorrelated dropout inside shard_map, identical
-    keys under GSPMD (where XLA partitions a single logical dropout)."""
-    class _Tracker:
-        def rng_state(self, name="local_seed"):
-            import contextlib
-
-            @contextlib.contextmanager
-            def _cm():
-                yield
-            return _cm()
-
-        def add(self, name, seed):
-            pass
-
-        def fold_axis(self, key, axis="mp"):
-            if axis_bound(axis):
-                return jax.random.fold_in(key, jax.lax.axis_index(axis))
-            return key
-    return _Tracker()
+    return _RNG_TRACKER

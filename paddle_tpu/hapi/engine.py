@@ -107,13 +107,21 @@ class Engine:
 
             def loss_fn(p):
                 run_p = {**frozen, **p}
+                run_in = inputs
                 if amp_dt is not None:
-                    run_p = jax.tree_util.tree_map(
+                    cast = jax.tree_util.tree_map(
                         lambda a: a.astype(amp_dt)
                         if jnp.issubdtype(a.dtype, jnp.floating) else a,
-                        run_p)
+                        (run_p, list(inputs)))
+                    run_p, run_in = cast
                 outs, new_buf = functional_call(
-                    network, run_p, buffers, *inputs, rng=rng, mutable=True)
+                    network, run_p, buffers, *run_in, rng=rng, mutable=True)
+                if amp_dt is not None:
+                    # keep running stats at their original dtype so the step
+                    # signature is stable (no recompile) and stats stay fp32
+                    new_buf = jax.tree_util.tree_map(
+                        lambda n, o: n.astype(o.dtype)
+                        if hasattr(n, "astype") else n, new_buf, buffers)
                 outs_t = outs if isinstance(outs, (list, tuple)) else [outs]
                 if loss_layer is not None:
                     l = loss_layer(*outs_t, *labels)

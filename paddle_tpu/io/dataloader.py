@@ -124,10 +124,16 @@ class DataLoader:
                     yield _to_tensors(item)
             finally:
                 # early exit included: wake the (possibly push-blocked)
-                # producer, join it, and only then free the native queue
+                # producer, join it, and only then free the native queue.
+                # If the producer is still alive after the join timeout
+                # (stuck in dataset code, not yet in push), destroying
+                # would free memory under a live thread — leak the handle
+                # instead; the daemon thread's eventual push fails safely
+                # against the closed-but-alive queue.
                 native.close()
                 t.join(timeout=10)
-                native.destroy()
+                if not t.is_alive():
+                    native.destroy()
             return
         # pure-python fallback
         q = _queue.Queue(maxsize=depth)

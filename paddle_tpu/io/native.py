@@ -203,6 +203,9 @@ def gather_rows(samples, out=None, pool_addr=None):
     shape = (n,) + first.shape
     rows = [np.ascontiguousarray(s) for s in samples]
     if lib is None:
+        if out is not None:
+            np.stack(rows, out=out)
+            return out
         return np.stack(rows)
     ptrs = (ctypes.c_void_p * n)(
         *[r.ctypes.data_as(ctypes.c_void_p).value for r in rows])

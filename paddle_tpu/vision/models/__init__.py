@@ -25,3 +25,6 @@ from .shufflenetv2 import (  # noqa: F401
 )
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
 from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
+from .detection import (  # noqa: F401
+    PPYOLOE, PPYOLOECriterion, DETR, DETRLoss,
+)

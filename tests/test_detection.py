@@ -1,0 +1,139 @@
+"""Detection zoo: PP-YOLOE + DETR (ref: PaddleDetection test suite shape —
+forward shapes, assigner/matcher correctness, one train step improves the
+loss). All static shapes, CPU."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi.engine import Engine
+from paddle_tpu.vision.models.detection import (
+    PPYOLOE, PPYOLOECriterion, DETR, DETRLoss, auction_match,
+    task_aligned_assign, multiclass_nms, pairwise_iou)
+
+
+def _gt(batch=1):
+    gt_boxes = paddle.to_tensor(np.tile(np.array(
+        [[[4, 4, 30, 30], [20, 10, 60, 50], [0, 0, 0, 0]]], np.float32),
+        (batch, 1, 1)))
+    gt_class = paddle.to_tensor(np.tile(
+        np.array([[1, 2, 0]], np.int64), (batch, 1)))
+    gt_mask = paddle.to_tensor(np.tile(
+        np.array([[1, 1, 0]], np.float32), (batch, 1)))
+    return gt_boxes, gt_class, gt_mask
+
+
+class TestPPYOLOE:
+    def _model(self):
+        paddle.seed(0)
+        return PPYOLOE(num_classes=4, channels=(16, 32, 48, 64, 80))
+
+    def test_forward_shapes(self):
+        m = self._model()
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32"))
+        boxes, scores = m(x)
+        a = 8 * 8 + 4 * 4 + 2 * 2  # strides 8/16/32 on 64px
+        assert list(boxes.shape) == [2, a, 4]
+        assert list(scores.shape) == [2, a, 4]
+
+    def test_train_step_improves_loss(self):
+        m = self._model()
+        m.train()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        eng = Engine(m, loss=PPYOLOECriterion(m), optimizer=opt)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(1, 3, 64, 64).astype("float32"))
+        labels = _gt()
+        losses = [float(eng.train_batch([x], list(labels))[0])
+                  for _ in range(5)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_tal_assigner_prefers_high_iou_anchor(self):
+        a = 16
+        anchors = jnp.stack(
+            [jnp.linspace(4, 60, a), jnp.full((a,), 16.0)], -1)
+        boxes = jnp.stack([anchors[:, 0] - 8, anchors[:, 1] - 8,
+                           anchors[:, 0] + 8, anchors[:, 1] + 8], -1)
+        gt = jnp.asarray([[0.0, 8.0, 16.0, 24.0]])  # matches anchor near x=8
+        scores = jnp.full((a, 3), 0.5)
+        assigned, fg, tscore = task_aligned_assign(
+            scores, boxes, anchors, gt, jnp.asarray([1]), jnp.asarray([1.0]),
+            topk=4)
+        fg_idx = np.where(np.asarray(fg))[0]
+        assert len(fg_idx) > 0
+        iou, _ = pairwise_iou(boxes, gt)
+        assert np.asarray(iou)[fg_idx, 0].min() > 0.2
+        assert np.asarray(tscore)[fg_idx, 1].min() > 0.0
+        assert np.asarray(tscore)[:, [0, 2]].max() == 0.0
+
+    def test_multiclass_nms_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                         np.float32)
+        scores = np.zeros((3, 2), np.float32)
+        scores[:, 1] = [0.9, 0.8, 0.7]
+        dets = multiclass_nms(boxes, scores, score_thresh=0.1,
+                              iou_thresh=0.5)
+        assert len(dets) == 2  # the two overlapping boxes collapse to one
+
+
+class TestDETR:
+    def _model(self):
+        paddle.seed(0)
+        return DETR(num_classes=4, num_queries=10, d_model=32, nhead=2,
+                    num_encoder_layers=1, num_decoder_layers=1,
+                    dim_feedforward=64, backbone="resnet18", dropout=0.0)
+
+    def test_forward_shapes(self):
+        m = self._model()
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32"))
+        boxes, probs = m(x)
+        assert list(boxes.shape) == [2, 10, 4]
+        assert list(probs.shape) == [2, 10, 5]  # +1 no-object class
+        # boxes are in pixel space
+        assert float(boxes.max()) <= 64.0 + 1e-3
+
+    def test_train_step_improves_loss(self):
+        m = self._model()
+        m.train()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        eng = Engine(m, loss=DETRLoss(num_classes=4), optimizer=opt)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(1, 3, 64, 64).astype("float32"))
+        gt_boxes = paddle.to_tensor(np.array(
+            [[[.3, .3, .2, .2], [.6, .6, .3, .3], [0, 0, 0, 0]]],
+            np.float32))
+        gt_class = paddle.to_tensor(np.array([[1, 2, 0]], np.int64))
+        gt_mask = paddle.to_tensor(np.array([[1, 1, 0]], np.float32))
+        losses = [float(eng.train_batch([x],
+                                        [gt_boxes, gt_class, gt_mask])[0])
+                  for _ in range(5)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+
+class TestAuctionMatch:
+    def test_matches_scipy_optimum(self):
+        scipy_opt = pytest.importorskip("scipy.optimize")
+        rng = np.random.default_rng(0)
+        for trial in range(10):
+            q, m = 16, 5
+            cost = rng.normal(size=(q, m)).astype("float32")
+            valid = np.ones(m, bool)
+            if trial % 2:
+                valid[3:] = False
+            match = np.asarray(auction_match(jnp.asarray(cost),
+                                             jnp.asarray(valid)))
+            # distinct queries for valid gts
+            assert len(set(match[valid])) == valid.sum()
+            r, c = scipy_opt.linear_sum_assignment(cost[:, valid].T)
+            opt = cost[:, valid].T[r, c].sum()
+            got = cost[match[valid], np.arange(m)[valid]].sum()
+            assert abs(got - opt) < 0.05

@@ -59,6 +59,7 @@ class TestDataLoader:
 
 class TestModelFit:
     def test_fit_evaluate_predict(self, tmp_path):
+        paddle.seed(1234)  # init/shuffle must not depend on test order
         ds, xs, ys = make_ds(128)
         net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
         model = paddle.Model(net)
