@@ -55,3 +55,4 @@ from .functional_transforms import value_and_grad, functional_grad, vmap, checkp
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
 from . import text  # noqa: F401
+from . import incubate  # noqa: E402,F401

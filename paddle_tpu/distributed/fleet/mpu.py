@@ -71,6 +71,17 @@ def annotate(x, *spec):
         if s is not None and int(dim) % mesh.shape[s] != 0:
             return x
     spec = [P.UNCONSTRAINED if s is None else s for s in spec]
+    # inside a PARTIAL shard_map (e.g. manual over 'pp', auto over dp/mp)
+    # the constraint must be built on the trace's abstract mesh so axis
+    # types line up (pp: Manual); the concrete mesh types everything Auto
+    # and jax rejects the mismatch
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if am is not None and am.axis_names == mesh.axis_names and any(
+                "Manual" in str(t) for t in getattr(am, "axis_types", ())):
+            mesh = am
+    except Exception:
+        pass
     sharding = NamedSharding(mesh, P(*spec))
     if isinstance(x, Tensor):
         return apply_op(

@@ -12,13 +12,15 @@ TPU-native design — the whole pipeline is ONE jitted SPMD program:
   (where the reference materialises only the local stage's Layers per rank).
 - microbatches march through a lax.scan over T = n_micro + S - 1 ticks;
   activations hop stage i -> i+1 by lax.ppermute over ICI (the reference's
-  p2p send/recv pairs).
+  p2p send/recv pairs). The S-1 extra ticks are the pipeline bubble —
+  identical cost shape to the reference's warmup/drain; drained stages
+  compute on zeros (SPMD lock-step means the FLOPs happen either way).
 - backward is jax.grad *through* the scan: ppermute transposes to the
-  reverse shift, so XLA compiles the FThenB schedule; per-microbatch
-  jax.checkpoint bounds activation memory exactly like the reference's
-  recompute interval. (1F1B in the reference is a scheduling change with
-  identical math; under XLA the scheduler owns op ordering, so we expose
-  schedule_mode for parity but compile one program.)
+  reverse shift. Schedule note: this compiles the FThenB dataflow; the
+  reference's 1F1B is an op-ORDERING policy for memory, which under XLA
+  belongs to the compiler's scheduler — its memory benefit is delivered
+  here by per-microbatch jax.checkpoint (activations for at most one
+  microbatch per stage are live at a time), not by hand-ordering ops.
 - all other mesh axes (dp/mp/sp) stay *auto*: GSPMD keeps partitioning the
   batch and the tensor-parallel weights inside each stage, so dp x mp x pp
   hybrids compose with no extra code.
@@ -60,7 +62,10 @@ def _pipeline_local(stage_params, x, *, stage_fn, n_stages, n_micro,
 
     def tick(carry, t):
         act, outbuf = carry
-        inj = micro[jnp.minimum(t, n_micro - 1)]
+        # past the last microbatch stage 0 feeds zeros (the drain ticks);
+        # their outputs are never harvested
+        inj = jnp.where(t < n_micro, micro[jnp.minimum(t, n_micro - 1)],
+                        jnp.zeros_like(micro[0]))
         act = jnp.where(stage == 0, inj, act)
         out = f(local, act)
         oidx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)

@@ -1,0 +1,11 @@
+"""paddle.incubate parity (ref: python/paddle/incubate/__init__.py).
+
+Currently the optimizer extensions: LookAhead, ModelAverage, EMA.
+"""
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from .ema import ExponentialMovingAverage  # noqa: F401
+
+EMA = ExponentialMovingAverage
+
+__all__ = ["LookAhead", "ModelAverage", "ExponentialMovingAverage", "EMA",
+           "optimizer"]

@@ -1,0 +1,77 @@
+"""Exponential moving average of model weights
+(ref: python/paddle/static's ExponentialMovingAverage; the dygraph
+pattern in PaddleDetection ppdet/optimizer/ema.py).
+
+Eager API mirrors the reference (update/apply/restore); the functional
+pair (ema_init / ema_update) slots into jitted training loops so the EMA
+update fuses into the train step.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+
+def ema_init(params):
+    # copy so the shadow never aliases live (possibly donated) buffers
+    return jax.tree_util.tree_map(lambda p: jnp.array(p, copy=True), params)
+
+
+def ema_update(ema, params, decay=0.999, step=None):
+    """One EMA step. With `step`, uses the reference's warmup-corrected
+    decay min(decay, (1+step)/(10+step))."""
+    if step is not None:
+        d = jnp.minimum(decay, (1.0 + step) / (10.0 + step))
+    else:
+        d = decay
+    return jax.tree_util.tree_map(
+        lambda e, p: e * d + p.astype(e.dtype) * (1.0 - d), ema, params)
+
+
+class ExponentialMovingAverage:
+    def __init__(self, parameters=None, decay=0.999, use_warmup=False,
+                 name=None):
+        self._params = list(parameters or [])
+        self.decay = float(decay)
+        self.use_warmup = bool(use_warmup)
+        self._step = 0
+        self._ema = None
+        self._backup = None
+
+    def update(self):
+        vals = [p._value for p in self._params]
+        if self._ema is None:
+            self._ema = ema_init(vals)
+        self._step += 1
+        self._ema = ema_update(
+            self._ema, vals, self.decay,
+            step=jnp.float32(self._step) if self.use_warmup else None)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        if self._ema is None:
+            yield
+            return
+        self._backup = [p._value for p in self._params]
+        for p, e in zip(self._params, self._ema):
+            p._value = e.astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, v in zip(self._params, self._backup):
+                p._value = v
+            self._backup = None
+
+    def state_dict(self):
+        return {"ema": self._ema, "step": self._step}
+
+    def set_state_dict(self, d):
+        self._ema = d.get("ema")
+        self._step = d.get("step", 0)

@@ -1,0 +1,159 @@
+"""Incubate optimizers (ref: python/paddle/incubate/optimizer/lookahead.py,
+modelaverage.py).
+
+Both follow this package's optimizer design: a *functional core*
+(``init_state`` / ``update`` over pytrees, branch-free so it jits into the
+Engine's single fused train step) plus the reference's eager API
+(``step()`` / ``apply()`` / ``restore()``).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..optimizer.optimizer import Optimizer
+from ..tensor import Tensor
+
+
+class LookAhead(Optimizer):
+    """ref: incubate/optimizer/lookahead.py — wraps an inner (fast)
+    optimizer; every k steps the slow weights catch up by
+    slow += alpha * (fast - slow) and the fast weights reset to slow.
+
+    The k-step branch is a ``jnp.where`` on ``step % k`` so one compiled
+    step serves every iteration (no retrace, TPU-friendly).
+    """
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not isinstance(inner_optimizer, Optimizer):
+            raise TypeError("inner_optimizer must be a paddle_tpu Optimizer")
+        inner = inner_optimizer
+        super().__init__(learning_rate=inner._lr,
+                         parameters=inner._parameter_list,
+                         grad_clip=inner._grad_clip)
+        self.inner_optimizer = inner
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    # functional core --------------------------------------------------
+    def init_state(self, params):
+        # slow weights must be a COPY: sharing buffers with the live params
+        # breaks the Engine's donation (same buffer donated as both params
+        # and opt_state)
+        return {"inner": self.inner_optimizer.init_state(params),
+                "slow": jax.tree_util.tree_map(
+                    lambda p: jnp.array(p, copy=True), params)}
+
+    def update(self, params, grads, state, lr, step, lr_mult=None):
+        fast, inner_state = self.inner_optimizer.update(
+            params, grads, state["inner"], lr, step)
+        sync = (step % self.k) == 0
+        new_slow = jax.tree_util.tree_map(
+            lambda s, f: jnp.where(sync, s + self.alpha * (f - s), s),
+            state["slow"], fast)
+        new_fast = jax.tree_util.tree_map(
+            lambda s, f: jnp.where(sync, s, f), new_slow, fast)
+        return new_fast, {"inner": inner_state, "slow": new_slow}
+
+    # eager API ---------------------------------------------------------
+    def step(self):
+        params = {i: p for i, p in enumerate(self._parameter_list)}
+        grads = {i: (p.grad._value if p.grad is not None else None)
+                 for i, p in enumerate(self._parameter_list)}
+        live = {i: p._value for i, p in params.items()
+                if grads[i] is not None}
+        g = {i: grads[i] for i in live}
+        if self._func_state is None:
+            self._func_state = self.init_state(live)
+        self._step_count += 1
+        new_p, self._func_state = self.update(
+            live, g, self._func_state, jnp.float32(self.get_lr()),
+            jnp.int32(self._step_count))
+        for i, v in new_p.items():
+            params[i]._value = v
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    def state_dict(self):
+        return {"func_state": self._func_state,
+                "step": self._step_count}
+
+    def set_state_dict(self, d):
+        self._func_state = d.get("func_state")
+        self._step_count = d.get("step", 0)
+
+
+class ModelAverage(Optimizer):
+    """ref: incubate/optimizer/modelaverage.py — maintains a running
+    average of parameter values over a trailing window; ``apply()`` swaps
+    the averaged weights in for evaluation, ``restore()`` swaps back.
+
+    The reference tracks sum_1/sum_2/sum_3 blocks to bound the window on
+    GPU memory; a single (sum, count) pair with the same min/max window
+    clamping is equivalent math and one less state tensor per param.
+    """
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        super().__init__(learning_rate=0.0, parameters=parameters)
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._sum = None
+        self._count = 0
+        self._backup = None
+
+    def _params(self):
+        return list(self._parameter_list or [])
+
+    def accumulate(self):
+        """Call once per optimizer step (the reference hooks this into
+        minimize())."""
+        ps = self._params()
+        if self._sum is None:
+            self._sum = [jnp.zeros_like(p._value) for p in ps]
+        window = max(self.min_w, min(self.max_w,
+                                     int(self._count * self.rate) + 1))
+        if self._count >= window:
+            # decay old contributions so the average tracks the trailing
+            # window (exponential forget with the same horizon)
+            keep = 1.0 - 1.0 / window
+            self._sum = [s * keep for s in self._sum]
+            self._count = int(self._count * keep)
+        self._sum = [s + p._value for s, p in zip(self._sum, ps)]
+        self._count += 1
+
+    step = accumulate
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context manager, ref: apply())."""
+        ps = self._params()
+        if self._sum is None or self._count == 0:
+            yield
+            return
+        self._backup = [p._value for p in ps]
+        for p, s in zip(ps, self._sum):
+            p._value = (s / self._count).astype(p._value.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, v in zip(self._params(), self._backup):
+                p._value = v
+            self._backup = None
+
+    def minimize(self, loss=None):
+        self.accumulate()
+
+    def clear_grad(self, set_to_zero=True):
+        pass

@@ -183,3 +183,39 @@ def load(path, **configs):
     params = {k: v._value for k, v in blob["params"].items()}
     buffers = {k: v._value for k, v in blob["buffers"].items()}
     return TranslatedLayer(exp, params, buffers)
+
+
+def get_hlo(layer_or_fn, *example_inputs, stage="stablehlo",
+            optimized=False):
+    """Program introspection: the traced program's IR as text.
+
+    ref: paddle.static.Program.to_string / print_program — the reference
+    dumps its static Program proto; the XLA-native equivalent is the
+    lowered StableHLO (or backend-optimized HLO) of the jitted function.
+
+    layer_or_fn: a Layer (traced as functional_call over its state) or any
+    jax-traceable callable. example_inputs: Tensors/arrays/InputSpecs.
+    stage: "stablehlo" (portable pre-optimization IR) or "hlo".
+    optimized=True returns the backend-optimized HLO (after fusion —
+    what the R3 fusion audit reads).
+    """
+    args = [a.to_shape_struct() if isinstance(a, InputSpec)
+            else _unwrap(a) for a in example_inputs]
+    if isinstance(layer_or_fn, Layer):
+        layer = layer_or_fn
+        params, buffers = layer.raw_state()
+
+        def fn(p, b, *xs):
+            out = functional_call(layer, p, b, *[Tensor(x) for x in xs])
+            return _unwrap(out)
+        lowered = jax.jit(fn).lower(params, buffers, *args)
+    else:
+        lowered = jax.jit(layer_or_fn).lower(*args)
+    if optimized:
+        return lowered.compile().as_text()
+    if stage not in ("stablehlo", "hlo"):
+        raise ValueError(f"stage must be 'stablehlo' or 'hlo', got {stage!r}")
+    return lowered.as_text(dialect=stage)
+
+
+__all__.append("get_hlo")

@@ -241,15 +241,20 @@ def _resolve_config(name, **overrides):
     return GPTConfig(**cfg)
 
 
+def _coerce_config(config, kwargs):
+    if config is None:
+        return GPTConfig(**kwargs)
+    if isinstance(config, dict):
+        return GPTConfig(**config)
+    return config
+
+
 class GPTModel(Layer):
     """ref: paddlenlp/transformers/gpt/modeling.py GPTModel."""
 
     def __init__(self, config: GPTConfig = None, **kwargs):
         super().__init__()
-        if config is None:
-            config = GPTConfig(**kwargs)
-        elif isinstance(config, dict):
-            config = GPTConfig(**config)
+        config = _coerce_config(config, kwargs)
         self.config = config
         self.embeddings = GPTEmbeddings(config)
         self.h = LayerList([GPTDecoderLayer(config)
@@ -384,3 +389,60 @@ class GPTPretrainingCriterion(Layer):
             den = m.astype(loss.dtype).sum()
             return num / den
         return loss.mean()
+
+
+class GPTForCausalLMPipe(Layer):
+    """Pipeline-parallel GPT (ref: paddlenlp/transformers/gpt/modeling_pp.py
+    GPTForCausalLMPipe — PipelineLayer of [embedding, N decoder LayerDescs,
+    ln_f, tied lm-head]).
+
+    TPU-native split of responsibilities: the decoder trunk — where the
+    per-layer weights live — runs through the shard_map+ppermute pipeline
+    over the 'pp' mesh axis (equal-structure stages of
+    num_hidden_layers/pp blocks each); embeddings, final LN and the tied
+    LM head run outside the pipelined region, partitioned by GSPMD over
+    dp/mp like any other op (the reference pins them to the first/last
+    stage rank instead — under one SPMD program there is no rank to pin
+    to, and XLA already shards the vocab matmul over 'mp').
+
+    Composes dp x mp x pp: batch sharded over 'dp', weights over 'mp'
+    (shard_model), trunk stages over 'pp'. Dropout must be 0 inside the
+    trunk (stage_fn runs without a traced rng stream).
+    """
+
+    def __init__(self, config: GPTConfig = None, mesh=None, n_micro=None,
+                 **kwargs):
+        super().__init__()
+        from ..distributed.fleet.pipeline import PipelineLayer
+        config = _coerce_config(config, kwargs)
+        if config.hidden_dropout_prob or config.attention_probs_dropout_prob:
+            # inside the pipelined shard_map+scan there is no traced rng
+            # stream: one mask would be baked in at trace time and reused
+            # for every microbatch/stage/tick — silently wrong, so refuse
+            raise ValueError(
+                "GPTForCausalLMPipe requires hidden_dropout_prob=0 and "
+                "attention_probs_dropout_prob=0 (dropout masks cannot vary "
+                "across pipeline microbatches)")
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.pipe = PipelineLayer(
+            [GPTDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.mesh = mesh
+        self.n_micro = n_micro
+
+    @classmethod
+    def from_config_name(cls, name, mesh=None, n_micro=None, **overrides):
+        return cls(_resolve_config(name, **overrides), mesh=mesh,
+                   n_micro=n_micro)
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.embeddings(input_ids, position_ids)
+        x = annotate(x, "dp", None, None)
+        x = self.pipe(x, n_micro=self.n_micro, mesh=self.mesh)
+        x = self.ln_f(x)
+        return parallel_matmul(
+            x, self.embeddings.word_embeddings.weight,
+            transpose_y=True, gather_output=False)

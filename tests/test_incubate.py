@@ -1,0 +1,106 @@
+"""incubate optimizers: LookAhead / ModelAverage / EMA (ref:
+python/paddle/incubate/optimizer tests)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import LookAhead, ModelAverage, EMA
+from paddle_tpu.incubate.ema import ema_init, ema_update
+
+
+def _problem(seed=0):
+    rng = np.random.RandomState(seed)
+    x = paddle.to_tensor(rng.randn(32, 8).astype("float32"))
+    y = paddle.to_tensor(rng.randn(32, 1).astype("float32"))
+    paddle.seed(seed)
+    net = paddle.nn.Linear(8, 1)
+    return net, x, y
+
+
+class TestLookAhead:
+    def test_eager_training_decreases_loss(self):
+        net, x, y = _problem()
+        inner = paddle.optimizer.SGD(learning_rate=0.05,
+                                     parameters=net.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=3)
+        losses = []
+        for _ in range(12):
+            loss = paddle.nn.functional.mse_loss(net(x), y)
+            losses.append(float(loss))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert losses[-1] < losses[0]
+
+    def test_functional_core_sync_semantics(self):
+        opt = LookAhead(paddle.optimizer.SGD(learning_rate=1.0),
+                        alpha=0.5, k=2)
+        params = {"w": jnp.zeros(3)}
+        state = opt.init_state(params)
+        g = {"w": jnp.full(3, -1.0)}  # sgd: w += 1 each step
+        # step 1: no sync -> fast=1, slow=0
+        p1, state = opt.update(params, g, state, jnp.float32(1.0),
+                               jnp.int32(1))
+        assert np.allclose(np.asarray(p1["w"]), 1.0)
+        # step 2: fast=2, sync -> slow=1, fast resets to slow
+        p2, state = opt.update(p1, g, state, jnp.float32(1.0),
+                               jnp.int32(2))
+        assert np.allclose(np.asarray(p2["w"]), 1.0)
+        assert np.allclose(np.asarray(state["slow"]["w"]), 1.0)
+
+    def test_with_engine(self):
+        from paddle_tpu.hapi.engine import Engine
+        net, x, y = _problem(1)
+        opt = LookAhead(paddle.optimizer.Adam(
+            learning_rate=0.01, parameters=net.parameters()), k=2)
+        eng = Engine(net, loss=paddle.nn.MSELoss(), optimizer=opt)
+        losses = [float(eng.train_batch([x], [y])[0]) for _ in range(6)]
+        assert losses[-1] < losses[0]
+
+
+class TestModelAverage:
+    def test_apply_restores(self):
+        net, x, y = _problem(2)
+        ma = ModelAverage(parameters=net.parameters(),
+                          min_average_window=2, max_average_window=100)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        for _ in range(5):
+            loss = paddle.nn.functional.mse_loss(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ma.accumulate()
+        before = np.asarray(net.weight)
+        with ma.apply():
+            averaged = np.asarray(net.weight)
+            assert not np.allclose(averaged, before)
+        after = np.asarray(net.weight)
+        np.testing.assert_allclose(after, before)
+
+
+class TestEMA:
+    def test_tracks_params_and_restores(self):
+        net, x, y = _problem(3)
+        ema = EMA(parameters=net.parameters(), decay=0.5)
+        w0 = np.asarray(net.weight).copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        for _ in range(3):
+            loss = paddle.nn.functional.mse_loss(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ema.update()
+        live = np.asarray(net.weight)
+        with ema.apply():
+            shadow = np.asarray(net.weight)
+            # shadow lags behind the live weights, between w0 and live
+            assert not np.allclose(shadow, live)
+        np.testing.assert_allclose(np.asarray(net.weight), live)
+
+    def test_functional_update(self):
+        ema = ema_init({"w": jnp.zeros(2)})
+        ema = ema_update(ema, {"w": jnp.ones(2)}, decay=0.9)
+        np.testing.assert_allclose(np.asarray(ema["w"]), 0.1, rtol=1e-5)

@@ -150,3 +150,62 @@ class TestPipelineLayerGrads:
             LayerDesc(Linear, 4, 4),
         ])
         assert pipe.blocks[0].weight is pipe.blocks[2].weight
+
+
+class TestGPTPipe:
+    """The flagship THROUGH the pipeline (VERDICT r1 #4): real decoder
+    blocks, pp==sequential numerics, and a full train step on a dp x pp
+    mesh."""
+
+    def _cfg(self):
+        from paddle_tpu.nlp.gpt import GPTConfig
+        return GPTConfig(vocab_size=128, hidden_size=32,
+                         num_hidden_layers=4, num_attention_heads=2,
+                         max_position_embeddings=32,
+                         hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0,
+                         use_flash_attention=False)
+
+    def test_pp_matches_sequential(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.nlp.gpt import GPTForCausalLMPipe
+        from paddle_tpu.nn.layer import functional_call
+        from paddle_tpu.tensor import Tensor
+        paddle.seed(0)
+        pipe = GPTForCausalLMPipe(self._cfg())
+        ids = paddle.to_tensor(np.random.RandomState(0)
+                               .randint(0, 128, (4, 16)).astype("int32"))
+        out_seq = pipe(ids)  # off-mesh -> sequential blocks
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("dp", "pp"))
+        pipe.mesh, pipe.n_micro = mesh, 2
+        params, buffers = pipe.raw_state()
+
+        def fwd(p, a):
+            return functional_call(pipe, p, buffers, Tensor(a))._value
+        with mesh:
+            out_pp = jax.jit(fwd)(params, ids._value)
+        np.testing.assert_allclose(np.asarray(out_pp),
+                                   np.asarray(out_seq), atol=2e-5)
+
+    def test_train_step_on_dp_pp_mesh(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.nlp.gpt import (GPTForCausalLMPipe,
+                                        GPTPretrainingCriterion)
+        from paddle_tpu.hapi.engine import Engine
+        paddle.seed(0)
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                    ("dp", "pp"))
+        pipe = GPTForCausalLMPipe(self._cfg(), mesh=mesh, n_micro=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=pipe.parameters())
+        eng = Engine(pipe, loss=GPTPretrainingCriterion(), optimizer=opt,
+                     mesh=mesh)
+        rng = np.random.RandomState(1)
+        ids = paddle.to_tensor(rng.randint(0, 128, (4, 16)).astype("int32"))
+        lbl = paddle.to_tensor(rng.randint(0, 128, (4, 16)).astype("int32"))
+        with mesh:
+            losses = [float(eng.train_batch([ids], [lbl])[0])
+                      for _ in range(3)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
