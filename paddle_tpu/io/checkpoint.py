@@ -7,8 +7,14 @@ supports exact resume (params + opt state + lr + scaler + rng). Here:
 - CheckpointManager: save(step, state) with an async background thread
   (train loop never blocks on disk), keep_max rolling retention +
   best-metric pinning, latest()/best() lookup, exact-resume payloads.
-- Backend: orbax when available (async sharded saves on real TPU pods),
-  else the built-in serialization (np .pdparams-style pickle).
+- Backend: sharded=True routes every jax.Array leaf through orbax
+  (per-shard tensorstore writes driven by the array's NamedSharding — the
+  full tree is NEVER gathered to one host; on a pod each host writes only
+  its addressable shards, the moral equivalent of fleet's sharded
+  save/load). Non-array leaves (steps, rng seeds, scaler scalars) ride in
+  a pickled skeleton next to it. restore(target=...) places arrays
+  straight onto the target shardings. sharded=False (default) is the
+  plain single-host pickle.
 """
 from __future__ import annotations
 
@@ -40,6 +46,60 @@ def _host_tree(tree):
         one, tree, is_leaf=lambda t: isinstance(t, Tensor))
 
 
+class _ArrayRef:
+    """Pickle-able placeholder marking an array's position in the state
+    skeleton; `key` addresses the array in the orbax store."""
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
+
+
+def _split_arrays(state, refs_from=None):
+    """(skeleton, arrays): skeleton is `state` with every jax.Array /
+    Tensor leaf replaced by an _ArrayRef; arrays is a flat {key: jax.Array}
+    dict (device-resident, shardings intact — nothing gathered).
+
+    refs_from: an existing skeleton whose _ArrayRef positions dictate which
+    leaves of `state` are treated as arrays (used for restore targets,
+    where a leaf may be an abstract ShapeDtypeStruct)."""
+    from ..tensor import Tensor
+
+    unwrapped = jax.tree_util.tree_map(
+        lambda t: t._value if isinstance(t, Tensor) else t, state,
+        is_leaf=lambda t: isinstance(t, Tensor))
+    counter = [0]
+    arrays = {}
+
+    def fresh(x):
+        if isinstance(x, (jax.Array, jax.ShapeDtypeStruct)):
+            key = f"a{counter[0]}"
+            counter[0] += 1
+            arrays[key] = x
+            return _ArrayRef(key)
+        return x
+
+    def from_ref(x, ref):
+        if isinstance(ref, _ArrayRef):
+            arrays[ref.key] = x  # reuse the SAVED key so lookups line up
+            return ref
+        return x
+
+    if refs_from is None:
+        skeleton = jax.tree_util.tree_map(fresh, unwrapped)
+    else:
+        skeleton = jax.tree_util.tree_map(
+            from_ref, unwrapped, refs_from,
+            is_leaf=lambda t: isinstance(t, _ArrayRef))
+    return skeleton, arrays
+
+
+def _merge_arrays(skeleton, arrays):
+    return jax.tree_util.tree_map(
+        lambda x: arrays[x.key] if isinstance(x, _ArrayRef) else x,
+        skeleton, is_leaf=lambda t: isinstance(t, _ArrayRef))
+
+
 class CheckpointManager:
     """Rolling, optionally-async checkpoint directory:
 
@@ -52,11 +112,15 @@ class CheckpointManager:
     """
 
     def __init__(self, directory, keep_max=5, async_save=False,
-                 mode="max"):
-        self.dir = str(directory)
+                 mode="max", sharded=False):
+        self.dir = os.path.abspath(str(directory))
         self.keep_max = keep_max
         self.async_save = async_save
         self.mode = mode
+        self.sharded = sharded
+        self._ckptr = None
+        if sharded:
+            import orbax.checkpoint  # noqa: F401  (fail fast if absent)
         os.makedirs(self.dir, exist_ok=True)
         self._lock = threading.Lock()
         self._pending: threading.Thread | None = None
@@ -85,9 +149,27 @@ class CheckpointManager:
     # -- save --------------------------------------------------------------
     def save(self, step, state, metric=None):
         """Snapshot `state` (any pytree: params/opt/lr/rng/scaler) at
-        `step`. Device arrays are fetched to host synchronously (cheap —
-        they were about to be donated anyway); disk write happens on the
-        background thread when async_save."""
+        `step`.
+
+        sharded=False: device arrays are fetched to host synchronously
+        (cheap — they were about to be donated anyway); disk write happens
+        on the background thread when async_save.
+        sharded=True: jax.Array leaves are written per-shard by orbax with
+        no host gather of the full tree; the write itself runs on the
+        background thread when async_save (arrays are immutable, so the
+        snapshot is consistent even while training continues — but see
+        Engine donation: pass a non-donated copy or save before step)."""
+        if self.sharded:
+            skeleton, arrays = _split_arrays(state)
+            self.wait()
+            if self.async_save:
+                self._pending = threading.Thread(
+                    target=self._write_guarded,
+                    args=(step, (skeleton, arrays), metric), daemon=True)
+                self._pending.start()
+            else:
+                self._write(step, (skeleton, arrays), metric)
+            return
         host = _host_tree(state)
         self.wait()  # one in-flight save at a time, like orbax
         if self.async_save:
@@ -107,8 +189,18 @@ class CheckpointManager:
     def _write(self, step, host_state, metric):
         d = self._step_dir(step)
         tmp = d + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
         os.makedirs(tmp, exist_ok=True)
-        serialization.save(host_state, os.path.join(tmp, "state.pdparams"))
+        if self.sharded:
+            skeleton, arrays = host_state
+            serialization.save(skeleton, os.path.join(tmp, "skeleton.pd"))
+            ckptr = self._orbax()
+            ckptr.save(os.path.join(tmp, "arrays"), arrays)
+            ckptr.wait_until_finished()
+        else:
+            serialization.save(host_state,
+                               os.path.join(tmp, "state.pdparams"))
         meta = {"step": step, "metric": metric, "time": time.time()}
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
@@ -166,9 +258,15 @@ class CheckpointManager:
         with self._lock:
             return list(self._index["steps"])
 
-    def restore(self, step=None, best=False):
+    def restore(self, step=None, best=False, target=None):
         """Load a snapshot (default: latest). Returns the saved pytree with
-        numpy leaves, or None when the directory is empty."""
+        numpy leaves, or None when the directory is empty.
+
+        sharded manager: `target` may be a pytree matching the saved state
+        whose array leaves are jax.ShapeDtypeStruct(shape, dtype,
+        sharding=NamedSharding(...)) (or live arrays to copy the spec
+        from) — each restored array is then materialized directly onto its
+        target sharding, shard by shard, never as one host copy."""
         self.wait()
         if best:
             step = self.best_step()
@@ -180,6 +278,37 @@ class CheckpointManager:
             step = self.latest_step()
         if step is None:
             return None
+        if self.sharded:
+            return self._restore_sharded(step, target)
         return serialization.load(
             os.path.join(self._step_dir(step), "state.pdparams"),
             return_numpy=True)
+
+    def _orbax(self):
+        """One StandardCheckpointer per manager — constructing one per call
+        leaks its async worker machinery over a long run."""
+        if self._ckptr is None:
+            import orbax.checkpoint as ocp
+            self._ckptr = ocp.StandardCheckpointer()
+        return self._ckptr
+
+    def close(self):
+        if self._ckptr is not None:
+            self._ckptr.close()
+            self._ckptr = None
+
+    def _restore_sharded(self, step, target):
+        d = self._step_dir(step)
+        skeleton = serialization.load(os.path.join(d, "skeleton.pd"),
+                                      return_numpy=False)
+        ckptr = self._orbax()
+        abstract = None
+        if target is not None:
+            _, tgt_arrays = _split_arrays(target, refs_from=skeleton)
+            abstract = jax.tree_util.tree_map(
+                lambda a: a if isinstance(a, jax.ShapeDtypeStruct)
+                else jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                          sharding=a.sharding),
+                tgt_arrays)
+        arrays = ckptr.restore(os.path.join(d, "arrays"), abstract)
+        return _merge_arrays(skeleton, arrays)

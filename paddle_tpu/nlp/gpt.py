@@ -150,6 +150,13 @@ class GPTAttention(Layer):
             vbuf = jax.lax.dynamic_update_slice(
                 vbuf, vv.astype(vbuf.dtype), (zero, idx, zero, zero))
             sq, s_max = qv.shape[1], kbuf.shape[1]
+            if sq == 1:
+                # decode step: flash-decode kernel over the padded cache
+                # (causal == "first idx+1 keys are valid" when sq == 1)
+                from ..ops.attention import flash_decode
+                lens = jnp.full((qv.shape[0],), idx + 1, jnp.int32)
+                out = flash_decode(qv, kbuf, vbuf, lens)
+                return out, kbuf, vbuf
             # causal validity against absolute positions: query row r sits
             # at position idx+r and may attend keys at positions <= idx+r
             kpos = jnp.arange(s_max)[None, :]

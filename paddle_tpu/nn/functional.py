@@ -1318,10 +1318,21 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """
     from ..ops import flash_attention_available, flash_attention
     q, k, v = _t(query), _t(key), _t(value)
+    eff_drop = float(dropout_p) if (dropout_p and training) else 0.0
     if (use_flash
             and flash_attention_available(q.shape, k.shape, attn_mask,
-                                          dropout_p)
+                                          eff_drop)
             and training is not None):
+        if eff_drop:
+            # in-kernel dropout: seed folds from the step's rng stream so
+            # every step (and every jitted-step invocation) gets fresh masks
+            seed = jax.random.randint(next_rng_key(), (), 0, 2 ** 31 - 1,
+                                      dtype=jnp.int32)
+            return apply_op(
+                lambda qq, kk, vv, sd: flash_attention(
+                    qq, kk, vv, causal=is_causal, dropout_p=eff_drop,
+                    dropout_seed=sd),
+                q, k, v, _t(seed))
         return apply_op(
             lambda qq, kk, vv: flash_attention(qq, kk, vv, causal=is_causal),
             q, k, v)

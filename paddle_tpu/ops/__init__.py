@@ -9,5 +9,6 @@ from __future__ import annotations
 
 import jax
 
-from .attention import flash_attention, flash_attention_available  # noqa: F401
+from .attention import (  # noqa: F401
+    flash_attention, flash_attention_available, flash_decode)
 from .fused import fused_rms_norm, fused_softmax_cross_entropy  # noqa: F401

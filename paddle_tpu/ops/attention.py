@@ -4,6 +4,11 @@ ref parity: paddle.nn.functional.flash_attention (CUDA flash-attn v2 in the
 reference). Here: a Pallas TPU kernel (ops/pallas/flash_attention.py) tiled
 for the MXU, with an XLA-fusable jnp fallback. The public entry keeps the
 reference's [batch, seq, heads, head_dim] layout.
+
+In-kernel coverage (matching the reference's flash_attn feature set):
+causal, per-sequence KV padding lengths (kv_lens), attention dropout
+(mask regenerated in backward). Arbitrary dense attn_mask tensors still
+fall back to the jnp path — the reference routes those off flash too.
 """
 from __future__ import annotations
 
@@ -25,9 +30,10 @@ def _platform():
 
 
 def flash_attention_available(q_shape, k_shape, attn_mask, dropout_p) -> bool:
-    """Pallas kernel handles: TPU, no explicit mask, no dropout, seq multiple
-    of block, supported head dims."""
-    if attn_mask is not None or dropout_p:
+    """Pallas kernel handles: TPU, no explicit dense mask (padding lengths
+    and dropout ARE supported in-kernel), seq multiple of block, supported
+    head dims."""
+    if attn_mask is not None:
         return False
     if _platform() != "tpu":
         return False
@@ -39,30 +45,62 @@ def flash_attention_available(q_shape, k_shape, attn_mask, dropout_p) -> bool:
             and sk % _PALLAS_MIN_SEQ == 0)
 
 
-def flash_attention(q, k, v, causal=False, sm_scale=None):
+def flash_attention(q, k, v, causal=False, sm_scale=None, kv_lens=None,
+                    dropout_p=0.0, dropout_seed=0):
     """[B, S, H, D] flash attention. Uses the Pallas kernel on TPU, jnp
     reference otherwise."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    if flash_attention_available(q.shape, k.shape, None, 0.0):
+    if flash_attention_available(q.shape, k.shape, None, dropout_p):
         from .pallas.flash_attention import flash_attention as pallas_flash
         # On a real TPU the kernel compiles natively; if the availability
         # gate was forced on elsewhere (CPU tests), run in interpret mode so
         # the identical kernel/ad path is exercised.
         return pallas_flash(q, k, v, causal=causal, sm_scale=sm_scale,
+                            kv_lens=kv_lens, dropout_p=dropout_p,
+                            dropout_seed=dropout_seed,
                             interpret=_platform() != "tpu")
-    return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+    return reference_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               kv_lens=kv_lens, dropout_p=dropout_p,
+                               dropout_seed=dropout_seed)
 
 
-def reference_attention(q, k, v, causal=False, sm_scale=None):
+def flash_decode(q, k_cache, v_cache, kv_lens, sm_scale=None):
+    """Single-query decode against a padded KV cache ([B, 1, H, D] x
+    [B, S, H, D] + kv_lens [B]). Pallas on TPU, jnp fallback elsewhere."""
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    sk = k_cache.shape[1]
+    if (_platform() == "tpu" and d in _PALLAS_HEAD_DIMS
+            and sk % _PALLAS_MIN_SEQ == 0):
+        from .pallas.flash_attention import flash_decode as pallas_decode
+        return pallas_decode(q, k_cache, v_cache, kv_lens,
+                             sm_scale=sm_scale)
+    return reference_attention(q, k_cache, v_cache, sm_scale=sm_scale,
+                               kv_lens=kv_lens)
+
+
+def reference_attention(q, k, v, causal=False, sm_scale=None, kv_lens=None,
+                        dropout_p=0.0, dropout_seed=0):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     qh, kh, vh = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
     logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * sm_scale
+    sq, sk = logits.shape[-2], logits.shape[-1]
     if causal:
-        sq, sk = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
         logits = jnp.where(mask, logits, -jnp.inf)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if kv_lens is not None:
+        lm = jnp.arange(sk)[None, None, None, :] < \
+            jnp.asarray(kv_lens)[:, None, None, None]
+        logits = jnp.where(lm, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    # fully-masked rows produce NaN softmax -> zero them (kernel outputs 0)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs).astype(q.dtype)
+    if dropout_p:
+        key = jax.random.PRNGKey(dropout_seed)
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     return jnp.swapaxes(out, 1, 2)

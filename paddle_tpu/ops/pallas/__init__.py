@@ -5,4 +5,4 @@ ref parity: the reference's hand-written CUDA kernels
 Here each kernel is written against the MXU/VPU with VMEM blocking and is
 validated in interpret mode on CPU (tests/test_pallas_*).
 """
-from .flash_attention import flash_attention  # noqa: F401
+from .flash_attention import flash_attention, flash_decode  # noqa: F401

@@ -26,3 +26,17 @@ for reg in ("_backend_factories", "backend_factories"):
 
 assert jax.devices()[0].platform == "cpu"
 assert jax.device_count() == 8, jax.devices()
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    """Isolate tests from global-mesh leakage: a mesh set by one test
+    (shard_model/set_mesh) must not change another test's sharding
+    constraints or pipeline routing."""
+    from paddle_tpu.distributed import mesh as mesh_mod
+    mesh_mod._global_mesh = None
+    yield
+    mesh_mod._global_mesh = None

@@ -270,3 +270,50 @@ class TestSecondReviewRegressions:
         plan = plan_model(m, mesh, Strategy(min_shard_elems=1))
         assert tuple(plan["weight"]) == (None, "mp")
         assert tuple(plan["bias"]) == ("mp",)
+
+
+class TestShardedCheckpoint:
+    """VERDICT r1 #8: sharded save/restore via orbax — no full host
+    gather; ZeRO-style sharded state round-trips onto its shardings."""
+
+    def test_sharded_roundtrip_preserves_shardings(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from paddle_tpu.io.checkpoint import CheckpointManager
+
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        sh = NamedSharding(mesh, P("dp"))
+        rep = NamedSharding(mesh, P())
+        w = jax.device_put(jnp.arange(16.0).reshape(8, 2), sh)
+        b = jax.device_put(jnp.ones(3), rep)
+        state = {"model": {"w": w, "b": b}, "step": 7, "lr": 0.5}
+
+        mgr = CheckpointManager(str(tmp_path / "ck"), sharded=True)
+        mgr.save(1, state)
+
+        target = {"model": {"w": jax.ShapeDtypeStruct((8, 2), w.dtype,
+                                                      sharding=sh),
+                            "b": jax.ShapeDtypeStruct((3,), b.dtype,
+                                                      sharding=rep)},
+                  "step": 7, "lr": 0.5}
+        got = CheckpointManager(str(tmp_path / "ck"),
+                                sharded=True).restore(target=target)
+        assert got["step"] == 7 and got["lr"] == 0.5
+        np.testing.assert_allclose(np.asarray(got["model"]["w"]),
+                                   np.asarray(w))
+        np.testing.assert_allclose(np.asarray(got["model"]["b"]),
+                                   np.asarray(b))
+        # arrays came back ON their shardings (placed, not host numpy)
+        assert got["model"]["w"].sharding.is_equivalent_to(sh, 2)
+
+    def test_sharded_restore_without_target(self, tmp_path):
+        import jax.numpy as jnp
+        import numpy as np
+        from paddle_tpu.io.checkpoint import CheckpointManager
+        mgr = CheckpointManager(str(tmp_path / "ck"), sharded=True)
+        mgr.save(3, {"x": jnp.ones((4, 4)), "note": 11})
+        got = mgr.restore()
+        assert got["note"] == 11
+        np.testing.assert_allclose(np.asarray(got["x"]), 1.0)

@@ -83,3 +83,98 @@ def test_flash_rejects_unaligned_seq():
     q = _rand((1, 200, 2, 64), 40)
     with pytest.raises(ValueError):
         flash_attention(q, q, q, interpret=True)
+
+
+class TestFlashMaskDropoutDecode:
+    """r2 kernel completeness: kv-length padding masks, in-kernel dropout
+    (fwd/bwd mask regeneration), flash decode (ref: flash_attn varlen +
+    dropout paths in phi/kernels/gpu/flash_attn_kernel.cu)."""
+
+    def _qkv(self, b=2, s=256, h=2, d=64):
+        return tuple(_rand((b, s, h, d), 30 + i) for i in range(3))
+
+    def _ref_masked(self, q, k, v, lens, causal=False):
+        sq, sk = q.shape[1], k.shape[1]
+        qh, kh, vh = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
+        lg = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(q.shape[-1])
+        m = jnp.arange(sk)[None, None, None, :] < lens[:, None, None, None]
+        if causal:
+            m = m & jnp.tril(jnp.ones((sq, sk), bool),
+                             k=sk - sq)[None, None]
+        p = jax.nn.softmax(jnp.where(m, lg, -jnp.inf), -1)
+        p = jnp.where(jnp.isnan(p), 0.0, p)
+        return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2)
+
+    def test_kv_lens_mask(self):
+        q, k, v = self._qkv()
+        lens = jnp.asarray([200, 128], jnp.int32)
+        got = flash_attention(q, k, v, kv_lens=lens, interpret=True)
+        want = self._ref_masked(q, k, v, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_kv_lens_causal_grads(self):
+        q, k, v = self._qkv(b=1, s=128)
+        lens = jnp.asarray([100], jnp.int32)
+
+        def lf(q, k, v):
+            o = flash_attention(q, k, v, causal=True, kv_lens=lens,
+                                interpret=True)
+            return jnp.sum(o * jnp.cos(o))
+
+        def lr(q, k, v):
+            o = self._ref_masked(q, k, v, lens, causal=True)
+            return jnp.sum(o * jnp.cos(o))
+        gf = jax.grad(lf, (0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, (0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-3)
+
+    def test_dropout_deterministic_and_mean_preserving(self):
+        q, k, v = self._qkv(b=1, s=128)
+        o1 = flash_attention(q, k, v, dropout_p=0.3, dropout_seed=7,
+                             interpret=True)
+        o2 = flash_attention(q, k, v, dropout_p=0.3, dropout_seed=7,
+                             interpret=True)
+        o3 = flash_attention(q, k, v, dropout_p=0.3, dropout_seed=8,
+                             interpret=True)
+        assert np.array_equal(np.asarray(o1), np.asarray(o2))
+        assert not np.array_equal(np.asarray(o1), np.asarray(o3))
+        # averaged over many seeds the dropout estimate approaches the
+        # exact attention (unbiasedness of the 1/(1-p) scaling)
+        acc = np.zeros(o1.shape, np.float64)
+        n = 24
+        for s in range(n):
+            acc += np.asarray(flash_attention(
+                q, k, v, dropout_p=0.3, dropout_seed=100 + s,
+                interpret=True), np.float64)
+        want = np.asarray(flash_attention(q, k, v, interpret=True))
+        err = np.abs(acc / n - want).mean()
+        assert err < 0.05, err
+
+    def test_dropout_grad_matches_finite_difference(self):
+        q, k, v = self._qkv(b=1, s=128, h=1)
+
+        def loss(qq):
+            o = flash_attention(qq, k, v, dropout_p=0.25, dropout_seed=9,
+                                causal=True, interpret=True)
+            return (o ** 2).sum()
+        g = jax.grad(loss)(q)
+        eps = 1e-2
+        for (i, j) in [(5, 10), (100, 63)]:
+            dq = np.zeros(q.shape, np.float32)
+            dq[0, i, 0, j] = eps
+            fd = (float(loss(q + dq)) - float(loss(q - dq))) / (2 * eps)
+            rel = abs(fd - float(g[0, i, 0, j])) / max(1.0, abs(fd))
+            assert rel < 0.02, (i, j, rel)
+
+    def test_flash_decode_matches_reference(self):
+        from paddle_tpu.ops.pallas.flash_attention import flash_decode
+        q, k, v = self._qkv()
+        qd = q[:, :1]
+        lens = jnp.asarray([200, 128], jnp.int32)
+        got = flash_decode(qd, k, v, lens, interpret=True)
+        want = self._ref_masked(qd, k, v, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-3, atol=2e-3)
