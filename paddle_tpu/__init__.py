@@ -31,7 +31,7 @@ def cast(x, dtype):
     """ref: paddle.cast."""
     return x.astype(dtype)
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 
 def _lazy_import():

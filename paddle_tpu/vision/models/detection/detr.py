@@ -139,9 +139,24 @@ class DETR(Layer):
         if backbone == "resnet50":
             self.backbone = resnet50(num_classes=0, with_pool=False)
             c_feat = 2048
-        else:
+        elif backbone == "resnet18":
             self.backbone = resnet18(num_classes=0, with_pool=False)
             c_feat = 512
+        elif backbone == "tiny":  # 4-conv stride-16 stack for tests/smoke
+            from ....nn import Sequential, BatchNorm2D, ReLU as _R
+            c_feat = 64
+            self.backbone = Sequential(
+                Conv2D(3, 16, 3, stride=2, padding=1), BatchNorm2D(16), _R(),
+                Conv2D(16, 32, 3, stride=2, padding=1), BatchNorm2D(32),
+                _R(),
+                Conv2D(32, 64, 3, stride=2, padding=1), BatchNorm2D(64),
+                _R(),
+                Conv2D(64, c_feat, 3, stride=2, padding=1),
+                BatchNorm2D(c_feat), _R())
+        else:
+            raise ValueError(
+                f"unknown backbone {backbone!r}; expected 'resnet50', "
+                "'resnet18' or 'tiny'")
         self.input_proj = Conv2D(c_feat, d_model, 1)
         self.transformer = Transformer(
             d_model, nhead, num_encoder_layers, num_decoder_layers,
