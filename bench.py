@@ -47,7 +47,8 @@ def gpt_flops_per_token(model, seq):
     return 6 * n + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
 
 
-def build_engine(cfg_name, batch, seq, amp, use_flash=True):
+def build_engine(cfg_name, batch, seq, amp, use_flash=True,
+                 recompute=False):
     from paddle_tpu.nlp.gpt import (GPTForCausalLM, GPT_CONFIGS,
                                     GPTPretrainingCriterion, _resolve_config)
     from paddle_tpu.hapi.engine import Engine
@@ -57,7 +58,7 @@ def build_engine(cfg_name, batch, seq, amp, use_flash=True):
     model = GPTForCausalLM(_resolve_config(
         cfg_name, max_position_embeddings=max_pos,
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
-        use_flash_attention=use_flash))
+        use_flash_attention=use_flash, recompute=recompute))
     model.train()
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
                 parameters=model.parameters())
@@ -137,6 +138,9 @@ def main():
     ap.add_argument("--no-flash", action="store_true",
                     help="disable the Pallas flash-attention path (fallback "
                          "number if the kernel regresses)")
+    ap.add_argument("--recompute", action="store_true",
+                    help="rematerialize decoder blocks (enables larger "
+                         "batches)")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
@@ -182,8 +186,10 @@ def main():
 
     use_flash = not args.no_flash
     log(f"bench: {cfg} batch={batch} seq={seq} steps={steps} "
-        f"backend={jax.default_backend()} amp={amp} flash={use_flash}")
-    eng = build_engine(cfg, batch, seq, amp, use_flash=use_flash)
+        f"backend={jax.default_backend()} amp={amp} flash={use_flash} "
+        f"recompute={args.recompute}")
+    eng = build_engine(cfg, batch, seq, amp, use_flash=use_flash,
+                       recompute=args.recompute)
     tput = run(eng, batch, seq, steps, warmup)
     print(json.dumps({
         "metric": "gpt_pretrain_tokens_per_sec_per_chip",

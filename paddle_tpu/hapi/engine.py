@@ -59,10 +59,6 @@ class Engine:
     def sync_to_layer(self):
         self.network.load_raw_state(self._params, self._buffers)
 
-    def _split_key(self):
-        self._rng_key, sub = jax.random.split(self._rng_key)
-        return sub
-
     def _shard_batch(self, arrs):
         if self.mesh is None or "dp" not in self.mesh.axis_names:
             return arrs
@@ -101,6 +97,10 @@ class Engine:
 
         def train_step(params, buffers, opt_state, lr, step_i, rng, inputs,
                        labels):
+            # per-step randomness folds from a CONSTANT base key inside the
+            # compiled step — splitting on the host would cost device ops
+            # (and, on a remote backend, round trips) every iteration
+            rng = jax.random.fold_in(rng, step_i)
             frozen = {k: v for k, v in params.items()
                       if k not in trainable_keys}
             live = {k: v for k, v in params.items() if k in trainable_keys}
@@ -190,11 +190,13 @@ class Engine:
             self._train_fn = self._build_train_fn()
         in_arrs = self._shard_batch(_unwrap(list(inputs)))
         lab_arrs = self._shard_batch(_unwrap(list(labels)))
-        lr = jnp.float32(self._lr_now())
+        # host-side numpy scalars: they ride along with the execute call
+        # instead of costing standalone device ops each step
+        lr = np.float32(self._lr_now())
         self._step += 1
         (self._params, self._buffers, self._opt_state, loss_v,
          outs) = self._train_fn(self._params, self._buffers, self._opt_state,
-                                lr, jnp.int32(self._step), self._split_key(),
+                                lr, np.int32(self._step), self._rng_key,
                                 in_arrs, lab_arrs)
         # donation deleted the old param/buffer jax arrays: rebind the live
         # Parameter tensors to the new ones so direct network access (eager

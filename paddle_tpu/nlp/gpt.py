@@ -47,6 +47,10 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     use_flash_attention: bool = True
     tie_word_embeddings: bool = True
+    # rematerialize each decoder block in backward (ref: fleet GPT-3
+    # configs train with recompute on) — ~1/3 more FLOPs for O(1)-block
+    # activation memory, the enabler for large batch/seq on one chip
+    recompute: bool = False
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -221,6 +225,24 @@ class GPTDecoderLayer(Layer):
         return (x, cache) if cache is not None else x
 
 
+def _recompute_block(blk, x, attention_mask):
+    """jax.checkpoint around one decoder block (array-level function; layer
+    params are closed-over tracers, which checkpoint treats as implicit
+    inputs). Full recompute: only the block INPUT is saved — saving dot
+    outputs (dots_saveable) keeps ~300MB/layer of qkv/mlp activations
+    alive and defeats the point on a 16GB chip."""
+    from ..autograd import in_jax_trace
+
+    def f(xa):
+        out = blk(Tensor(xa), attention_mask)
+        return out._value if isinstance(out, Tensor) else out
+
+    xa = x._value if isinstance(x, Tensor) else x
+    if not in_jax_trace((xa,)):
+        return blk(x, attention_mask)  # eager: nothing to rematerialize
+    return Tensor(jax.checkpoint(f)(xa), stop_gradient=False)
+
+
 class GPTEmbeddings(Layer):
     """word (vocab-parallel) + learned position embeddings."""
 
@@ -306,6 +328,8 @@ class GPTModel(Layer):
                 x, c = blk(x, attention_mask, layer_cache,
                            cache_index=cache_index)
                 new_caches.append(c)
+            elif self.config.recompute and self.training:
+                x = _recompute_block(blk, x, attention_mask)
             else:
                 x = blk(x, attention_mask)
         x = self.ln_f(x)

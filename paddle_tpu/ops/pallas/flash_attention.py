@@ -57,9 +57,31 @@ def _x32_traced(fn):
     return wrapped
 
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+#   measured on v5e (b8 h16 d64, fwd+bwd, causal): 512x512 blocks beat both
+#   128x128 (2.2-4.5x) and XLA's fused attention (1.2x @1k ... 1.8x @4k) —
+#   large tiles keep the MXU busy across the k-scan and amortize the
+#   per-block rescale
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
+
+
+def _fit_block(seq, want, head_dim):
+    """Pick the kernel block for one sequence axis.
+
+    seq <= want: the whole sequence is one block. Otherwise: halve `want`
+    (scaled down for wide heads so bwd tiles stay within VMEM — the 512
+    default was measured at d=64) until it divides seq, floored at 128;
+    if nothing >= 128 divides seq the caller's validity check rejects the
+    shape (tiny tiles would silently run orders of magnitude slower than
+    the XLA fallback)."""
+    want = max(128, (want * 64) // max(head_dim, 64))
+    if seq <= want:
+        return seq
+    b = want
+    while b > 128 and seq % b:
+        b //= 2
+    return b
 # trailing lane dim for per-row stats (lse, delta): Mosaic requires the last
 # block dim to be 128-divisible or equal to the array dim, so per-row vectors
 # are carried as [bh, sq, 8] with the value replicated over the 8 lanes.
@@ -434,12 +456,12 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, kv_lens=None,
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
+    block_q = _fit_block(sq, block_q, d)
+    block_k = _fit_block(sk, block_k, d)
+    if sq % block_q or sk % block_k or block_q % 8 or block_k % 8:
         raise ValueError(
-            f"flash_attention requires seq lens divisible by the block "
-            f"sizes, got sq={sq} (block {block_q}), sk={sk} "
+            f"flash_attention requires seq lens tileable into 8-row blocks "
+            f"of at least 128, got sq={sq} (block {block_q}), sk={sk} "
             f"(block {block_k}); pad or use F.scaled_dot_product_attention")
 
     def fold(x):
