@@ -95,6 +95,54 @@ def run(eng, batch, seq, steps, warmup):
 
 BASELINE_RESNET50_IMG_PER_SEC_PER_CHIP = 2900.0  # SURVEY §6: A100 fp16
 
+# ERNIE-3.0-base (118M params): the reference's fleet-class A100 share,
+# derived from the GPT-1.3B 3.5k tok/s baseline by the 6N FLOPs/token
+# ratio (same training-efficiency assumption): 3.5k * 1.3e9/118e6
+BASELINE_ERNIE_TOKENS_PER_SEC_PER_CHIP = 38500.0
+
+
+def build_ernie_engine(batch, seq, amp):
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import (ErnieForPretraining,
+                                ErniePretrainingCriterion)
+    from paddle_tpu.hapi.engine import Engine
+    from paddle_tpu.optimizer import AdamW
+
+    from paddle_tpu.nlp.ernie import ERNIE_CONFIGS
+    from paddle_tpu.nlp.ernie import _resolve_config as _ernie_cfg
+    paddle.seed(0)
+    max_pos = max(ERNIE_CONFIGS["ernie-3.0-base-zh"]
+                  ["max_position_embeddings"], seq)
+    model = ErnieForPretraining(_ernie_cfg(
+        "ernie-3.0-base-zh", max_position_embeddings=max_pos,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+    model.train()
+    opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
+                parameters=model.parameters())
+    return Engine(model, loss=ErniePretrainingCriterion(), optimizer=opt,
+                  amp_dtype=jnp.bfloat16 if amp else None)
+
+
+def run_ernie(eng, batch, seq, steps, warmup):
+    import numpy as np
+    rng = np.random.default_rng(0)
+    vocab = eng.network.config.vocab_size
+    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), dtype=jnp.int32)
+    # MLM labels: 15% masked positions carry the target id, rest -100
+    lbl = np.where(rng.random((batch, seq)) < 0.15,
+                   rng.integers(0, vocab, (batch, seq)), -100)
+    labels = jnp.asarray(lbl, dtype=jnp.int32)
+    nsp = jnp.asarray(rng.integers(0, 2, (batch,)), dtype=jnp.int32)
+    log("compiling + warmup (ernie) ...")
+    for _ in range(warmup):
+        loss, _ = eng.train_batch([ids], [labels, nsp])
+        float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, _ = eng.train_batch([ids], [labels, nsp])
+    float(loss)
+    return batch * seq * steps / (time.perf_counter() - t0)
+
 
 def build_resnet_engine(amp):
     import paddle_tpu as paddle
@@ -134,7 +182,8 @@ def main():
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--seq", type=int, default=None)
     ap.add_argument("--config", default=None)
-    ap.add_argument("--model", choices=("gpt", "resnet50"), default="gpt")
+    ap.add_argument("--model", choices=("gpt", "resnet50", "ernie"),
+                    default="gpt")
     ap.add_argument("--no-flash", action="store_true",
                     help="disable the Pallas flash-attention path (fallback "
                          "number if the kernel regresses)")
@@ -171,6 +220,32 @@ def main():
             "mfu": round(tput * flops_per_img / TPU_PEAK_FLOPS, 4)
             if on_tpu else None,
             "batch": batch, "image": hw,
+            "backend": jax.default_backend(),
+        }))
+        return
+
+    if args.model == "ernie":
+        if args.smoke or not on_tpu:
+            batch, seq, steps, warmup, amp = 4, 64, 3, 2, False
+        else:
+            batch, seq, steps, warmup, amp = 32, 512, 20, 3, True
+        batch = args.batch or batch
+        seq = args.seq or seq
+        steps = args.steps or steps
+        log(f"bench: ernie-3.0-base batch={batch} seq={seq} steps={steps} "
+            f"backend={jax.default_backend()} amp={amp}")
+        eng = build_ernie_engine(batch, seq, amp)
+        tput = run_ernie(eng, batch, seq, steps, warmup)
+        fpt = gpt_flops_per_token(eng.network, seq)  # same 6N+12Lhs conv.
+        print(json.dumps({
+            "metric": "ernie3_base_pretrain_tokens_per_sec_per_chip",
+            "value": round(tput, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(
+                tput / BASELINE_ERNIE_TOKENS_PER_SEC_PER_CHIP, 4)
+            if on_tpu else None,
+            "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
+            "batch": batch, "seq": seq,
             "backend": jax.default_backend(),
         }))
         return

@@ -49,3 +49,35 @@ jax.config.update("jax_compilation_cache_dir",
                   os.environ.get("PADDLE_TPU_TEST_CACHE",
                                  "/tmp/paddle_tpu_xla_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy variant with a cheaper sibling in the default run; "
+        "included when PADDLE_TPU_RUN_SLOW=1")
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("PADDLE_TPU_RUN_SLOW") == "1":
+        return
+    skip = pytest.mark.skip(reason="slow variant (set PADDLE_TPU_RUN_SLOW=1)")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
+def jit_forward(m, *xs):
+    """Shared helper: run a Layer's forward as ONE jitted functional call
+    (the production Engine/jit path) and return plain arrays."""
+    from paddle_tpu.nn.layer import functional_call
+    from paddle_tpu.tensor import Tensor
+    params, buffers = m.raw_state()
+
+    @jax.jit
+    def fwd(p, b, *a):
+        out = functional_call(m, p, b, *[Tensor(x) for x in a])
+        if isinstance(out, (tuple, list)):
+            return tuple(t._value for t in out)
+        return out._value
+    return fwd(params, buffers, *xs)

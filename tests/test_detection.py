@@ -30,11 +30,11 @@ class TestPPYOLOE:
         return PPYOLOE(num_classes=4, channels=(8, 16, 24, 32, 40))
 
     def test_forward_shapes(self):
+        from tests.conftest import jit_forward
         m = self._model()
         m.eval()
-        x = paddle.to_tensor(
-            np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32"))
-        boxes, scores = m(x)
+        x = np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32")
+        boxes, scores = jit_forward(m, jnp.asarray(x))
         a = 8 * 8 + 4 * 4 + 2 * 2  # strides 8/16/32 on 64px
         assert list(boxes.shape) == [2, a, 4]
         assert list(scores.shape) == [2, a, 4]
@@ -89,11 +89,11 @@ class TestDETR:
                     dim_feedforward=64, backbone="tiny", dropout=0.0)
 
     def test_forward_shapes(self):
+        from tests.conftest import jit_forward
         m = self._model()
         m.eval()
-        x = paddle.to_tensor(
-            np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32"))
-        boxes, probs = m(x)
+        x = np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32")
+        boxes, probs = jit_forward(m, jnp.asarray(x))
         assert list(boxes.shape) == [2, 10, 4]
         assert list(probs.shape) == [2, 10, 5]  # +1 no-object class
         # boxes are in pixel space

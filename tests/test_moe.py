@@ -99,6 +99,7 @@ class TestExpertParallel:
                            atol=1e-4).all(axis=-1)
         assert close.mean() > 0.5, close.mean()
 
+    @pytest.mark.slow
     def test_ep_singleton_equals_dense_exactly(self):
         """ep=1 mesh: the all-to-all path must reduce to the dense math."""
         e, d = 4, 8

@@ -15,43 +15,53 @@ def _img(b=2, c=3, hw=64, seed=0):
 
 
 # constructor, input size, kwargs — small classes to keep CPU time low
+# (ctor, hw, kwargs, slow) — slow marks the heavier sibling of a family
+# whose cheaper member stays in the default run
 _CASES = [
-    (M.vgg11, 64, {}),
-    (M.vgg16, 64, {"batch_norm": True}),
-    (M.alexnet, 96, {}),
-    (M.squeezenet1_0, 64, {}),
-    (M.squeezenet1_1, 64, {}),
-    (M.mobilenet_v1, 64, {"scale": 0.25}),
-    (M.mobilenet_v2, 64, {"scale": 0.25}),
-    (M.mobilenet_v3_small, 64, {"scale": 0.5}),
-    (M.mobilenet_v3_large, 64, {"scale": 0.5}),
-    (M.densenet121, 64, {}),
-    (M.shufflenet_v2_x0_25, 64, {}),
-    (M.shufflenet_v2_swish, 64, {}),
-    (M.inception_v3, 128, {}),
+    (M.vgg11, 64, {}, False),
+    (M.vgg16, 64, {"batch_norm": True}, True),
+    (M.alexnet, 96, {}, False),
+    (M.squeezenet1_0, 64, {}, False),
+    (M.squeezenet1_1, 64, {}, True),
+    (M.mobilenet_v1, 64, {"scale": 0.25}, False),
+    (M.mobilenet_v2, 64, {"scale": 0.25}, False),
+    (M.mobilenet_v3_small, 64, {"scale": 0.5}, False),
+    (M.mobilenet_v3_large, 64, {"scale": 0.5}, True),
+    (M.densenet121, 64, {}, True),
+    (M.shufflenet_v2_x0_25, 64, {}, False),
+    (M.shufflenet_v2_swish, 64, {}, True),
+    (M.inception_v3, 128, {}, True),
 ]
 
 
-@pytest.mark.parametrize("ctor,hw,kw",
-                         _CASES, ids=[c[0].__name__ for c in _CASES])
+@pytest.mark.parametrize(
+    "ctor,hw,kw",
+    [pytest.param(c, h, k,
+                  marks=[pytest.mark.slow] if sl else [])
+     for c, h, k, sl in _CASES],
+    ids=[c[0].__name__ for c in _CASES])
 def test_forward_shape(ctor, hw, kw):
+    # jitted functional forward: the production (Engine/jit) path, and one
+    # persistent-cached compile instead of thousands of eager dispatches
+    from tests.conftest import jit_forward
     paddle.seed(0)
     m = ctor(num_classes=10, **kw)
     m.eval()
-    out = m(_img(hw=hw))
+    out = jit_forward(m, _img(hw=hw)._value)
     assert tuple(out.shape) == (2, 10)
-    assert bool(jnp.isfinite(out._value).all())
+    assert bool(jnp.isfinite(out).all())
 
 
 def test_googlenet_aux_heads():
+    from tests.conftest import jit_forward
     paddle.seed(0)
     m = M.googlenet(num_classes=10)
     m.train()
-    out, aux1, aux2 = m(_img(hw=96))
+    out, aux1, aux2 = jit_forward(m, _img(hw=96)._value)
     assert tuple(out.shape) == tuple(aux1.shape) == tuple(aux2.shape) \
         == (2, 10)
     m.eval()
-    out = m(_img(hw=96))
+    out = jit_forward(m, _img(hw=96)._value)
     assert tuple(out.shape) == (2, 10)
 
 
