@@ -56,3 +56,6 @@ from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
 from . import text  # noqa: F401
 from . import incubate  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
+from . import fft  # noqa: E402,F401
+from . import signal  # noqa: E402,F401

@@ -1,0 +1,36 @@
+"""paddle.distribution parity (ref: python/paddle/distribution/__init__.py).
+
+Probability distributions with TPU-shaped sampling (fused Gumbel-argmax
+categorical draws, pathwise gradients, jit-safe rng via the global
+generator), transforms, and a KL-divergence registry.
+"""
+from .distribution import Distribution, ExponentialFamily  # noqa: F401
+from .continuous import (  # noqa: F401
+    Beta, Cauchy, Dirichlet, Exponential, Gamma, Gumbel, Laplace, LogNormal,
+    Normal, StudentT, Uniform,
+)
+from .discrete import (  # noqa: F401
+    Bernoulli, Binomial, Categorical, Geometric, Multinomial, Poisson,
+)
+from .transform import (  # noqa: F401
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    Independent, IndependentTransform, PowerTransform, ReshapeTransform,
+    SigmoidTransform, SoftmaxTransform, StackTransform,
+    StickBreakingTransform, TanhTransform, Transform,
+    TransformedDistribution,
+)
+from .kl import kl_divergence, register_kl  # noqa: F401
+
+__all__ = [
+    "Distribution", "ExponentialFamily",
+    "Beta", "Cauchy", "Dirichlet", "Exponential", "Gamma", "Gumbel",
+    "Laplace", "LogNormal", "Normal", "StudentT", "Uniform",
+    "Bernoulli", "Binomial", "Categorical", "Geometric", "Multinomial",
+    "Poisson",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "PowerTransform", "SigmoidTransform",
+    "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+    "TanhTransform", "ReshapeTransform", "IndependentTransform",
+    "TransformedDistribution", "Independent",
+    "kl_divergence", "register_kl",
+]

@@ -1,0 +1,551 @@
+"""Continuous distributions (ref: python/paddle/distribution/{normal,uniform,
+beta,dirichlet,gamma,exponential,laplace,lognormal,gumbel,cauchy,
+student_t}.py).
+
+All math is closed-form jnp (lgamma/digamma from jax.scipy.special) so every
+method fuses into the surrounding XLA graph. Parameters are stored as
+Tensors (`d.loc`, `d.scale`, ... — the reference's dygraph convention), and
+every density/statistic routes through apply_op, so gradients flow to
+parameters on the eager tape AND under jit; rsample is reparameterized
+(pathwise) wherever the reference supports it.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jss
+
+from ..autograd import apply_op
+from ..framework import get_default_dtype, next_rng_key
+from ..tensor import Tensor
+from .distribution import Distribution, _arr, _fshape, _pt, _t
+
+__all__ = [
+    "Normal", "Uniform", "Beta", "Dirichlet", "Gamma", "Exponential",
+    "Laplace", "LogNormal", "Gumbel", "Cauchy", "StudentT",
+]
+
+
+def _bshape(*ts):
+    return jnp.broadcast_shapes(*[jnp.shape(_arr(t)) for t in ts])
+
+
+class Normal(Distribution):
+    """ref: paddle.distribution.Normal(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _pt(loc)
+        self.scale = _pt(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return apply_op(lambda l: jnp.broadcast_to(l, self.batch_shape),
+                        self.loc)
+
+    @property
+    def variance(self):
+        return apply_op(lambda s: jnp.broadcast_to(s ** 2, self.batch_shape),
+                        self.scale)
+
+    @property
+    def stddev(self):
+        return apply_op(lambda s: jnp.broadcast_to(s, self.batch_shape),
+                        self.scale)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        eps = jax.random.normal(next_rng_key(), shp,
+                                dtype=_arr(self.loc).dtype)
+        return apply_op(lambda l, s: l + s * eps, self.loc, self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, l, s: -((v - l) ** 2) / (2 * s ** 2)
+            - jnp.log(s) - 0.5 * math.log(2 * math.pi),
+            _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply_op(
+            lambda s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s),
+                self.batch_shape),
+            self.scale)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, l, s: 0.5 * (1 + jss.erf((v - l) / (s * math.sqrt(2)))),
+            _t(value), self.loc, self.scale)
+
+    def icdf(self, value):
+        return apply_op(lambda q, l, s: l + s * jss.ndtri(q),
+                        _t(value), self.loc, self.scale)
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    """ref: paddle.distribution.Uniform(low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _pt(low)
+        self.high = _pt(high)
+        super().__init__(_bshape(self.low, self.high))
+
+    @property
+    def mean(self):
+        return apply_op(
+            lambda lo, hi: jnp.broadcast_to((lo + hi) / 2, self.batch_shape),
+            self.low, self.high)
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda lo, hi: jnp.broadcast_to((hi - lo) ** 2 / 12,
+                                            self.batch_shape),
+            self.low, self.high)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(next_rng_key(), shp,
+                               dtype=get_default_dtype())
+        return apply_op(lambda lo, hi: lo + (hi - lo) * u,
+                        self.low, self.high)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, lo, hi: jnp.where(
+                (v >= lo) & (v < hi), -jnp.log(hi - lo), -jnp.inf),
+            _t(value), self.low, self.high)
+
+    def entropy(self):
+        return apply_op(lambda lo, hi: jnp.log(hi - lo),
+                        self.low, self.high)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, lo, hi: jnp.clip((v - lo) / (hi - lo), 0.0, 1.0),
+            _t(value), self.low, self.high)
+
+
+class Beta(Distribution):
+    """ref: paddle.distribution.Beta(alpha, beta)."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _pt(alpha)
+        self.beta = _pt(beta)
+        super().__init__(_bshape(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return apply_op(lambda a, b: a / (a + b), self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        return apply_op(lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                        self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        k1, k2 = jax.random.split(next_rng_key())
+
+        def _rs(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, shp),
+                                  dtype=get_default_dtype())
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, shp),
+                                  dtype=get_default_dtype())
+            return ga / (ga + gb)
+        return apply_op(_rs, self.alpha, self.beta)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, a, b: (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+            - (jss.gammaln(a) + jss.gammaln(b) - jss.gammaln(a + b)),
+            _t(value), self.alpha, self.beta)
+
+    def entropy(self):
+        def _ent(a, b):
+            total = a + b
+            lbeta = jss.gammaln(a) + jss.gammaln(b) - jss.gammaln(total)
+            return (lbeta - (a - 1) * jss.digamma(a)
+                    - (b - 1) * jss.digamma(b)
+                    + (total - 2) * jss.digamma(total))
+        return apply_op(_ent, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    """ref: paddle.distribution.Dirichlet(concentration)."""
+
+    def __init__(self, concentration):
+        self.concentration = _pt(concentration)
+        c = _arr(self.concentration)
+        if c.ndim < 1:
+            raise ValueError("concentration must be at least 1-D")
+        super().__init__(c.shape[:-1], c.shape[-1:])
+
+    @property
+    def mean(self):
+        return apply_op(lambda c: c / jnp.sum(c, -1, keepdims=True),
+                        self.concentration)
+
+    @property
+    def variance(self):
+        def _var(c):
+            c0 = jnp.sum(c, -1, keepdims=True)
+            m = c / c0
+            return m * (1 - m) / (c0 + 1)
+        return apply_op(_var, self.concentration)
+
+    def rsample(self, shape=()):
+        shp = _fshape(shape) + jnp.shape(_arr(self.concentration))
+        key = next_rng_key()
+
+        def _rs(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, shp),
+                                 dtype=get_default_dtype())
+            return g / jnp.sum(g, -1, keepdims=True)
+        return apply_op(_rs, self.concentration)
+
+    def log_prob(self, value):
+        def _lp(v, c):
+            lnorm = jnp.sum(jss.gammaln(c), -1) - jss.gammaln(jnp.sum(c, -1))
+            return jnp.sum((c - 1) * jnp.log(v), -1) - lnorm
+        return apply_op(_lp, _t(value), self.concentration)
+
+    def entropy(self):
+        def _ent(c):
+            k = c.shape[-1]
+            c0 = jnp.sum(c, -1)
+            lnorm = jnp.sum(jss.gammaln(c), -1) - jss.gammaln(c0)
+            return (lnorm + (c0 - k) * jss.digamma(c0)
+                    - jnp.sum((c - 1) * jss.digamma(c), -1))
+        return apply_op(_ent, self.concentration)
+
+
+class Gamma(Distribution):
+    """ref: paddle.distribution.Gamma(concentration, rate)."""
+
+    def __init__(self, concentration, rate):
+        self.concentration = _pt(concentration)
+        self.rate = _pt(rate)
+        super().__init__(_bshape(self.concentration, self.rate))
+
+    @property
+    def mean(self):
+        return apply_op(
+            lambda a, r: jnp.broadcast_to(a / r, self.batch_shape),
+            self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda a, r: jnp.broadcast_to(a / r ** 2, self.batch_shape),
+            self.concentration, self.rate)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = next_rng_key()
+        # jax.random.gamma is reparameterized (implicit-gradient rule)
+        return apply_op(
+            lambda a, r: jax.random.gamma(
+                key, jnp.broadcast_to(a, shp), dtype=get_default_dtype())
+            / jnp.broadcast_to(r, shp),
+            self.concentration, self.rate)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, a, r: a * jnp.log(r) + (a - 1) * jnp.log(v)
+            - r * v - jss.gammaln(a),
+            _t(value), self.concentration, self.rate)
+
+    def entropy(self):
+        return apply_op(
+            lambda a, r: a - jnp.log(r) + jss.gammaln(a)
+            + (1 - a) * jss.digamma(a),
+            self.concentration, self.rate)
+
+
+class Exponential(Distribution):
+    """ref: paddle.distribution.Exponential(rate)."""
+
+    def __init__(self, rate):
+        self.rate = _pt(rate)
+        super().__init__(jnp.shape(_arr(self.rate)))
+
+    @property
+    def mean(self):
+        return apply_op(lambda r: 1.0 / r, self.rate)
+
+    @property
+    def variance(self):
+        return apply_op(lambda r: r ** -2.0, self.rate)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(next_rng_key(), shp,
+                               dtype=get_default_dtype())
+        # inverse-CDF; -log1p(-u) is exact near 0
+        return apply_op(lambda r: -jnp.log1p(-u) / jnp.broadcast_to(r, shp),
+                        self.rate)
+
+    def log_prob(self, value):
+        return apply_op(lambda v, r: jnp.log(r) - r * v,
+                        _t(value), self.rate)
+
+    def entropy(self):
+        return apply_op(lambda r: 1.0 - jnp.log(r), self.rate)
+
+    def cdf(self, value):
+        return apply_op(lambda v, r: -jnp.expm1(-r * v),
+                        _t(value), self.rate)
+
+
+class Laplace(Distribution):
+    """ref: paddle.distribution.Laplace(loc, scale)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _pt(loc)
+        self.scale = _pt(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return apply_op(lambda l: jnp.broadcast_to(l, self.batch_shape),
+                        self.loc)
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda s: jnp.broadcast_to(2 * s ** 2, self.batch_shape),
+            self.scale)
+
+    @property
+    def stddev(self):
+        return apply_op(
+            lambda s: jnp.broadcast_to(math.sqrt(2) * s, self.batch_shape),
+            self.scale)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        # sample from U(-1/2, 1/2); finfo.tiny keeps |u| away from 1/2
+        u = jax.random.uniform(
+            next_rng_key(), shp, dtype=get_default_dtype(),
+            minval=jnp.finfo(get_default_dtype()).tiny - 0.5, maxval=0.5)
+        return apply_op(
+            lambda l, s: l - jnp.broadcast_to(s, shp) * jnp.sign(u)
+            * jnp.log1p(-2 * jnp.abs(u)),
+            self.loc, self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply_op(
+            lambda s: jnp.broadcast_to(1 + jnp.log(2 * s), self.batch_shape),
+            self.scale)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, l, s: 0.5 - 0.5 * jnp.sign(v - l)
+            * jnp.expm1(-jnp.abs(v - l) / s),
+            _t(value), self.loc, self.scale)
+
+    def icdf(self, value):
+        return apply_op(
+            lambda q, l, s: l - s * jnp.sign(q - 0.5)
+            * jnp.log1p(-2 * jnp.abs(q - 0.5)),
+            _t(value), self.loc, self.scale)
+
+
+class LogNormal(Distribution):
+    """ref: paddle.distribution.LogNormal(loc, scale) — exp(Normal)."""
+
+    def __init__(self, loc, scale):
+        self._base = Normal(loc, scale)
+        self.loc = self._base.loc
+        self.scale = self._base.scale
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return apply_op(
+            lambda l, s: jnp.broadcast_to(jnp.exp(l + s ** 2 / 2),
+                                          self.batch_shape),
+            self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda l, s: jnp.broadcast_to(
+                jnp.expm1(s ** 2) * jnp.exp(2 * l + s ** 2),
+                self.batch_shape),
+            self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        return apply_op(jnp.exp, self._base.rsample(shape))
+
+    def log_prob(self, value):
+        v = _t(value)
+        base_lp = self._base.log_prob(apply_op(jnp.log, v))
+        return apply_op(lambda lp, vv: lp - jnp.log(vv), base_lp, v)
+
+    def entropy(self):
+        return apply_op(
+            lambda l, s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s) + l,
+                self.batch_shape),
+            self.loc, self.scale)
+
+
+class Gumbel(Distribution):
+    """ref: paddle.distribution.Gumbel(loc, scale)."""
+
+    _EULER = 0.57721566490153286060
+
+    def __init__(self, loc, scale):
+        self.loc = _pt(loc)
+        self.scale = _pt(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return apply_op(
+            lambda l, s: jnp.broadcast_to(l + self._EULER * s,
+                                          self.batch_shape),
+            self.loc, self.scale)
+
+    @property
+    def variance(self):
+        return apply_op(
+            lambda s: jnp.broadcast_to((math.pi ** 2 / 6) * s ** 2,
+                                       self.batch_shape),
+            self.scale)
+
+    @property
+    def stddev(self):
+        return apply_op(jnp.sqrt, self.variance)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        g = jax.random.gumbel(next_rng_key(), shp, dtype=get_default_dtype())
+        return apply_op(lambda l, s: l + jnp.broadcast_to(s, shp) * g,
+                        self.loc, self.scale)
+
+    def log_prob(self, value):
+        def _lp(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return apply_op(_lp, _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply_op(
+            lambda s: jnp.broadcast_to(jnp.log(s) + 1 + self._EULER,
+                                       self.batch_shape),
+            self.scale)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, l, s: jnp.exp(-jnp.exp(-(v - l) / s)),
+            _t(value), self.loc, self.scale)
+
+
+class Cauchy(Distribution):
+    """ref: paddle.distribution.Cauchy(loc, scale)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _pt(loc)
+        self.scale = _pt(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(next_rng_key(), shp,
+                               dtype=get_default_dtype(),
+                               minval=jnp.finfo(get_default_dtype()).eps,
+                               maxval=1.0)
+        return apply_op(
+            lambda l, s: l + jnp.broadcast_to(s, shp)
+            * jnp.tan(math.pi * (u - 0.5)),
+            self.loc, self.scale)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, l, s: -math.log(math.pi) - jnp.log(s)
+            - jnp.log1p(((v - l) / s) ** 2),
+            _t(value), self.loc, self.scale)
+
+    def entropy(self):
+        return apply_op(
+            lambda s: jnp.broadcast_to(math.log(4 * math.pi) + jnp.log(s),
+                                       self.batch_shape),
+            self.scale)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, l, s: jnp.arctan((v - l) / s) / math.pi + 0.5,
+            _t(value), self.loc, self.scale)
+
+
+class StudentT(Distribution):
+    """ref: paddle.distribution.StudentT(df, loc, scale)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = _pt(df)
+        self.loc = _pt(loc)
+        self.scale = _pt(scale)
+        super().__init__(_bshape(self.df, self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return apply_op(
+            lambda df, l: jnp.broadcast_to(jnp.where(df > 1, l, jnp.nan),
+                                           self.batch_shape),
+            self.df, self.loc)
+
+    @property
+    def variance(self):
+        def _var(df, s):
+            v = jnp.where(df > 2, s ** 2 * df / (df - 2),
+                          jnp.where(df > 1, jnp.inf, jnp.nan))
+            return jnp.broadcast_to(v, self.batch_shape)
+        return apply_op(_var, self.df, self.scale)
+
+    def rsample(self, shape=()):
+        shp = self._extend_shape(shape)
+        key = next_rng_key()
+
+        def _rs(df, l, s):
+            z = jax.random.t(key, jnp.broadcast_to(df, shp),
+                             dtype=get_default_dtype())
+            return l + jnp.broadcast_to(s, shp) * z
+        return apply_op(_rs, self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def _lp(v, df, l, s):
+            z = (v - l) / s
+            return (jss.gammaln((df + 1) / 2) - jss.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+        return apply_op(_lp, _t(value), self.df, self.loc, self.scale)
+
+    def entropy(self):
+        def _ent(df, s):
+            return (jnp.log(s) + (df + 1) / 2
+                    * (jss.digamma((df + 1) / 2) - jss.digamma(df / 2))
+                    + 0.5 * jnp.log(df)
+                    + jss.gammaln(df / 2) + jss.gammaln(0.5)
+                    - jss.gammaln((df + 1) / 2))
+        return apply_op(_ent, self.df, self.scale)

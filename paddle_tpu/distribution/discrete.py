@@ -1,0 +1,326 @@
+"""Discrete distributions (ref: python/paddle/distribution/{bernoulli,
+categorical,multinomial,geometric,poisson,binomial}.py).
+
+Sampling is TPU-shaped: Categorical/Multinomial use the Gumbel-argmax trick
+(jax.random.categorical) so draws are one fused kernel, no host round trip;
+Poisson/Binomial route through jax.random's rejection samplers. Parameters
+are stored as Tensors and all densities route through apply_op, so
+score-function gradients flow to parameters on the eager tape and under jit.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jss
+
+from ..autograd import apply_op
+from ..framework import get_default_dtype, next_rng_key
+from ..tensor import Tensor
+from .distribution import Distribution, _arr, _fshape, _pt, _t
+
+__all__ = ["Bernoulli", "Categorical", "Multinomial", "Geometric",
+           "Poisson", "Binomial"]
+
+
+# x*log(y) with 0*log(0)=0 — jax maintains the gradient rule upstream
+_xlogy = jss.xlogy
+
+
+class Bernoulli(Distribution):
+    """ref: paddle.distribution.Bernoulli(probs)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_param = _pt(probs)
+        super().__init__(jnp.shape(_arr(self.probs_param)))
+
+    @property
+    def mean(self):
+        return apply_op(lambda p: p, self.probs_param)
+
+    @property
+    def variance(self):
+        return apply_op(lambda p: p * (1 - p), self.probs_param)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(next_rng_key(), shp,
+                               dtype=get_default_dtype())
+        return Tensor((u < jnp.broadcast_to(_arr(self.probs_param), shp))
+                      .astype(get_default_dtype()))
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxation (the reference's rsample contract)."""
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(
+            next_rng_key(), shp, dtype=get_default_dtype(),
+            minval=jnp.finfo(get_default_dtype()).eps, maxval=1.0)
+        logistic = jnp.log(u) - jnp.log1p(-u)
+
+        def _rs(p):
+            logits = jnp.log(p) - jnp.log1p(-p)
+            return jax.nn.sigmoid(
+                (jnp.broadcast_to(logits, shp) + logistic) / temperature)
+        return apply_op(_rs, self.probs_param)
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, p: _xlogy(v, p) + _xlogy(1 - v, 1 - p),
+            _t(value), self.probs_param)
+
+    def entropy(self):
+        return apply_op(
+            lambda p: -(_xlogy(p, p) + _xlogy(1 - p, 1 - p)),
+            self.probs_param)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, p: jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - p, 1.0)),
+            _t(value), self.probs_param)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class Categorical(Distribution):
+    """ref: paddle.distribution.Categorical(logits).
+
+    NOTE the reference quirk: `logits` are UNNORMALIZED NON-NEGATIVE scores
+    (normalized by their sum), not log-probabilities. We follow it for
+    parity; `Categorical.from_logits` gives the conventional log-space
+    constructor.
+    """
+
+    def __init__(self, logits, name=None):
+        self.scores = _pt(logits)
+        self._logits_t = None
+        super().__init__(jnp.shape(_arr(self.scores))[:-1])
+
+    @classmethod
+    def from_logits(cls, logits):
+        c = cls.__new__(cls)
+        c.scores = None
+        c._logits_t = _pt(logits)
+        Distribution.__init__(c, jnp.shape(_arr(c._logits_t))[:-1])
+        return c
+
+    def _logp_t(self):
+        """log-probabilities as a Tensor (grads flow to the params)."""
+        if self._logits_t is not None:
+            return apply_op(lambda lg: jax.nn.log_softmax(lg, axis=-1),
+                            self._logits_t)
+        return apply_op(lambda s: jnp.log(s / jnp.sum(s, -1, keepdims=True)),
+                        self.scores)
+
+    @property
+    def num_events(self):
+        return jnp.shape(_arr(self._logp_t()))[-1]
+
+    def sample(self, shape=()):
+        shp = _fshape(shape)
+        lp = _arr(self._logp_t())
+        draw = jax.random.categorical(
+            next_rng_key(), lp, shape=shp + self.batch_shape)
+        return Tensor(draw.astype(jnp.int64))
+
+    def probs(self, value):
+        def _p(lp, v):
+            return jnp.take_along_axis(
+                jnp.exp(lp), v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        return apply_op(_p, self._logp_t(), _t(value))
+
+    def log_prob(self, value):
+        def _lp(lp, v):
+            return jnp.take_along_axis(
+                lp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        return apply_op(_lp, self._logp_t(), _t(value))
+
+    def entropy(self):
+        return apply_op(lambda lp: -jnp.sum(jnp.exp(lp) * lp, -1),
+                        self._logp_t())
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class Multinomial(Distribution):
+    """ref: paddle.distribution.Multinomial(total_count, probs).
+
+    Sampling is `total_count` fused categorical draws scattered into counts
+    via one_hot-sum — static shapes throughout, so it jits cleanly.
+    """
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        p = _pt(probs)
+        self.probs_param = apply_op(
+            lambda a: a / jnp.sum(a, -1, keepdims=True), p)
+        shp = jnp.shape(_arr(p))
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        return apply_op(lambda p: self.total_count * p, self.probs_param)
+
+    @property
+    def variance(self):
+        return apply_op(lambda p: self.total_count * p * (1 - p),
+                        self.probs_param)
+
+    def sample(self, shape=()):
+        shp = _fshape(shape)
+        p = _arr(self.probs_param)
+        k = p.shape[-1]
+        draws = jax.random.categorical(
+            next_rng_key(), jnp.log(p),
+            shape=(self.total_count,) + shp + self.batch_shape)
+        counts = jax.nn.one_hot(draws, k, dtype=get_default_dtype()).sum(0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        def _lp(v, p):
+            return (jss.gammaln(jnp.asarray(self.total_count + 1.0))
+                    - jnp.sum(jss.gammaln(v + 1.0), -1)
+                    + jnp.sum(_xlogy(v, p), -1))
+        return apply_op(_lp, _t(value), self.probs_param)
+
+    def entropy(self):
+        # exact entropy has no closed form; we report the independent-draws
+        # bound n*H(p) (documented approximation, matching scale)
+        def _ent(p):
+            h = -jnp.sum(_xlogy(p, p), -1)
+            return self.total_count * h
+        return apply_op(_ent, self.probs_param)
+
+
+class Geometric(Distribution):
+    """ref: paddle.distribution.Geometric(probs) — #failures before the
+    first success, support {0, 1, 2, ...}."""
+
+    def __init__(self, probs):
+        self.probs_param = _pt(probs)
+        super().__init__(jnp.shape(_arr(self.probs_param)))
+
+    @property
+    def mean(self):
+        return apply_op(lambda p: (1 - p) / p, self.probs_param)
+
+    @property
+    def variance(self):
+        return apply_op(lambda p: (1 - p) / p ** 2, self.probs_param)
+
+    @property
+    def stddev(self):
+        return apply_op(jnp.sqrt, self.variance)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(
+            next_rng_key(), shp, dtype=get_default_dtype(),
+            minval=jnp.finfo(get_default_dtype()).tiny, maxval=1.0)
+        p = jnp.broadcast_to(_arr(self.probs_param), shp)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-p)))
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, p: v * jnp.log1p(-p) + jnp.log(p),
+            _t(value), self.probs_param)
+
+    def entropy(self):
+        return apply_op(
+            lambda p: -((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p,
+            self.probs_param)
+
+    def cdf(self, value):
+        return apply_op(
+            lambda v, p: 1 - jnp.power(1 - p, jnp.floor(v) + 1),
+            _t(value), self.probs_param)
+
+
+class Poisson(Distribution):
+    """ref: paddle.distribution.Poisson(rate)."""
+
+    def __init__(self, rate):
+        self.rate = _pt(rate)
+        super().__init__(jnp.shape(_arr(self.rate)))
+
+    @property
+    def mean(self):
+        return apply_op(lambda r: r, self.rate)
+
+    @property
+    def variance(self):
+        return apply_op(lambda r: r, self.rate)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        draw = jax.random.poisson(next_rng_key(),
+                                  jnp.broadcast_to(_arr(self.rate), shp))
+        return Tensor(draw.astype(get_default_dtype()))
+
+    def log_prob(self, value):
+        return apply_op(
+            lambda v, r: _xlogy(v, r) - r - jss.gammaln(v + 1.0),
+            _t(value), self.rate)
+
+    def entropy(self):
+        # exact truncated sum for small rate; Stirling series for large
+        def _ent(r):
+            n = 32
+            ks = jnp.arange(n, dtype=r.dtype)
+            lp = (_xlogy(ks, r[..., None]) - r[..., None]
+                  - jss.gammaln(ks + 1.0))
+            small = -jnp.sum(jnp.exp(lp) * lp, -1)
+            large = (0.5 * jnp.log(2 * math.pi * math.e * r)
+                     - 1 / (12 * r) - 1 / (24 * r ** 2))
+            return jnp.where(r < 16.0, small, large)
+        return apply_op(_ent, self.rate)
+
+
+class Binomial(Distribution):
+    """ref: paddle.distribution.Binomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_param = _pt(probs)
+        super().__init__(jnp.shape(_arr(self.probs_param)))
+
+    @property
+    def mean(self):
+        return apply_op(lambda p: self.total_count * p, self.probs_param)
+
+    @property
+    def variance(self):
+        return apply_op(lambda p: self.total_count * p * (1 - p),
+                        self.probs_param)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        p = jnp.broadcast_to(_arr(self.probs_param), shp)
+        draw = jax.random.binomial(next_rng_key(), self.total_count, p)
+        return Tensor(draw.astype(get_default_dtype()))
+
+    def log_prob(self, value):
+        n = float(self.total_count)
+
+        def _lp(v, p):
+            comb = (jss.gammaln(jnp.asarray(n + 1.0)) - jss.gammaln(v + 1.0)
+                    - jss.gammaln(n - v + 1.0))
+            return comb + _xlogy(v, p) + _xlogy(n - v, 1 - p)
+        return apply_op(_lp, _t(value), self.probs_param)
+
+    def entropy(self):
+        # exact sum over the (static) support
+        n = self.total_count
+
+        def _ent(p):
+            ks = jnp.arange(n + 1, dtype=p.dtype)
+            pb = p[..., None]
+            comb = (jss.gammaln(jnp.asarray(n + 1.0))
+                    - jss.gammaln(ks + 1.0) - jss.gammaln(n - ks + 1.0))
+            lp = comb + _xlogy(ks, pb) + _xlogy(n - ks, 1 - pb)
+            return -jnp.sum(jnp.exp(lp) * lp, -1)
+        return apply_op(_ent, self.probs_param)

@@ -1,0 +1,147 @@
+"""Distribution base classes (ref: python/paddle/distribution/distribution.py).
+
+TPU-native design notes: every density/statistic is a pure jnp function
+routed through apply_op so it is differentiable both on the eager tape and
+under jit/grad; sampling draws keys from the global generator
+(framework.next_rng_key), which inside a traced step is a pure function of
+the step's rng scope — so `dist.sample()` is legal inside a jitted train
+step and reproducible across replicas.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import apply_op
+from ..framework import next_rng_key
+from ..tensor import Tensor, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _arr(x):
+    """jnp array view of a Tensor / python scalar / array."""
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+def _pt(x):
+    """Parameter Tensor: preserves a caller's float Tensor identity, so
+    eager pathwise/score-function gradients flow back to distribution
+    parameters (the reference's dygraph behavior); scalars/arrays wrap as
+    constant Tensors, promoted to the default float dtype."""
+    from ..framework import get_default_dtype
+    if isinstance(x, Tensor):
+        if jnp.issubdtype(x._value.dtype, jnp.floating):
+            return x
+        return x.astype(get_default_dtype())
+    a = jnp.asarray(x)
+    if not jnp.issubdtype(a.dtype, jnp.floating):
+        a = a.astype(get_default_dtype())
+    return Tensor(a)
+
+
+def _fshape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    """Base class (ref: paddle.distribution.Distribution).
+
+    `batch_shape`/`event_shape` follow the reference semantics; sample
+    shapes are `sample_shape + batch_shape + event_shape`.
+    """
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    # -- interface -----------------------------------------------------
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        """Non-reparameterized draw (wrapped in stop_gradient)."""
+        s = self.rsample(shape)
+        return Tensor(jax.lax.stop_gradient(_arr(s)))
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply_op(jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    # -- helpers -------------------------------------------------------
+    def _extend_shape(self, sample_shape):
+        return _fshape(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return "{}(batch_shape={}, event_shape={})".format(
+            type(self).__name__, self._batch_shape, self._event_shape)
+
+
+class ExponentialFamily(Distribution):
+    """Exponential-family base (ref: paddle.distribution.ExponentialFamily).
+
+    Subclasses expose `_natural_parameters` and `_log_normalizer`; entropy
+    falls back to the Bregman-divergence identity computed with jax.grad —
+    the reference's autodiff trick, expressed functionally.
+    """
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = [_arr(p) for p in self._natural_parameters]
+
+        def _ent(*np_):
+            lg = self._log_normalizer(*np_)
+            grads = jax.grad(lambda *a: jnp.sum(self._log_normalizer(*a)),
+                             argnums=tuple(range(len(np_))))(*np_)
+            ent = lg - self._mean_carrier_measure
+            for p, g in zip(np_, grads):
+                ent = ent - p * g
+            return ent
+
+        return apply_op(_ent, *[Tensor(n) for n in nat])

@@ -1,0 +1,171 @@
+"""KL divergence registry (ref: python/paddle/distribution/kl.py).
+
+`register_kl((P, Q))` decorator + closed forms for the shipped pairs;
+dispatch walks the MRO like the reference so subclasses inherit entries.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax.scipy import special as jss
+
+from ..autograd import apply_op
+from ..tensor import Tensor
+from .continuous import (Beta, Dirichlet, Exponential, Gamma, Gumbel,
+                         Laplace, LogNormal, Normal, Uniform)
+from .discrete import Bernoulli, Categorical, Geometric, Poisson
+from .distribution import Distribution, _arr
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def deco(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return deco
+
+
+def _dispatch(tp, tq):
+    matches = []
+    for (p, q), fn in _REGISTRY.items():
+        if issubclass(tp, p) and issubclass(tq, q):
+            matches.append((p, q, fn))
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered for ({tp.__name__}, {tq.__name__})")
+    # most-derived match, like the reference's total-order heuristic
+    matches.sort(key=lambda t: (len(t[0].__mro__) + len(t[1].__mro__)),
+                 reverse=True)
+    return matches[0][2]
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    return _dispatch(type(p), type(q))(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def _kl(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return apply_op(_kl, p.loc, p.scale, q.loc,
+                    q.scale)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    def _kl(pl, ph, ql, qh):
+        out = jnp.log((qh - ql) / (ph - pl))
+        return jnp.where((ql <= pl) & (ph <= qh), out, jnp.inf)
+    return apply_op(_kl, p.low, p.high, q.low,
+                    q.high)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def _kl(pp, qp):
+        eps = jnp.finfo(pp.dtype).eps
+        pp = jnp.clip(pp, eps, 1 - eps)
+        qp = jnp.clip(qp, eps, 1 - eps)
+        return (pp * (jnp.log(pp) - jnp.log(qp))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+    return apply_op(_kl, p.probs_param, q.probs_param)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def _kl(lp, lq):
+        return jnp.sum(jnp.exp(lp) * (lp - lq), -1)
+    return apply_op(_kl, p._logp_t(), q._logp_t())
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def _kl(pa, pb, qa, qb):
+        pt = pa + pb
+        return (jss.gammaln(pt) - jss.gammaln(pa) - jss.gammaln(pb)
+                - jss.gammaln(qa + qb) + jss.gammaln(qa) + jss.gammaln(qb)
+                + (pa - qa) * jss.digamma(pa) + (pb - qb) * jss.digamma(pb)
+                + (qa + qb - pt) * jss.digamma(pt))
+    return apply_op(_kl, p.alpha, p.beta, q.alpha,
+                    q.beta)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def _kl(pc, qc):
+        p0 = jnp.sum(pc, -1)
+        return (jss.gammaln(p0) - jnp.sum(jss.gammaln(pc), -1)
+                - jss.gammaln(jnp.sum(qc, -1))
+                + jnp.sum(jss.gammaln(qc), -1)
+                + jnp.sum((pc - qc)
+                          * (jss.digamma(pc) - jss.digamma(p0)[..., None]),
+                          -1))
+    return apply_op(_kl, p.concentration, q.concentration)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def _kl(pa, pr, qa, qr):
+        return ((pa - qa) * jss.digamma(pa) - jss.gammaln(pa)
+                + jss.gammaln(qa) + qa * (jnp.log(pr) - jnp.log(qr))
+                + pa * (qr / pr - 1.0))
+    return apply_op(_kl, p.concentration, p.rate,
+                    q.concentration, q.rate)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    def _kl(pr, qr):
+        ratio = qr / pr
+        return ratio - 1 - jnp.log(ratio)
+    return apply_op(_kl, p.rate, q.rate)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def _kl(pl, ps, ql, qs):
+        d = jnp.abs(pl - ql)
+        return (jnp.log(qs / ps) + d / qs
+                + ps / qs * jnp.exp(-d / ps) - 1)
+    return apply_op(_kl, p.loc, p.scale, q.loc,
+                    q.scale)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    return _kl_normal(p._base, q._base)
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel(p, q):
+    # closed form: log(qs/ps) + euler*(ps/qs - 1) + (pl - ql)/qs
+    #              + exp(-(pl - ql)/qs) * Gamma(ps/qs + 1) - 1
+    def _kl(pl, ps, ql, qs):
+        euler = 0.57721566490153286060
+        ratio = ps / qs
+        return (jnp.log(qs) - jnp.log(ps) + euler * (ratio - 1.0)
+                + (pl - ql) / qs
+                + jnp.exp(-(pl - ql) / qs + jss.gammaln(ratio + 1.0)) - 1.0)
+    return apply_op(_kl, p.loc, p.scale, q.loc,
+                    q.scale)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    def _kl(pp, qp):
+        return (-(-pp * jnp.log(pp) - (1 - pp) * jnp.log1p(-pp)) / pp
+                + (-jnp.log(qp) * pp - jnp.log1p(-qp) * (1 - pp)) / pp)
+    return apply_op(_kl, p.probs_param, q.probs_param)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    def _kl(pr, qr):
+        return pr * (jnp.log(pr) - jnp.log(qr)) - pr + qr
+    return apply_op(_kl, p.rate, q.rate)

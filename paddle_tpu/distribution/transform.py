@@ -1,0 +1,337 @@
+"""Transforms + TransformedDistribution + Independent
+(ref: python/paddle/distribution/transform.py,
+ transformed_distribution.py, independent.py).
+
+Transforms are pure jnp bijections with closed-form
+`forward_log_det_jacobian`; TransformedDistribution composes them with a
+base distribution's log_prob via the change-of-variables formula — all of
+it fuses under jit.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import apply_op
+from ..tensor import Tensor
+from .distribution import Distribution, _arr, _t
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "PowerTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "ReshapeTransform", "IndependentTransform", "TransformedDistribution",
+    "Independent",
+]
+
+
+class Transform:
+    """Bijection base class (ref: paddle.distribution.Transform)."""
+
+    _codomain_event_rank = 0
+    _domain_event_rank = 0
+
+    def forward(self, x):
+        return apply_op(self._forward, _t(x))
+
+    def inverse(self, y):
+        return apply_op(self._inverse, _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(self._fldj, _t(x))
+
+    def inverse_log_det_jacobian(self, y):
+        return apply_op(lambda yv: -self._fldj(self._inverse(yv)), _t(y))
+
+    # jnp-level hooks
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # right inverse (the reference returns the positive branch)
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _arr(_t(loc))
+        self.scale = _arr(_t(scale))
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(_t(power))
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x)) — stable form
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """Not a bijection; forward normalizes exp(x), inverse returns log(y)
+    (the reference's convention)."""
+
+    _codomain_event_rank = 1
+    _domain_event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError("SoftmaxTransform has no log-det jacobian")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> K-simplex (ref semantics)."""
+
+    _codomain_event_rank = 1
+    _domain_event_rank = 1
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zc = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(zc[..., :1]), zc[..., :-1]], -1)
+        head = z * lead
+        return jnp.concatenate([head, zc[..., -1:]], -1)
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        offset = jnp.arange(k, 0, -1, dtype=y.dtype)
+        csum = jnp.cumsum(y[..., :-1], -1)
+        rem = 1 - jnp.concatenate(
+            [jnp.zeros_like(csum[..., :1]), csum[..., :-1]], -1)
+        z = y[..., :-1] / rem
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _fldj(self, x):
+        k = x.shape[-1]
+        offset = jnp.arange(k, 0, -1, dtype=x.dtype)
+        t = x - jnp.log(offset)
+        z = jax.nn.sigmoid(t)
+        zc = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate([jnp.ones_like(zc[..., :1]), zc[..., :-1]], -1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(lead), -1)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] along slice i of `axis`."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, fn_name, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(p)
+                for t, p in zip(self.transforms, parts)]
+        return jnp.concatenate(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _fldj(self, x):
+        return self._map("_fldj", x)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._domain_event_rank = len(self.in_event_shape)
+        self._codomain_event_rank = len(self.out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, dtype=x.dtype)
+
+
+class IndependentTransform(Transform):
+    """Sums the base transform's log-det over trailing dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._domain_event_rank = base._domain_event_rank + self.rank
+        self._codomain_event_rank = base._codomain_event_rank + self.rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ld = self.base._fldj(x)
+        return jnp.sum(ld, axis=tuple(range(ld.ndim - self.rank, ld.ndim)))
+
+
+class TransformedDistribution(Distribution):
+    """ref: paddle.distribution.TransformedDistribution(base, transforms)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        self._chain = chain
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return Tensor(jax.lax.stop_gradient(self._chain._forward(_arr(x))))
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return apply_op(self._chain._forward, x)
+
+    def log_prob(self, value):
+        # composed from separate apply_op calls (NOT one fused op over
+        # `value` alone) so eager-tape gradients reach the base
+        # distribution's parameters through base.log_prob
+        x = apply_op(self._chain._inverse, _t(value))
+        base_lp = self.base.log_prob(x)
+        return apply_op(lambda lp, xv: lp - self._chain._fldj(xv),
+                        base_lp, x)
+
+
+class Independent(Distribution):
+    """Reinterprets trailing batch dims as event dims
+    (ref: paddle.distribution.Independent)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = base.batch_shape
+        if self.rank > len(bshape):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        cut = len(bshape) - self.rank
+        super().__init__(bshape[:cut],
+                         bshape[cut:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def _sum_rightmost(self, x):
+        if self.rank == 0:
+            return x
+        return jnp.sum(x, axis=tuple(range(x.ndim - self.rank, x.ndim)))
+
+    def log_prob(self, value):
+        return apply_op(self._sum_rightmost, self.base.log_prob(value))
+
+    def entropy(self):
+        return apply_op(self._sum_rightmost, self.base.entropy())

@@ -1,0 +1,169 @@
+"""paddle.fft parity (ref: python/paddle/fft.py).
+
+Thin differentiable wrappers over jnp.fft — XLA lowers these to the TPU
+FFT HLO, so they fuse with surrounding ops and run on device. Norm-mode
+semantics ('backward' | 'ortho' | 'forward') match the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .autograd import apply_op
+from .tensor import Tensor, to_tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _norm(norm):
+    n = norm or "backward"
+    if n not in _NORMS:
+        raise ValueError(f"norm must be one of {_NORMS}, got {norm!r}")
+    return n
+
+
+def _wrap1(jfn, x, n, axis, norm):
+    return apply_op(lambda a: jfn(a, n=n, axis=axis, norm=_norm(norm)), _t(x))
+
+
+def _wrapn(jfn, x, s, axes, norm):
+    return apply_op(lambda a: jfn(a, s=s, axes=axes, norm=_norm(norm)), _t(x))
+
+
+# 1-D -----------------------------------------------------------------------
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap1(jnp.fft.fft, x, n, axis, norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap1(jnp.fft.ifft, x, n, axis, norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap1(jnp.fft.rfft, x, n, axis, norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap1(jnp.fft.irfft, x, n, axis, norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap1(jnp.fft.hfft, x, n, axis, norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _wrap1(jnp.fft.ihfft, x, n, axis, norm)
+
+
+# 2-D -----------------------------------------------------------------------
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrapn(jnp.fft.fft2, x, s, axes, norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrapn(jnp.fft.ifft2, x, s, axes, norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrapn(jnp.fft.rfft2, x, s, axes, norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _wrapn(jnp.fft.irfft2, x, s, axes, norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _hfftn_impl(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _ihfftn_impl(x, s, axes, norm)
+
+
+# N-D -----------------------------------------------------------------------
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrapn(jnp.fft.fftn, x, s, axes, norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrapn(jnp.fft.ifftn, x, s, axes, norm)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrapn(jnp.fft.rfftn, x, s, axes, norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _wrapn(jnp.fft.irfftn, x, s, axes, norm)
+
+
+def _hfftn_impl(x, s, axes, norm):
+    """hermitian-input N-D (jnp has no hfftn): forward fft over the leading
+    axes + hfft on the last — matches scipy.fft.hfftn."""
+    def f(a):
+        ax = axes if axes is not None else tuple(range(a.ndim))
+        lead, last = tuple(ax[:-1]), ax[-1]
+        if lead:
+            s_lead = None if s is None else tuple(s[:-1])
+            a = jnp.fft.fftn(a, s=s_lead, axes=lead, norm=_norm(norm))
+        n_last = None if s is None else s[-1]
+        return jnp.fft.hfft(a, n=n_last, axis=last, norm=_norm(norm))
+    return apply_op(f, _t(x))
+
+
+def _ihfftn_impl(x, s, axes, norm):
+    """inverse of hfftn: ihfft on the last axis + ifftn over the leading
+    axes — matches scipy.fft.ihfftn."""
+    def f(a):
+        ax = axes if axes is not None else tuple(range(a.ndim))
+        lead, last = tuple(ax[:-1]), ax[-1]
+        n_last = None if s is None else s[-1]
+        a = jnp.fft.ihfft(a, n=n_last, axis=last, norm=_norm(norm))
+        if lead:
+            s_lead = None if s is None else tuple(s[:-1])
+            a = jnp.fft.ifftn(a, s=s_lead, axes=lead, norm=_norm(norm))
+        return a
+    return apply_op(f, _t(x))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _hfftn_impl(x, s, axes, norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _ihfftn_impl(x, s, axes, norm)
+
+
+# helpers -------------------------------------------------------------------
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .framework import convert_dtype
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .framework import convert_dtype
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.fftshift(a, axes=axes), _t(x))
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.ifftshift(a, axes=axes), _t(x))

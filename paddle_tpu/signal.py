@@ -1,0 +1,156 @@
+"""paddle.signal parity (ref: python/paddle/signal.py): frame, overlap_add,
+stft, istft.
+
+TPU-native framing: `frame` is a gather over a static index grid (no
+dynamic slicing in a Python loop), so stft lowers to one batched FFT —
+the whole pipeline jits and differentiates.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .autograd import apply_op
+from .tensor import Tensor, to_tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _frame(a, frame_length, hop_length, axis=-1):
+    if axis not in (-1, a.ndim - 1, 0):
+        raise ValueError("frame: axis must be 0 or -1")
+    seq_last = axis in (-1, a.ndim - 1)
+    if not seq_last:
+        a = jnp.moveaxis(a, 0, -1)
+    n = a.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (np.arange(frame_length)[None, :]
+           + hop_length * np.arange(num)[:, None])  # [num, frame_length]
+    out = a[..., idx]                                # [..., num, fl]
+    out = jnp.swapaxes(out, -1, -2)                  # [..., fl, num]
+    if not seq_last:
+        out = jnp.moveaxis(out, (-2, -1), (0, 1))
+    return out
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """ref: paddle.signal.frame — [..., frame_length, num_frames]."""
+    return apply_op(
+        lambda a: _frame(a, int(frame_length), int(hop_length), axis), _t(x))
+
+
+def _overlap_add(a, hop_length, axis=-1):
+    seq_last = axis in (-1, a.ndim - 1)
+    if not seq_last:
+        # [fl, num, ...] -> [..., fl, num]
+        a = jnp.moveaxis(a, (0, 1), (-2, -1))
+    fl = a.shape[-2]
+    num = a.shape[-1]
+    n_out = fl + hop_length * (num - 1)
+    # scatter-add each frame at its offset: one_hot matmul keeps it static
+    # and MXU-friendly for the typical fl<=1024
+    frames = jnp.swapaxes(a, -1, -2)  # [..., num, fl]
+    idx = (np.arange(fl)[None, :]
+           + hop_length * np.arange(num)[:, None])  # [num, fl]
+    flat = frames.reshape(frames.shape[:-2] + (num * fl,))
+    out = jnp.zeros(frames.shape[:-2] + (n_out,), dtype=a.dtype)
+    out = out.at[..., idx.reshape(-1)].add(flat)
+    if not seq_last:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """ref: paddle.signal.overlap_add."""
+    return apply_op(lambda a: _overlap_add(a, int(hop_length), axis), _t(x))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """ref: paddle.signal.stft — input [B, T] (or [T]), output
+    [B, n_fft//2+1 (or n_fft), num_frames], complex."""
+    n_fft = int(n_fft)
+    hop_length = int(hop_length) if hop_length else n_fft // 4
+    win_length = int(win_length) if win_length else n_fft
+    if window is not None:
+        w = _t(window)._value.astype(jnp.float32)
+    else:
+        w = jnp.ones((win_length,), jnp.float32)
+    # center-pad the window to n_fft like the reference
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+
+    def f(a):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(n_fft // 2,) * 2],
+                        mode=pad_mode)
+        fr = _frame(a, n_fft, hop_length)            # [B, n_fft, num]
+        fr = fr * w[:, None]
+        if onesided:
+            spec = jnp.fft.rfft(fr, axis=-2)
+        else:
+            spec = jnp.fft.fft(fr, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec[0] if squeeze else spec
+
+    return apply_op(f, _t(x))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """ref: paddle.signal.istft — least-squares inverse with window
+    normalization (NOLA)."""
+    n_fft = int(n_fft)
+    hop_length = int(hop_length) if hop_length else n_fft // 4
+    win_length = int(win_length) if win_length else n_fft
+    if window is not None:
+        w = _t(window)._value.astype(jnp.float32)
+    else:
+        w = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+
+    if return_complex and onesided:
+        raise ValueError(
+            "istft: return_complex=True requires onesided=False "
+            "(a onesided spectrum reconstructs a real signal)")
+
+    def f(spec):
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            fr = jnp.fft.irfft(spec, n=n_fft, axis=-2)
+        elif return_complex:
+            fr = jnp.fft.ifft(spec, axis=-2)
+        else:
+            fr = jnp.fft.ifft(spec, axis=-2).real
+        fr = fr * w[:, None]
+        out = _overlap_add(fr, hop_length)
+        # NOLA normalization: overlap-added squared window
+        wsq = jnp.broadcast_to((w ** 2)[:, None], (n_fft, spec.shape[-1]))
+        denom = _overlap_add(wsq, hop_length)
+        out = out / jnp.maximum(denom, jnp.finfo(jnp.float32).tiny)
+        if center:
+            out = out[..., n_fft // 2:]
+            tail = out.shape[-1] - n_fft // 2
+            out = out[..., :tail]
+        if length is not None:
+            out = out[..., :length]
+        return out[0] if squeeze else out
+
+    return apply_op(f, _t(x))

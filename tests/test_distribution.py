@@ -1,0 +1,307 @@
+"""paddle.distribution parity tests — closed forms vs scipy/numpy,
+sampling moments, KL registry, transforms, jit-compat."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+class TestNormal:
+    def test_log_prob_entropy_cdf(self):
+        d = D.Normal(1.0, 2.0)
+        x = 0.5
+        ref = -((x - 1.0) ** 2) / 8 - math.log(2.0) - 0.5 * math.log(2 * math.pi)
+        assert np.allclose(_np(d.log_prob(x)), ref, atol=1e-6)
+        assert np.allclose(_np(d.entropy()),
+                           0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0))
+        assert np.allclose(_np(d.cdf(1.0)), 0.5, atol=1e-6)
+        assert np.allclose(_np(d.icdf(d.cdf(x))), x, atol=1e-5)
+
+    def test_sample_moments(self):
+        paddle.seed(0)
+        d = D.Normal(3.0, 0.5)
+        s = _np(d.sample((20000,)))
+        assert s.shape == (20000,)
+        assert abs(s.mean() - 3.0) < 0.02
+        assert abs(s.std() - 0.5) < 0.02
+
+    def test_rsample_pathwise_grad(self):
+        paddle.seed(0)
+        loc = paddle.to_tensor(2.0, stop_gradient=False)
+
+        def f(l):
+            d = D.Normal(l, paddle.to_tensor(1.0))
+            return (d.rsample((256,)) ** 2).mean()
+
+        # E[(l+eps)^2] -> d/dl = 2l
+        g = paddle.grad(f(loc), loc)[0]
+        assert abs(float(g) - 4.0) < 0.3
+
+    def test_kl(self):
+        p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        ref = (math.log(2.0) + (1 + 1) / (2 * 4) - 0.5)
+        assert np.allclose(_np(D.kl_divergence(p, q)), ref, atol=1e-6)
+        assert np.allclose(_np(p.kl_divergence(q)), ref, atol=1e-6)
+
+
+class TestUniform:
+    def test_basics(self):
+        d = D.Uniform(1.0, 3.0)
+        assert np.allclose(_np(d.mean), 2.0)
+        assert np.allclose(_np(d.variance), 4.0 / 12)
+        assert np.allclose(_np(d.log_prob(2.0)), -math.log(2.0))
+        assert np.isneginf(_np(d.log_prob(3.5)))
+        assert np.allclose(_np(d.entropy()), math.log(2.0))
+        paddle.seed(1)
+        s = _np(d.sample((4000,)))
+        assert s.min() >= 1.0 and s.max() < 3.0
+        assert abs(s.mean() - 2.0) < 0.05
+
+
+class TestBetaGammaDirichlet:
+    def test_beta(self):
+        d = D.Beta(2.0, 3.0)
+        assert np.allclose(_np(d.mean), 0.4)
+        # B(2,3) = 1/12 → pdf(x) = 12 x (1-x)^2
+        assert np.allclose(_np(d.prob(0.5)), 12 * 0.5 * 0.25, atol=1e-5)
+        paddle.seed(2)
+        s = _np(d.sample((8000,)))
+        assert abs(s.mean() - 0.4) < 0.02
+
+    def test_gamma(self):
+        d = D.Gamma(3.0, 2.0)
+        assert np.allclose(_np(d.mean), 1.5)
+        assert np.allclose(_np(d.variance), 0.75)
+        # pdf(x) = r^a x^(a-1) e^(-rx) / Γ(a)
+        x = 1.2
+        ref = (2.0 ** 3) * x ** 2 * math.exp(-2 * x) / math.gamma(3.0)
+        assert np.allclose(_np(d.prob(x)), ref, atol=1e-5)
+        assert np.allclose(_np(D.kl_divergence(d, d)), 0.0, atol=1e-6)
+
+    def test_dirichlet(self):
+        c = np.array([1.0, 2.0, 3.0], dtype=np.float32)
+        d = D.Dirichlet(paddle.to_tensor(c))
+        assert np.allclose(_np(d.mean), c / 6.0, atol=1e-6)
+        paddle.seed(3)
+        s = _np(d.sample((5000,)))
+        assert s.shape == (5000, 3)
+        assert np.allclose(s.sum(-1), 1.0, atol=1e-5)
+        assert np.allclose(s.mean(0), c / 6.0, atol=0.02)
+        assert np.allclose(_np(D.kl_divergence(d, d)), 0.0, atol=1e-5)
+
+
+class TestLaplaceGumbelCauchyStudentT:
+    def test_laplace(self):
+        d = D.Laplace(0.0, 1.0)
+        assert np.allclose(_np(d.log_prob(0.0)), -math.log(2.0))
+        assert np.allclose(_np(d.cdf(0.0)), 0.5)
+        assert np.allclose(_np(d.icdf(0.8)), -math.log(2 * 0.2), atol=1e-5)
+        paddle.seed(4)
+        s = _np(d.sample((20000,)))
+        assert abs(s.mean()) < 0.05
+        assert abs(s.var() - 2.0) < 0.15
+
+    def test_gumbel(self):
+        d = D.Gumbel(1.0, 2.0)
+        euler = 0.5772156649
+        assert np.allclose(_np(d.mean), 1.0 + 2.0 * euler, atol=1e-5)
+        paddle.seed(5)
+        s = _np(d.sample((20000,)))
+        assert abs(s.mean() - (1 + 2 * euler)) < 0.1
+        assert np.allclose(_np(D.kl_divergence(d, d)), 0.0, atol=1e-5)
+
+    def test_gumbel_kl_different_locs(self):
+        # regression: exponent sign + missing (pl-ql)/qs linear term.
+        # reference value by numerical integration of p*log(p/q)
+        p, q = D.Gumbel(1.0, 1.0), D.Gumbel(0.0, 1.0)
+        xs = np.linspace(-8, 20, 200001)
+        lp = -(xs - 1.0) - np.exp(-(xs - 1.0))
+        lq = -xs - np.exp(-xs)
+        ref = np.trapezoid(np.exp(lp) * (lp - lq), xs)
+        assert np.allclose(_np(D.kl_divergence(p, q)), ref, atol=1e-4)
+        p2, q2 = D.Gumbel(0.5, 2.0), D.Gumbel(-0.5, 1.5)
+        lp2 = -np.log(2.0) - (xs - 0.5) / 2 - np.exp(-(xs - 0.5) / 2)
+        lq2 = -np.log(1.5) - (xs + 0.5) / 1.5 - np.exp(-(xs + 0.5) / 1.5)
+        ref2 = np.trapezoid(np.exp(lp2) * (lp2 - lq2), xs)
+        assert np.allclose(_np(D.kl_divergence(p2, q2)), ref2, atol=1e-3)
+
+    def test_cauchy(self):
+        d = D.Cauchy(0.0, 1.0)
+        assert np.allclose(_np(d.prob(0.0)), 1 / math.pi, atol=1e-6)
+        assert np.allclose(_np(d.cdf(1.0)), 0.75, atol=1e-6)
+        assert np.allclose(_np(d.entropy()), math.log(4 * math.pi), atol=1e-5)
+        with pytest.raises(ValueError):
+            d.mean
+
+    def test_student_t(self):
+        d = D.StudentT(5.0, 0.0, 1.0)
+        assert np.allclose(_np(d.variance), 5.0 / 3.0, atol=1e-5)
+        # t(0; df) = Γ((df+1)/2) / (sqrt(df π) Γ(df/2))
+        ref = math.gamma(3.0) / (math.sqrt(5 * math.pi) * math.gamma(2.5))
+        assert np.allclose(_np(d.prob(0.0)), ref, atol=1e-5)
+
+
+class TestDiscrete:
+    def test_bernoulli(self):
+        d = D.Bernoulli(0.3)
+        assert np.allclose(_np(d.log_prob(1.0)), math.log(0.3), atol=1e-6)
+        assert np.allclose(_np(d.log_prob(0.0)), math.log(0.7), atol=1e-6)
+        ent = -(0.3 * math.log(0.3) + 0.7 * math.log(0.7))
+        assert np.allclose(_np(d.entropy()), ent, atol=1e-6)
+        paddle.seed(6)
+        s = _np(d.sample((20000,)))
+        assert abs(s.mean() - 0.3) < 0.01
+        q = D.Bernoulli(0.5)
+        ref = 0.3 * math.log(0.3 / 0.5) + 0.7 * math.log(0.7 / 0.5)
+        assert np.allclose(_np(D.kl_divergence(d, q)), ref, atol=1e-5)
+
+    def test_categorical_reference_quirk(self):
+        # scores normalized by sum (the reference's convention)
+        d = D.Categorical(paddle.to_tensor([1.0, 2.0, 1.0]))
+        assert np.allclose(_np(d.probs(paddle.to_tensor(1))), 0.5, atol=1e-6)
+        paddle.seed(7)
+        s = _np(d.sample((8000,)))
+        frac1 = (s == 1).mean()
+        assert abs(frac1 - 0.5) < 0.03
+        ent = -(0.25 * math.log(0.25) * 2 + 0.5 * math.log(0.5))
+        assert np.allclose(_np(d.entropy()), ent, atol=1e-5)
+
+    def test_categorical_kl_and_from_logits(self):
+        p = D.Categorical.from_logits(paddle.to_tensor([0.0, 0.0]))
+        q = D.Categorical(paddle.to_tensor([1.0, 3.0]))
+        ref = 0.5 * math.log(0.5 / 0.25) + 0.5 * math.log(0.5 / 0.75)
+        assert np.allclose(_np(D.kl_divergence(p, q)), ref, atol=1e-5)
+
+    def test_multinomial(self):
+        d = D.Multinomial(10, paddle.to_tensor([0.2, 0.3, 0.5]))
+        assert np.allclose(_np(d.mean), [2.0, 3.0, 5.0], atol=1e-5)
+        paddle.seed(8)
+        s = _np(d.sample((2000,)))
+        assert s.shape == (2000, 3)
+        assert np.allclose(s.sum(-1), 10.0)
+        assert np.allclose(s.mean(0), [2, 3, 5], atol=0.2)
+        # pmf of (2,3,5): 10!/(2!3!5!) 0.2^2 0.3^3 0.5^5
+        coef = math.factorial(10) / (2 * 6 * 120)
+        ref = math.log(coef * 0.2 ** 2 * 0.3 ** 3 * 0.5 ** 5)
+        v = paddle.to_tensor([2.0, 3.0, 5.0])
+        assert np.allclose(_np(d.log_prob(v)), ref, atol=1e-5)
+
+    def test_geometric_poisson_binomial(self):
+        g = D.Geometric(0.25)
+        assert np.allclose(_np(g.mean), 3.0)
+        assert np.allclose(_np(g.log_prob(2.0)),
+                           math.log(0.75 ** 2 * 0.25), atol=1e-6)
+        p = D.Poisson(4.0)
+        assert np.allclose(_np(p.log_prob(3.0)),
+                           math.log(math.exp(-4) * 4 ** 3 / 6), atol=1e-5)
+        paddle.seed(9)
+        s = _np(p.sample((10000,)))
+        assert abs(s.mean() - 4.0) < 0.1
+        b = D.Binomial(8, 0.5)
+        assert np.allclose(_np(b.log_prob(4.0)),
+                           math.log(70 / 256), atol=1e-5)
+        ref_kl = 4.0 * (math.log(4.0 / 2.0)) - 4.0 + 2.0
+        assert np.allclose(_np(D.kl_divergence(D.Poisson(4.0), D.Poisson(2.0))),
+                           ref_kl, atol=1e-5)
+
+
+class TestTransforms:
+    def test_affine_exp_roundtrip(self):
+        t = D.ChainTransform([D.AffineTransform(1.0, 2.0), D.ExpTransform()])
+        x = paddle.to_tensor([0.1, -0.3, 0.7])
+        y = t.forward(x)
+        assert np.allclose(_np(t.inverse(y)), _np(x), atol=1e-6)
+        # fldj = log|2| + (1 + 2x)
+        ref = math.log(2.0) + (1 + 2 * _np(x))
+        assert np.allclose(_np(t.forward_log_det_jacobian(x)), ref, atol=1e-5)
+
+    def test_tanh_sigmoid_stable(self):
+        for t in (D.TanhTransform(), D.SigmoidTransform()):
+            x = paddle.to_tensor([-3.0, 0.0, 3.0])
+            y = t.forward(x)
+            assert np.allclose(_np(t.inverse(y)), _np(x), atol=1e-4)
+            # fldj matches autodiff of forward
+            import jax
+            import jax.numpy as jnp
+            g = jax.vmap(jax.grad(lambda v: t._forward(v)))(
+                jnp.asarray(_np(x)))
+            assert np.allclose(_np(t.forward_log_det_jacobian(x)),
+                               np.log(np.abs(np.asarray(g))), atol=1e-5)
+
+    def test_stick_breaking(self):
+        t = D.StickBreakingTransform()
+        x = paddle.to_tensor([0.3, -0.2, 0.5])
+        y = _np(t.forward(x))
+        assert y.shape == (4,)
+        assert np.allclose(y.sum(), 1.0, atol=1e-6)
+        assert (y > 0).all()
+        assert np.allclose(_np(t.inverse(paddle.to_tensor(y))), _np(x),
+                           atol=1e-5)
+
+    def test_transformed_distribution_lognormal(self):
+        paddle.seed(11)
+        base = D.Normal(0.0, 0.25)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        ln = D.LogNormal(0.0, 0.25)
+        x = 1.3
+        assert np.allclose(_np(td.log_prob(x)), _np(ln.log_prob(x)),
+                           atol=1e-5)
+        s = _np(td.sample((20000,)))
+        assert abs(s.mean() - math.exp(0.25 ** 2 / 2)) < 0.02
+
+    def test_transformed_log_prob_grad_reaches_base_params(self):
+        # regression: log_prob was one fused apply_op over `value`, so the
+        # base distribution's params entered as constants and eager grads
+        # never reached them
+        loc = paddle.to_tensor(0.5, stop_gradient=False)
+        td = D.TransformedDistribution(D.Normal(loc, paddle.to_tensor(1.0)),
+                                       [D.ExpTransform()])
+        g = paddle.grad(td.log_prob(2.0), loc)[0]
+        # d/dloc log N(log 2; loc, 1) = (log 2 - loc)
+        assert np.allclose(_np(g), math.log(2.0) - 0.5, atol=1e-5)
+
+    def test_independent(self):
+        d = D.Independent(D.Normal(np.zeros(3, np.float32),
+                                   np.ones(3, np.float32)), 1)
+        assert d.batch_shape == ()
+        assert d.event_shape == (3,)
+        lp = _np(d.log_prob(paddle.to_tensor([0.0, 0.0, 0.0])))
+        assert np.allclose(lp, 3 * (-0.5 * math.log(2 * math.pi)), atol=1e-5)
+
+
+class TestJitCompat:
+    def test_log_prob_inside_jit(self):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(loc, x):
+            d = D.Normal(loc, 1.0)
+            return d.log_prob(x)._value
+
+        out = f(jnp.float32(0.0), jnp.float32(1.0))
+        assert np.allclose(np.asarray(out), -0.5 - 0.5 * math.log(2 * math.pi),
+                           atol=1e-6)
+
+    def test_rsample_in_traced_step(self):
+        # sampling inside an rng_scope'd traced fn (Engine-style) works and
+        # is a pure function of the scope key
+        import jax
+        from paddle_tpu import framework
+
+        def step(key):
+            with framework.rng_scope(key):
+                return D.Normal(0.0, 1.0).rsample((4,))._value
+
+        a = jax.jit(step)(jax.random.PRNGKey(0))
+        b = jax.jit(step)(jax.random.PRNGKey(0))
+        c = jax.jit(step)(jax.random.PRNGKey(1))
+        assert np.allclose(np.asarray(a), np.asarray(b))
+        assert not np.allclose(np.asarray(a), np.asarray(c))

@@ -1,0 +1,172 @@
+"""paddle.fft / paddle.signal parity vs numpy + torch-style istft identity."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft as pfft
+from paddle_tpu import signal as psignal
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+class TestFFT:
+    def setup_method(self, m):
+        self.rng = np.random.default_rng(0)
+
+    def test_fft_ifft_roundtrip(self):
+        x = self.rng.standard_normal(32).astype(np.float32)
+        y = pfft.fft(paddle.to_tensor(x))
+        assert np.allclose(_np(y), np.fft.fft(x), atol=1e-4)
+        back = pfft.ifft(y)
+        assert np.allclose(_np(back).real, x, atol=1e-5)
+
+    def test_norm_modes(self):
+        x = self.rng.standard_normal(16).astype(np.float32)
+        for norm in ("backward", "ortho", "forward"):
+            y = pfft.fft(paddle.to_tensor(x), norm=norm)
+            assert np.allclose(_np(y), np.fft.fft(x, norm=norm), atol=1e-4)
+        with pytest.raises(ValueError):
+            pfft.fft(paddle.to_tensor(x), norm="bogus")
+
+    def test_rfft_irfft(self):
+        x = self.rng.standard_normal(30).astype(np.float32)
+        y = pfft.rfft(paddle.to_tensor(x))
+        assert y.shape[-1] == 16
+        assert np.allclose(_np(y), np.fft.rfft(x), atol=1e-4)
+        assert np.allclose(_np(pfft.irfft(y, n=30)), x, atol=1e-5)
+
+    def test_hfft_ihfft(self):
+        x = self.rng.standard_normal(17).astype(np.float32) \
+            + 1j * self.rng.standard_normal(17).astype(np.float32)
+        x = x.astype(np.complex64)
+        x[0] = x[0].real  # hermitian-compatible DC
+        assert np.allclose(_np(pfft.hfft(paddle.to_tensor(x))),
+                           np.fft.hfft(x), atol=1e-3)
+        r = self.rng.standard_normal(32).astype(np.float32)
+        assert np.allclose(_np(pfft.ihfft(paddle.to_tensor(r))),
+                           np.fft.ihfft(r), atol=1e-5)
+
+    def test_2d_nd(self):
+        x = self.rng.standard_normal((8, 12)).astype(np.float32)
+        assert np.allclose(_np(pfft.fft2(paddle.to_tensor(x))),
+                           np.fft.fft2(x), atol=1e-3)
+        assert np.allclose(_np(pfft.rfft2(paddle.to_tensor(x))),
+                           np.fft.rfft2(x), atol=1e-3)
+        x3 = self.rng.standard_normal((4, 6, 10)).astype(np.float32)
+        assert np.allclose(_np(pfft.fftn(paddle.to_tensor(x3))),
+                           np.fft.fftn(x3), atol=1e-3)
+        assert np.allclose(
+            _np(pfft.irfftn(pfft.rfftn(paddle.to_tensor(x3)), s=x3.shape)),
+            x3, atol=1e-4)
+
+    def test_hfftn_ihfftn_match_scipy(self):
+        # regression: leading axes used ifftn/fftn+conj instead of
+        # fftn/ifftn
+        import scipy.fft as sfft
+        x = (self.rng.standard_normal((6, 5))
+             + 1j * self.rng.standard_normal((6, 5))).astype(np.complex64)
+        assert np.allclose(_np(pfft.hfft2(paddle.to_tensor(x))),
+                           sfft.hfft2(x), atol=1e-3)
+        r = self.rng.standard_normal((6, 8)).astype(np.float32)
+        assert np.allclose(_np(pfft.ihfft2(paddle.to_tensor(r))),
+                           sfft.ihfft2(r), atol=1e-5)
+        x3 = (self.rng.standard_normal((3, 4, 5))
+              + 1j * self.rng.standard_normal((3, 4, 5))).astype(np.complex64)
+        assert np.allclose(_np(pfft.hfftn(paddle.to_tensor(x3))),
+                           sfft.hfftn(x3), atol=1e-3)
+        r3 = self.rng.standard_normal((3, 4, 8)).astype(np.float32)
+        assert np.allclose(_np(pfft.ihfftn(paddle.to_tensor(r3))),
+                           sfft.ihfftn(r3), atol=1e-5)
+
+    def test_freq_shift(self):
+        assert np.allclose(_np(pfft.fftfreq(10, 0.1)), np.fft.fftfreq(10, 0.1))
+        assert np.allclose(_np(pfft.rfftfreq(10, 0.1)),
+                           np.fft.rfftfreq(10, 0.1))
+        x = self.rng.standard_normal((4, 5)).astype(np.float32)
+        assert np.allclose(_np(pfft.fftshift(paddle.to_tensor(x))),
+                           np.fft.fftshift(x))
+        assert np.allclose(
+            _np(pfft.ifftshift(pfft.fftshift(paddle.to_tensor(x)))), x)
+
+    def test_grad_through_rfft(self):
+        x = paddle.to_tensor(
+            self.rng.standard_normal(16).astype(np.float32),
+            stop_gradient=False)
+        y = pfft.rfft(x)
+        # |rfft(x)|^2 summed = parseval-ish; grad exists and is finite
+        mag = (y.real() ** 2 + y.imag() ** 2).sum() if hasattr(y, "real") \
+            else None
+        if mag is None:
+            import jax.numpy as jnp
+            from paddle_tpu.autograd import apply_op
+            mag = apply_op(lambda a: jnp.sum(jnp.abs(a) ** 2), y)
+        g = paddle.grad(mag, x)[0]
+        assert np.all(np.isfinite(_np(g)))
+
+
+class TestSignal:
+    def setup_method(self, m):
+        self.rng = np.random.default_rng(1)
+
+    def test_frame_shape_and_content(self):
+        x = np.arange(10, dtype=np.float32)
+        f = psignal.frame(paddle.to_tensor(x), frame_length=4, hop_length=2)
+        assert tuple(f.shape) == (4, 4)
+        ref = np.stack([x[i * 2:i * 2 + 4] for i in range(4)], -1)
+        assert np.allclose(_np(f), ref)
+
+    def test_frame_batched(self):
+        x = self.rng.standard_normal((3, 20)).astype(np.float32)
+        f = psignal.frame(paddle.to_tensor(x), 5, 3)
+        assert tuple(f.shape) == (3, 5, 6)
+
+    def test_overlap_add_inverts_nonoverlapping(self):
+        x = self.rng.standard_normal((2, 12)).astype(np.float32)
+        f = psignal.frame(paddle.to_tensor(x), 4, 4)
+        back = psignal.overlap_add(f, 4)
+        assert np.allclose(_np(back), x, atol=1e-6)
+
+    def test_stft_matches_manual_dft(self):
+        x = self.rng.standard_normal((1, 64)).astype(np.float32)
+        n_fft, hop = 16, 8
+        w = np.hanning(n_fft + 1)[:-1].astype(np.float32)
+        s = psignal.stft(paddle.to_tensor(x), n_fft, hop,
+                         window=paddle.to_tensor(w), center=False)
+        # manual reference
+        frames = np.stack([x[0, i * hop:i * hop + n_fft] * w
+                           for i in range((64 - n_fft) // hop + 1)], -1)
+        ref = np.fft.rfft(frames, axis=0)
+        assert tuple(s.shape) == (1, n_fft // 2 + 1, frames.shape[-1])
+        assert np.allclose(_np(s)[0], ref, atol=1e-4)
+
+    def test_stft_istft_roundtrip(self):
+        x = self.rng.standard_normal((2, 256)).astype(np.float32)
+        n_fft, hop = 64, 16
+        w = np.hanning(n_fft + 1)[:-1].astype(np.float32)
+        s = psignal.stft(paddle.to_tensor(x), n_fft, hop,
+                         window=paddle.to_tensor(w))
+        back = psignal.istft(s, n_fft, hop, window=paddle.to_tensor(w),
+                             length=256)
+        assert np.allclose(_np(back), x, atol=1e-4)
+
+    def test_istft_return_complex(self):
+        # regression: return_complex under onesided crashed on a shape
+        # mismatch; now validated, and the onesided=False path round-trips
+        x = self.rng.standard_normal((2, 256)).astype(np.float32)
+        n_fft, hop = 64, 16
+        w = np.hanning(n_fft + 1)[:-1].astype(np.float32)
+        s1 = psignal.stft(paddle.to_tensor(x), n_fft, hop,
+                          window=paddle.to_tensor(w))
+        with pytest.raises(ValueError):
+            psignal.istft(s1, n_fft, hop, window=paddle.to_tensor(w),
+                          return_complex=True)
+        s2 = psignal.stft(paddle.to_tensor(x), n_fft, hop,
+                          window=paddle.to_tensor(w), onesided=False)
+        back = psignal.istft(s2, n_fft, hop, window=paddle.to_tensor(w),
+                             onesided=False, return_complex=True, length=256)
+        b = _np(back)
+        assert np.iscomplexobj(b)
+        assert np.allclose(b.real, x, atol=1e-4)
+        assert np.allclose(b.imag, 0.0, atol=1e-4)
