@@ -59,3 +59,5 @@ from . import incubate  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
+from . import audio  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401

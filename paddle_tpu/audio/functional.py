@@ -1,0 +1,201 @@
+"""paddle.audio.functional parity (ref: python/paddle/audio/functional/
+{window,functional}.py): window functions, mel filterbanks, unit
+conversions.
+
+All closed-form jnp — filterbanks are built once (host numpy) and applied
+as a single matmul against the power spectrogram, which is the
+MXU-friendly formulation.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = [
+    "get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+    "fft_frequencies", "compute_fbank_matrix", "power_to_db",
+    "create_dct",
+]
+
+
+def _window_np(name, win_length, fftbins=True, param=None):
+    n = int(win_length)
+    if name in ("hann", "hanning"):
+        return np.hanning(n + 1)[:-1] if fftbins else np.hanning(n)
+    if name in ("hamming",):
+        return np.hamming(n + 1)[:-1] if fftbins else np.hamming(n)
+    if name in ("blackman",):
+        return np.blackman(n + 1)[:-1] if fftbins else np.blackman(n)
+    if name in ("bartlett", "triang"):
+        return np.bartlett(n + 1)[:-1] if fftbins else np.bartlett(n)
+    if name in ("rect", "boxcar", "ones"):
+        return np.ones(n)
+    if name in ("kaiser",):
+        beta = 12.0 if param is None else float(param)
+        return (np.kaiser(n + 1, beta)[:-1] if fftbins
+                else np.kaiser(n, beta))
+    if name in ("gaussian",):
+        std = 7.0 if param is None else float(param)
+        k = np.arange(n) - (n - 1) / 2
+        return np.exp(-0.5 * (k / std) ** 2)
+    if name in ("exponential",):
+        tau = (n / 8.0) if param is None else float(param)
+        k = np.arange(n)
+        return np.exp(-np.abs(k - (n - 1) / 2) / tau)
+    if name in ("taylor",):
+        # 4-term Taylor window, 30 dB sidelobe (the reference's default)
+        nbar, sll = 4, 30.0
+        b = 10 ** (sll / 20)
+        a = np.arccosh(b) / np.pi
+        s2 = nbar ** 2 / (a ** 2 + (nbar - 0.5) ** 2)
+        ma = np.arange(1, nbar)
+        fm = np.empty(nbar - 1)
+        signs = np.empty_like(ma, float)
+        signs[::2] = 1
+        signs[1::2] = -1
+        m2 = ma ** 2
+        for mi, _ in enumerate(ma):
+            numer = signs[mi] * np.prod(
+                1 - m2[mi] / s2 / (a ** 2 + (ma - 0.5) ** 2))
+            denom = 2 * np.prod([1 - m2[mi] / m2[j]
+                                 for j in range(len(ma)) if j != mi])
+            fm[mi] = numer / denom
+        k = np.arange(n)
+        w = np.ones(n)
+        for mi, m in enumerate(ma):
+            w += 2 * fm[mi] * np.cos(2 * np.pi * m * (k - (n - 1) / 2) / n)
+        return w / w.max()
+    raise ValueError(f"unsupported window {name!r}")
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """ref: paddle.audio.functional.get_window."""
+    if isinstance(window, tuple):
+        name = window[0]
+        param = window[1] if len(window) > 1 else None
+    else:
+        name, param = window, None
+    from ..framework import convert_dtype
+    w = _window_np(name, win_length, fftbins, param)
+    return Tensor(jnp.asarray(w, dtype=convert_dtype(dtype)))
+
+
+def hz_to_mel(freq, htk=False):
+    """ref: paddle.audio.functional.hz_to_mel (slaney default)."""
+    scalar = not hasattr(freq, "__len__") and not isinstance(freq, Tensor)
+    f = np.asarray(freq._value if isinstance(freq, Tensor) else freq,
+                   dtype=np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mels = np.where(f >= min_log_hz,
+                        min_log_mel + np.log(np.maximum(f, 1e-10)
+                                             / min_log_hz) / logstep,
+                        mels)
+        out = mels
+    if scalar:
+        return float(out)
+    return Tensor(jnp.asarray(out, jnp.float32)) if isinstance(freq, Tensor) \
+        else out
+
+
+def mel_to_hz(mel, htk=False):
+    """ref: paddle.audio.functional.mel_to_hz."""
+    scalar = not hasattr(mel, "__len__") and not isinstance(mel, Tensor)
+    m = np.asarray(mel._value if isinstance(mel, Tensor) else mel,
+                   dtype=np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        freqs = np.where(m >= min_log_mel,
+                         min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                         freqs)
+        out = freqs
+    if scalar:
+        return float(out)
+    return Tensor(jnp.asarray(out, jnp.float32)) if isinstance(mel, Tensor) \
+        else out
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """ref: paddle.audio.functional.mel_frequencies."""
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(lo, hi, n_mels)
+    from ..framework import convert_dtype
+    return Tensor(jnp.asarray(mel_to_hz(mels, htk),
+                              dtype=convert_dtype(dtype)))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """ref: paddle.audio.functional.fft_frequencies."""
+    from ..framework import convert_dtype
+    return Tensor(jnp.asarray(
+        np.linspace(0, float(sr) / 2, 1 + n_fft // 2),
+        dtype=convert_dtype(dtype)))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """ref: paddle.audio.functional.compute_fbank_matrix →
+    [n_mels, 1 + n_fft//2]."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = np.linspace(0, float(sr) / 2, 1 + n_fft // 2)
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mel_f = mel_to_hz(np.linspace(lo, hi, n_mels + 2), htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    from ..framework import convert_dtype
+    return Tensor(jnp.asarray(weights, dtype=convert_dtype(dtype)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """ref: paddle.audio.functional.power_to_db."""
+    from ..autograd import apply_op
+    from .layers import _t
+
+    def f(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+    return apply_op(f, _t(spect))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """ref: paddle.audio.functional.create_dct → [n_mels, n_mfcc]
+    (type-II DCT basis)."""
+    n = np.arange(n_mels, dtype=np.float64)
+    k = np.arange(n_mfcc, dtype=np.float64)[None, :]
+    basis = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(2)
+        basis *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    from ..framework import convert_dtype
+    return Tensor(jnp.asarray(basis, dtype=convert_dtype(dtype)))

@@ -1,0 +1,110 @@
+"""paddle.audio.features parity (ref: python/paddle/audio/features/layers.py):
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC as nn.Layers.
+
+The whole chain is stft -> |.|^p -> fbank matmul -> dct matmul: two matmuls
+and an FFT, fully jittable, so feature extraction runs on-device inside the
+training step (the reference extracts on CPU workers; TPU-side extraction
+avoids the host->device feature transfer entirely).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd import apply_op
+from ..nn.layer import Layer
+from ..tensor import Tensor, to_tensor
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+class Spectrogram(Layer):
+    """ref: paddle.audio.features.Spectrogram — [B, T] ->
+    [B, n_fft//2+1, num_frames] power spectrogram."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = AF.get_window(window, self.win_length,
+                                        fftbins=True, dtype=dtype)
+
+    def forward(self, x):
+        from ..signal import stft
+        spec = stft(x, self.n_fft, self.hop_length, self.win_length,
+                    window=self.fft_window, center=self.center,
+                    pad_mode=self.pad_mode)
+        return apply_op(
+            lambda s: jnp.abs(s) ** self.power, spec)
+
+
+class MelSpectrogram(Layer):
+    """ref: paddle.audio.features.MelSpectrogram."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.n_mels = n_mels
+        self.fbank_matrix = AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)                  # [B, F, T]
+        return apply_op(lambda fb, s: jnp.einsum("mf,...ft->...mt", fb, s),
+                        self.fbank_matrix, spec)
+
+
+class LogMelSpectrogram(Layer):
+    """ref: paddle.audio.features.LogMelSpectrogram."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """ref: paddle.audio.features.MFCC — [B, T] -> [B, n_mfcc, num_frames]."""
+
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct_matrix = AF.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)          # [B, M, T]
+        return apply_op(lambda d, s: jnp.einsum("mc,...mt->...ct", d, s),
+                        self.dct_matrix, logmel)

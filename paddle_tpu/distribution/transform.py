@@ -267,6 +267,12 @@ class IndependentTransform(Transform):
         return jnp.sum(ld, axis=tuple(range(ld.ndim - self.rank, ld.ndim)))
 
 
+def _sum_rightmost(a, k):
+    if k <= 0:
+        return a
+    return jnp.sum(a, axis=tuple(range(a.ndim - k, a.ndim)))
+
+
 class TransformedDistribution(Distribution):
     """ref: paddle.distribution.TransformedDistribution(base, transforms)."""
 
@@ -275,9 +281,24 @@ class TransformedDistribution(Distribution):
         if isinstance(transforms, Transform):
             transforms = [transforms]
         self.transforms = list(transforms)
-        chain = ChainTransform(self.transforms)
-        self._chain = chain
-        super().__init__(base.batch_shape, base.event_shape)
+        self._chain = ChainTransform(self.transforms)
+        # the transforms may change the event shape (e.g. StickBreaking
+        # maps R^{K-1} -> K-simplex) and may also reinterpret trailing
+        # batch dims as event dims: derive the output shape by shape-
+        # tracing forward over an abstract sample (no FLOPs) and split it
+        # at the codomain event rank
+        in_shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        cod_rank = max([len(base.event_shape)]
+                       + [t._codomain_event_rank for t in self.transforms])
+        try:
+            out = jax.eval_shape(
+                self._chain._forward,
+                jax.ShapeDtypeStruct(in_shape, jnp.float32))
+            out_shape = tuple(out.shape)
+        except Exception:
+            out_shape = in_shape
+        cut = len(out_shape) - cod_rank
+        super().__init__(out_shape[:cut], out_shape[cut:])
 
     def sample(self, shape=()):
         x = self.base.sample(shape)
@@ -288,13 +309,27 @@ class TransformedDistribution(Distribution):
         return apply_op(self._chain._forward, x)
 
     def log_prob(self, value):
-        # composed from separate apply_op calls (NOT one fused op over
-        # `value` alone) so eager-tape gradients reach the base
-        # distribution's parameters through base.log_prob
-        x = apply_op(self._chain._inverse, _t(value))
-        base_lp = self.base.log_prob(x)
-        return apply_op(lambda lp, xv: lp - self._chain._fldj(xv),
-                        base_lp, x)
+        # change-of-variables with event-rank bookkeeping: each per-
+        # transform log-det and the base log_prob are reduced over the
+        # dims they don't already reduce (the reference's sum_rightmost
+        # logic). Composed from separate apply_op calls so eager-tape
+        # gradients reach the base distribution's parameters.
+        base_rank = len(self.base.event_shape)
+        event_dim = max([base_rank]
+                        + [t._domain_event_rank for t in self.transforms])
+        y = _t(value)
+        lds = []
+        for t in reversed(self.transforms):
+            x = apply_op(t._inverse, y)
+            k = event_dim - t._domain_event_rank
+            lds.append(apply_op(
+                lambda xv, t=t, k=k: _sum_rightmost(t._fldj(xv), k), x))
+            y = x
+        lp = apply_op(lambda a: _sum_rightmost(a, event_dim - base_rank),
+                      self.base.log_prob(y))
+        for ld in lds:
+            lp = apply_op(jnp.subtract, lp, ld)
+        return lp
 
 
 class Independent(Distribution):

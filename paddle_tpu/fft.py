@@ -111,7 +111,11 @@ def _hfftn_impl(x, s, axes, norm):
     """hermitian-input N-D (jnp has no hfftn): forward fft over the leading
     axes + hfft on the last — matches scipy.fft.hfftn."""
     def f(a):
-        ax = axes if axes is not None else tuple(range(a.ndim))
+        # scipy convention: axes default to the last len(s) axes when s is
+        # given, else all axes
+        ax = (tuple(axes) if axes is not None
+              else tuple(range(0 if s is None else a.ndim - len(s),
+                               a.ndim)))
         lead, last = tuple(ax[:-1]), ax[-1]
         if lead:
             s_lead = None if s is None else tuple(s[:-1])
@@ -125,7 +129,9 @@ def _ihfftn_impl(x, s, axes, norm):
     """inverse of hfftn: ihfft on the last axis + ifftn over the leading
     axes — matches scipy.fft.ihfftn."""
     def f(a):
-        ax = axes if axes is not None else tuple(range(a.ndim))
+        ax = (tuple(axes) if axes is not None
+              else tuple(range(0 if s is None else a.ndim - len(s),
+                               a.ndim)))
         lead, last = tuple(ax[:-1]), ax[-1]
         n_last = None if s is None else s[-1]
         a = jnp.fft.ihfft(a, n=n_last, axis=last, norm=_norm(norm))

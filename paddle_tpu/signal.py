@@ -23,7 +23,9 @@ def _t(x):
 def _frame(a, frame_length, hop_length, axis=-1):
     if axis not in (-1, a.ndim - 1, 0):
         raise ValueError("frame: axis must be 0 or -1")
-    seq_last = axis in (-1, a.ndim - 1)
+    # axis=0 always selects the [num_frames, frame_length, ...] layout,
+    # including for 1-D input where axis 0 is also the last axis
+    seq_last = axis == -1 or (axis == a.ndim - 1 and axis != 0)
     if not seq_last:
         a = jnp.moveaxis(a, 0, -1)
     n = a.shape[-1]
@@ -33,12 +35,14 @@ def _frame(a, frame_length, hop_length, axis=-1):
     out = a[..., idx]                                # [..., num, fl]
     out = jnp.swapaxes(out, -1, -2)                  # [..., fl, num]
     if not seq_last:
-        out = jnp.moveaxis(out, (-2, -1), (0, 1))
+        # reference layout for axis=0: [num_frames, frame_length, ...]
+        out = jnp.moveaxis(out, (-1, -2), (0, 1))
     return out
 
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
-    """ref: paddle.signal.frame — [..., frame_length, num_frames]."""
+    """ref: paddle.signal.frame — [..., frame_length, num_frames] for
+    axis=-1, [num_frames, frame_length, ...] for axis=0."""
     return apply_op(
         lambda a: _frame(a, int(frame_length), int(hop_length), axis), _t(x))
 
@@ -46,8 +50,8 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
 def _overlap_add(a, hop_length, axis=-1):
     seq_last = axis in (-1, a.ndim - 1)
     if not seq_last:
-        # [fl, num, ...] -> [..., fl, num]
-        a = jnp.moveaxis(a, (0, 1), (-2, -1))
+        # [num, fl, ...] -> [..., fl, num]
+        a = jnp.moveaxis(a, (0, 1), (-1, -2))
     fl = a.shape[-2]
     num = a.shape[-1]
     n_out = fl + hop_length * (num - 1)

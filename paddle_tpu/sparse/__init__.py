@@ -1,0 +1,314 @@
+"""paddle.sparse parity subset (ref: python/paddle/sparse/__init__.py).
+
+TPU-native design: sparse tensors wrap `jax.experimental.sparse.BCOO` —
+XLA's batched-COO format whose matmuls lower to gather/segment-sum (and,
+for structured patterns, MXU-friendly dots). COO and CSR constructors are
+supported; CSR converts to BCOO internally and keeps its compressed attrs
+for API parity. Elementwise ops act on `values` only (zero-preserving ops,
+like the reference). 3-D point-cloud convs (SubmConv3D) are out of scope
+and gated with a clear error.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..autograd import apply_op
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "is_sparse", "is_sparse_coo", "is_sparse_csr",
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul",
+    "relu", "tanh", "sqrt", "sin", "abs", "pow", "neg", "cast",
+    "transpose", "coalesce", "nn",
+]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor over BCOO (ref: paddle's SparseCooTensor)."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    # -- paddle surface -------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)  # paddle: [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return apply_op(lambda d: jsparse.BCOO(
+            (d, self._bcoo.indices), shape=self._bcoo.shape).todense(),
+            Tensor(self._bcoo.data))
+
+    def to_sparse_csr(self):
+        dense = np.asarray(self.to_dense()._value)
+        return _dense_to_csr(dense)
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def with_values(self, values):
+        return SparseCooTensor(jsparse.BCOO(
+            (_arr(values), self._bcoo.indices), shape=self._bcoo.shape))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR view (ref: paddle's SparseCsrTensor): keeps crows/cols for API
+    parity, computes on the BCOO equivalent."""
+
+    def __init__(self, bcoo, crows, cols):
+        super().__init__(bcoo)
+        self._crows = jnp.asarray(crows)
+        self._cols = jnp.asarray(cols)
+
+    def crows(self):
+        return Tensor(self._crows)
+
+    def cols(self):
+        return Tensor(self._cols)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._bcoo)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def _dense_to_csr(dense):
+    rows, cols = np.nonzero(dense)
+    vals = dense[rows, cols]
+    n = dense.shape[0]
+    crows = np.zeros(n + 1, np.int64)
+    for r in rows:
+        crows[r + 1] += 1
+    crows = np.cumsum(crows)
+    idx = np.stack([rows, cols], -1)
+    bcoo = jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx)),
+                        shape=dense.shape)
+    return SparseCsrTensor(bcoo, crows, cols)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """ref: paddle.sparse.sparse_coo_tensor — indices [ndim, nnz]."""
+    idx = np.asarray(_arr(to_tensor(indices))).astype(np.int32)
+    vals = _arr(to_tensor(values))
+    if dtype is not None:
+        from ..framework import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """ref: paddle.sparse.sparse_csr_tensor (2-D)."""
+    crows_np = np.asarray(_arr(to_tensor(crows))).astype(np.int64)
+    cols_np = np.asarray(_arr(to_tensor(cols))).astype(np.int64)
+    vals = _arr(to_tensor(values))
+    if dtype is not None:
+        from ..framework import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    idx = np.stack([rows, cols_np], -1)
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.astype(np.int32))),
+                        shape=tuple(shape))
+    return SparseCsrTensor(bcoo, crows_np, cols_np)
+
+
+def is_sparse(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor) and not isinstance(
+        x, SparseCsrTensor)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+# ---------------------------------------------------------------------------
+# elementwise (zero-preserving unary ops act on values; binary ops require
+# matching sparsity like the reference)
+# ---------------------------------------------------------------------------
+def _unary(fn, x):
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("expected a sparse tensor")
+    return x.with_values(_arr(apply_op(fn, x.values())))
+
+
+def relu(x, name=None):
+    return _unary(jax.nn.relu, x)
+
+
+def tanh(x, name=None):
+    return _unary(jnp.tanh, x)
+
+
+def sqrt(x, name=None):
+    return _unary(jnp.sqrt, x)
+
+
+def sin(x, name=None):
+    return _unary(jnp.sin, x)
+
+
+def abs(x, name=None):  # noqa: A001
+    return _unary(jnp.abs, x)
+
+
+def neg(x, name=None):
+    return _unary(jnp.negative, x)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _unary(lambda v: jnp.power(v, factor), x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    vals = x._bcoo.data
+    if value_dtype is not None:
+        from ..framework import convert_dtype
+        vals = vals.astype(convert_dtype(value_dtype))
+    return x.with_values(vals)
+
+
+def _binary(fn, x, y):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        # same-pattern fast path; general case goes dense->sparse
+        if (x._bcoo.indices.shape == y._bcoo.indices.shape
+                and bool(jnp.all(x._bcoo.indices == y._bcoo.indices))):
+            return x.with_values(fn(x._bcoo.data, y._bcoo.data))
+        out = fn(_arr(x.to_dense()), _arr(y.to_dense()))
+        return _from_dense_coo(out)
+    raise TypeError("sparse binary ops expect two sparse tensors")
+
+
+def _from_dense_coo(dense):
+    d = np.asarray(dense)
+    idx = np.stack(np.nonzero(d), 0)
+    return sparse_coo_tensor(idx, d[tuple(idx)], d.shape)
+
+
+def add(x, y, name=None):
+    return _binary(jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return _binary(jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return _binary(jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return _binary(jnp.divide, x, y)
+
+
+# ---------------------------------------------------------------------------
+# matmul
+# ---------------------------------------------------------------------------
+def matmul(x, y, name=None):
+    """ref: paddle.sparse.matmul — sparse @ dense -> dense (grads flow
+    through the dense operand and the sparse values)."""
+    if isinstance(x, SparseCooTensor) and not isinstance(y, SparseCooTensor):
+        bcoo = x._bcoo
+
+        def f(vals, dense):
+            m = jsparse.BCOO((vals, bcoo.indices), shape=bcoo.shape)
+            return m @ dense
+        return apply_op(f, x.values(), to_tensor(y) if not isinstance(
+            y, Tensor) else y)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        out = _arr(x.to_dense()) @ _arr(y.to_dense())
+        return _from_dense_coo(out)
+    raise TypeError("matmul: x must be sparse")
+
+
+def masked_matmul(x, y, mask, name=None):
+    """ref: paddle.sparse.masked_matmul — dense @ dense evaluated only at
+    `mask`'s sparsity pattern (sampled-dense-dense matmul). One gather per
+    side + a row-dot — never materializes the dense product."""
+    xa, ya = _arr(_t_dense(x)), _arr(_t_dense(y))
+    idx = mask._bcoo.indices  # [nnz, 2]
+
+    def f(a, b):
+        rows = a[idx[:, 0]]          # [nnz, K]
+        cols = b[:, idx[:, 1]].T     # [nnz, K]
+        vals = jnp.sum(rows * cols, -1)
+        return vals
+    vals = apply_op(f, Tensor(xa), Tensor(ya))
+    return mask.with_values(_arr(vals))
+
+
+def _t_dense(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def transpose(x, perm, name=None):
+    dense = _arr(x.to_dense())
+    return _from_dense_coo(jnp.transpose(dense, perm))
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+# ---------------------------------------------------------------------------
+# sparse.nn subset
+# ---------------------------------------------------------------------------
+class _SparseReLU:
+    """ref: paddle.sparse.nn.ReLU."""
+
+    def __call__(self, x):
+        return relu(x)
+
+
+class _GatedConv:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "paddle.sparse.nn 3-D point-cloud convolutions (Conv3D/"
+            "SubmConv3D) are gated: XLA has no submanifold gather-scatter "
+            "primitive; use dense conv3d or an external point-cloud "
+            "pipeline")
+
+
+class _nn:
+    ReLU = _SparseReLU
+    Conv3D = _GatedConv
+    SubmConv3D = _GatedConv
+
+
+nn = _nn()

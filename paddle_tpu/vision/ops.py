@@ -1,0 +1,534 @@
+"""paddle.vision.ops parity (ref: python/paddle/vision/ops.py).
+
+TPU-first designs:
+- `nms`: iterative greedy NMS is O(N) sequential host logic on GPU; here it
+  is a fixed-trip-count `lax.fori_loop` over a precomputed [N, N] IoU
+  matrix — one matmul-shaped batch of comparisons, static shapes, jittable.
+- `roi_align`: expressed as a bilinear-gather + mean over a static sampling
+  grid, vectorized over rois — no per-roi dynamic loops.
+- `deform_conv2d`: sample-then-matmul (gather the deformed patches, one
+  einsum against the kernel), the standard TPU formulation for deformable
+  conv since dynamic scatter/gather convs don't exist in XLA.
+- `distribute_fpn_proposals` returns static-shape per-level masks instead
+  of ragged per-level lists (documented divergence: XLA has no ragged
+  outputs; callers mask instead of gather).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import apply_op
+from ..tensor import Tensor, to_tensor
+from ..nn.layer import Layer
+
+__all__ = [
+    "nms", "box_iou", "roi_align", "roi_pool", "box_coder", "yolo_box",
+    "distribute_fpn_proposals", "deform_conv2d", "DeformConv2D", "PSRoIPool",
+    "RoIAlign", "RoIPool",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _arr(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ---------------------------------------------------------------------------
+# box ops
+# ---------------------------------------------------------------------------
+def box_iou(boxes1, boxes2, name=None):
+    """ref: paddle.vision.ops.box_iou — [N,4] x [M,4] xyxy -> [N,M]."""
+    from .models.detection.box_utils import pairwise_iou
+
+    def f(a, b):
+        iou, _ = pairwise_iou(a, b)
+        return iou
+    return apply_op(f, _t(boxes1), _t(boxes2))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """ref: paddle.vision.ops.nms.
+
+    Greedy NMS as a static-shape `fori_loop`: at step i the highest-scored
+    surviving box is selected and every box with IoU > threshold against it
+    is suppressed. Returns kept indices sorted by score (dynamic length on
+    the host; inside jit use the returned mask form via `top_k`).
+
+    With `top_k=None` the call is a host-facing API (returns a variable-
+    length index Tensor). With `top_k=k` the result is the fixed-shape
+    first-k kept indices (padded with -1) — the jit-safe form.
+    """
+    b = _arr(_t(boxes)).astype(jnp.float32)
+    n = b.shape[0]
+    s = (jnp.arange(n, 0, -1, dtype=jnp.float32) if scores is None
+         else _arr(_t(scores)).astype(jnp.float32))
+
+    if category_idxs is not None:
+        # category-aware: offset boxes per category so cross-category pairs
+        # never overlap (the standard batched-NMS trick)
+        cidx = _arr(_t(category_idxs)).astype(jnp.float32)
+        span = jnp.max(b) - jnp.min(b) + 1.0
+        b = b + (cidx * span)[:, None]
+
+    from .models.detection.box_utils import pairwise_iou
+    iou, _ = pairwise_iou(b, b)
+
+    # sort by score; greedy NMS becomes: keep[j] unless some KEPT i<j
+    # overlaps it. The only sequential dependency is the keep vector — a
+    # fori_loop over one precomputed [N, N] bool matrix (no per-step IoU
+    # kernels, unlike the GPU reference's atomic bitmask walk)
+    order = jnp.argsort(-s)
+    inv = jnp.argsort(order)
+    iou_sorted = iou[order][:, order]
+    tri = jnp.tril(iou_sorted > iou_threshold, k=-1)  # j vs any i<j
+
+    def loop_body(j, keep):
+        suppressed = jnp.any(tri[j] & keep)
+        return keep.at[j].set(~suppressed)
+
+    keep_sorted = jax.lax.fori_loop(0, n, loop_body, jnp.zeros((n,), bool))
+    keep = keep_sorted[inv]
+
+    if top_k is not None:
+        k = int(top_k)
+        score_keep = jnp.where(keep, s, -jnp.inf)
+        idx = jnp.argsort(-score_keep)[:k]
+        valid = keep[idx]
+        return Tensor(jnp.where(valid, idx, -1).astype(jnp.int64))
+    # host-facing: variable-length kept indices sorted by score
+    keep_np = np.asarray(keep)
+    s_np = np.asarray(s)
+    kept = np.nonzero(keep_np)[0]
+    kept = kept[np.argsort(-s_np[kept])]
+    return Tensor(jnp.asarray(kept, dtype=jnp.int64))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """ref: paddle.vision.ops.box_coder (encode/decode center-size)."""
+    pb = _arr(_t(prior_box)).astype(jnp.float32)
+    pbv = (jnp.asarray(prior_box_var, jnp.float32)
+           if not isinstance(prior_box_var, (Tensor,))
+           else _arr(prior_box_var).astype(jnp.float32))
+    norm = 0.0 if box_normalized else 1.0
+
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+
+    if code_type == "encode_center_size":
+        def f(tb):
+            tw = tb[:, None, 2] - tb[:, None, 0] + norm
+            th = tb[:, None, 3] - tb[:, None, 1] + norm
+            tcx = tb[:, None, 0] + tw / 2
+            tcy = tb[:, None, 1] + th / 2
+            out = jnp.stack([
+                (tcx - pcx[None]) / pw[None],
+                (tcy - pcy[None]) / ph[None],
+                jnp.log(tw / pw[None]),
+                jnp.log(th / ph[None]),
+            ], -1)
+            return out / jnp.reshape(pbv, (1, -1, 4) if pbv.ndim == 2
+                                     else (1, 1, 4))
+        return apply_op(f, _t(target_box))
+
+    if code_type == "decode_center_size":
+        def f(tb):
+            v = pbv if pbv.ndim == 2 else jnp.broadcast_to(
+                jnp.reshape(pbv, (1, 4)), pb.shape)
+            if axis == 0:
+                prior = (pcx[None, :], pcy[None, :], pw[None, :], ph[None, :])
+                var = v[None, :, :]
+            else:
+                prior = (pcx[:, None], pcy[:, None], pw[:, None], ph[:, None])
+                var = v[:, None, :]
+            dcx = var[..., 0] * tb[..., 0] * prior[2] + prior[0]
+            dcy = var[..., 1] * tb[..., 1] * prior[3] + prior[1]
+            dw = jnp.exp(var[..., 2] * tb[..., 2]) * prior[2]
+            dh = jnp.exp(var[..., 3] * tb[..., 3]) * prior[3]
+            return jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                              dcx + dw / 2 - norm, dcy + dh / 2 - norm], -1)
+        return apply_op(f, _t(target_box))
+
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5, name=None):
+    """ref: paddle.vision.ops.yolo_box — decode YOLO head predictions.
+
+    x: [B, na*(5+C), H, W]; returns (boxes [B, H*W*na, 4],
+    scores [B, H*W*na, C]). Low-confidence boxes are zeroed (static shape),
+    matching the reference's behavior of zero-filling below conf_thresh.
+    """
+    na = len(anchors) // 2
+    anc = jnp.asarray(np.asarray(anchors, np.float32).reshape(na, 2))
+    imgs = _arr(_t(img_size)).astype(jnp.float32)  # [B, 2] (h, w)
+
+    def f(xv):
+        b, _, h, w = xv.shape
+        if iou_aware:
+            # iou-aware head layout: the first na channels are IoU
+            # predictions, then the standard na*(5+C) block
+            iou_p = jax.nn.sigmoid(xv[:, :na].reshape(b, na, h, w))
+            v = xv[:, na:].reshape(b, na, 5 + class_num, h, w)
+        else:
+            v = xv.reshape(b, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+        cx = (sig(v[:, :, 0]) * alpha + beta + gx) / w
+        cy = (sig(v[:, :, 1]) * alpha + beta + gy) / h
+        in_w, in_h = w * downsample_ratio, h * downsample_ratio
+        bw = jnp.exp(v[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+        bh = jnp.exp(v[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+        obj = sig(v[:, :, 4])
+        if iou_aware:
+            # conf = obj^(1-f) * iou^f
+            f_ = iou_aware_factor
+            obj = jnp.power(obj, 1.0 - f_) * jnp.power(iou_p, f_)
+        cls = sig(v[:, :, 5:])  # [B, na, C, H, W]
+        conf = obj[:, :, None] * cls
+        # to pixel coords per image
+        imw = imgs[:, 1][:, None, None, None]
+        imh = imgs[:, 0][:, None, None, None]
+        x0 = (cx - bw / 2) * imw
+        y0 = (cy - bh / 2) * imh
+        x1 = (cx + bw / 2) * imw
+        y1 = (cy + bh / 2) * imh
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0, imw - 1)
+            y0 = jnp.clip(y0, 0, imh - 1)
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], -1)        # [B,na,H,W,4]
+        keep = (obj > conf_thresh)[..., None]
+        boxes = jnp.where(keep, boxes, 0.0)
+        conf = jnp.moveaxis(conf, 2, -1)               # [B,na,H,W,C]
+        conf = jnp.where(keep, conf, 0.0)
+        return (boxes.reshape(b, -1, 4),
+                conf.reshape(b, -1, class_num))
+
+    return apply_op(f, _t(x))
+
+
+# ---------------------------------------------------------------------------
+# roi ops
+# ---------------------------------------------------------------------------
+def _bilinear_gather(feat, ys, xs):
+    """feat [C, H, W]; ys/xs [...] float coords -> [C, ...]."""
+    h, w = feat.shape[-2:]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    y1, x1 = y0 + 1, x0 + 1
+    wy1 = ys - y0
+    wx1 = xs - x0
+    wy0, wx0 = 1 - wy1, 1 - wx1
+
+    def g(yy, xx):
+        yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        return feat[:, yi, xi]
+
+    out = (g(y0, x0) * (wy0 * wx0) + g(y0, x1) * (wy0 * wx1)
+           + g(y1, x0) * (wy1 * wx0) + g(y1, x1) * (wy1 * wx1))
+    # zero outside [-1, H/W] like the reference (sampling beyond the map)
+    valid = (ys >= -1) & (ys <= h) & (xs >= -1) & (xs <= w)
+    return out * valid
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """ref: paddle.vision.ops.roi_align.
+
+    x: [B, C, H, W]; boxes: [R, 4] xyxy (concatenated over the batch,
+    boxes_num[i] rois for image i); output [R, C, out_h, out_w].
+    """
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    bn = np.asarray(_arr(_t(boxes_num)))
+    img_of_roi = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+
+    def f(xv, bx):
+        off = 0.5 if aligned else 0.0
+        bx = bx * spatial_scale - off
+        rw = jnp.maximum(bx[:, 2] - bx[:, 0], 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(bx[:, 3] - bx[:, 1], 1e-3 if aligned else 1.0)
+        # static sampling grid: [oh*sr] x [ow*sr] points per roi
+        gy = (jnp.arange(oh * sr, dtype=jnp.float32) + 0.5) / (oh * sr)
+        gx = (jnp.arange(ow * sr, dtype=jnp.float32) + 0.5) / (ow * sr)
+        ys = bx[:, 1, None] + gy[None, :] * rh[:, None]   # [R, oh*sr]
+        xs = bx[:, 0, None] + gx[None, :] * rw[:, None]   # [R, ow*sr]
+
+        def per_roi(img_i, y, xcoord):
+            feat = xv[img_i]                               # [C, H, W]
+            yy = jnp.broadcast_to(y[:, None], (oh * sr, ow * sr))
+            xx = jnp.broadcast_to(xcoord[None, :], (oh * sr, ow * sr))
+            s = _bilinear_gather(feat, yy, xx)             # [C, ohsr, owsr]
+            c = s.shape[0]
+            s = s.reshape(c, oh, sr, ow, sr)
+            return s.mean((2, 4))                          # [C, oh, ow]
+
+        return jax.vmap(per_roi)(img_of_roi, ys, xs)
+
+    return apply_op(f, _t(x), _t(boxes))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """ref: paddle.vision.ops.roi_pool — max over the integer pixels of
+    each bin, evaluated on a static sr x sr sample grid snapped to pixel
+    coords (exact when bins have <= sr pixels per side, subsampled max
+    beyond that — documented static-shape approximation)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    sr = 8
+    bn = np.asarray(_arr(_t(boxes_num)))
+    img_of_roi = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+
+    def f(xv, bx):
+        bx = bx * spatial_scale
+        rw = jnp.maximum(bx[:, 2] - bx[:, 0], 1.0)
+        rh = jnp.maximum(bx[:, 3] - bx[:, 1], 1.0)
+        gy = (jnp.arange(oh * sr, dtype=jnp.float32) + 0.5) / (oh * sr)
+        gx = (jnp.arange(ow * sr, dtype=jnp.float32) + 0.5) / (ow * sr)
+        # snap samples to pixel indices (floor): max of true pixel values
+        ys = jnp.floor(bx[:, 1, None] + gy[None, :] * rh[:, None])
+        xs = jnp.floor(bx[:, 0, None] + gx[None, :] * rw[:, None])
+
+        def per_roi(img_i, y, xcoord):
+            feat = xv[img_i]
+            h, w = feat.shape[-2:]
+            yi = jnp.clip(y, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xcoord, 0, w - 1).astype(jnp.int32)
+            s = feat[:, yi[:, None], xi[None, :]]  # [C, oh*sr, ow*sr]
+            c = s.shape[0]
+            return s.reshape(c, oh, sr, ow, sr).max((2, 4))
+
+        return jax.vmap(per_roi)(img_of_roi, ys, xs)
+
+    return apply_op(f, _t(x), _t(boxes))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """ref: paddle.vision.ops.distribute_fpn_proposals.
+
+    TPU divergence (documented): XLA has no ragged outputs, so instead of
+    per-level gathered roi lists this returns (level_idx [R], masks
+    [L, R]) — callers select with the mask (multiply or where), keeping
+    every shape static.
+    """
+    def f(rois):
+        off = 1.0 if pixel_offset else 0.0
+        w = rois[:, 2] - rois[:, 0] + off
+        h = rois[:, 3] - rois[:, 1] + off
+        scale = jnp.sqrt(jnp.maximum(w * h, 1e-9))
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-9)) + refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        n_levels = max_level - min_level + 1
+        masks = jax.nn.one_hot(lvl - min_level, n_levels,
+                               dtype=jnp.float32).T  # [L, R]
+        return lvl, masks
+    return apply_op(f, _t(fpn_rois))
+
+
+# ---------------------------------------------------------------------------
+# deformable conv
+# ---------------------------------------------------------------------------
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """ref: paddle.vision.ops.deform_conv2d (v1; v2 when mask given).
+
+    sample-then-matmul: bilinear-gather the kh*kw deformed taps for every
+    output position, then a single einsum against the kernel — the gather
+    is data-parallel over B*H*W (vmap), the contraction hits the MXU.
+
+    x [B, Cin, H, W]; offset [B, 2*dg*kh*kw, Ho, Wo];
+    weight [Cout, Cin/groups, kh, kw]; mask [B, dg*kh*kw, Ho, Wo].
+    """
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    wshape = tuple(_arr(_t(weight)).shape)
+    cout, cin_g, kh, kw = wshape
+
+    def f(xv, off, wv, *rest):
+        mask_v = None
+        bias_v = None
+        rest = list(rest)
+        if mask is not None:
+            mask_v = rest.pop(0)
+        if bias is not None:
+            bias_v = rest.pop(0)
+        b, cin, h, w = xv.shape
+        ho = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        wo = (w + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        dg = deformable_groups
+        off = off.reshape(b, dg, kh * kw, 2, ho, wo)
+        # base sampling positions
+        oy = jnp.arange(ho, dtype=jnp.float32) * st[0] - pd[0]   # [Ho]
+        ox = jnp.arange(wo, dtype=jnp.float32) * st[1] - pd[1]   # [Wo]
+        # tap grid flattened row-major to K = kh*kw (matches the offset
+        # channel layout (dg, kh*kw, 2))
+        ky = jnp.repeat(jnp.arange(kh, dtype=jnp.float32) * dl[0], kw)
+        kx = jnp.tile(jnp.arange(kw, dtype=jnp.float32) * dl[1], kh)
+        base_y = oy[None, :, None] + ky[:, None, None]  # [K, Ho, 1]
+        base_x = ox[None, None, :] + kx[:, None, None]  # [K, 1, Wo]
+        ys = base_y[None, None] + off[:, :, :, 0]   # [B, dg, K, Ho, Wo]
+        xs = base_x[None, None] + off[:, :, :, 1]
+
+        cpg = cin // dg  # channels per deformable group
+
+        def per_image(feat, y, xcoord):
+            # feat [Cin, H, W]; y/x [dg, K, Ho, Wo]
+            def per_dg(fg, yy, xx):
+                # fg [cpg, H, W]; yy/xx [K, Ho, Wo]
+                return _bilinear_gather(fg, yy, xx)  # [cpg, K, Ho, Wo]
+            return jax.vmap(per_dg)(
+                feat.reshape(dg, cpg, h, w), y, xcoord)  # [dg,cpg,K,Ho,Wo]
+
+        cols = jax.vmap(per_image)(xv, ys, xs)  # [B,dg,cpg,K,Ho,Wo]
+        if mask_v is not None:
+            cols = cols * mask_v.reshape(b, dg, 1, kh * kw, ho, wo)
+        cols = cols.reshape(b, cin, kh * kw, ho, wo)
+        # cols [B, Cin, K, Ho, Wo] x weight [Cout, Cin/g, kh*kw]
+        wv2 = wv.reshape(cout, cin_g, kh * kw)
+        if groups == 1:
+            out = jnp.einsum("bckhw,ock->bohw", cols, wv2)
+        else:
+            cols_g = cols.reshape(b, groups, cin // groups, kh * kw, ho, wo)
+            wv_g = wv2.reshape(groups, cout // groups, cin_g, kh * kw)
+            out = jnp.einsum("bgckhw,gock->bgohw", cols_g, wv_g)
+            out = out.reshape(b, cout, ho, wo)
+        if bias_v is not None:
+            out = out + bias_v.reshape(1, -1, 1, 1)
+        return out
+
+    args = [_t(x), _t(offset), _t(weight)]
+    if mask is not None:
+        args.append(_t(mask))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op(f, *args)
+
+
+class DeformConv2D(Layer):
+    """ref: paddle.vision.ops.DeformConv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+              else tuple(kernel_size))
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + ks, attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups, groups=self._groups,
+            mask=mask)
+
+
+class RoIAlign(Layer):
+    """ref: paddle.vision.ops.RoIAlign."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+class RoIPool(Layer):
+    """ref: paddle.vision.ops.RoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(Layer):
+    """ref: paddle.vision.ops.PSRoIPool — position-sensitive RoI average
+    pooling: channel c of output bin (i, j) reads ONLY input channel group
+    c, position (i, j) (channel index c*oh*ow + i*ow + j). The sampling is
+    done per-bin against its matched channel slice — 1/(oh*ow) the gather
+    work of pooling all channels then selecting the diagonal."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = (output_size if isinstance(output_size, tuple)
+                             else (output_size, output_size))
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        oh, ow = self._output_size
+        scale = self._spatial_scale
+        sr = 2
+        bn = np.asarray(_arr(_t(boxes_num)))
+        img_of_roi = jnp.asarray(np.repeat(np.arange(len(bn)), bn),
+                                 jnp.int32)
+
+        def f(xv, bx):
+            b, c_total, h, w = xv.shape
+            c_out = c_total // (oh * ow)
+            bx = bx * scale
+            rw = jnp.maximum(bx[:, 2] - bx[:, 0], 0.1)
+            rh = jnp.maximum(bx[:, 3] - bx[:, 1], 0.1)
+            gy = (jnp.arange(oh * sr, dtype=jnp.float32) + 0.5) / (oh * sr)
+            gx = (jnp.arange(ow * sr, dtype=jnp.float32) + 0.5) / (ow * sr)
+            ys = bx[:, 1, None] + gy[None, :] * rh[:, None]  # [R, oh*sr]
+            xs = bx[:, 0, None] + gx[None, :] * rw[:, None]  # [R, ow*sr]
+
+            def per_roi(img_i, y, xcoord):
+                # [oh, ow, c_out, H, W]: bin (i, j) maps to its channel slice
+                feat = xv[img_i].reshape(c_out, oh, ow, h, w)
+                feat = jnp.moveaxis(feat, 0, 2)
+                ybin = y.reshape(oh, sr)
+                xbin = xcoord.reshape(ow, sr)
+
+                def per_row(feat_row, yb):
+                    def per_bin(feat_ij, xb):
+                        yy = jnp.broadcast_to(yb[:, None], (sr, sr))
+                        xx = jnp.broadcast_to(xb[None, :], (sr, sr))
+                        return _bilinear_gather(feat_ij, yy, xx).mean((1, 2))
+                    return jax.vmap(per_bin)(feat_row, xbin)  # [ow, c_out]
+                out = jax.vmap(per_row)(feat, ybin)           # [oh, ow, c_out]
+                return jnp.moveaxis(out, 2, 0)                # [c_out, oh, ow]
+
+            return jax.vmap(per_roi)(img_of_roi, ys, xs)
+
+        return apply_op(f, _t(x), _t(boxes))

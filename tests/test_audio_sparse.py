@@ -1,0 +1,177 @@
+"""paddle.audio features vs closed forms; paddle.sparse subset vs dense."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio as A
+from paddle_tpu import sparse as S
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+class TestAudioFunctional:
+    def test_windows(self):
+        for name, ref in (("hann", np.hanning(33)[:-1]),
+                          ("hamming", np.hamming(33)[:-1]),
+                          ("blackman", np.blackman(33)[:-1])):
+            w = _np(A.functional.get_window(name, 32))
+            assert np.allclose(w, ref, atol=1e-6), name
+        assert np.allclose(_np(A.functional.get_window("rect", 8)), 1.0)
+        with pytest.raises(ValueError):
+            A.functional.get_window("bogus", 8)
+
+    def test_tuple_window_params_respected(self):
+        # regression: ('kaiser', beta) dropped beta and used 12.0
+        w5 = _np(A.functional.get_window(("kaiser", 5.0), 32))
+        assert np.allclose(w5, np.kaiser(33, 5.0)[:-1], atol=1e-6)
+        w12 = _np(A.functional.get_window(("kaiser", 12.0), 32))
+        assert not np.allclose(w5, w12)
+        g3 = _np(A.functional.get_window(("gaussian", 3.0), 16))
+        k = np.arange(16) - 7.5
+        assert np.allclose(g3, np.exp(-0.5 * (k / 3.0) ** 2), atol=1e-6)
+
+    def test_mel_conversions_roundtrip(self):
+        for htk in (False, True):
+            f = np.array([0.0, 440.0, 1000.0, 4000.0, 8000.0])
+            m = A.functional.hz_to_mel(f, htk)
+            back = A.functional.mel_to_hz(m, htk)
+            assert np.allclose(back, f, rtol=1e-4), htk
+        # slaney scale is linear below 1 kHz
+        assert abs(A.functional.hz_to_mel(500.0) - 7.5) < 1e-6
+
+    def test_fbank_matrix(self):
+        fb = _np(A.functional.compute_fbank_matrix(16000, 512, n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        # every filter has some support
+        assert (fb.sum(1) > 0).all()
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+        db = _np(A.functional.power_to_db(x, top_db=None))
+        assert np.allclose(db, [0.0, 10.0, 20.0], atol=1e-5)
+
+    def test_create_dct_orthonormal(self):
+        d = _np(A.functional.create_dct(8, 8))
+        # ortho-normalized type-II DCT basis: D^T D = I
+        assert np.allclose(d.T @ d, np.eye(8), atol=1e-5)
+
+
+class TestAudioFeatures:
+    def test_spectrogram_parseval_tone(self):
+        sr = 8000
+        t = np.arange(sr, dtype=np.float32) / sr
+        tone = np.sin(2 * np.pi * 1000 * t)[None]  # 1 kHz
+        spec = A.Spectrogram(n_fft=256, hop_length=128)(
+            paddle.to_tensor(tone))
+        s = _np(spec)
+        assert s.shape[1] == 129
+        # spectral peak at bin 1000/ (8000/256) = 32
+        assert np.argmax(s.mean(-1)[0]) == 32
+
+    def test_mel_and_mfcc_shapes(self):
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((2, 4000)).astype(np.float32))
+        mel = A.MelSpectrogram(sr=8000, n_fft=256, n_mels=32, f_min=0.0)(x)
+        assert tuple(mel.shape)[:2] == (2, 32)
+        logmel = A.LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32,
+                                     f_min=0.0)(x)
+        assert tuple(logmel.shape) == tuple(mel.shape)
+        assert np.allclose(_np(logmel),
+                           10 * np.log10(np.maximum(_np(mel), 1e-10)),
+                           atol=1e-4)
+        mfcc = A.MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32, f_min=0.0)(x)
+        assert tuple(mfcc.shape)[:2] == (2, 13)
+
+    def test_jit_and_grad(self):
+        import jax
+        layer = A.MelSpectrogram(sr=8000, n_fft=128, n_mels=16, f_min=0.0)
+        x = paddle.to_tensor(
+            np.random.default_rng(1).standard_normal(2000).astype(np.float32),
+            stop_gradient=False)
+        out = layer(x)
+        g = paddle.grad(out.sum(), x)[0]
+        assert np.all(np.isfinite(_np(g)))
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        idx = np.array([[0, 1, 2], [1, 0, 2]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        sp = S.sparse_coo_tensor(idx, vals, (3, 3))
+        assert S.is_sparse_coo(sp)
+        assert sp.nnz() == 3
+        dense = _np(sp.to_dense())
+        ref = np.zeros((3, 3), np.float32)
+        ref[0, 1], ref[1, 0], ref[2, 2] = 1, 2, 3
+        assert np.allclose(dense, ref)
+        assert np.allclose(_np(sp.indices()), idx)
+        assert np.allclose(_np(sp.values()), vals)
+
+    def test_csr_roundtrip(self):
+        crows = np.array([0, 1, 3, 3])
+        cols = np.array([2, 0, 1])
+        vals = np.array([5.0, 1.0, 2.0], np.float32)
+        sp = S.sparse_csr_tensor(crows, cols, vals, (3, 3))
+        assert S.is_sparse_csr(sp)
+        ref = np.zeros((3, 3), np.float32)
+        ref[0, 2], ref[1, 0], ref[1, 1] = 5, 1, 2
+        assert np.allclose(_np(sp.to_dense()), ref)
+        coo = sp.to_sparse_coo()
+        assert S.is_sparse_coo(coo) or S.is_sparse(coo)
+
+    def test_elementwise(self):
+        idx = np.array([[0, 1], [1, 0]])
+        sp = S.sparse_coo_tensor(idx, np.array([-1.0, 4.0], np.float32),
+                                 (2, 2))
+        assert np.allclose(_np(S.relu(sp).values()), [0.0, 4.0])
+        assert np.allclose(_np(S.sqrt(S.abs(sp)).values()), [1.0, 2.0])
+        sp2 = S.sparse_coo_tensor(idx, np.array([2.0, 2.0], np.float32),
+                                  (2, 2))
+        assert np.allclose(_np(S.add(sp, sp2).values()), [1.0, 6.0])
+        assert np.allclose(_np(S.multiply(sp, sp2).values()), [-2.0, 8.0])
+
+    def test_matmul_vs_dense(self):
+        rng = np.random.default_rng(2)
+        dense = rng.standard_normal((5, 4)).astype(np.float32)
+        dense[np.abs(dense) < 0.8] = 0.0
+        idx = np.stack(np.nonzero(dense), 0)
+        sp = S.sparse_coo_tensor(idx, dense[tuple(idx)], dense.shape)
+        y = rng.standard_normal((4, 3)).astype(np.float32)
+        out = S.matmul(sp, paddle.to_tensor(y))
+        assert np.allclose(_np(out), dense @ y, atol=1e-5)
+
+    def test_matmul_grad_flows_to_dense(self):
+        dense = np.array([[1.0, 0.0], [0.0, 2.0]], np.float32)
+        idx = np.stack(np.nonzero(dense), 0)
+        sp = S.sparse_coo_tensor(idx, dense[tuple(idx)], dense.shape)
+        y = paddle.to_tensor(np.ones((2, 2), np.float32),
+                             stop_gradient=False)
+        out = S.matmul(sp, y)
+        g = paddle.grad(out.sum(), y)[0]
+        # d/dy sum(S y) = column sums of S broadcast
+        assert np.allclose(_np(g), [[1.0, 1.0], [2.0, 2.0]])
+
+    def test_masked_matmul(self):
+        rng = np.random.default_rng(3)
+        a = rng.standard_normal((4, 6)).astype(np.float32)
+        b = rng.standard_normal((6, 4)).astype(np.float32)
+        mask_idx = np.array([[0, 1, 3], [0, 2, 3]])
+        mask = S.sparse_coo_tensor(mask_idx,
+                                   np.ones(3, np.float32), (4, 4))
+        out = S.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), mask)
+        full = a @ b
+        assert np.allclose(_np(out.values()),
+                           full[tuple(mask_idx)], atol=1e-5)
+
+    def test_nn_relu_and_gated_conv(self):
+        idx = np.array([[0], [0]])
+        sp = S.sparse_coo_tensor(idx, np.array([-3.0], np.float32), (1, 1))
+        out = S.nn.ReLU()(sp)
+        assert np.allclose(_np(out.values()), [0.0])
+        with pytest.raises(NotImplementedError):
+            S.nn.SubmConv3D(1, 1, 3)

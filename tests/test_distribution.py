@@ -256,6 +256,25 @@ class TestTransforms:
         s = _np(td.sample((20000,)))
         assert abs(s.mean() - math.exp(0.25 ** 2 / 2)) < 0.02
 
+    def test_transformed_event_rank_change(self):
+        # regression: StickBreaking over a factored Normal must produce a
+        # SCALAR log_prob (base reduced over the transform's domain event
+        # dim), and event_shape must reflect the K-simplex output
+        base = D.Normal(np.zeros(3, np.float32), np.ones(3, np.float32))
+        td = D.TransformedDistribution(base, [D.StickBreakingTransform()])
+        assert td.event_shape == (4,)
+        paddle.seed(12)
+        s = td.sample()
+        assert tuple(s.shape) == (4,)
+        lp = td.log_prob(s)
+        assert _np(lp).shape == ()
+        # numerical check vs change of variables computed by hand
+        t = D.StickBreakingTransform()
+        x = _np(t.inverse(s))
+        base_lp = sum(-0.5 * x ** 2 - 0.5 * math.log(2 * math.pi))
+        fldj = _np(t.forward_log_det_jacobian(paddle.to_tensor(x)))
+        assert np.allclose(_np(lp), base_lp - fldj, atol=1e-5)
+
     def test_transformed_log_prob_grad_reaches_base_params(self):
         # regression: log_prob was one fused apply_op over `value`, so the
         # base distribution's params entered as constants and eager grads

@@ -79,6 +79,10 @@ class TestFFT:
         r3 = self.rng.standard_normal((3, 4, 8)).astype(np.float32)
         assert np.allclose(_np(pfft.ihfftn(paddle.to_tensor(r3))),
                            sfft.ihfftn(r3), atol=1e-5)
+        # regression: s given with axes=None — scipy defaults to the LAST
+        # len(s) axes
+        assert np.allclose(_np(pfft.hfftn(paddle.to_tensor(x3), s=(4, 8))),
+                           sfft.hfftn(x3, s=(4, 8)), atol=1e-3)
 
     def test_freq_shift(self):
         assert np.allclose(_np(pfft.fftfreq(10, 0.1)), np.fft.fftfreq(10, 0.1))
@@ -127,6 +131,22 @@ class TestSignal:
         f = psignal.frame(paddle.to_tensor(x), 4, 4)
         back = psignal.overlap_add(f, 4)
         assert np.allclose(_np(back), x, atol=1e-6)
+
+    def test_frame_axis0_reference_layout(self):
+        # regression: axis=0 must give [num_frames, frame_length, ...]
+        x = np.arange(10, dtype=np.float32)
+        f = psignal.frame(paddle.to_tensor(x), 4, 2, axis=0)
+        assert tuple(f.shape) == (4, 4)
+        ref = np.stack([x[i * 2:i * 2 + 4] for i in range(4)], 0)
+        assert np.allclose(_np(f), ref)
+        # batched: [num, fl, B]
+        xb = self.rng.standard_normal((20, 3)).astype(np.float32)
+        fb = psignal.frame(paddle.to_tensor(xb), 5, 3, axis=0)
+        assert tuple(fb.shape) == (6, 5, 3)
+        # overlap_add round-trips the axis=0 layout
+        f0 = psignal.frame(paddle.to_tensor(xb[:12]), 4, 4, axis=0)
+        back = psignal.overlap_add(f0, 4, axis=0)
+        assert np.allclose(_np(back), xb[:12], atol=1e-6)
 
     def test_stft_matches_manual_dft(self):
         x = self.rng.standard_normal((1, 64)).astype(np.float32)

@@ -1,0 +1,243 @@
+"""paddle.vision.ops: nms vs naive greedy reference, roi ops invariants,
+deform_conv2d degenerate == regular conv, box_coder round trip."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+def naive_nms(boxes, scores, thr):
+    """reference greedy NMS."""
+    order = np.argsort(-scores)
+    keep = []
+    alive = np.ones(len(boxes), bool)
+    for j in order:
+        if not alive[j]:
+            continue
+        keep.append(j)
+        for k in order:
+            if alive[k] and k != j:
+                # iou
+                lt = np.maximum(boxes[j, :2], boxes[k, :2])
+                rb = np.minimum(boxes[j, 2:], boxes[k, 2:])
+                wh = np.clip(rb - lt, 0, None)
+                inter = wh[0] * wh[1]
+                a1 = np.prod(np.clip(boxes[j, 2:] - boxes[j, :2], 0, None))
+                a2 = np.prod(np.clip(boxes[k, 2:] - boxes[k, :2], 0, None))
+                if inter / (a1 + a2 - inter + 1e-9) > thr:
+                    alive[k] = False
+    return np.array(keep)
+
+
+class TestNMS:
+    def test_vs_naive(self):
+        rng = np.random.default_rng(0)
+        for trial in range(5):
+            xy = rng.uniform(0, 50, (40, 2)).astype(np.float32)
+            wh = rng.uniform(5, 25, (40, 2)).astype(np.float32)
+            boxes = np.concatenate([xy, xy + wh], -1)
+            scores = rng.uniform(0, 1, 40).astype(np.float32)
+            got = _np(V.nms(paddle.to_tensor(boxes), 0.4,
+                            scores=paddle.to_tensor(scores)))
+            ref = naive_nms(boxes, scores, 0.4)
+            assert np.array_equal(np.sort(got), np.sort(ref)), trial
+            # sorted by score
+            assert np.all(np.diff(scores[got]) <= 1e-6)
+
+    def test_no_scores_uses_input_order(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [30, 30, 40, 40]],
+                         np.float32)
+        got = _np(V.nms(paddle.to_tensor(boxes), 0.3))
+        assert np.array_equal(np.sort(got), [0, 2])
+
+    def test_categories(self):
+        # same box, different category: both kept
+        boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        got = _np(V.nms(paddle.to_tensor(boxes), 0.3,
+                        scores=paddle.to_tensor(scores),
+                        category_idxs=paddle.to_tensor(
+                            np.array([0, 1], np.int64)),
+                        categories=[0, 1]))
+        assert len(got) == 2
+
+    def test_top_k_fixed_shape(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [30, 30, 40, 40]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        got = _np(V.nms(paddle.to_tensor(boxes), 0.3,
+                        scores=paddle.to_tensor(scores), top_k=3))
+        assert got.shape == (3,)
+        assert got[0] == 0 and got[1] == 2 and got[2] == -1
+
+    def test_box_iou(self):
+        a = np.array([[0, 0, 10, 10]], np.float32)
+        b = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]],
+                     np.float32)
+        iou = _np(V.box_iou(paddle.to_tensor(a), paddle.to_tensor(b)))
+        assert np.allclose(iou, [[1.0, 25 / 175, 0.0]], atol=1e-5)
+
+
+class TestRoiOps:
+    def test_roi_align_constant_feature(self):
+        x = np.full((1, 3, 16, 16), 7.0, np.float32)
+        boxes = np.array([[2, 2, 10, 10], [0, 0, 15, 15]], np.float32)
+        out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(np.array([2], np.int32)), 4)
+        o = _np(out)
+        assert o.shape == (2, 3, 4, 4)
+        assert np.allclose(o, 7.0, atol=1e-5)
+
+    def test_roi_align_linear_gradient_field(self):
+        # f(y, x) = x: averaged over a bin = bin center x
+        x = np.broadcast_to(np.arange(32, dtype=np.float32)[None, None, None, :],
+                            (1, 1, 32, 32)).copy()
+        boxes = np.array([[4, 4, 12, 12]], np.float32)
+        out = _np(V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                              paddle.to_tensor(np.array([1], np.int32)),
+                              2, aligned=False))
+        # unaligned convention: bins are x in [4,8] and [8,12]; bilinear
+        # samples of a linear field average to the bin centers 6 and 10
+        assert out.shape == (1, 1, 2, 2)
+        assert np.allclose(out[0, 0, 0, 0], 6.0, atol=0.05)
+        assert np.allclose(out[0, 0, 0, 1], 10.0, atol=0.05)
+        assert np.allclose(out[0, 0, 0], out[0, 0, 1], atol=1e-5)
+
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        x[0, 0, 3, 3] = 5.0
+        boxes = np.array([[0, 0, 8, 8]], np.float32)
+        out = _np(V.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                             paddle.to_tensor(np.array([1], np.int32)), 2))
+        assert out.shape == (1, 1, 2, 2)
+        # exact max semantics: the 5.0 peak pixel is in bin (0, 0)
+        assert np.allclose(out[0, 0], [[5.0, 0.0], [0.0, 0.0]])
+
+    def test_distribute_fpn(self):
+        rois = np.array([
+            [0, 0, 10, 10],      # small -> low level
+            [0, 0, 500, 500],    # big  -> high level
+        ], np.float32)
+        lvl, masks = V.distribute_fpn_proposals(
+            paddle.to_tensor(rois), 2, 5, 4, 224)
+        lv = _np(lvl)
+        m = _np(masks)
+        assert lv[0] == 2 and lv[1] == 5
+        assert m.shape == (4, 2)
+        assert m[0, 0] == 1 and m[3, 1] == 1
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2, 4, 9, 9)).astype(np.float32)
+        w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32) * 0.2
+        off = np.zeros((2, 2 * 9, 7, 7), np.float32)
+        got = _np(V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                  paddle.to_tensor(w)))
+        ref = _np(F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)))
+        assert got.shape == ref.shape
+        assert np.allclose(got, ref, atol=1e-4)
+
+    def test_mask_scales(self):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((1, 2, 7, 7)).astype(np.float32)
+        w = rng.standard_normal((3, 2, 3, 3)).astype(np.float32) * 0.2
+        off = np.zeros((1, 18, 5, 5), np.float32)
+        mask_half = np.full((1, 9, 5, 5), 0.5, np.float32)
+        full = _np(V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                   paddle.to_tensor(w)))
+        halfd = _np(V.deform_conv2d(paddle.to_tensor(x),
+                                    paddle.to_tensor(off),
+                                    paddle.to_tensor(w),
+                                    mask=paddle.to_tensor(mask_half)))
+        assert np.allclose(halfd, full * 0.5, atol=1e-4)
+
+    def test_layer_trains(self):
+        layer = V.DeformConv2D(2, 3, 3, padding=1)
+        x = paddle.to_tensor(
+            np.random.default_rng(3).standard_normal((1, 2, 6, 6))
+            .astype(np.float32))
+        off = paddle.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+        out = layer(x, off)
+        assert tuple(out.shape) == (1, 3, 6, 6)
+        loss = (out ** 2).mean()
+        loss.backward()
+        assert layer.weight.grad is not None
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(4)
+        priors = np.array([[10, 10, 30, 30], [40, 40, 90, 100]], np.float32)
+        var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+        targets = np.array([[12, 14, 33, 35], [45, 42, 80, 95]], np.float32)
+        enc = _np(V.box_coder(paddle.to_tensor(priors), var,
+                              paddle.to_tensor(targets),
+                              code_type="encode_center_size"))
+        # decode each target's own prior (diagonal of the N x M encoding)
+        diag = np.stack([enc[i, i] for i in range(2)])[None]  # [1, M, 4]
+        dec = _np(V.box_coder(paddle.to_tensor(priors), var,
+                              paddle.to_tensor(diag.transpose(1, 0, 2)),
+                              code_type="decode_center_size", axis=1))
+        assert np.allclose(dec[:, 0, :], targets, atol=1e-3)
+
+    def test_yolo_box_shapes(self):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((2, 3 * 7, 4, 4)).astype(np.float32)
+        boxes, scores = V.yolo_box(
+            paddle.to_tensor(x),
+            paddle.to_tensor(np.array([[64, 64], [64, 64]], np.int32)),
+            anchors=[10, 13, 16, 30, 33, 23], class_num=2,
+            conf_thresh=0.01, downsample_ratio=16)
+        assert tuple(boxes.shape) == (2, 48, 4)
+        assert tuple(scores.shape) == (2, 48, 2)
+        b = _np(boxes)
+        assert b.min() >= 0 and b.max() <= 63.001
+
+    def test_yolo_box_iou_aware(self):
+        # regression: iou channels were silently ignored. Layout: na iou
+        # channels first, then na*(5+C)
+        rng = np.random.default_rng(6)
+        na, C = 3, 2
+        x = rng.standard_normal((1, na + na * (5 + C), 4, 4)) \
+            .astype(np.float32)
+        img = paddle.to_tensor(np.array([[64, 64]], np.int32))
+        kw = dict(anchors=[10, 13, 16, 30, 33, 23], class_num=C,
+                  conf_thresh=-1.0, downsample_ratio=16)
+        _, s_aware = V.yolo_box(paddle.to_tensor(x), img, iou_aware=True,
+                                iou_aware_factor=0.5, **kw)
+        # reference: conf = obj^(1-f) * iou^f * cls
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+        v = x[:, na:].reshape(1, na, 5 + C, 4, 4)
+        iou = sig(x[:, :na].reshape(1, na, 4, 4))
+        obj = sig(v[:, :, 4]) ** 0.5 * iou ** 0.5
+        ref = (obj[:, :, None] * sig(v[:, :, 5:])).transpose(0, 1, 3, 4, 2)
+        assert np.allclose(_np(s_aware), ref.reshape(1, -1, C), atol=1e-4)
+
+    def test_psroi_pool(self):
+        # constant per channel-group: output bin (i, j) must read group
+        # value c*oh*ow + i*ow + j
+        oh = ow = 2
+        c_out = 3
+        x = np.zeros((1, c_out * oh * ow, 8, 8), np.float32)
+        for c in range(c_out):
+            for i in range(oh):
+                for j in range(ow):
+                    x[0, c * oh * ow + i * ow + j] = c * 100 + i * 10 + j
+        boxes = np.array([[0, 0, 8, 8]], np.float32)
+        layer = V.PSRoIPool(2)
+        out = _np(layer(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                        paddle.to_tensor(np.array([1], np.int32))))
+        assert out.shape == (1, c_out, 2, 2)
+        for c in range(c_out):
+            for i in range(oh):
+                for j in range(ow):
+                    assert np.allclose(out[0, c, i, j], c * 100 + i * 10 + j)
