@@ -207,6 +207,17 @@ def _toposort(roots):
     return order
 
 
+def _apply_grad_hooks(t, c):
+    """Run a tensor's registered grad hooks on cotangent array `c`; a
+    non-None Tensor/array return replaces it."""
+    from .tensor import Tensor
+    for hook in list(t._grad_hooks.values()):
+        r = hook(Tensor(c, stop_gradient=True))
+        if r is not None:
+            c = r._value if _is_tensor(r) else jnp.asarray(r)
+    return c
+
+
 def backward(tensors, grad_tensors=None, retain_graph: bool = False):
     """ref: paddle.autograd.backward / Tensor.backward."""
     from .tensor import Tensor
@@ -227,11 +238,24 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
         cot[id(t)] = cot.get(id(t), 0) + g_arr
 
     order = _toposort(tensors)
+    hooked_leaves = {}
+    hooks_done = set()
     for node in order:
         out_cots = []
         has_any = False
         for ref in node.out_refs:
             o = ref()
+            # grad hooks fire on the ACCUMULATED gradient of a tensor: for
+            # produced tensors that moment is here (topo order guarantees
+            # every consumer already contributed to cot[id(o)])
+            if (o is not None and o._grad_hooks and id(o) in cot
+                    and id(o) not in hooks_done):
+                hooks_done.add(id(o))
+                cot[id(o)] = _apply_grad_hooks(o, cot[id(o)])
+                if not o.stop_gradient and o._retain_grads:
+                    prev = o._grad_value
+                    o._grad_value = (cot[id(o)] if prev is None
+                                     else prev + cot[id(o)])
             c = cot.get(id(o)) if o is not None else None
             if c is None:
                 shape_src = o._value if o is not None else None
@@ -250,9 +274,22 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
         for t, c in zip(node.inputs, in_cots):
             cot[id(t)] = cot.get(id(t), 0) + c
             is_leaf = getattr(t, "_grad_node", None) is None
+            if t._grad_hooks:
+                # defer the .grad write until the accumulated total is
+                # final and the hooks have fired (producer time for
+                # intermediates, post-loop for leaves)
+                if is_leaf:
+                    hooked_leaves[id(t)] = t
+                continue
             if not t.stop_gradient and (is_leaf or t._retain_grads):
                 prev = t._grad_value
                 t._grad_value = c if prev is None else prev + c
+
+    for t in hooked_leaves.values():
+        total = _apply_grad_hooks(t, cot[id(t)])
+        if not t.stop_gradient:
+            prev = t._grad_value
+            t._grad_value = total if prev is None else prev + total
 
     if not retain_graph:
         # sever links so the graph (and its vjp residuals) frees now
@@ -299,3 +336,168 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         t._grad_value = keep[id(t)]
         t._retain_grads = r
     return res
+
+
+# ---------------------------------------------------------------------------
+# PyLayer: user-defined forward/backward (ref: paddle.autograd.PyLayer,
+# python/paddle/autograd/py_layer.py)
+# ---------------------------------------------------------------------------
+class PyLayerContext:
+    """ref: paddle.autograd.PyLayerContext — carries state from forward to
+    backward (`save_for_backward` / `saved_tensor`, plus arbitrary
+    attributes)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """ref: paddle.autograd.PyLayer — custom op with a user-defined
+    backward.
+
+    TPU-native dual dispatch:
+    - eagerly, ``apply`` runs ``forward`` under no_grad and links one
+      GradNode whose pullback calls ``backward`` (exact reference
+      semantics: ops inside forward are NOT taped);
+    - inside a jax trace (Engine/jit/grad), ``apply`` wraps the pair as a
+      ``jax.custom_vjp`` so the compiled step uses the custom rule — the
+      same mechanism the Pallas flash-attention kernel uses. Saved
+      tensors ride the custom_vjp residuals, so nothing leaks across
+      traces.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grad_outputs):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from .tensor import Tensor
+
+        flat, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=_is_tensor)
+        t_idx = [i for i, x in enumerate(flat) if _is_tensor(x)]
+        tensors = [flat[i] for i in t_idx]
+        arrs = [t._value for t in tensors]
+
+        def rebuild(darrs, stop_gradient=True):
+            buf = list(flat)
+            for i, a in zip(t_idx, darrs):
+                buf[i] = Tensor(a, stop_gradient=stop_gradient)
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, buf)
+            return a2, k2
+
+        if in_jax_trace(arrs):
+            return cls._apply_traced(rebuild, arrs)
+
+        ctx = PyLayerContext()
+        a2, k2 = rebuild(arrs)
+        with no_grad():
+            out = cls.forward(ctx, *a2, **k2)
+
+        needs_grad = (is_grad_enabled()
+                      and any(not t.stop_gradient for t in tensors))
+        out_flat = [t for t in jax.tree_util.tree_leaves(
+            out, is_leaf=_is_tensor) if _is_tensor(t)]
+        if not needs_grad:
+            return out
+
+        for t in out_flat:
+            t.stop_gradient = False
+        diff_pos = [i for i, t in enumerate(tensors)
+                    if not t.stop_gradient and _float_like(t._value)]
+        n_outs = len(out_flat)
+
+        def vjp_fn(seed):
+            seeds = (seed,) if n_outs == 1 else tuple(seed)
+            seed_ts = [Tensor(s, stop_gradient=True) for s in seeds]
+            with no_grad():
+                grads = cls.backward(ctx, *seed_ts)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            if len(grads) != len(tensors):
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(grads)} grads "
+                    f"for {len(tensors)} tensor inputs")
+            out = []
+            for i in diff_pos:
+                g = grads[i]
+                if g is None:
+                    out.append(jnp.zeros_like(tensors[i]._value))
+                else:
+                    out.append(g._value if _is_tensor(g) else jnp.asarray(g))
+            return tuple(out)
+
+        node = GradNode(inputs=[tensors[i] for i in diff_pos],
+                        outputs=out_flat, vjp_fn=vjp_fn)
+        for t in out_flat:
+            t._grad_node = node
+        return out
+
+    @classmethod
+    def _apply_traced(cls, rebuild, arrs):
+        from .tensor import Tensor
+
+        n_in = len(arrs)
+        ctx_cell = {}
+
+        def prim(*darrs):
+            ctx = PyLayerContext()
+            ctx_cell["ctx"] = ctx
+            a2, k2 = rebuild(darrs)
+            out = cls.forward(ctx, *a2, **k2)
+            return jax.tree_util.tree_map(
+                lambda t: t._value if _is_tensor(t) else t, out,
+                is_leaf=_is_tensor)
+
+        f = jax.custom_vjp(prim)
+
+        def fwd(*darrs):
+            out = prim(*darrs)
+            ctx = ctx_cell["ctx"]
+            saved = tuple(t._value if _is_tensor(t) else t
+                          for t in ctx._saved)
+            return out, saved
+
+        def bwd(saved, ct):
+            ctx = ctx_cell["ctx"]
+            ctx._saved = tuple(Tensor(s) if isinstance(s, jax.Array)
+                               or hasattr(s, "dtype") else s for s in saved)
+            cts = jax.tree_util.tree_leaves(ct)
+            seed_ts = [Tensor(c, stop_gradient=True) for c in cts]
+            grads = cls.backward(ctx, *seed_ts)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            if len(grads) != n_in:
+                raise ValueError(
+                    f"{cls.__name__}.backward returned {len(grads)} grads "
+                    f"for {n_in} tensor inputs")
+            out = []
+            for i in range(n_in):
+                g = grads[i]
+                if g is None:
+                    out.append(jnp.zeros_like(arrs[i]))
+                else:
+                    out.append((g._value if _is_tensor(g)
+                                else jnp.asarray(g)).astype(arrs[i].dtype))
+            return tuple(out)
+
+        f.defvjp(fwd, bwd)
+        out = f(*arrs)
+        return jax.tree_util.tree_map(lambda a: Tensor(a, stop_gradient=False),
+                                      out)

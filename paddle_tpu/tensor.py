@@ -30,7 +30,7 @@ def register_tensor_method(name, fn=None):
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "_grad_value", "_retain_grads",
-                 "_grad_node", "name", "__weakref__")
+                 "_grad_node", "_grad_hooks", "name", "__weakref__")
     __array_priority__ = 100  # numpy defers binary ops to us
 
     def __init__(self, value, stop_gradient: bool = True, name: str = None):
@@ -43,6 +43,7 @@ class Tensor:
         self._grad_value = None
         self._retain_grads = False
         self._grad_node = None
+        self._grad_hooks = None
         self.name = name
 
     # -- basic properties ---------------------------------------------------
@@ -117,6 +118,28 @@ class Tensor:
 
     def retain_grads(self):
         self._retain_grads = True
+
+    def register_hook(self, hook):
+        """ref: Tensor.register_hook — `hook(grad) -> Tensor | None` runs
+        when this tensor's gradient is computed in backward; a non-None
+        return replaces the gradient (both for `.grad` and for further
+        propagation). Returns a removable handle."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "register_hook: cannot register a hook on a tensor with "
+                "stop_gradient=True")
+        if self._grad_hooks is None:
+            self._grad_hooks = {}
+        hooks = self._grad_hooks
+        hid = (max(hooks) + 1) if hooks else 0
+        hooks[hid] = hook
+
+        class _Handle:
+            def remove(h, _hooks=hooks, _id=hid):
+                # keyed removal: idempotent, never touches another handle's
+                # registration of the same callable
+                _hooks.pop(_id, None)
+        return _Handle()
 
     def detach(self):
         return Tensor(self._value, stop_gradient=True, name=self.name)
@@ -323,8 +346,12 @@ class Tensor:
                 if slot == "__weakref__":
                     continue
                 try:
-                    # jax arrays are immutable; share them
-                    object.__setattr__(obj, slot, getattr(self, slot))
+                    v = getattr(self, slot)
+                    # jax arrays are immutable; share them — but the hook
+                    # registry is mutable and must not be shared
+                    if slot == "_grad_hooks" and v is not None:
+                        v = dict(v)
+                    object.__setattr__(obj, slot, v)
                 except AttributeError:
                     pass
         return obj

@@ -1,0 +1,228 @@
+"""paddle.autograd.PyLayer (eager tape + traced custom_vjp) and
+Tensor.register_hook."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+class DoubleBack(PyLayer):
+    """y = tanh(x), but backward deliberately returns 2x the true grad so
+    tests can tell the custom rule ran."""
+
+    @staticmethod
+    def forward(ctx, x):
+        y = paddle.tanh(x)
+        ctx.save_for_backward(y)
+        return y
+
+    @staticmethod
+    def backward(ctx, dy):
+        (y,) = ctx.saved_tensor()
+        return dy * (1 - y * y) * 2.0
+
+
+class TwoInOut(PyLayer):
+    @staticmethod
+    def forward(ctx, a, b):
+        ctx.save_for_backward(a, b)
+        return a * b, a + b
+
+    @staticmethod
+    def backward(ctx, d_mul, d_add):
+        a, b = ctx.saved_tensor()
+        return d_mul * b + d_add, d_mul * a + d_add
+
+
+class TestPyLayerEager:
+    def test_custom_backward_used(self):
+        x = paddle.to_tensor(np.array([0.3, -0.7], np.float32),
+                             stop_gradient=False)
+        y = DoubleBack.apply(x)
+        y.sum().backward()
+        ref = (1 - np.tanh(_np(x)) ** 2) * 2.0
+        assert np.allclose(_np(x.grad), ref, atol=1e-6)
+
+    def test_multi_output(self):
+        a = paddle.to_tensor(2.0, stop_gradient=False)
+        b = paddle.to_tensor(3.0, stop_gradient=False)
+        m, s = TwoInOut.apply(a, b)
+        (m + s).backward()
+        # d/da (ab + a + b) = b + 1 = 4; d/db = a + 1 = 3
+        assert np.allclose(_np(a.grad), 4.0)
+        assert np.allclose(_np(b.grad), 3.0)
+
+    def test_ctx_attributes(self):
+        class Scale(PyLayer):
+            @staticmethod
+            def forward(ctx, x, factor):
+                ctx.factor = factor
+                return x * factor
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * ctx.factor
+
+        x = paddle.to_tensor(1.5, stop_gradient=False)
+        y = Scale.apply(x, 4.0)
+        y.backward()
+        assert np.allclose(_np(x.grad), 4.0)
+
+    def test_no_grad_inputs_passthrough(self):
+        x = paddle.to_tensor(1.0)  # stop_gradient=True
+        y = DoubleBack.apply(x)
+        assert np.allclose(_np(y), np.tanh(1.0), atol=1e-6)
+
+
+class TestPyLayerTraced:
+    def test_inside_jax_grad(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.tensor import Tensor
+
+        def loss(x):
+            y = DoubleBack.apply(Tensor(x))
+            return jnp.sum(y._value)
+
+        x = jnp.asarray([0.3, -0.7], jnp.float32)
+        g = jax.grad(loss)(x)
+        ref = (1 - np.tanh(np.asarray(x)) ** 2) * 2.0
+        assert np.allclose(np.asarray(g), ref, atol=1e-6)
+
+    def test_inside_jit_grad(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.tensor import Tensor
+
+        @jax.jit
+        def gf(x):
+            return jax.grad(
+                lambda v: jnp.sum(DoubleBack.apply(Tensor(v))._value))(x)
+
+        x = jnp.asarray([0.1, 0.9], jnp.float32)
+        ref = (1 - np.tanh(np.asarray(x)) ** 2) * 2.0
+        assert np.allclose(np.asarray(gf(x)), ref, atol=1e-6)
+
+    def test_in_layer_through_engine_step(self):
+        # a Layer whose forward uses a PyLayer, trained one Engine step
+        import paddle_tpu.nn as nn
+        from paddle_tpu.hapi.engine import Engine
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, x):
+                return DoubleBack.apply(self.fc(x))
+
+        paddle.seed(0)
+        net = Net()
+        eng = Engine(net, loss=paddle.nn.MSELoss(),
+                     optimizer=paddle.optimizer.SGD(
+                         0.1, parameters=net.parameters()))
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        y = paddle.to_tensor(np.zeros((2, 2), np.float32))
+        w0 = _np(net.fc.weight).copy()
+        loss, _ = eng.train_batch([x], [y])
+        assert np.isfinite(float(loss))
+        assert not np.allclose(_np(net.fc.weight), w0)  # stepped
+
+
+class TestRegisterHook:
+    def test_hook_scales_leaf_grad(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        x.register_hook(lambda g: g * 10.0)
+        (x * 3.0).sum().backward()
+        assert np.allclose(_np(x.grad), [30.0, 30.0])
+
+    def test_hook_none_return_keeps_grad(self):
+        seen = []
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        x.register_hook(lambda g: seen.append(_np(g)))
+        (x ** 2).backward()
+        assert np.allclose(_np(x.grad), 4.0)
+        assert len(seen) == 1 and np.allclose(seen[0], 4.0)
+
+    def test_hook_on_intermediate_affects_propagation(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        h = x * 2.0        # dh/dx = 2
+        h.register_hook(lambda g: g * 5.0)
+        (h * 4.0).backward()  # dL/dh = 4 -> hook -> 20 -> dL/dx = 40
+        assert np.allclose(_np(x.grad), 40.0)
+
+    def test_hook_accumulated_before_firing(self):
+        # diamond: two consumers of h; hook must see the SUM (6), not fire
+        # per-edge
+        seen = []
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        h = x * 1.0
+
+        def hook(g):
+            seen.append(float(_np(g)))
+            return g
+
+        h.register_hook(hook)
+        (h * 2.0 + h * 4.0).backward()
+        assert seen == [6.0]
+        assert np.allclose(_np(x.grad), 6.0)
+
+    def test_remove_handle(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        handle = x.register_hook(lambda g: g * 100.0)
+        handle.remove()
+        (x * 2.0).backward()
+        assert np.allclose(_np(x.grad), 2.0)
+
+    def test_remove_is_idempotent_and_keyed(self):
+        # regression: double-remove of one handle must not delete another
+        # registration of the same callable
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        fn = lambda g: g * 10.0  # noqa: E731
+        h1 = x.register_hook(fn)
+        x.register_hook(fn)
+        h1.remove()
+        h1.remove()
+        (x * 1.0).backward()
+        assert np.allclose(_np(x.grad), 10.0)  # second registration fires
+
+    def test_deepcopy_does_not_share_hooks(self):
+        import copy
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        x.register_hook(lambda g: g * 3.0)
+        y = copy.deepcopy(x)
+        y.register_hook(lambda g: g * 7.0)
+        (x * 1.0).backward()
+        assert np.allclose(_np(x.grad), 3.0)  # y's hook did not fire on x
+
+    def test_traced_backward_arity_mismatch_raises(self):
+        # regression: traced path silently zero-padded missing grads
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.tensor import Tensor
+
+        class Bad(PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                return a * b
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy  # WRONG: one grad for two inputs
+
+        def loss(a, b):
+            return jnp.sum(Bad.apply(Tensor(a), Tensor(b))._value)
+
+        with pytest.raises(ValueError, match="returned 1 grads"):
+            jax.grad(loss, argnums=(0, 1))(jnp.float32(3.0), jnp.float32(2.0))
+
+    def test_register_on_stopped_tensor_raises(self):
+        x = paddle.to_tensor(1.0)
+        with pytest.raises(RuntimeError):
+            x.register_hook(lambda g: g)
