@@ -1,0 +1,48 @@
+"""Finetune BERT for sequence classification on a synthetic text task.
+
+Run:  python examples/finetune_bert.py
+"""
+try:
+    import paddle_tpu  # noqa: F401 (pip install -e . makes this work)
+except ModuleNotFoundError:  # running from a source checkout
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi.engine import Engine
+from paddle_tpu.nlp.bert import BertConfig, BertForSequenceClassification
+
+
+def main():
+    paddle.seed(7)
+    cfg = BertConfig(vocab_size=1000, hidden_size=128, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=256,
+                     max_position_embeddings=64)
+    model = BertForSequenceClassification(cfg, num_labels=2)
+    model.train()
+    opt = paddle.optimizer.AdamW(5e-4, parameters=model.parameters())
+    eng = Engine(model, loss=paddle.nn.CrossEntropyLoss(), optimizer=opt)
+
+    # synthetic task: class = whether token 7 appears in the sequence
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 1000, (256, 32)).astype("int32")
+    labels = (ids == 7).any(axis=1).astype("int64")
+
+    for epoch in range(3):
+        perm = rng.permutation(len(ids))
+        losses = []
+        for i in range(0, len(ids), 32):
+            sl = perm[i:i + 32]
+            loss, _ = eng.train_batch(
+                [paddle.to_tensor(ids[sl])],
+                [paddle.to_tensor(labels[sl])])
+            losses.append(float(loss))
+        print(f"epoch {epoch}: loss {np.mean(losses):.4f}")
+
+
+if __name__ == "__main__":
+    main()

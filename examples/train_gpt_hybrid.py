@@ -1,0 +1,79 @@
+"""Pretrain a GPT with hybrid parallelism (dp x mp x pp) on a device mesh.
+
+On a real pod this uses every chip; to smoke-test on one host run:
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/train_gpt_hybrid.py --dp 2 --mp 2 --pp 2
+"""
+try:
+    import paddle_tpu  # noqa: F401 (pip install -e . makes this work)
+except ModuleNotFoundError:  # running from a source checkout
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.mpu import shard_model
+from paddle_tpu.hapi.engine import Engine
+from paddle_tpu.nlp.gpt import (GPTConfig, GPTForCausalLM,
+                                GPTForCausalLMPipe, GPTPretrainingCriterion)
+
+
+def main():
+    import jax
+    from jax.sharding import Mesh
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--mp", type=int, default=2)
+    ap.add_argument("--pp", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=128)
+    args = ap.parse_args()
+
+    devices = jax.devices()
+    n = args.dp * args.mp * args.pp
+    assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
+
+    cfg = GPTConfig(
+        vocab_size=4096, hidden_size=256, num_hidden_layers=4,
+        num_attention_heads=8, max_position_embeddings=args.seq,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        use_flash_attention=False,
+    )
+
+    if args.pp > 1:
+        mesh = Mesh(np.array(devices[:n]).reshape(args.dp, args.mp, args.pp),
+                    ("dp", "mp", "pp"))
+        model = GPTForCausalLMPipe(cfg, mesh=mesh, n_micro=2)
+    else:
+        mesh = Mesh(np.array(devices[:n]).reshape(args.dp, args.mp),
+                    ("dp", "mp"))
+        model = GPTForCausalLM(cfg)
+    model.train()
+    shard_model(model, mesh)  # GSPMD placement: embeddings/mlp mp-sharded
+
+    opt = paddle.optimizer.AdamW(1e-4, weight_decay=0.01,
+                                 parameters=model.parameters())
+    eng = Engine(model, loss=GPTPretrainingCriterion(), optimizer=opt,
+                 mesh=mesh)
+
+    rng = np.random.default_rng(0)
+    with mesh:
+        for step in range(args.steps):
+            ids = rng.integers(0, cfg.vocab_size, (args.batch, args.seq))
+            labels = rng.integers(0, cfg.vocab_size, (args.batch, args.seq))
+            loss, _ = eng.train_batch(
+                [paddle.to_tensor(ids.astype("int32"))],
+                [paddle.to_tensor(labels.astype("int32"))])
+            print(f"step {step}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

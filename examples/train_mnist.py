@@ -1,0 +1,34 @@
+"""Train LeNet on MNIST with the high-level Model API.
+
+Run:  python examples/train_mnist.py  (CPU or TPU; ~20 s on CPU)
+"""
+try:
+    import paddle_tpu  # noqa: F401 (pip install -e . makes this work)
+except ModuleNotFoundError:  # running from a source checkout
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import paddle_tpu as paddle
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def main():
+    paddle.seed(42)
+    net = LeNet()
+    model = paddle.Model(net)
+    model.prepare(
+        paddle.optimizer.Adam(1e-3, parameters=net.parameters()),
+        paddle.nn.CrossEntropyLoss(),
+        Accuracy(),
+    )
+    model.fit(MNIST(mode="train"), epochs=2, batch_size=256, verbose=1)
+    print(model.evaluate(MNIST(mode="test"), batch_size=256, verbose=0))
+    model.save("./mnist_ckpt/final")
+
+
+if __name__ == "__main__":
+    main()

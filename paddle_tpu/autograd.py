@@ -412,10 +412,20 @@ class PyLayer(metaclass=PyLayerMeta):
 
         needs_grad = (is_grad_enabled()
                       and any(not t.stop_gradient for t in tensors))
-        out_flat = [t for t in jax.tree_util.tree_leaves(
-            out, is_leaf=_is_tensor) if _is_tensor(t)]
         if not needs_grad:
             return out
+
+        # pass-through outputs (forward returns an input unchanged) must
+        # become fresh views: a tensor that is simultaneously a node input
+        # and output would self-cycle the toposort and silently drop the
+        # node from backward
+        in_ids = {id(t) for t in tensors}
+        out = jax.tree_util.tree_map(
+            lambda t: Tensor(t._value, stop_gradient=False)
+            if _is_tensor(t) and id(t) in in_ids else t,
+            out, is_leaf=_is_tensor)
+        out_flat = [t for t in jax.tree_util.tree_leaves(
+            out, is_leaf=_is_tensor) if _is_tensor(t)]
 
         for t in out_flat:
             t.stop_gradient = False

@@ -4,3 +4,4 @@ from .optimizer import (  # noqa: F401
     Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, NAdam, Optimizer,
     RAdam, RMSProp, Rprop, SGD,
 )
+from .lbfgs import LBFGS  # noqa: F401

@@ -1,0 +1,296 @@
+"""LBFGS optimizer (ref: python/paddle/optimizer/lbfgs.py).
+
+Closure-driven quasi-Newton for the eager path: two-loop recursion over a
+bounded (s, y) history with optional strong-Wolfe line search (cubic
+interpolation). Parameters are flattened into one vector per step so the
+history math is a handful of dot products — fine on TPU since each closure
+evaluation is the dominant cost.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+def _flat(tensors):
+    return jnp.concatenate([t.reshape(-1) for t in tensors])
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    if bounds is not None:
+        xmin_bound, xmax_bound = bounds
+    else:
+        xmin_bound, xmax_bound = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_square = d1 ** 2 - g1 * g2
+    if d2_square >= 0:
+        d2 = d2_square ** 0.5
+        if x1 <= x2:
+            min_pos = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            min_pos = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return min(max(min_pos, xmin_bound), xmax_bound)
+    return (xmin_bound + xmax_bound) / 2.0
+
+
+def _strong_wolfe(obj_func, x_init, t, d, f, g, gtd, c1=1e-4, c2=0.9,
+                  tolerance_change=1e-9, max_ls=25):
+    """torch-style strong-Wolfe line search. obj_func(x, t, d) -> (f, g)."""
+    d_norm = float(jnp.abs(d).max())
+    g = jnp.array(g)
+    f_new, g_new = obj_func(x_init, t, d)
+    ls_func_evals = 1
+    gtd_new = float(g_new @ d)
+
+    t_prev, f_prev, g_prev, gtd_prev = 0.0, f, g, gtd
+    done = False
+    ls_iter = 0
+    while ls_iter < max_ls:
+        if f_new > (f + c1 * t * gtd) or (ls_iter > 1 and f_new >= f_prev):
+            bracket = [t_prev, t]
+            bracket_f = [f_prev, f_new]
+            bracket_g = [g_prev, g_new]
+            bracket_gtd = [gtd_prev, gtd_new]
+            break
+        if abs(gtd_new) <= -c2 * gtd:
+            bracket = [t]
+            bracket_f = [f_new]
+            bracket_g = [g_new]
+            done = True
+            break
+        if gtd_new >= 0:
+            bracket = [t_prev, t]
+            bracket_f = [f_prev, f_new]
+            bracket_g = [g_prev, g_new]
+            bracket_gtd = [gtd_prev, gtd_new]
+            break
+        min_step = t + 0.01 * (t - t_prev)
+        max_step = t * 10
+        tmp = t
+        t = _cubic_interpolate(t_prev, f_prev, gtd_prev, t, f_new, gtd_new,
+                               bounds=(min_step, max_step))
+        t_prev, f_prev, g_prev, gtd_prev = tmp, f_new, g_new, gtd_new
+        f_new, g_new = obj_func(x_init, t, d)
+        ls_func_evals += 1
+        gtd_new = float(g_new @ d)
+        ls_iter += 1
+    else:
+        bracket = [0, t]
+        bracket_f = [f, f_new]
+        bracket_g = [g, g_new]
+        bracket_gtd = [gtd, gtd_new]
+
+    insuf_progress = False
+    low_pos, high_pos = (0, 1) if bracket_f[0] <= bracket_f[-1] else (1, 0)
+    while not done and ls_iter < max_ls:
+        if abs(bracket[1] - bracket[0]) * d_norm < tolerance_change:
+            break
+        t = _cubic_interpolate(bracket[0], bracket_f[0], bracket_gtd[0],
+                               bracket[1], bracket_f[1], bracket_gtd[1])
+        eps = 0.1 * abs(bracket[1] - bracket[0])
+        if min(max(bracket) - t, t - min(bracket)) < eps:
+            if insuf_progress or t >= max(bracket) or t <= min(bracket):
+                t = (max(bracket) - eps if abs(t - max(bracket))
+                     < abs(t - min(bracket)) else min(bracket) + eps)
+                insuf_progress = False
+            else:
+                insuf_progress = True
+        else:
+            insuf_progress = False
+        f_new, g_new = obj_func(x_init, t, d)
+        ls_func_evals += 1
+        gtd_new = float(g_new @ d)
+        ls_iter += 1
+        if f_new > (f + c1 * t * gtd) or f_new >= bracket_f[low_pos]:
+            bracket[high_pos] = t
+            bracket_f[high_pos] = f_new
+            bracket_g[high_pos] = g_new
+            bracket_gtd[high_pos] = gtd_new
+            low_pos, high_pos = ((0, 1) if bracket_f[0] <= bracket_f[1]
+                                 else (1, 0))
+        else:
+            if abs(gtd_new) <= -c2 * gtd:
+                done = True
+            elif gtd_new * (bracket[high_pos] - bracket[low_pos]) >= 0:
+                bracket[high_pos] = bracket[low_pos]
+                bracket_f[high_pos] = bracket_f[low_pos]
+                bracket_g[high_pos] = bracket_g[low_pos]
+                bracket_gtd[high_pos] = bracket_gtd[low_pos]
+            bracket[low_pos] = t
+            bracket_f[low_pos] = f_new
+            bracket_g[low_pos] = g_new
+            bracket_gtd[low_pos] = gtd_new
+
+    t = bracket[low_pos] if len(bracket) > 1 else bracket[0]
+    f_new = bracket_f[low_pos] if len(bracket) > 1 else bracket_f[0]
+    g_new = bracket_g[low_pos] if len(bracket) > 1 else bracket_g[0]
+    return f_new, g_new, t, ls_func_evals
+
+
+class LBFGS(Optimizer):
+    """ref: paddle.optimizer.LBFGS — `step(closure)` API; closure clears
+    grads, computes the loss, calls backward, and returns the loss."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name=name)
+        if max_eval is None:
+            max_eval = max_iter * 5 // 4
+        self._max_iter = max_iter
+        self._max_eval = max_eval
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history_size = history_size
+        self._line_search_fn = line_search_fn
+        self._state = {}
+
+    # -- eager plumbing -----------------------------------------------------
+    def _params(self):
+        # plain Tensors (x.stop_gradient=False) are accepted like the
+        # reference; Parameters additionally honor .trainable
+        return [p for p in (self._parameter_list or [])
+                if getattr(p, "trainable", not p.stop_gradient)]
+
+    def _gather_flat_grad(self):
+        wd = self._weight_decay
+        gs = {}
+        for i, p in enumerate(self._params()):
+            g = p._grad_value
+            g = (jnp.zeros_like(p._value) if g is None
+                 else jnp.asarray(g, p._value.dtype))
+            if wd:
+                g = g + wd * p._value  # coupled L2, like the reference
+            gs[i] = g
+        if self._grad_clip is not None:
+            gs = self._grad_clip.apply(gs)
+        return jnp.concatenate([g.reshape(-1) for g in gs.values()])
+
+    def _set_flat_params(self, flat):
+        off = 0
+        for p in self._params():
+            n = int(p._value.size)
+            p._value = flat[off:off + n].reshape(p._value.shape) \
+                .astype(p._value.dtype)
+            off += n
+
+    def _gather_flat_params(self):
+        return _flat([p._value for p in self._params()])
+
+    def _directional_evaluate(self, closure, x, t, d):
+        self._set_flat_params(x + t * d)
+        loss = closure()
+        fv = float(loss._value if isinstance(loss, Tensor) else loss)
+        g = self._gather_flat_grad()
+        self._set_flat_params(x)
+        return fv, g
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that "
+                             "re-evaluates the model and returns the loss")
+        st = self._state
+        lr = self.get_lr()
+
+        loss = closure()
+        orig_loss = loss
+        fv = float(loss._value if isinstance(loss, Tensor) else loss)
+        current_evals = 1
+        flat_grad = self._gather_flat_grad()
+        if float(jnp.abs(flat_grad).max()) <= self._tol_grad:
+            return orig_loss
+
+        d = st.get("d")
+        t = st.get("t", lr)
+        old_sk = st.setdefault("old_sk", [])
+        old_yk = st.setdefault("old_yk", [])
+        ro = st.setdefault("ro", [])
+        prev_flat_grad = st.get("prev_flat_grad")
+        h_diag = st.get("h_diag", 1.0)
+
+        n_iter = 0
+        while n_iter < self._max_iter:
+            n_iter += 1
+            if n_iter == 1 and prev_flat_grad is None:
+                d = -flat_grad
+                h_diag = 1.0
+            else:
+                y = flat_grad - prev_flat_grad
+                s = d * t
+                ys = float(y @ s)
+                if ys > 1e-10:
+                    if len(old_yk) == self._history_size:
+                        old_yk.pop(0)
+                        old_sk.pop(0)
+                        ro.pop(0)
+                    old_yk.append(y)
+                    old_sk.append(s)
+                    ro.append(1.0 / ys)
+                    h_diag = ys / float(y @ y)
+                num_old = len(old_yk)
+                al = [0.0] * num_old
+                q = -flat_grad
+                for i in range(num_old - 1, -1, -1):
+                    al[i] = float(old_sk[i] @ q) * ro[i]
+                    q = q - al[i] * old_yk[i]
+                d = q * h_diag
+                for i in range(num_old):
+                    be_i = float(old_yk[i] @ d) * ro[i]
+                    d = d + old_sk[i] * (al[i] - be_i)
+            prev_flat_grad = flat_grad
+
+            if n_iter == 1:
+                t = min(1.0, 1.0 / float(jnp.abs(flat_grad).sum())) * lr
+            else:
+                t = lr
+
+            gtd = float(flat_grad @ d)
+            if gtd > -self._tol_change:
+                break
+
+            if self._line_search_fn is not None:
+                if self._line_search_fn != "strong_wolfe":
+                    raise ValueError("only 'strong_wolfe' is supported")
+                x_init = self._gather_flat_params()
+
+                def obj_func(x, t, d):
+                    return self._directional_evaluate(closure, x, t, d)
+
+                fv, flat_grad, t, ls_evals = _strong_wolfe(
+                    obj_func, x_init, t, d, fv, flat_grad, gtd,
+                    tolerance_change=self._tol_change)
+                self._set_flat_params(x_init + t * d)
+                current_evals += ls_evals
+            else:
+                self._set_flat_params(self._gather_flat_params() + t * d)
+                if n_iter != self._max_iter:
+                    loss = closure()
+                    fv = float(loss._value if isinstance(loss, Tensor)
+                               else loss)
+                    flat_grad = self._gather_flat_grad()
+                    current_evals += 1
+
+            if current_evals >= self._max_eval:
+                break
+            if float(jnp.abs(flat_grad).max()) <= self._tol_grad:
+                break
+            if float(jnp.abs(d * t).max()) <= self._tol_change:
+                break
+
+        st["d"], st["t"] = d, t
+        st["prev_flat_grad"] = prev_flat_grad
+        st["h_diag"] = h_diag
+        return orig_loss
+
+    # functional Engine path intentionally unsupported: LBFGS is a
+    # closure-driven host-loop algorithm (ref has the same eager-only shape)
+    def init_state(self, params):
+        raise NotImplementedError(
+            "LBFGS is closure-driven (multiple loss evaluations per step) "
+            "and runs on the eager path only — use opt.step(closure)")

@@ -13,8 +13,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import itertools
+
 from . import framework
 from .autograd import apply_op, backward as _backward
+
+_hook_id_counter = itertools.count()
 
 _tensor_method_registry = {}
 
@@ -131,8 +135,8 @@ class Tensor:
         if self._grad_hooks is None:
             self._grad_hooks = {}
         hooks = self._grad_hooks
-        hid = (max(hooks) + 1) if hooks else 0
-        hooks[hid] = hook
+        hid = next(_hook_id_counter)  # monotonic: stale handles can never
+        hooks[hid] = hook             # alias a later registration's id
 
         class _Handle:
             def remove(h, _hooks=hooks, _id=hid):

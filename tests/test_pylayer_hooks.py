@@ -78,6 +78,24 @@ class TestPyLayerEager:
         y = DoubleBack.apply(x)
         assert np.allclose(_np(y), np.tanh(1.0), atol=1e-6)
 
+    def test_identity_passthrough_no_self_cycle(self):
+        # regression: forward returning an input unchanged created a
+        # self-cycle GradNode that the toposort silently dropped
+        class Ident(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                return x
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 3.0  # marker so we know this ran
+
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        h = x * 2.0        # upstream op that must also receive grads
+        y = Ident.apply(h)
+        y.backward()
+        assert np.allclose(_np(x.grad), 6.0)  # 3 (custom) * 2 (upstream)
+
 
 class TestPyLayerTraced:
     def test_inside_jax_grad(self):
@@ -191,6 +209,18 @@ class TestRegisterHook:
         h1.remove()
         (x * 1.0).backward()
         assert np.allclose(_np(x.grad), 10.0)  # second registration fires
+
+    def test_stale_handle_cannot_alias_new_registration(self):
+        # regression: ids were max+1, so remove+register reused an id and a
+        # stale handle's second remove() killed the new hook
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        x.register_hook(lambda g: g)          # id a
+        h2 = x.register_hook(lambda g: g)     # id b
+        h2.remove()
+        x.register_hook(lambda g: g * 10.0)   # new id, must not equal b
+        h2.remove()  # stale second remove
+        (x * 1.0).backward()
+        assert np.allclose(_np(x.grad), 10.0)
 
     def test_deepcopy_does_not_share_hooks(self):
         import copy
