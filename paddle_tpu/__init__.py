@@ -61,3 +61,4 @@ from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
 from . import audio  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
+from . import quantization  # noqa: E402,F401

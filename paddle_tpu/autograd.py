@@ -415,15 +415,10 @@ class PyLayer(metaclass=PyLayerMeta):
         if not needs_grad:
             return out
 
-        # pass-through outputs (forward returns an input unchanged) must
-        # become fresh views: a tensor that is simultaneously a node input
-        # and output would self-cycle the toposort and silently drop the
-        # node from backward
-        in_ids = {id(t) for t in tensors}
-        out = jax.tree_util.tree_map(
-            lambda t: Tensor(t._value, stop_gradient=False)
-            if _is_tensor(t) and id(t) in in_ids else t,
-            out, is_leaf=_is_tensor)
+        # pass-through outputs cannot self-cycle the toposort: forward only
+        # ever sees the REBUILT input wrappers (rebuild() above), never the
+        # caller's tensors, so a returned input is already a distinct
+        # object from the node's recorded inputs
         out_flat = [t for t in jax.tree_util.tree_leaves(
             out, is_leaf=_is_tensor) if _is_tensor(t)]
 

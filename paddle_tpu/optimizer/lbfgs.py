@@ -214,9 +214,11 @@ class LBFGS(Optimizer):
         prev_flat_grad = st.get("prev_flat_grad")
         h_diag = st.get("h_diag", 1.0)
 
+        prev_fv = None
         n_iter = 0
         while n_iter < self._max_iter:
             n_iter += 1
+            st["n_iter_total"] = st.get("n_iter_total", 0) + 1
             if n_iter == 1 and prev_flat_grad is None:
                 d = -flat_grad
                 h_diag = 1.0
@@ -245,7 +247,9 @@ class LBFGS(Optimizer):
                     d = d + old_sk[i] * (al[i] - be_i)
             prev_flat_grad = flat_grad
 
-            if n_iter == 1:
+            # trial-step rescale applies only on the FIRST-EVER iteration
+            # (reference: state n_iter == 1, cumulative across step() calls)
+            if st["n_iter_total"] == 1:
                 t = min(1.0, 1.0 / float(jnp.abs(flat_grad).sum())) * lr
             else:
                 t = lr
@@ -282,6 +286,11 @@ class LBFGS(Optimizer):
                 break
             if float(jnp.abs(d * t).max()) <= self._tol_change:
                 break
+            # reference's flat-loss criterion: stop when the loss stops
+            # moving even though grad/step tolerances haven't triggered
+            if prev_fv is not None and abs(fv - prev_fv) < self._tol_change:
+                break
+            prev_fv = fv
 
         st["d"], st["t"] = d, t
         st["prev_flat_grad"] = prev_flat_grad

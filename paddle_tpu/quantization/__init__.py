@@ -1,0 +1,30 @@
+"""paddle.quantization parity (ref: python/paddle/quantization/).
+
+TPU-native quantization-aware training and post-training quantization:
+
+- fake-quant runs INSIDE the jitted train step as pure ops with a
+  straight-through estimator (jnp.round has zero gradient; the STE is the
+  `x + stop_gradient(q - x)` identity), so QAT costs one fused
+  multiply-round-clip per quantized tensor — no custom kernels needed;
+- observers are functional: they fold the running absmax into the layer's
+  buffer dict, so calibration (PTQ) is just forward passes under the
+  normal Engine/eager machinery;
+- `convert` produces an inference model whose weights are materialized
+  int8 with per-channel scales — int8 matmuls lower onto the v5e int8
+  MXU path (394 TOPS) via lax.dot_general preferred_element_type.
+"""
+from .config import QuantConfig  # noqa: F401
+from .observers import AbsmaxObserver, EMAObserver  # noqa: F401
+from .quanters import (  # noqa: F401
+    FakeQuanterWithAbsMax, FakeQuanterChannelWiseAbsMax, quant_dequant,
+)
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .layers import Int8InferLinear, QuantedConv2D, QuantedLinear  # noqa: F401
+
+__all__ = [
+    "QuantConfig", "AbsmaxObserver", "EMAObserver",
+    "FakeQuanterWithAbsMax", "FakeQuanterChannelWiseAbsMax",
+    "quant_dequant", "QAT", "PTQ", "QuantedLinear", "QuantedConv2D",
+    "Int8InferLinear",
+]

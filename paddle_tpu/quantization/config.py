@@ -1,0 +1,41 @@
+"""QuantConfig (ref: python/paddle/quantization/config.py).
+
+Maps layer types / names to (activation quanter factory, weight quanter
+factory). The default covers Linear and Conv2D like the reference's
+`add_type_config` common path.
+"""
+from __future__ import annotations
+
+__all__ = ["QuantConfig"]
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        """activation/weight: factory callables returning quanter/observer
+        Layers (e.g. `lambda: FakeQuanterWithAbsMax(8)`), applied as the
+        global default."""
+        self._default = (activation, weight)
+        self._type_configs = {}
+        self._name_configs = {}
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        for t in layer_types:
+            self._type_configs[t] = (activation, weight)
+        return self
+
+    def add_name_config(self, names, activation=None, weight=None):
+        if not isinstance(names, (list, tuple)):
+            names = [names]
+        for n in names:
+            self._name_configs[n] = (activation, weight)
+        return self
+
+    def lookup(self, layer, name):
+        if name in self._name_configs:
+            return self._name_configs[name]
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return self._default

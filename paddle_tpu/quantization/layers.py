@@ -1,0 +1,126 @@
+"""Quant-wrapped layers (ref: python/paddle/nn/quant/ qat layers).
+
+QuantedLinear/QuantedConv2D wrap an existing float layer: activations pass
+through the activation quanter, weights through the weight quanter, then
+the original op runs. `convert()` (see qat.py) turns these into int8-
+weight inference layers whose matmul runs on the int8 MXU path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import apply_op
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["QuantedLinear", "QuantedConv2D", "Int8InferLinear"]
+
+
+class QuantedLinear(Layer):
+    """QAT wrapper for nn.Linear."""
+
+    def __init__(self, float_layer, activation_quanter=None,
+                 weight_quanter=None):
+        super().__init__()
+        self._inner = float_layer
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    @property
+    def weight(self):
+        return self._inner.weight
+
+    @property
+    def bias(self):
+        return self._inner.bias
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self._inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self._inner.bias)
+
+
+class QuantedConv2D(Layer):
+    """QAT wrapper for nn.Conv2D."""
+
+    def __init__(self, float_layer, activation_quanter=None,
+                 weight_quanter=None):
+        super().__init__()
+        self._inner = float_layer
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    @property
+    def weight(self):
+        return self._inner.weight
+
+    @property
+    def bias(self):
+        return self._inner.bias
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self._inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        inner = self._inner
+        return F.conv2d(x, w, inner.bias, inner._stride, inner._padding,
+                        inner._dilation, inner._groups)
+
+
+class Int8InferLinear(Layer):
+    """Converted inference layer: int8 weights + per-channel fp scales.
+
+    The matmul computes in int8 x int8 -> int32 on the MXU
+    (preferred_element_type=jnp.int32), then applies the combined
+    activation/weight scales — the standard TPU int8 serving formulation.
+    """
+
+    def __init__(self, w_int8, w_scale, bias, act_scale=None, bit_length=8):
+        super().__init__()
+        self.register_buffer("w_int8", to_tensor(w_int8))
+        self.register_buffer("w_scale", to_tensor(w_scale))
+        self.register_buffer("bias_t",
+                             to_tensor(bias) if bias is not None else None)
+        self.register_buffer(
+            "act_scale",
+            to_tensor(act_scale) if act_scale is not None else None)
+        self.bit_length = bit_length
+
+    def forward(self, x):
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+
+        def f(xv, w8, ws, *rest):
+            rest = list(rest)
+            asv = rest.pop(0) if self.act_scale is not None else None
+            bv = rest.pop(0) if self.bias_t is not None else None
+            if asv is not None:
+                # quantize activations on the fly: int8 x int8 -> int32
+                xq = jnp.clip(jnp.round(xv / jnp.maximum(asv, 1e-9) * qmax),
+                              -qmax, qmax).astype(jnp.int8)
+                acc = jax.lax.dot_general(
+                    xq, w8, (((xq.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out = acc.astype(jnp.float32) \
+                    * (asv / qmax) * (ws[None, :] / qmax)
+            else:
+                # weight-only quant: dequantize weights into the matmul
+                w = w8.astype(xv.dtype) * (ws[None, :] / qmax).astype(xv.dtype)
+                out = xv @ w
+            if bv is not None:
+                out = out + bv
+            return out.astype(xv.dtype) if asv is None else out
+
+        args = [x if isinstance(x, Tensor) else to_tensor(x),
+                self.w_int8, self.w_scale]
+        if self.act_scale is not None:
+            args.append(self.act_scale)
+        if self.bias_t is not None:
+            args.append(self.bias_t)
+        return apply_op(f, *args)

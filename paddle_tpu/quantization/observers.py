@@ -1,0 +1,58 @@
+"""Calibration observers (ref: python/paddle/quantization/observers/).
+
+Observers watch activations during PTQ calibration forwards and expose the
+resulting scale. State lives in buffers so calibration works through the
+same functional machinery as training.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd import apply_op
+from ..nn.layer import Layer
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["AbsmaxObserver", "EMAObserver"]
+
+
+class AbsmaxObserver(Layer):
+    """ref: AbsmaxObserver — running max of |x| over calibration batches."""
+
+    def __init__(self, bit_length=8, name=None):
+        super().__init__()
+        self.bit_length = bit_length
+        self.register_buffer("scale", to_tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        t = x if isinstance(x, Tensor) else to_tensor(x)
+        cur = apply_op(lambda a: jnp.max(jnp.abs(a)).astype(jnp.float32),
+                       t, differentiable=False)
+        # in-place buffer value update (see quanters.py note)
+        self.scale._value = jnp.maximum(self.scale._value, cur._value)
+        return x
+
+    def quant_axis(self):
+        return None
+
+
+class EMAObserver(Layer):
+    """ref: EMDObserver-family — exponential moving average of absmax."""
+
+    def __init__(self, bit_length=8, moving_rate=0.9, name=None):
+        super().__init__()
+        self.bit_length = bit_length
+        self.moving_rate = moving_rate
+        self.register_buffer("scale", to_tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        t = x if isinstance(x, Tensor) else to_tensor(x)
+        cur = apply_op(lambda a: jnp.max(jnp.abs(a)).astype(jnp.float32),
+                       t, differentiable=False)
+        r = self.moving_rate
+        s = self.scale._value
+        self.scale._value = jnp.where(s > 0, r * s + (1 - r) * cur._value,
+                                      cur._value)
+        return x
+
+    def quant_axis(self):
+        return None

@@ -1,0 +1,37 @@
+"""PTQ driver (ref: python/paddle/quantization/ptq.py).
+
+`PTQ(config).quantize(model)` inserts observers; run calibration forwards;
+`convert(model)` freezes observed scales into int8 inference layers.
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..nn.layers_common import Linear
+from ..nn.layers_conv import Conv2D
+from .config import QuantConfig
+from .layers import QuantedConv2D, QuantedLinear
+from .observers import AbsmaxObserver
+from .qat import QAT
+from .quanters import FakeQuanterChannelWiseAbsMax
+
+__all__ = ["PTQ"]
+
+
+def _default_ptq_config():
+    cfg = QuantConfig(
+        activation=lambda: AbsmaxObserver(8),
+        weight=lambda: FakeQuanterChannelWiseAbsMax(8, channel_axis=1))
+    cfg.add_type_config(
+        Conv2D,
+        activation=lambda: AbsmaxObserver(8),
+        weight=lambda: FakeQuanterChannelWiseAbsMax(8, channel_axis=0))
+    return cfg
+
+
+class PTQ(QAT):
+    """ref: paddle.quantization.PTQ — observer insertion + convert. The
+    quantize/convert walks are shared with QAT; only the default config
+    (observers instead of trainable fake-quanters) differs."""
+
+    def __init__(self, config: QuantConfig = None):
+        super().__init__(config or _default_ptq_config())

@@ -1,0 +1,98 @@
+"""QAT driver (ref: python/paddle/quantization/qat.py).
+
+`QAT(config).quantize(model)` swaps Linear/Conv2D sublayers for quant
+wrappers in place (returns the same model object, like the reference's
+in-place=True default); `convert(model)` materializes int8 inference
+layers from the learned scales.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..nn.layers_common import Linear
+from ..nn.layers_conv import Conv2D
+from .config import QuantConfig
+from .layers import Int8InferLinear, QuantedConv2D, QuantedLinear
+from .quanters import FakeQuanterChannelWiseAbsMax, FakeQuanterWithAbsMax
+
+__all__ = ["QAT"]
+
+_WRAP = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+def _default_config():
+    cfg = QuantConfig(
+        activation=lambda: FakeQuanterWithAbsMax(8),
+        weight=lambda: FakeQuanterChannelWiseAbsMax(
+            8, channel_axis=1))  # Linear weight [in, out]: per-out-feature
+    cfg.add_type_config(
+        Conv2D,
+        activation=lambda: FakeQuanterWithAbsMax(8),
+        weight=lambda: FakeQuanterChannelWiseAbsMax(8, channel_axis=0))
+    return cfg
+
+
+class QAT:
+    """ref: paddle.quantization.QAT."""
+
+    def __init__(self, config: QuantConfig = None):
+        self._config = config or _default_config()
+
+    def quantize(self, model: Layer, inplace=True):
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._walk(model, prefix="")
+        return model
+
+    def _walk(self, layer: Layer, prefix: str):
+        for name, sub in list(layer._sub_layers.items()):
+            if sub is None:
+                continue
+            full = f"{prefix}.{name}" if prefix else name
+            wrap = _WRAP.get(type(sub))
+            if wrap is not None:
+                act_f, w_f = self._config.lookup(sub, full)
+                if act_f is None and w_f is None:
+                    continue
+                layer._sub_layers[name] = wrap(
+                    sub,
+                    activation_quanter=act_f() if act_f else None,
+                    weight_quanter=w_f() if w_f else None)
+            else:
+                self._walk(sub, full)
+
+    def convert(self, model: Layer, inplace=True):
+        """Materialize int8 inference layers from the QAT wrappers
+        (Linear only; quantized conv serving falls back to fake-quant)."""
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
+        self._convert_walk(model)
+        model.eval()
+        return model
+
+    def _convert_walk(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if sub is None:
+                continue
+            if isinstance(sub, QuantedLinear):
+                inner = sub._inner
+                w = np.asarray(inner.weight._value, np.float32)
+                qmax = 127.0
+                ws = np.maximum(np.abs(w).max(axis=0), 1e-9)  # per out-feat
+                w_int8 = np.clip(np.round(w / ws[None, :] * qmax),
+                                 -qmax, qmax).astype(np.int8)
+                act_scale = None
+                aq = sub.activation_quanter
+                if aq is not None and hasattr(aq, "scale"):
+                    s = float(np.asarray(aq.scale._value))
+                    if s > 0:
+                        act_scale = jnp.float32(s)
+                bias = inner.bias._value if inner.bias is not None else None
+                layer._sub_layers[name] = Int8InferLinear(
+                    w_int8, ws.astype(np.float32), bias, act_scale)
+            elif isinstance(sub, Layer):
+                self._convert_walk(sub)

@@ -1,0 +1,100 @@
+"""Fake quanters (ref: python/paddle/quantization/quanters/abs_max.py).
+
+`quant_dequant` is the core primitive: symmetric int-k fake quantization
+with a straight-through gradient, expressed as `x + sg(qdq(x) - x)` so it
+is exact under jit/grad AND on the eager tape without custom vjp rules.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import apply_op
+from ..nn.layer import Layer
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["quant_dequant", "FakeQuanterWithAbsMax",
+           "FakeQuanterChannelWiseAbsMax"]
+
+
+def _qdq(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def quant_dequant(x, scale, bit_length=8, channel_axis=None, name=None):
+    """Symmetric fake quant-dequant with straight-through gradients.
+
+    scale: per-tensor scalar or per-channel vector (paired with
+    channel_axis)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+
+    def f(xv, sv):
+        if channel_axis is not None:
+            shape = [1] * xv.ndim
+            shape[channel_axis] = -1
+            sv = sv.reshape(shape)
+        qd = _qdq(xv, sv, qmax)
+        # straight-through: forward = qd, gradient = identity w.r.t. x
+        return xv + jax.lax.stop_gradient(qd - xv)
+
+    t = x if isinstance(x, Tensor) else to_tensor(x)
+    s = scale if isinstance(scale, Tensor) else to_tensor(scale)
+    return apply_op(f, t, s)
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """ref: FakeQuanterWithAbsMaxObserver — per-tensor absmax scale with
+    EMA tracking during training (scale is a buffer: it rides the jitted
+    step's buffer dict, no host sync)."""
+
+    def __init__(self, bit_length=8, moving_rate=0.9, name=None):
+        super().__init__()
+        self.bit_length = bit_length
+        self.moving_rate = moving_rate
+        self.register_buffer("scale", to_tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        t = x if isinstance(x, Tensor) else to_tensor(x)
+        if self.training:
+            cur = apply_op(lambda a: jnp.max(jnp.abs(a)).astype(jnp.float32),
+                           t, differentiable=False)
+            r = self.moving_rate
+            new_scale = apply_op(
+                lambda s, c: jnp.where(s > 0, r * s + (1 - r) * c, c),
+                self.scale, cur, differentiable=False)
+            # IN-PLACE buffer value update (BatchNorm pattern): the Engine's
+            # functional_call captures the buffer OBJECT, so rebinding the
+            # attribute would lose the traced update
+            self.scale._value = new_scale._value
+            use = new_scale
+        else:
+            use = self.scale
+        out = quant_dequant(t, use, self.bit_length)
+        # uncalibrated (scale == 0, e.g. eval before any training forward):
+        # pass through unquantized instead of collapsing everything to ~0
+        return apply_op(lambda o, xv, s: jnp.where(s > 0, o, xv),
+                        out, t, use)
+
+
+class FakeQuanterChannelWiseAbsMax(Layer):
+    """ref: FakeQuanterChannelWiseAbsMax — per-output-channel scales for
+    weights (axis 0 for Linear [in,out]->axis 1? The reference quantizes
+    conv weights per out-channel (axis 0 of OIHW) and linear weights per
+    out-feature (axis 1 of [in, out]))."""
+
+    def __init__(self, bit_length=8, channel_axis=0, name=None):
+        super().__init__()
+        self.bit_length = bit_length
+        self.channel_axis = channel_axis
+
+    def forward(self, w):
+        t = w if isinstance(w, Tensor) else to_tensor(w)
+        ax = self.channel_axis
+
+        def scales(a):
+            red = tuple(i for i in range(a.ndim) if i != ax)
+            return jnp.max(jnp.abs(a), axis=red).astype(jnp.float32)
+        s = apply_op(scales, t, differentiable=False)
+        return quant_dequant(t, s, self.bit_length, channel_axis=ax)

@@ -1,0 +1,168 @@
+"""paddle.quantization: fake-quant math, STE gradients, QAT swap+train,
+PTQ calibrate+convert, int8 inference parity."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import quantization as Q
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+class TestQuantDequant:
+    def test_values_on_grid(self):
+        x = paddle.to_tensor(np.array([-1.0, -0.5, 0.0, 0.4, 1.0],
+                                      np.float32))
+        out = _np(Q.quant_dequant(x, paddle.to_tensor(1.0), bit_length=8))
+        # every output is k/127 for integer k; max error <= 0.5/127
+        k = out * 127
+        assert np.allclose(k, np.round(k), atol=1e-4)
+        assert np.abs(out - _np(x)).max() <= 0.5 / 127 + 1e-6
+
+    def test_straight_through_gradient(self):
+        x = paddle.to_tensor(np.array([0.3, -0.8], np.float32),
+                             stop_gradient=False)
+        y = Q.quant_dequant(x, paddle.to_tensor(1.0))
+        y.sum().backward()
+        assert np.allclose(_np(x.grad), 1.0)  # identity grad (STE)
+
+    def test_per_channel(self):
+        w = np.array([[1.0, 10.0], [-2.0, 20.0]], np.float32)  # [in, out]
+        s = np.array([2.0, 20.0], np.float32)
+        out = _np(Q.quant_dequant(paddle.to_tensor(w), paddle.to_tensor(s),
+                                  channel_axis=1))
+        assert np.abs(out - w).max() < 20.0 / 127 + 1e-5
+
+    def test_clipping(self):
+        x = paddle.to_tensor(np.array([5.0], np.float32))
+        out = _np(Q.quant_dequant(x, paddle.to_tensor(1.0)))
+        assert np.allclose(out, 1.0, atol=1e-6)  # clipped to scale
+
+
+class TestQAT:
+    def _net(self):
+        paddle.seed(0)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(8, 16)
+                self.fc2 = nn.Linear(16, 4)
+
+            def forward(self, x):
+                return self.fc2(nn.functional.relu(self.fc1(x)))
+        return Net()
+
+    def test_quantize_swaps_layers(self):
+        net = self._net()
+        q = Q.QAT()
+        q.quantize(net)
+        assert isinstance(net._sub_layers["fc1"], Q.QuantedLinear)
+        assert isinstance(net._sub_layers["fc2"], Q.QuantedLinear)
+
+    def test_qat_trains_eager(self):
+        net = self._net()
+        Q.QAT().quantize(net)
+        net.train()
+        opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+        rng = np.random.default_rng(0)
+        x = paddle.to_tensor(rng.standard_normal((32, 8)).astype("float32"))
+        y = paddle.to_tensor(rng.integers(0, 4, 32))
+        lossfn = paddle.nn.CrossEntropyLoss()
+        first = None
+        for _ in range(25):
+            loss = lossfn(net(x), y)
+            first = first if first is not None else float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < first * 0.7
+
+    def test_qat_through_engine(self):
+        from paddle_tpu.hapi.engine import Engine
+        net = self._net()
+        Q.QAT().quantize(net)
+        net.train()
+        eng = Engine(net, loss=paddle.nn.CrossEntropyLoss(),
+                     optimizer=paddle.optimizer.Adam(
+                         1e-2, parameters=net.parameters()))
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.standard_normal((16, 8)).astype("float32"))
+        y = paddle.to_tensor(rng.integers(0, 4, 16))
+        l0, _ = eng.train_batch([x], [y])
+        for _ in range(10):
+            l, _ = eng.train_batch([x], [y])
+        assert float(l) < float(l0)
+        # the EMA scale buffer updated inside the jitted step
+        aq = net._sub_layers["fc1"].activation_quanter
+        assert float(_np(aq.scale)) > 0
+
+    def test_quantized_close_to_float(self):
+        net = self._net()
+        rng = np.random.default_rng(2)
+        x = paddle.to_tensor(rng.standard_normal((8, 8)).astype("float32"))
+        ref = _np(net(x))
+        Q.QAT().quantize(net)
+        net.eval()
+        # run once in train mode to set activation scales
+        net.train()
+        net(x)
+        net.eval()
+        out = _np(net(x))
+        assert np.abs(out - ref).max() < 0.15  # int8 error bound
+
+
+class TestUncalibratedEval:
+    def test_eval_before_training_passes_through(self):
+        # regression: eval with a never-updated scale (0) collapsed all
+        # activations to ~0 output
+        paddle.seed(5)
+        net = nn.Sequential(nn.Linear(4, 3))
+        rng = np.random.default_rng(5)
+        x = paddle.to_tensor(rng.standard_normal((2, 4)).astype("float32"))
+        ref = _np(net(x))
+        Q.QAT().quantize(net)
+        net.eval()
+        out = _np(net(x))
+        # weights still fake-quantized; activations pass through
+        assert np.abs(out).max() > 0.01
+        assert np.abs(out - ref).max() < 0.05
+
+
+class TestPTQConvert:
+    def test_ptq_calibrate_and_convert(self):
+        paddle.seed(3)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        rng = np.random.default_rng(3)
+        x = paddle.to_tensor(rng.standard_normal((64, 8)).astype("float32"))
+        ref = _np(net(x))
+
+        ptq = Q.PTQ()
+        ptq.quantize(net)
+        net.eval()
+        net(x)  # calibration forward (observers track absmax)
+        ptq.convert(net)
+        # converted: Int8InferLinear inside
+        inner = [l for _, l in net.named_sublayers()
+                 if isinstance(l, Q.Int8InferLinear)]
+        assert len(inner) == 2
+        out = _np(net(x))
+        assert np.abs(out - ref).max() < 0.25
+        # sanity: still correlated with float output
+        c = np.corrcoef(out.ravel(), ref.ravel())[0, 1]
+        assert c > 0.99
+
+    def test_weight_only_convert_without_calibration(self):
+        paddle.seed(4)
+        net = nn.Sequential(nn.Linear(6, 3))
+        rng = np.random.default_rng(4)
+        x = paddle.to_tensor(rng.standard_normal((4, 6)).astype("float32"))
+        ref = _np(net(x))
+        qat = Q.QAT()
+        qat.quantize(net)
+        qat.convert(net)  # no calibration -> act_scale None (weight-only)
+        out = _np(net(x))
+        assert np.abs(out - ref).max() < 0.05
