@@ -15,7 +15,7 @@ from .framework import (  # noqa: F401
     CPUPlace, CUDAPlace, Place, TPUPlace,
     get_default_dtype, set_default_dtype, seed, get_flags, set_flags,
     get_device, set_device, device_count, is_compiled_with_cuda,
-    is_compiled_with_tpu, in_dynamic_mode, rng_scope,
+    is_compiled_with_tpu, in_dynamic_mode, rng_scope, iinfo, finfo,
 )
 from .autograd import no_grad, enable_grad, is_grad_enabled, set_grad_enabled, grad  # noqa: F401
 from .tensor import Tensor, to_tensor  # noqa: F401
@@ -62,3 +62,5 @@ from . import signal  # noqa: E402,F401
 from . import audio  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
+from . import onnx  # noqa: E402,F401
+from . import hub  # noqa: E402,F401

@@ -245,3 +245,34 @@ def in_dynamic_mode() -> bool:
         return trace_state_clean()
     except Exception:
         return True
+
+
+class _DtypeInfo:
+    __slots__ = ("min", "max", "bits", "dtype", "eps", "tiny", "smallest_normal")
+
+    def __repr__(self):
+        return f"{type(self).__name__}(dtype={self.dtype})"
+
+
+def iinfo(dtype):
+    """ref: paddle.iinfo."""
+    import numpy as np
+    d = convert_dtype(dtype)
+    inf = np.iinfo(np.dtype(str(jnp.dtype(d))))
+    out = _DtypeInfo()
+    out.min, out.max, out.bits = int(inf.min), int(inf.max), int(inf.bits)
+    out.dtype = str(inf.dtype)
+    return out
+
+
+def finfo(dtype):
+    """ref: paddle.finfo."""
+    d = convert_dtype(dtype)
+    inf = jnp.finfo(d)
+    out = _DtypeInfo()
+    out.min, out.max, out.bits = float(inf.min), float(inf.max), int(inf.bits)
+    out.eps = float(inf.eps)
+    out.tiny = float(inf.tiny)
+    out.smallest_normal = float(inf.smallest_normal)
+    out.dtype = str(inf.dtype)
+    return out

@@ -1,0 +1,54 @@
+"""paddle.hub parity-lite (ref: python/paddle/hapi/hub.py).
+
+`list`/`help`/`load` over LOCAL hubconf.py directories work exactly like
+the reference; github/gitee sources are gated (this environment has no
+network egress, and TPU deployments typically vendor their model code).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load"]
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no hubconf.py in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise NotImplementedError(
+            "paddle_tpu.hub supports source='local' only (no network "
+            "egress on TPU pods; vendor the repo and point at its "
+            "directory)")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoints exposed by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"hubconf has no entrypoint {model!r}; "
+                         f"available: {list(repo_dir)}")
+    return fn(**kwargs)

@@ -1,0 +1,21 @@
+"""paddle.onnx gate (ref: python/paddle/onnx/export.py).
+
+ONNX export is NOT the TPU-native serialization path — `paddle.jit.save`
+emits a StableHLO artifact (`jax.export`) that reloads and runs without
+model code, which is the portable format for the XLA ecosystem. This
+module exists so `paddle.onnx.export` callers get a precise error with
+the migration path instead of an AttributeError.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=None, **configs):
+    raise NotImplementedError(
+        "paddle_tpu does not emit ONNX: the portable serialization format "
+        "here is StableHLO — use paddle_tpu.jit.save(layer, path, "
+        "input_spec=...) which produces an artifact that "
+        "paddle_tpu.jit.load can run without the model's Python code. "
+        "For ONNX interchange, export from the original framework or "
+        "convert the StableHLO module with external tooling.")

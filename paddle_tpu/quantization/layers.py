@@ -71,7 +71,7 @@ class QuantedConv2D(Layer):
             w = self.weight_quanter(w)
         inner = self._inner
         return F.conv2d(x, w, inner.bias, inner._stride, inner._padding,
-                        inner._dilation, inner._groups)
+                        inner._dilation, inner._groups, inner._data_format)
 
 
 class Int8InferLinear(Layer):
@@ -80,9 +80,13 @@ class Int8InferLinear(Layer):
     The matmul computes in int8 x int8 -> int32 on the MXU
     (preferred_element_type=jnp.int32), then applies the combined
     activation/weight scales — the standard TPU int8 serving formulation.
+
+    channel_axis: which weight axis [in, out] the scales index (1 =
+    per-out-feature, the default; 0 = per-in-feature).
     """
 
-    def __init__(self, w_int8, w_scale, bias, act_scale=None, bit_length=8):
+    def __init__(self, w_int8, w_scale, bias, act_scale=None, bit_length=8,
+                 channel_axis=1):
         super().__init__()
         self.register_buffer("w_int8", to_tensor(w_int8))
         self.register_buffer("w_scale", to_tensor(w_scale))
@@ -92,16 +96,23 @@ class Int8InferLinear(Layer):
             "act_scale",
             to_tensor(act_scale) if act_scale is not None else None)
         self.bit_length = bit_length
+        self.channel_axis = channel_axis
 
     def forward(self, x):
         qmax = float(2 ** (self.bit_length - 1) - 1)
+        ax = self.channel_axis
+
+        def _wscale(ws):
+            # broadcastable over the weight [in, out]
+            return ws[None, :] if ax == 1 else ws[:, None]
 
         def f(xv, w8, ws, *rest):
             rest = list(rest)
             asv = rest.pop(0) if self.act_scale is not None else None
             bv = rest.pop(0) if self.bias_t is not None else None
-            if asv is not None:
-                # quantize activations on the fly: int8 x int8 -> int32
+            if asv is not None and ax == 1:
+                # quantize activations on the fly: int8 x int8 -> int32;
+                # per-out-feature weight scales factor out of the K-sum
                 xq = jnp.clip(jnp.round(xv / jnp.maximum(asv, 1e-9) * qmax),
                               -qmax, qmax).astype(jnp.int8)
                 acc = jax.lax.dot_general(
@@ -110,12 +121,13 @@ class Int8InferLinear(Layer):
                 out = acc.astype(jnp.float32) \
                     * (asv / qmax) * (ws[None, :] / qmax)
             else:
-                # weight-only quant: dequantize weights into the matmul
-                w = w8.astype(xv.dtype) * (ws[None, :] / qmax).astype(xv.dtype)
-                out = xv @ w
+                # weight-only quant (or per-in-feature scales, which do not
+                # factor out of the contraction): dequantize into the matmul
+                w = w8.astype(jnp.float32) * (_wscale(ws) / qmax)
+                out = xv.astype(jnp.float32) @ w
             if bv is not None:
                 out = out + bv
-            return out.astype(xv.dtype) if asv is None else out
+            return out.astype(xv.dtype)
 
         args = [x if isinstance(x, Tensor) else to_tensor(x),
                 self.w_int8, self.w_scale]

@@ -21,9 +21,12 @@ class AbsmaxObserver(Layer):
     def __init__(self, bit_length=8, name=None):
         super().__init__()
         self.bit_length = bit_length
+        self._frozen = False  # convert() sets this: calibration ends there
         self.register_buffer("scale", to_tensor(jnp.zeros((), jnp.float32)))
 
     def forward(self, x):
+        if self._frozen:
+            return x
         t = x if isinstance(x, Tensor) else to_tensor(x)
         cur = apply_op(lambda a: jnp.max(jnp.abs(a)).astype(jnp.float32),
                        t, differentiable=False)
@@ -42,9 +45,12 @@ class EMAObserver(Layer):
         super().__init__()
         self.bit_length = bit_length
         self.moving_rate = moving_rate
+        self._frozen = False  # convert() sets this: calibration ends there
         self.register_buffer("scale", to_tensor(jnp.zeros((), jnp.float32)))
 
     def forward(self, x):
+        if self._frozen:
+            return x
         t = x if isinstance(x, Tensor) else to_tensor(x)
         cur = apply_op(lambda a: jnp.max(jnp.abs(a)).astype(jnp.float32),
                        t, differentiable=False)

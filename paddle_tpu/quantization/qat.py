@@ -80,10 +80,15 @@ class QAT:
                 continue
             if isinstance(sub, QuantedLinear):
                 inner = sub._inner
+                wq = sub.weight_quanter
+                bits = getattr(wq, "bit_length", 8)
+                ax = getattr(wq, "channel_axis", 1)
                 w = np.asarray(inner.weight._value, np.float32)
-                qmax = 127.0
-                ws = np.maximum(np.abs(w).max(axis=0), 1e-9)  # per out-feat
-                w_int8 = np.clip(np.round(w / ws[None, :] * qmax),
+                qmax = float(2 ** (bits - 1) - 1)
+                red_ax = 0 if ax == 1 else 1
+                ws = np.maximum(np.abs(w).max(axis=red_ax), 1e-9)
+                wsb = ws[None, :] if ax == 1 else ws[:, None]
+                w_int8 = np.clip(np.round(w / wsb * qmax),
                                  -qmax, qmax).astype(np.int8)
                 act_scale = None
                 aq = sub.activation_quanter
@@ -93,6 +98,11 @@ class QAT:
                         act_scale = jnp.float32(s)
                 bias = inner.bias._value if inner.bias is not None else None
                 layer._sub_layers[name] = Int8InferLinear(
-                    w_int8, ws.astype(np.float32), bias, act_scale)
+                    w_int8, ws.astype(np.float32), bias, act_scale,
+                    bit_length=bits, channel_axis=ax)
             elif isinstance(sub, Layer):
+                # freeze any observers/quanters that stay in the graph
+                # (e.g. inside QuantedConv2D): calibration ends at convert
+                if hasattr(sub, "_frozen"):
+                    sub._frozen = True
                 self._convert_walk(sub)

@@ -7,7 +7,9 @@ synthesises a deterministic corpus with the same shapes/contract
 into ~/.cache/paddle_tpu/text/<name>/ with the reference layout.
 """
 from .datasets import (  # noqa: F401
-    Imdb, Imikolov, UCIHousing, ViterbiDataset, WMT14,
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, ViterbiDataset,
+    WMT14, WMT16,
 )
 
-__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "ViterbiDataset"]
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "WMT14", "WMT16",
+           "Conll05st", "Movielens", "ViterbiDataset"]

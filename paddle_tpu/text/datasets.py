@@ -123,3 +123,61 @@ class ViterbiDataset(Dataset):
 
     def __len__(self):
         return len(self.x)
+
+
+class Conll05st(ViterbiDataset):
+    """ref: paddle.text.Conll05st — SRL sequence labeling. Synthetic
+    deterministic corpus with the reference's (tokens, predicate, tags)
+    sample shape."""
+
+    def __init__(self, mode="train", vocab=800, n_tags=18, n_samples=1500,
+                 seq_len=30):
+        super().__init__(mode=mode, vocab=vocab, n_tags=n_tags,
+                         n_samples=n_samples, seq_len=seq_len)
+        rng = _rng(10 if mode == "train" else 11)
+        self.pred = rng.integers(0, seq_len, (n_samples,)).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.pred[idx], self.y[idx]
+
+
+class Movielens(Dataset):
+    """ref: paddle.text.Movielens — rating prediction. Samples:
+    (user_id, gender, age, job, movie_id, category_vec, title_vec,
+    rating)."""
+
+    def __init__(self, mode="train", n_users=500, n_movies=800,
+                 n_samples=4000, n_cats=18, title_len=8):
+        super().__init__()
+        rng = _rng(12 if mode == "train" else 13)
+        self.samples = []
+        for _ in range(n_samples):
+            u = int(rng.integers(0, n_users))
+            m = int(rng.integers(0, n_movies))
+            gender = int(rng.integers(0, 2))
+            age = int(rng.integers(0, 7))
+            job = int(rng.integers(0, 21))
+            cats = rng.integers(0, 2, (n_cats,)).astype(np.int64)
+            title = rng.integers(1, 1000, (title_len,)).astype(np.int64)
+            # deterministic latent structure so models can learn
+            rating = np.float32(((u * 7 + m * 13) % 50) / 10.0)
+            self.samples.append((np.int64(u), np.int64(gender),
+                                 np.int64(age), np.int64(job), np.int64(m),
+                                 cats, title, rating))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class WMT16(WMT14):
+    """ref: paddle.text.WMT16 — same sample shape as WMT14 with BPE-sized
+    vocab defaults."""
+
+    def __init__(self, mode="train", src_dict_size=2000, trg_dict_size=2000,
+                 n_samples=2000, seq_len=24):
+        super().__init__(mode=mode, dict_size=min(src_dict_size,
+                                                  trg_dict_size),
+                         n_samples=n_samples, seq_len=seq_len)

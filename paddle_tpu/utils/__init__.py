@@ -77,3 +77,38 @@ class _UniqueName:
 
 
 unique_name = _UniqueName()
+
+
+# ---------------------------------------------------------------------------
+# dlpack interop (ref: python/paddle/utils/dlpack.py)
+# ---------------------------------------------------------------------------
+class dlpack:
+    """ref: paddle.utils.dlpack — zero-copy tensor exchange with other
+    frameworks (torch, numpy, ...) through the DLPack protocol. jax arrays
+    already speak __dlpack__; Tensors delegate to their backing array."""
+
+    @staticmethod
+    def to_dlpack(x):
+        from ..tensor import Tensor
+        arr = x._value if isinstance(x, Tensor) else x
+        return arr.__dlpack__()
+
+    @staticmethod
+    def from_dlpack(capsule):
+        import jax
+        import jax.numpy as jnp
+        from ..tensor import Tensor
+        if isinstance(capsule, Tensor):
+            capsule = capsule._value
+        if hasattr(capsule, "__dlpack__"):
+            # consumer-style: accept any dlpack-exporting object (torch
+            # tensor, numpy array, jax array)
+            arr = jnp.from_dlpack(capsule)
+        else:
+            arr = jax.dlpack.from_dlpack(capsule)
+        return Tensor(arr)
+
+
+to_dlpack = dlpack.to_dlpack
+from_dlpack = dlpack.from_dlpack
+__all__ += ["dlpack", "to_dlpack", "from_dlpack"]

@@ -1,0 +1,82 @@
+"""Small-API parity: dlpack, iinfo/finfo, text datasets, hub, onnx gate."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestDlpack:
+    def test_roundtrip_with_numpy(self):
+        from paddle_tpu.utils import from_dlpack, to_dlpack
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        back = from_dlpack(x)  # consumer-style from a Tensor-backed array
+        assert np.allclose(np.asarray(back.numpy()), np.asarray(x.numpy()))
+        cap = to_dlpack(x)
+        assert cap is not None
+
+    def test_from_torch(self):
+        torch = pytest.importorskip("torch")
+        from paddle_tpu.utils import from_dlpack
+        t = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+        out = from_dlpack(t)
+        assert tuple(out.shape) == (3, 4)
+        assert np.allclose(np.asarray(out.numpy()),
+                           t.numpy())
+
+
+class TestDtypeInfo:
+    def test_iinfo(self):
+        i = paddle.iinfo(paddle.int8)
+        assert i.min == -128 and i.max == 127 and i.bits == 8
+        i32 = paddle.iinfo("int32")
+        assert i32.max == 2 ** 31 - 1
+
+    def test_finfo(self):
+        f = paddle.finfo(paddle.float32)
+        assert f.bits == 32
+        assert np.isclose(f.eps, np.finfo(np.float32).eps)
+        bf = paddle.finfo(paddle.bfloat16)
+        assert bf.bits == 16
+        assert bf.max > 3e38
+
+
+class TestTextDatasets:
+    def test_conll05st_shape(self):
+        from paddle_tpu.text import Conll05st
+        d = Conll05st(mode="train", n_samples=20)
+        x, pred, y = d[0]
+        assert x.shape == y.shape
+        assert 0 <= int(pred) < x.shape[0]
+        assert len(d) == 20
+
+    def test_movielens(self):
+        from paddle_tpu.text import Movielens
+        d = Movielens(n_samples=10)
+        s = d[0]
+        assert len(s) == 8
+        assert s[5].shape == (18,)  # category vec
+        assert isinstance(float(s[7]), float)
+
+    def test_wmt16(self):
+        from paddle_tpu.text import WMT16
+        d = WMT16(n_samples=5)
+        src, tin, tout = d[0]
+        assert src.ndim == 1 and len(tin) == len(tout)
+
+
+class TestHubOnnx:
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(scale=1):\n"
+            "    'a tiny entrypoint'\n"
+            "    return {'scale': scale}\n")
+        from paddle_tpu import hub
+        assert "tiny" in hub.list(str(tmp_path))
+        assert "tiny entrypoint" in hub.help(str(tmp_path), "tiny")
+        assert hub.load(str(tmp_path), "tiny", scale=3) == {"scale": 3}
+        with pytest.raises(NotImplementedError):
+            hub.load("any/repo", "m", source="github")
+
+    def test_onnx_gate_points_to_jit_save(self):
+        with pytest.raises(NotImplementedError, match="jit.save"):
+            paddle.onnx.export(None, "model.onnx")

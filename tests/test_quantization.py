@@ -155,6 +155,56 @@ class TestPTQConvert:
         c = np.corrcoef(out.ravel(), ref.ravel())[0, 1]
         assert c > 0.99
 
+    def test_convert_honors_quanter_bits_and_axis(self):
+        # regression: convert hardcoded 8-bit per-out-feature regardless of
+        # the trained config
+        paddle.seed(6)
+        net = nn.Sequential(nn.Linear(6, 4))
+        cfg = Q.QuantConfig(
+            activation=None,
+            weight=lambda: Q.FakeQuanterChannelWiseAbsMax(4, channel_axis=0))
+        qat = Q.QAT(cfg)
+        qat.quantize(net)
+        rng = np.random.default_rng(6)
+        x = paddle.to_tensor(rng.standard_normal((4, 6)).astype("float32"))
+        fq = _np(net(x))  # 4-bit fake-quant reference
+        qat.convert(net)
+        lin = net._sub_layers["0"]
+        assert isinstance(lin, Q.Int8InferLinear)
+        assert lin.bit_length == 4 and lin.channel_axis == 0
+        out = _np(net(x))
+        assert np.allclose(out, fq, atol=1e-5)  # same grid as training
+
+    def test_observers_freeze_at_convert(self):
+        # regression: observers kept updating scales after convert
+        paddle.seed(7)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(2, 3, 3, padding=1)
+                self.fc = nn.Linear(3 * 4 * 4, 2)
+
+            def forward(self, x):
+                h = self.conv(x)
+                return self.fc(h.reshape([x.shape[0], -1]))
+
+        net = Net()
+        ptq = Q.PTQ()
+        ptq.quantize(net)
+        net.eval()
+        rng = np.random.default_rng(7)
+        x = paddle.to_tensor(rng.standard_normal((2, 2, 4, 4))
+                             .astype("float32"))
+        net(x)  # calibrate
+        ptq.convert(net)
+        obs = net._sub_layers["conv"].activation_quanter
+        s0 = float(_np(obs.scale))
+        big = paddle.to_tensor(
+            100 * rng.standard_normal((2, 2, 4, 4)).astype("float32"))
+        net(big)  # serving traffic must NOT move the scale
+        assert float(_np(obs.scale)) == s0
+
     def test_weight_only_convert_without_calibration(self):
         paddle.seed(4)
         net = nn.Sequential(nn.Linear(6, 3))
