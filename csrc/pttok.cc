@@ -1,0 +1,185 @@
+// Native WordPiece encoder (fast path for BertTokenizer).
+//
+// TPU-native rationale: tokenization is host-side work that competes with
+// the input pipeline for the single Python thread; this encoder runs the
+// basic-tokenize + greedy-longest-match loop in C++ (GIL released around
+// the ctypes call), matching paddlenlp's faster_tokenizer role
+// (ref: fast_tokenizer/fast_tokenizer/models/wordpiece.cc).
+//
+// Scope contract (checked Python-side): input text contains only ASCII or
+// CJK codepoints. Anything else (accents needing NFD stripping, unicode
+// punctuation/whitespace classes) falls back to the Python reference
+// implementation, so parity is exact by construction.
+//
+// Build: make -C csrc  ->  build/libpttok.so
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Tok {
+  std::unordered_map<std::string, int> vocab;
+  int unk_id;
+  int max_word_chars;
+};
+
+inline bool is_ascii_space(uint32_t c) {
+  // python str.split() whitespace: \t\n\v\f\r space + \x1c-\x1f
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+         c == 0x0b || (c >= 0x1c && c <= 0x1f);
+}
+
+inline bool is_ascii_punct(uint32_t c) {
+  return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+         (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
+}
+
+inline bool is_cjk(uint32_t c) {
+  return (c >= 0x4E00 && c <= 0x9FFF) || (c >= 0x3400 && c <= 0x4DBF) ||
+         (c >= 0x20000 && c <= 0x2A6DF) || (c >= 0xF900 && c <= 0xFAFF);
+}
+
+// decode one utf-8 codepoint at p (n bytes left); returns byte length, 0 on
+// malformed input
+inline int decode_utf8(const unsigned char* p, long n, uint32_t* out) {
+  if (n <= 0) return 0;
+  if (p[0] < 0x80) { *out = p[0]; return 1; }
+  if ((p[0] >> 5) == 0x6 && n >= 2) {
+    *out = ((p[0] & 0x1F) << 6) | (p[1] & 0x3F);
+    return 2;
+  }
+  if ((p[0] >> 4) == 0xE && n >= 3) {
+    *out = ((p[0] & 0x0F) << 12) | ((p[1] & 0x3F) << 6) | (p[2] & 0x3F);
+    return 3;
+  }
+  if ((p[0] >> 3) == 0x1E && n >= 4) {
+    *out = ((p[0] & 0x07) << 18) | ((p[1] & 0x3F) << 12) |
+           ((p[2] & 0x3F) << 6) | (p[3] & 0x3F);
+    return 4;
+  }
+  return 0;
+}
+
+// greedy longest-match wordpiece over a single word; appends ids
+void wordpiece(const Tok* t, const std::string& word, int n_chars,
+               std::vector<int>* out) {
+  if (n_chars > t->max_word_chars) {
+    out->push_back(t->unk_id);
+    return;
+  }
+  std::vector<int> pieces;
+  size_t start = 0;
+  while (start < word.size()) {
+    size_t end = word.size();
+    int cur = -1;
+    size_t cur_end = start;
+    while (start < end) {
+      std::string sub =
+          (start > 0 ? "##" : "") + word.substr(start, end - start);
+      auto it = t->vocab.find(sub);
+      if (it != t->vocab.end()) {
+        cur = it->second;
+        cur_end = end;
+        break;
+      }
+      // back off one CODEPOINT (not byte): find previous utf-8 boundary
+      do {
+        --end;
+      } while (end > start && (word[end] & 0xC0) == 0x80);
+    }
+    if (cur < 0) {
+      out->push_back(t->unk_id);
+      return;
+    }
+    pieces.push_back(cur);
+    start = cur_end;
+  }
+  out->insert(out->end(), pieces.begin(), pieces.end());
+}
+
+}  // namespace
+
+extern "C" {
+
+// vocab_buf: '\n'-separated tokens; ids: parallel explicit id per line
+// (vocab ids need not be contiguous — e.g. dict construction over a token
+// list with duplicates leaves holes).
+void* pttok_create(const char* vocab_buf, long n_bytes, const int* ids,
+                   int n_tokens, int unk_id, int max_word_chars) {
+  Tok* t = new Tok();
+  t->unk_id = unk_id;
+  t->max_word_chars = max_word_chars > 0 ? max_word_chars : 100;
+  const char* p = vocab_buf;
+  const char* end = vocab_buf + n_bytes;
+  int line = 0;
+  while (p < end && line < n_tokens) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    size_t len = (nl ? nl : end) - p;
+    t->vocab[std::string(p, len)] = ids[line++];
+    p += len + 1;
+  }
+  return t;
+}
+
+// Returns #ids written to out, -1 if out_cap too small, -2 if the text is
+// outside the fast path's scope (non-ASCII non-CJK codepoint) — caller
+// falls back to the Python implementation.
+int pttok_encode(void* handle, const char* text, long n_bytes, int do_lower,
+                 int* out, int out_cap) {
+  const Tok* t = static_cast<const Tok*>(handle);
+  const unsigned char* p = reinterpret_cast<const unsigned char*>(text);
+  std::vector<int> ids;
+  std::string word;
+  int word_chars = 0;
+
+  auto flush = [&]() {
+    if (!word.empty()) {
+      wordpiece(t, word, word_chars, &ids);
+      word.clear();
+      word_chars = 0;
+    }
+  };
+
+  long i = 0;
+  while (i < n_bytes) {
+    uint32_t c;
+    int len = decode_utf8(p + i, n_bytes - i, &c);
+    if (len == 0) return -2;  // malformed utf-8: punt to Python
+    if (c < 128) {
+      if (is_ascii_space(c)) {
+        flush();
+      } else if (is_ascii_punct(c)) {
+        flush();
+        word.push_back(static_cast<char>(c));
+        word_chars = 1;
+        flush();
+      } else {
+        char ch = static_cast<char>(c);
+        if (do_lower && ch >= 'A' && ch <= 'Z') ch += 32;
+        word.push_back(ch);
+        ++word_chars;
+      }
+    } else if (is_cjk(c)) {
+      flush();
+      word.assign(text + i, len);
+      word_chars = 1;
+      flush();
+    } else {
+      return -2;  // needs NFD/unicode classes: Python path
+    }
+    i += len;
+  }
+  flush();
+
+  if (static_cast<int>(ids.size()) > out_cap) return -1;
+  memcpy(out, ids.data(), ids.size() * sizeof(int));
+  return static_cast<int>(ids.size());
+}
+
+void pttok_destroy(void* handle) { delete static_cast<Tok*>(handle); }
+
+}  // extern "C"

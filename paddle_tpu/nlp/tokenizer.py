@@ -18,6 +18,62 @@ __all__ = ["BasicTokenizer", "WordpieceTokenizer", "BertTokenizer",
            "GPTTokenizer"]
 
 
+# ---------------------------------------------------------------------------
+# native fast path (csrc/pttok.cc): C++ basic-tokenize + wordpiece for
+# ASCII/CJK text — the common pretraining-corpus case. Out-of-scope text
+# (NFD accent stripping, unicode punctuation classes) returns -2 from the
+# encoder and falls back to the Python reference implementation, so parity
+# is exact by construction. ref role: paddlenlp fast_tokenizer (C++).
+# ---------------------------------------------------------------------------
+def _load_pttok():
+    import ctypes
+    import os
+    import subprocess
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(pkg)
+    candidates = (os.path.join(repo, "csrc", "build", "libpttok.so"),
+                  os.path.join(pkg, "lib", "libpttok.so"))
+    so = next((c for c in candidates if os.path.exists(c)), None)
+    if so is None:
+        src_dir = os.path.join(repo, "csrc")
+        if os.path.exists(os.path.join(src_dir, "pttok.cc")):
+            try:
+                subprocess.run(["make", "-C", src_dir], capture_output=True,
+                               timeout=60, text=True)
+            except Exception:
+                return None
+        so = candidates[0] if os.path.exists(candidates[0]) else None
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    lib.pttok_create.restype = ctypes.c_void_p
+    lib.pttok_create.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                 ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+                                 ctypes.c_int, ctypes.c_int]
+    lib.pttok_encode.restype = ctypes.c_int
+    lib.pttok_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_long, ctypes.c_int,
+                                 ctypes.POINTER(ctypes.c_int), ctypes.c_int]
+    lib.pttok_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_PTTOK_LIB = None
+_PTTOK_TRIED = False
+
+
+def _pttok():
+    global _PTTOK_LIB, _PTTOK_TRIED
+    if not _PTTOK_TRIED:
+        _PTTOK_TRIED = True
+        _PTTOK_LIB = _load_pttok()
+    return _PTTOK_LIB
+
+
 def _is_punctuation(ch):
     cp = ord(ch)
     if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) \
@@ -156,6 +212,68 @@ class BertTokenizer:
             out.extend(self.wordpiece.tokenize(word))
         return out
 
+    # -- native fast path ---------------------------------------------------
+    def _ensure_native(self):
+        if getattr(self, "_native_handle", None) is not None:
+            return self._native_handle
+        if getattr(self, "_native_failed", False):
+            return None
+        lib = _pttok()
+        if lib is None:
+            self._native_failed = True
+            return None
+        # '\n'-joined tokens + parallel explicit id array (vocab ids can be
+        # non-contiguous when built from a token list with duplicates)
+        import ctypes
+        if any("\n" in t for t in self.vocab):
+            # a newline inside a token would corrupt the line-split buffer
+            self._native_failed = True
+            return None
+        inv = sorted(self.vocab.items(), key=lambda kv: kv[1])
+        buf = "\n".join(t for t, _ in inv).encode("utf-8")
+        ids = (ctypes.c_int * len(inv))(*[i for _, i in inv])
+        h = lib.pttok_create(buf, len(buf), ids, len(inv),
+                             self.vocab[self.unk_token],
+                             self.wordpiece.max_input_chars_per_word
+                             if hasattr(self.wordpiece,
+                                        "max_input_chars_per_word") else 100)
+        if not h:
+            self._native_failed = True
+            return None
+        self._native_lib = lib
+        self._native_handle = h
+        return h
+
+    def text_to_ids(self, text):
+        """Token ids for `text` (no specials) — C++ fast path for
+        ASCII/CJK input, Python reference otherwise. Both produce
+        identical output (tested)."""
+        h = self._ensure_native()
+        if h is not None:
+            import ctypes
+            raw = text.encode("utf-8")
+            cap = max(64, 2 * len(raw) + 8)
+            out = (ctypes.c_int * cap)()
+            n = self._native_lib.pttok_encode(
+                h, raw, len(raw), int(self.basic.do_lower_case), out, cap)
+            while n == -1:  # output buffer too small (pathological input)
+                cap *= 4
+                out = (ctypes.c_int * cap)()
+                n = self._native_lib.pttok_encode(
+                    h, raw, len(raw), int(self.basic.do_lower_case), out,
+                    cap)
+            if n >= 0:
+                return list(out[:n])
+        return self.convert_tokens_to_ids(self.tokenize(text))
+
+    def __del__(self):
+        h = getattr(self, "_native_handle", None)
+        if h is not None:
+            try:
+                self._native_lib.pttok_destroy(h)
+            except Exception:
+                pass
+
     def convert_tokens_to_ids(self, tokens):
         unk = self.vocab[self.unk_token]
         return [self.vocab.get(t, unk) for t in tokens]
@@ -170,9 +288,8 @@ class BertTokenizer:
 
     def __call__(self, text, text_pair=None, max_length=None, padding=False,
                  truncation=True):
-        a = self.convert_tokens_to_ids(self.tokenize(text))
-        b = self.convert_tokens_to_ids(self.tokenize(text_pair)) \
-            if text_pair else None
+        a = self.text_to_ids(text)
+        b = self.text_to_ids(text_pair) if text_pair else None
         cls_id, sep_id = self.vocab[self.cls_token], self.vocab[self.sep_token]
         if max_length and truncation:
             budget = max(max_length - (3 if b is not None else 2), 0)

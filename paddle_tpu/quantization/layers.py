@@ -82,11 +82,12 @@ class Int8InferLinear(Layer):
     activation/weight scales — the standard TPU int8 serving formulation.
 
     channel_axis: which weight axis [in, out] the scales index (1 =
-    per-out-feature, the default; 0 = per-in-feature).
+    per-out-feature, the default; 0 = per-in-feature). bit_length is the
+    WEIGHT grid; act_bit_length the activation grid (they can differ).
     """
 
     def __init__(self, w_int8, w_scale, bias, act_scale=None, bit_length=8,
-                 channel_axis=1):
+                 channel_axis=1, act_bit_length=8):
         super().__init__()
         self.register_buffer("w_int8", to_tensor(w_int8))
         self.register_buffer("w_scale", to_tensor(w_scale))
@@ -96,35 +97,42 @@ class Int8InferLinear(Layer):
             "act_scale",
             to_tensor(act_scale) if act_scale is not None else None)
         self.bit_length = bit_length
+        self.act_bit_length = act_bit_length
         self.channel_axis = channel_axis
 
     def forward(self, x):
-        qmax = float(2 ** (self.bit_length - 1) - 1)
+        w_qmax = float(2 ** (self.bit_length - 1) - 1)
+        a_qmax = float(2 ** (self.act_bit_length - 1) - 1)
         ax = self.channel_axis
-
-        def _wscale(ws):
-            # broadcastable over the weight [in, out]
-            return ws[None, :] if ax == 1 else ws[:, None]
 
         def f(xv, w8, ws, *rest):
             rest = list(rest)
             asv = rest.pop(0) if self.act_scale is not None else None
             bv = rest.pop(0) if self.bias_t is not None else None
-            if asv is not None and ax == 1:
-                # quantize activations on the fly: int8 x int8 -> int32;
-                # per-out-feature weight scales factor out of the K-sum
-                xq = jnp.clip(jnp.round(xv / jnp.maximum(asv, 1e-9) * qmax),
-                              -qmax, qmax).astype(jnp.int8)
+            if asv is not None and ax == 1 \
+                    and self.bit_length == self.act_bit_length == 8:
+                # int8 x int8 -> int32 MXU path: per-out-feature weight
+                # scales factor out of the K-sum
+                xq = jnp.clip(jnp.round(xv / jnp.maximum(asv, 1e-9)
+                                        * a_qmax),
+                              -a_qmax, a_qmax).astype(jnp.int8)
                 acc = jax.lax.dot_general(
                     xq, w8, (((xq.ndim - 1,), (0,)), ((), ())),
                     preferred_element_type=jnp.int32)
                 out = acc.astype(jnp.float32) \
-                    * (asv / qmax) * (ws[None, :] / qmax)
-            else:
-                # weight-only quant (or per-in-feature scales, which do not
-                # factor out of the contraction): dequantize into the matmul
-                w = w8.astype(jnp.float32) * (_wscale(ws) / qmax)
-                out = xv.astype(jnp.float32) @ w
+                    * (asv / a_qmax) * (ws[None, :] / w_qmax)
+                return (out + bv if bv is not None else out).astype(xv.dtype)
+            if asv is not None:
+                # general case (per-in-feature scales / mixed bit widths):
+                # fake-quant activations on THEIR grid, then float matmul
+                s = jnp.maximum(asv, 1e-9)
+                xv = (jnp.clip(jnp.round(xv / s * a_qmax), -a_qmax, a_qmax)
+                      * s / a_qmax).astype(xv.dtype)
+            wsb = ws[None, :] if ax == 1 else ws[:, None]
+            # dequantized weights in the activation dtype keeps the matmul
+            # on the bf16 MXU path for bf16 serving
+            w = (w8.astype(jnp.float32) * (wsb / w_qmax)).astype(xv.dtype)
+            out = xv @ w
             if bv is not None:
                 out = out + bv
             return out.astype(xv.dtype)

@@ -91,15 +91,17 @@ class QAT:
                 w_int8 = np.clip(np.round(w / wsb * qmax),
                                  -qmax, qmax).astype(np.int8)
                 act_scale = None
+                act_bits = 8
                 aq = sub.activation_quanter
                 if aq is not None and hasattr(aq, "scale"):
                     s = float(np.asarray(aq.scale._value))
                     if s > 0:
                         act_scale = jnp.float32(s)
+                        act_bits = getattr(aq, "bit_length", 8)
                 bias = inner.bias._value if inner.bias is not None else None
                 layer._sub_layers[name] = Int8InferLinear(
                     w_int8, ws.astype(np.float32), bias, act_scale,
-                    bit_length=bits, channel_axis=ax)
+                    bit_length=bits, channel_axis=ax, act_bit_length=act_bits)
             elif isinstance(sub, Layer):
                 # freeze any observers/quanters that stay in the graph
                 # (e.g. inside QuantedConv2D): calibration ends at convert

@@ -91,7 +91,16 @@ class dlpack:
     def to_dlpack(x):
         from ..tensor import Tensor
         arr = x._value if isinstance(x, Tensor) else x
-        return arr.__dlpack__()
+        try:
+            return arr.__dlpack__()
+        except Exception:
+            # TPU PJRT buffers don't support external references
+            # (PJRT_Buffer_IncreaseExternalReferenceCount unimplemented):
+            # export a host copy instead — consumers get the data, not
+            # zero-copy device sharing
+            import jax
+            import numpy as np
+            return np.asarray(jax.device_get(arr)).__dlpack__()
 
     @staticmethod
     def from_dlpack(capsule):

@@ -1,0 +1,76 @@
+"""Native C++ WordPiece fast path: exact parity with the Python reference
+on ASCII/CJK, fallback beyond, and full encode() integration."""
+import numpy as np
+import pytest
+
+from paddle_tpu.nlp.tokenizer import BertTokenizer, _pttok
+
+VOCAB = (["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+         + list("abcdefghijklmnopqrstuvwxyz")
+         + ["##" + c for c in "abcdefghijklmnopqrstuvwxyz"]
+         + ["the", "quick", "brown", "fox", "jump", "##s", "##ed", "over",
+            "lazy", "dog", "un", "##break", "##able", "!", ",", ".",
+            "hello", "world", chr(0x4E2D), chr(0x6587)])
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return BertTokenizer({t: i for i, t in enumerate(VOCAB)})
+
+
+def _ref(tok, text):
+    return tok.convert_tokens_to_ids(tok.tokenize(text))
+
+
+class TestNativeParity:
+    def test_lib_loads(self):
+        assert _pttok() is not None
+
+    def test_hand_cases(self, tok):
+        for text in [
+            "The quick brown fox jumps over the lazy dog!",
+            "unbreakable, hello world.",
+            f"hello {chr(0x4E2D)}{chr(0x6587)} world",
+            "zzz qqqqq hello",        # unknown word -> [UNK]
+            "", "!!!", "a" * 150,     # > max chars per word -> [UNK]
+            "A  B\t\nC",              # whitespace variety
+        ]:
+            assert tok.text_to_ids(text) == _ref(tok, text), text
+
+    def test_random_ascii_cjk_property(self, tok):
+        rng = np.random.default_rng(0)
+        alphabet = (list("abcdefghijklmnopqrstuvwxyz ABC !,.")
+                    + [chr(0x4E2D), chr(0x6587), chr(0x4E09)])
+        for _ in range(60):
+            n = int(rng.integers(0, 60))
+            text = "".join(rng.choice(alphabet) for _ in range(n))
+            assert tok.text_to_ids(text) == _ref(tok, text), repr(text)
+
+    def test_unicode_falls_back_identically(self, tok):
+        for text in ["café hello", "naïve fox", "Ω hello", "héllo wörld"]:
+            assert tok.text_to_ids(text) == _ref(tok, text), text
+
+    def test_call_uses_fast_path(self, tok):
+        out = tok("the quick fox", "hello world", max_length=16,
+                  padding=True)
+        assert len(out["input_ids"]) == 16
+        ids = out["input_ids"]
+        cls_id, sep_id = tok.vocab["[CLS]"], tok.vocab["[SEP]"]
+        assert ids[0] == cls_id and sep_id in ids
+
+    def test_long_text_buffer_growth(self, tok):
+        text = "the quick brown fox " * 500
+        assert tok.text_to_ids(text) == _ref(tok, text)
+
+    def test_control_char_whitespace(self, tok):
+        # regression: \x1c-\x1f are str.split() whitespace
+        for sep in ("\x1c", "\x1d", "\x1e", "\x1f", "\x0b"):
+            text = f"hello{sep}world"
+            assert tok.text_to_ids(text) == _ref(tok, text), repr(sep)
+
+    def test_newline_in_vocab_token_falls_back(self):
+        # regression: a '\n' inside a token mis-aligned the native vocab
+        t = BertTokenizer({"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3,
+                           "[MASK]": 4, "a\nb": 5, "hello": 6, "world": 7})
+        assert t.text_to_ids("hello world") == [6, 7]
+        assert getattr(t, "_native_failed", False)  # python path used

@@ -175,6 +175,46 @@ class TestPTQConvert:
         out = _np(net(x))
         assert np.allclose(out, fq, atol=1e-5)  # same grid as training
 
+    def test_convert_act_quant_with_per_in_feature_weights(self):
+        # regression: act_scale was silently dropped when channel_axis=0
+        paddle.seed(8)
+        net = nn.Sequential(nn.Linear(6, 4))
+        cfg = Q.QuantConfig(
+            activation=lambda: Q.FakeQuanterWithAbsMax(8),
+            weight=lambda: Q.FakeQuanterChannelWiseAbsMax(8, channel_axis=0))
+        qat = Q.QAT(cfg)
+        qat.quantize(net)
+        net.train()
+        rng = np.random.default_rng(8)
+        x = paddle.to_tensor(rng.standard_normal((8, 6)).astype("float32"))
+        net(x)  # set activation scale
+        net.eval()
+        fq = _np(net(x))
+        qat.convert(net)
+        out = _np(net(x))
+        assert np.abs(out - fq).max() < 1e-4
+
+    def test_convert_mixed_bit_widths(self):
+        # regression: weight bit_length was applied to the activation grid
+        paddle.seed(9)
+        net = nn.Sequential(nn.Linear(6, 4))
+        cfg = Q.QuantConfig(
+            activation=lambda: Q.FakeQuanterWithAbsMax(8),
+            weight=lambda: Q.FakeQuanterChannelWiseAbsMax(4, channel_axis=1))
+        qat = Q.QAT(cfg)
+        qat.quantize(net)
+        net.train()
+        rng = np.random.default_rng(9)
+        x = paddle.to_tensor(rng.standard_normal((8, 6)).astype("float32"))
+        net(x)
+        net.eval()
+        fq = _np(net(x))
+        qat.convert(net)
+        lin = net._sub_layers["0"]
+        assert lin.bit_length == 4 and lin.act_bit_length == 8
+        out = _np(net(x))
+        assert np.abs(out - fq).max() < 1e-4
+
     def test_observers_freeze_at_convert(self):
         # regression: observers kept updating scales after convert
         paddle.seed(7)
