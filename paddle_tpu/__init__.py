@@ -64,3 +64,5 @@ from . import sparse  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import onnx  # noqa: E402,F401
 from . import hub  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from . import compat  # noqa: E402,F401

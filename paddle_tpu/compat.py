@@ -1,0 +1,103 @@
+"""Checkpoint compatibility with the reference framework
+(ref: the .pdparams/.pdopt save format of paddle.save —
+python/paddle/framework/io.py).
+
+The reference pickles a dict of {param_name: numpy array} (state-dict
+saves convert tensors to ndarrays before pickling; some versions pickle
+tensor wrappers that reduce to an ndarray payload). `load_pdparams` reads
+both so real Paddle checkpoints migrate directly:
+
+    state = paddle_tpu.compat.load_pdparams("model.pdparams")
+    model.set_state_dict(state)
+
+`paddle_tpu.load` also sniffs the format and delegates here, so plain
+`paddle.load("model.pdparams")` works as advertised. `save_pdparams`
+writes the reference layout for users round-tripping OFF TPU.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+__all__ = ["load_pdparams", "save_pdparams"]
+
+# paddle globals that appear in checkpoints as tensor-REBUILD calls whose
+# first ndarray argument is the data; these (and only these) degrade to a
+# passthrough. Any other paddle.* global is an unsupported object save and
+# fails loudly rather than corrupting the state dict.
+_TENSOR_REBUILDERS = {
+    ("paddle", "Tensor"),
+    ("paddle.base.core", "eager"),
+    ("paddle.fluid.core", "eager"),
+    ("paddle.base.framework", "EagerParamBase"),
+    ("paddle.fluid.framework", "ParamBase"),
+    ("paddle.fluid.framework", "EagerParamBase"),
+    ("paddle.framework.io", "_rebuild_tensor"),
+    ("paddle.base.core", "_rebuild_tensor"),
+}
+
+
+class _CompatUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _TENSOR_REBUILDERS:
+            return _ndarray_passthrough
+        if module == "paddle" or module.startswith("paddle."):
+            raise pickle.UnpicklingError(
+                f"unsupported paddle object in checkpoint: {module}.{name}. "
+                "load_pdparams reads STATE-DICT saves ({name: array}); "
+                "whole-object paddle.save(layer) checkpoints must be "
+                "re-saved as state dicts in the reference framework first")
+        return super().find_class(module, name)
+
+
+class _ndarray_passthrough:
+    """Stand-in for the reference's tensor rebuild callables: called with
+    the pickled payload, returns the first ndarray argument."""
+
+    def __new__(cls, *args, **kwargs):
+        for a in args:
+            if isinstance(a, np.ndarray):
+                return a
+        raise pickle.UnpicklingError(
+            "paddle tensor rebuild carried no ndarray payload "
+            f"(args={tuple(type(a).__name__ for a in args)})")
+
+
+def load_pdparams(path, return_numpy=False):
+    """Load a reference-framework .pdparams/.pdopt pickle into a state
+    dict of Tensors (or raw ndarrays with return_numpy=True)."""
+    with open(path, "rb") as f:
+        state = _CompatUnpickler(f).load()
+    if return_numpy:
+        return state
+    from .tensor import Tensor
+
+    def wrap(x):
+        if isinstance(x, np.ndarray):
+            return Tensor(x)
+        if isinstance(x, dict):
+            return {k: wrap(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return type(x)(wrap(v) for v in x)
+        return x
+
+    return wrap(state)
+
+
+def save_pdparams(state_dict, path, protocol=2):
+    """Write a state dict in the reference's .pdparams layout (plain
+    pickled {name: ndarray} — loadable by paddle.load)."""
+    from .tensor import Tensor
+
+    def unwrap(x):
+        if isinstance(x, Tensor):
+            return np.asarray(x._value)
+        if isinstance(x, dict):
+            return {k: unwrap(v) for k, v in x.items()}
+        if isinstance(x, (list, tuple)):
+            return type(x)(unwrap(v) for v in x)
+        return x
+
+    with open(path, "wb") as f:
+        pickle.dump(unwrap(state_dict), f, protocol=protocol)

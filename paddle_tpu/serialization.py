@@ -72,6 +72,12 @@ def load(path, return_numpy=False, **configs):
     with open(path, "rb") as f:
         data = f.read()
     if not data.startswith(_MAGIC):
+        if data[:1] == b"\x80":
+            # a plain pickle: a reference-framework .pdparams/.pdopt
+            # checkpoint — delegate to the compat reader so
+            # paddle.load("model.pdparams") parity is real
+            from .compat import load_pdparams
+            return load_pdparams(path, return_numpy=return_numpy)
         raise ValueError(f"{path} is not a paddle_tpu checkpoint")
     body = data[len(_MAGIC):]
     sep = b"\n__NPZ__\n"

@@ -1,0 +1,71 @@
+"""paddle.static namespace (ref: python/paddle/static/).
+
+The reference's static graph mode (Program/Executor/feed-fetch) is
+subsumed by XLA here: `paddle.jit.to_static` traces once and compiles —
+that IS the static graph. This module keeps the `paddle.static` names
+import-compatible: `InputSpec` is the real one, introspection maps to the
+HLO dump, and Program/Executor construction raises with the exact
+migration recipe instead of an AttributeError.
+"""
+from __future__ import annotations
+
+from .jit import InputSpec  # noqa: F401  (the real thing)
+
+__all__ = ["InputSpec", "Program", "Executor", "default_main_program",
+           "default_startup_program", "program_guard", "data", "save",
+           "load", "name_scope"]
+
+_MSG = (
+    "paddle.static graph mode is replaced by XLA compilation: decorate "
+    "your function/Layer with paddle_tpu.jit.to_static(fn, "
+    "input_spec=[InputSpec(...)]) — it traces once and compiles, which is "
+    "the static graph. Use paddle_tpu.jit.save/load for deployment "
+    "artifacts and paddle_tpu.jit.get_hlo for program introspection."
+)
+
+
+class Program:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG)
+
+
+class Executor:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG)
+
+
+def default_main_program():
+    raise NotImplementedError(_MSG)
+
+
+def default_startup_program():
+    raise NotImplementedError(_MSG)
+
+
+def program_guard(*a, **k):
+    raise NotImplementedError(_MSG)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """ref: paddle.static.data — returns an InputSpec (the jit-era
+    equivalent of a feed placeholder)."""
+    return InputSpec(shape=shape, dtype=dtype, name=name)
+
+
+def name_scope(prefix=None):
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        yield
+    return cm()
+
+
+def save(layer, path, *a, **k):
+    from . import jit
+    return jit.save(layer, path, *a, **k)
+
+
+def load(path, *a, **k):
+    from . import jit
+    return jit.load(path, *a, **k)
