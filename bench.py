@@ -231,9 +231,49 @@ def main():
     ap.add_argument("--scan-steps", type=int, default=0,
                     help="run K optimizer steps per compiled call "
                          "(lax.scan) to amortize dispatch latency")
+    ap.add_argument("--decode", action="store_true",
+                    help="measure KV-cache generation throughput (flash "
+                         "decode) instead of training")
     args = ap.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
+
+    if args.decode:
+        from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+        from paddle_tpu.nlp.generation import generate
+        import numpy as np
+        if args.smoke or not on_tpu:
+            cfg, batch, new_tok = "gpt-tiny", 2, 16
+        else:
+            cfg, batch, new_tok = "gpt2-en", 8, 128
+        cfg = args.config or cfg
+        batch = args.batch or batch
+        model = GPTForCausalLM(_resolve_config(
+            cfg, max_position_embeddings=1024, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0, use_flash_attention=on_tpu))
+        model.eval()
+        rng = np.random.default_rng(0)
+        vocab = model.config.vocab_size
+        prompt = jnp.asarray(rng.integers(0, vocab, (batch, 64)), jnp.int32)
+        log(f"bench decode: {cfg} batch={batch} new_tokens={new_tok}")
+        out = generate(model, prompt, max_new_tokens=new_tok)  # compile
+        float(jnp.sum(out._value if hasattr(out, "_value") else out))
+        t0 = time.perf_counter()
+        reps = 3
+        for _ in range(reps):
+            out = generate(model, prompt, max_new_tokens=new_tok)
+        float(jnp.sum(out._value if hasattr(out, "_value") else out))
+        dt = (time.perf_counter() - t0) / reps
+        print(json.dumps({
+            "metric": "gpt_decode_tokens_per_sec_per_chip",
+            "value": round(batch * new_tok / dt, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": None,
+            "config": cfg, "batch": batch, "new_tokens": new_tok,
+            "ms_per_step": round(dt / new_tok * 1e3, 2),
+            "backend": jax.default_backend(),
+        }))
+        return
 
     if args.model == "resnet50":
         if args.smoke or not on_tpu:

@@ -1,11 +1,13 @@
 """paddle.incubate parity (ref: python/paddle/incubate/__init__.py).
 
-Currently the optimizer extensions: LookAhead, ModelAverage, EMA.
+Optimizer extensions (LookAhead, ModelAverage, EMA) + incubate.nn fused
+layers.
 """
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from .ema import ExponentialMovingAverage  # noqa: F401
+from . import nn  # noqa: F401
 
 EMA = ExponentialMovingAverage
 
 __all__ = ["LookAhead", "ModelAverage", "ExponentialMovingAverage", "EMA",
-           "optimizer"]
+           "optimizer", "nn"]

@@ -1,0 +1,239 @@
+"""paddle.incubate.nn fused layers (ref: python/paddle/incubate/nn/
+{fused_transformer,layer/fused_transformer}.py).
+
+On the reference these exist because CUDA needs hand-fused kernels
+(fused_attention/fused_feedforward ops). On TPU, XLA fuses the epilogues
+automatically and the attention core routes to the Pallas flash kernel —
+so these layers are the SAME math with the reference's fused-layer
+parameter names and layouts (packed qkv weight, flat `pre_ln_scale`-style
+LayerNorm params — state dicts migrate key-for-key), and fusion itself is
+the compiler's job.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd import apply_op
+from ..nn import functional as F
+from ..nn.initializer import Constant
+from ..nn.layer import Layer
+from ..nn.layers_common import Dropout
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["FusedLinear", "FusedDropoutAdd", "FusedMultiHeadAttention",
+           "FusedFeedForward", "FusedTransformerEncoderLayer"]
+
+
+def _bias_param(layer, shape, attr):
+    if attr is False:
+        return None
+    return layer.create_parameter(shape, attr=attr, is_bias=True)
+
+
+class FusedLinear(Layer):
+    """ref: paddle.incubate.nn.FusedLinear — plain GEMM+bias; on TPU the
+    'fusion' is XLA's epilogue fusion, so this is Linear with the fused
+    layer's name."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._transpose = transpose_weight
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = _bias_param(self, (out_features,), bias_attr)
+
+    def forward(self, x):
+        w = self.weight
+        if self._transpose:
+            w = apply_op(lambda a: a.T, w)
+        return F.linear(x, w, self.bias)
+
+
+class FusedDropoutAdd(Layer):
+    """ref: paddle.incubate.nn.FusedDropoutAdd — dropout(x) + y in one
+    fused pass (XLA fuses the mask-scale-add chain)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self._dropout = Dropout(p, mode=mode)
+
+    def forward(self, x, y):
+        return self._dropout(x) + y
+
+
+class FusedMultiHeadAttention(Layer):
+    """ref: paddle.incubate.nn.FusedMultiHeadAttention — packed qkv weight
+    [3, num_heads, head_dim, embed_dim], flat LN params
+    (pre_ln_scale/pre_ln_bias/ln_scale/ln_bias), pre/post-LN, residual
+    add. The attention core routes through
+    F.scaled_dot_product_attention (Pallas flash on TPU).
+
+    Unsupported reference corners raise rather than silently diverge:
+    kdim/vdim != embed_dim, need_weights, and cache-based incremental
+    decoding (use nlp.generation's KV-cache path for that).
+    """
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        if kdim is not None and kdim != embed_dim:
+            raise NotImplementedError("FusedMultiHeadAttention: kdim != "
+                                      "embed_dim is not supported")
+        if vdim is not None and vdim != embed_dim:
+            raise NotImplementedError("FusedMultiHeadAttention: vdim != "
+                                      "embed_dim is not supported")
+        if need_weights:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention: need_weights=True is not "
+                "supported (the flash kernel never materializes the "
+                "attention matrix); use nn.MultiHeadAttention")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+        # reference layout: qkv_weight [3, num_heads, head_dim, embed_dim]
+        self.qkv_weight = self.create_parameter(
+            (3, num_heads, self.head_dim, embed_dim), attr=qkv_weight_attr)
+        self.qkv_bias = _bias_param(self, (3, num_heads, self.head_dim),
+                                    qkv_bias_attr)
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), attr=linear_weight_attr)
+        self.linear_bias = _bias_param(self, (embed_dim,), linear_bias_attr)
+        # flat LN params, reference names (state dicts migrate key-for-key)
+        self.pre_ln_scale = self.create_parameter(
+            (embed_dim,), attr=pre_ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.pre_ln_bias = _bias_param(self, (embed_dim,), pre_ln_bias_attr)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = _bias_param(self, (embed_dim,), ln_bias_attr)
+        self._dropout = Dropout(dropout_rate)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention: cache-based incremental decoding "
+                "is not supported here — use the KV-cache generation path "
+                "(paddle_tpu.nlp.generation)")
+        residual = x
+        if self.normalize_before:
+            x = F.layer_norm(x, self.embed_dim, self.pre_ln_scale,
+                             self.pre_ln_bias, self._epsilon)
+        b, s = x.shape[0], x.shape[1]
+        h, d = self.num_heads, self.head_dim
+
+        if self.qkv_bias is not None:
+            def qkv(xv, wv, bv):
+                # [B,S,E] @ [3,H,D,E]^T -> [B,S,3,H,D]
+                return jnp.einsum("bse,khde->bskhd", xv, wv) + bv[None, None]
+            packed = apply_op(qkv, x, self.qkv_weight, self.qkv_bias)
+        else:
+            packed = apply_op(
+                lambda xv, wv: jnp.einsum("bse,khde->bskhd", xv, wv),
+                x, self.qkv_weight)
+        q = packed[:, :, 0]
+        k = packed[:, :, 1]
+        v = packed[:, :, 2]
+        attn = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate, training=self.training)
+        attn = attn.reshape([b, s, h * d])
+        out = F.linear(attn, self.linear_weight, self.linear_bias)
+        out = residual + self._dropout(out)
+        if not self.normalize_before:
+            out = F.layer_norm(out, self.embed_dim, self.ln_scale,
+                               self.ln_bias, self._epsilon)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """ref: paddle.incubate.nn.FusedFeedForward — LN + linear + act +
+    dropout + linear + dropout + residual with the reference's flat
+    parameter names (linear1_weight/..., ln1_scale/ln2_scale; ln1 is the
+    pre-LN, ln2 the post-LN — both exist like the reference)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self._d_model = d_model
+        self._epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), attr=linear1_weight_attr)
+        self.linear1_bias = _bias_param(self, (dim_feedforward,),
+                                        linear1_bias_attr)
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), attr=linear2_weight_attr)
+        self.linear2_bias = _bias_param(self, (d_model,), linear2_bias_attr)
+        self.ln1_scale = self.create_parameter(
+            (d_model,), attr=ln1_scale_attr,
+            default_initializer=Constant(1.0))
+        self.ln1_bias = _bias_param(self, (d_model,), ln1_bias_attr)
+        self.ln2_scale = self.create_parameter(
+            (d_model,), attr=ln2_scale_attr,
+            default_initializer=Constant(1.0))
+        self.ln2_bias = _bias_param(self, (d_model,), ln2_bias_attr)
+        self._dropout = Dropout(dropout_rate)
+        self._act_dropout = Dropout(
+            dropout_rate if act_dropout_rate is None else act_dropout_rate)
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = F.layer_norm(x, self._d_model, self.ln1_scale,
+                             self.ln1_bias, self._epsilon)
+        act = getattr(F, self.activation)
+        x = self._act_dropout(act(
+            F.linear(x, self.linear1_weight, self.linear1_bias)))
+        x = self._dropout(F.linear(x, self.linear2_weight,
+                                   self.linear2_bias))
+        out = residual + x
+        if not self.normalize_before:
+            out = F.layer_norm(out, self._d_model, self.ln2_scale,
+                               self.ln2_bias, self._epsilon)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """ref: paddle.incubate.nn.FusedTransformerEncoderLayer."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedTransformerEncoderLayer: cache is not supported — "
+                "use the KV-cache generation path (paddle_tpu.nlp"
+                ".generation)")
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

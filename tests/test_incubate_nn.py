@@ -1,0 +1,160 @@
+"""incubate.nn fused layers == unfused reference compositions."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import nn as inn
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+class TestFusedLinear:
+    def test_matches_linear(self):
+        paddle.seed(0)
+        fl = inn.FusedLinear(4, 3)
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((2, 4))
+            .astype("float32"))
+        ref = _np(x.numpy()) @ _np(fl.weight) + _np(fl.bias)
+        assert np.allclose(_np(fl(x)), ref, atol=1e-5)
+
+    def test_transpose_weight(self):
+        paddle.seed(1)
+        fl = inn.FusedLinear(4, 3, transpose_weight=True)
+        assert tuple(fl.weight.shape) == (3, 4)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        ref = np.ones((2, 4)) @ _np(fl.weight).T + _np(fl.bias)
+        assert np.allclose(_np(fl(x)), ref, atol=1e-5)
+
+
+class TestFusedDropoutAdd:
+    def test_eval_is_plain_add(self):
+        fda = inn.FusedDropoutAdd(0.5)
+        fda.eval()
+        x = paddle.to_tensor(np.full((3,), 2.0, np.float32))
+        y = paddle.to_tensor(np.full((3,), 1.0, np.float32))
+        assert np.allclose(_np(fda(x, y)), 3.0)
+
+    def test_train_drops(self):
+        paddle.seed(3)
+        fda = inn.FusedDropoutAdd(0.99)
+        fda.train()
+        x = paddle.to_tensor(np.full((1000,), 1.0, np.float32))
+        y = paddle.to_tensor(np.zeros((1000,), np.float32))
+        out = _np(fda(x, y))
+        assert (out == 0).mean() > 0.9  # most dropped
+
+
+class TestFusedMHA:
+    def test_matches_unfused_attention(self):
+        paddle.seed(4)
+        d, h = 16, 4
+        fmha = inn.FusedMultiHeadAttention(
+            d, h, dropout_rate=0.0, attn_dropout_rate=0.0,
+            normalize_before=True)
+        fmha.eval()
+        rng = np.random.default_rng(4)
+        x = paddle.to_tensor(rng.standard_normal((2, 6, d))
+                             .astype("float32"))
+        out = fmha(x)
+        assert tuple(out.shape) == (2, 6, d)
+
+        # manual recomputation with the packed weights
+        import jax.numpy as jnp
+        xv = _np(x)
+        mu = xv.mean(-1, keepdims=True)
+        var = xv.var(-1, keepdims=True)
+        xn = (xv - mu) / np.sqrt(var + 1e-5)
+        xn = xn * _np(fmha.pre_ln_scale) + _np(fmha.pre_ln_bias)
+        w = _np(fmha.qkv_weight)     # [3, H, D, E]
+        b = _np(fmha.qkv_bias)       # [3, H, D]
+        packed = np.einsum("bse,khde->bskhd", xn, w) + b[None, None]
+        q, k, v = packed[:, :, 0], packed[:, :, 1], packed[:, :, 2]
+        scale = 1.0 / np.sqrt(d // h)
+        s = np.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        att = np.einsum("bhqk,bkhd->bqhd", p, v).reshape(2, 6, d)
+        ref = att @ _np(fmha.linear_weight) + _np(fmha.linear_bias) + xv
+        assert np.allclose(_np(out), ref, atol=1e-4)
+
+    def test_trains(self):
+        paddle.seed(5)
+        layer = inn.FusedTransformerEncoderLayer(16, 4, 32,
+                                                 dropout_rate=0.0)
+        layer.train()
+        opt = paddle.optimizer.Adam(1e-3, parameters=layer.parameters())
+        rng = np.random.default_rng(5)
+        x = paddle.to_tensor(rng.standard_normal((2, 8, 16))
+                             .astype("float32"))
+        first = None
+        for _ in range(5):
+            out = layer(x)
+            loss = (out ** 2).mean()
+            first = first if first is not None else float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss) < first
+
+
+class TestFusedFFN:
+    def test_matches_unfused(self):
+        paddle.seed(6)
+        ffn = inn.FusedFeedForward(8, 16, dropout_rate=0.0,
+                                   normalize_before=True)
+        ffn.eval()
+        rng = np.random.default_rng(6)
+        x = paddle.to_tensor(rng.standard_normal((2, 3, 8))
+                             .astype("float32"))
+        xv = _np(x)
+        mu = xv.mean(-1, keepdims=True)
+        var = xv.var(-1, keepdims=True)
+        xn = (xv - mu) / np.sqrt(var + 1e-5)
+        xn = xn * _np(ffn.ln1_scale) + _np(ffn.ln1_bias)
+        h = np.maximum(xn @ _np(ffn.linear1_weight) + _np(ffn.linear1_bias),
+                       0)
+        ref = h @ _np(ffn.linear2_weight) + _np(ffn.linear2_bias) + xv
+        assert np.allclose(_np(ffn(x)), ref, atol=1e-4)
+
+
+class TestFusedEdgeCases:
+    def test_bias_attr_false(self):
+        fl = inn.FusedLinear(4, 2, bias_attr=False)
+        assert fl.bias is None
+        x = paddle.to_tensor(np.ones((1, 4), np.float32))
+        assert np.allclose(_np(fl(x)), np.ones((1, 4)) @ _np(fl.weight))
+        mha = inn.FusedMultiHeadAttention(8, 2, dropout_rate=0.0,
+                                          attn_dropout_rate=0.0,
+                                          qkv_bias_attr=False,
+                                          linear_bias_attr=False)
+        mha.eval()
+        out = mha(paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((1, 4, 8))
+            .astype("float32")))
+        assert tuple(out.shape) == (1, 4, 8)
+
+    def test_unsupported_corners_raise(self):
+        with pytest.raises(NotImplementedError):
+            inn.FusedMultiHeadAttention(8, 2, need_weights=True)
+        with pytest.raises(NotImplementedError):
+            inn.FusedMultiHeadAttention(8, 2, kdim=4)
+        mha = inn.FusedMultiHeadAttention(8, 2)
+        with pytest.raises(NotImplementedError):
+            mha(paddle.to_tensor(np.ones((1, 2, 8), np.float32)),
+                cache="anything")
+
+    def test_reference_state_dict_keys(self):
+        mha = inn.FusedMultiHeadAttention(8, 2)
+        keys = set(mha.state_dict().keys())
+        assert {"qkv_weight", "qkv_bias", "linear_weight", "linear_bias",
+                "pre_ln_scale", "pre_ln_bias", "ln_scale",
+                "ln_bias"} <= keys
+        ffn = inn.FusedFeedForward(8, 16)
+        fkeys = set(ffn.state_dict().keys())
+        assert {"linear1_weight", "linear1_bias", "linear2_weight",
+                "linear2_bias", "ln1_scale", "ln1_bias", "ln2_scale",
+                "ln2_bias"} <= fkeys

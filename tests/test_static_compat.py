@@ -79,13 +79,15 @@ class TestPdparamsCompat:
         EagerParamBase.__qualname__ = "EagerParamBase"
         fake = types.ModuleType("paddle.base.framework")
         fake.EagerParamBase = EagerParamBase
-        sys.modules.setdefault("paddle", types.ModuleType("paddle"))
-        sys.modules["paddle.base"] = types.ModuleType("paddle.base")
+        parents = ["paddle", "paddle.base"]
+        added = [m for m in parents if m not in sys.modules]
+        for m in added:
+            sys.modules[m] = types.ModuleType(m)
         sys.modules["paddle.base.framework"] = fake
         try:
             payload = pickle.dumps({"p": EagerParamBase(arr)}, protocol=2)
         finally:
-            for m in ("paddle.base.framework", "paddle.base", "paddle"):
+            for m in added + ["paddle.base.framework"]:
                 sys.modules.pop(m, None)
         assert b"paddle.base.framework" in payload
         p = tmp_path / "wrapped.pdparams"
