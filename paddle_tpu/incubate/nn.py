@@ -18,7 +18,6 @@ from ..nn import functional as F
 from ..nn.initializer import Constant
 from ..nn.layer import Layer
 from ..nn.layers_common import Dropout
-from ..tensor import Tensor, to_tensor
 
 __all__ = ["FusedLinear", "FusedDropoutAdd", "FusedMultiHeadAttention",
            "FusedFeedForward", "FusedTransformerEncoderLayer"]

@@ -14,6 +14,12 @@ from .layers_common import (  # noqa: F401
     PixelShuffle, PixelUnshuffle, Sequential, Unfold, Upsample,
     UpsamplingBilinear2D, UpsamplingNearest2D, ZeroPad2D,
 )
+from .layers_extra import (  # noqa: F401
+    AdaptiveLogSoftmaxWithLoss, Bilinear, FeatureAlphaDropout,
+    FractionalMaxPool2D, GaussianNLLLoss, LogSigmoid,
+    MultiLabelSoftMarginLoss, MultiMarginLoss, SoftMarginLoss, Softmax2D,
+    TripletMarginWithDistanceLoss,
+)
 from .layers_conv import (  # noqa: F401
     Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D, Conv3DTranspose,
 )

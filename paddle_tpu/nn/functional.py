@@ -51,6 +51,9 @@ __all__ = [
     # attention & misc
     "scaled_dot_product_attention", "one_hot", "cosine_similarity",
     "pairwise_distance", "linear_dtype_guard", "sequence_mask", "temporal_shift",
+    "gaussian_nll_loss", "soft_margin_loss", "multi_label_soft_margin_loss",
+    "multi_margin_loss", "triplet_margin_with_distance_loss", "zeropad2d",
+    "max_unpool2d",
 ]
 
 
@@ -1395,3 +1398,130 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         return (jnp.arange(m)[None, :] < lens[..., None]).astype(
             framework.convert_dtype(dtype))
     return apply_op(f, _t(x), differentiable=False)
+
+
+# ---------------------------------------------------------------------------
+# long-tail losses / ops (ref: python/paddle/nn/functional/loss.py,
+# common.py) — round-2 API sweep additions
+# ---------------------------------------------------------------------------
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """ref: F.gaussian_nll_loss."""
+    import math
+
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        out = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            out = out + 0.5 * math.log(2 * math.pi)
+        return _reduce(out, reduction)
+    return apply_op(f, _t(input), _t(label), _t(variance))
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """ref: F.soft_margin_loss — log(1 + exp(-y * x))."""
+    def f(x, y):
+        return _reduce(jax.nn.softplus(-y * x), reduction)
+    return apply_op(f, _t(input), _t(label))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    """ref: F.multi_label_soft_margin_loss."""
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+
+    def f(x, y, *w):
+        per = -(y * jax.nn.log_sigmoid(x)
+                + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            per = per * w[0]
+        return _reduce(jnp.mean(per, -1), reduction)
+    return apply_op(f, *args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """ref: F.multi_margin_loss (hinge over classes)."""
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+
+    def f(x, y, *w):
+        n, c = x.shape
+        yi = y.astype(jnp.int32)
+        xy = jnp.take_along_axis(x, yi[:, None], 1)       # [N,1]
+        m = jnp.maximum(0.0, margin - xy + x) ** p
+        if w:
+            m = m * w[0][yi][:, None]
+        onehot = jax.nn.one_hot(yi, c, dtype=x.dtype)
+        per = jnp.sum(m * (1 - onehot), -1) / c
+        return _reduce(per, reduction)
+    return apply_op(f, *args)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """ref: F.triplet_margin_with_distance_loss."""
+    dist = distance_function
+
+    def f(a, p, n):
+        if dist is None:
+            def d(u, v):
+                return jnp.sqrt(jnp.sum((u - v) ** 2, -1) + 1e-12)
+        else:
+            def d(u, v):
+                r = dist(Tensor(u), Tensor(v))
+                return r._value if isinstance(r, Tensor) else r
+        dp = d(a, p)
+        dn = d(a, n)
+        if swap:
+            dn = jnp.minimum(dn, d(p, n))
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return apply_op(f, _t(input), _t(positive), _t(negative))
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """ref: F.zeropad2d — padding [left, right, top, bottom]."""
+    l, r, t_, b = [int(v) for v in padding]
+
+    def f(a):
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (t_, b), (l, r)]
+        else:
+            cfg = [(0, 0), (t_, b), (l, r), (0, 0)]
+        return jnp.pad(a, cfg)
+    return apply_op(f, _t(x))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """ref: F.max_unpool2d — scatter pooled values back to the positions
+    recorded by max_pool2d(return_mask=True). Static-shape scatter."""
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride, stride) if isinstance(stride, int) else tuple(stride))
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+
+    def f(v, idx):
+        n, c, h, w = v.shape
+        if output_size is not None:
+            oh, ow = [int(s) for s in output_size[-2:]]
+        else:
+            oh = (h - 1) * st[0] - 2 * pd[0] + ks[0]
+            ow = (w - 1) * st[1] - 2 * pd[1] + ks[1]
+        flat = jnp.zeros((n, c, oh * ow), v.dtype)
+        ii = idx.reshape(n, c, h * w).astype(jnp.int32)
+        # duplicate indices (stride < kernel) all carry the SAME source
+        # value (the element that is max of several windows), so
+        # scatter-SET is deterministic and matches the reference; add
+        # would multiply-count it
+        flat = jax.vmap(jax.vmap(
+            lambda f_, i_, s_: f_.at[i_].set(s_)))(flat, ii,
+                                                   v.reshape(n, c, h * w))
+        return flat.reshape(n, c, oh, ow)
+    return apply_op(f, _t(x), _t(indices))

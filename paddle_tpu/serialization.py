@@ -69,20 +69,18 @@ def save(obj, path, protocol=4, **configs):
 
 
 def load(path, return_numpy=False, **configs):
-    # sniff the header before committing to reading the whole file
+    # sniff the header, then keep reading from the SAME handle
     with open(path, "rb") as f:
         head = f.read(len(_MAGIC))
-    if not head.startswith(_MAGIC):
-        if head[:1] == b"\x80":
-            # a plain pickle: a reference-framework .pdparams/.pdopt
-            # checkpoint — delegate to the compat reader so
-            # paddle.load("model.pdparams") parity is real
-            from .compat import load_pdparams
-            return load_pdparams(path, return_numpy=return_numpy)
-        raise ValueError(f"{path} is not a paddle_tpu checkpoint")
-    with open(path, "rb") as f:
-        data = f.read()
-    body = data[len(_MAGIC):]
+        if not head.startswith(_MAGIC):
+            if head[:1] == b"\x80":
+                # a plain pickle: a reference-framework .pdparams/.pdopt
+                # checkpoint — delegate to the compat reader so
+                # paddle.load("model.pdparams") parity is real
+                from .compat import load_pdparams
+                return load_pdparams(path, return_numpy=return_numpy)
+            raise ValueError(f"{path} is not a paddle_tpu checkpoint")
+        body = f.read()
     sep = b"\n__NPZ__\n"
     idx = body.index(sep)
     spec = pickle.loads(body[:idx])

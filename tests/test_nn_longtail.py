@@ -1,0 +1,182 @@
+"""Long-tail nn layers/losses vs torch reference numerics (torch-cpu is in
+the image; torch and the reference share these ops' definitions)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+def _tt(x):
+    return torch.tensor(x)
+
+
+class TestLossesVsTorch:
+    def setup_method(self, m):
+        self.rng = np.random.default_rng(0)
+
+    def test_gaussian_nll(self):
+        x = self.rng.standard_normal((8, 4)).astype(np.float32)
+        y = self.rng.standard_normal((8, 4)).astype(np.float32)
+        var = (self.rng.random((8, 4)).astype(np.float32) + 0.1)
+        for full in (False, True):
+            got = _np(F.gaussian_nll_loss(
+                paddle.to_tensor(x), paddle.to_tensor(y),
+                paddle.to_tensor(var), full=full))
+            ref = torch.nn.functional.gaussian_nll_loss(
+                _tt(x), _tt(y), _tt(var), full=full).numpy()
+            assert np.allclose(got, ref, atol=1e-5), full
+
+    def test_soft_margin(self):
+        x = self.rng.standard_normal((10,)).astype(np.float32)
+        y = np.where(self.rng.random(10) > 0.5, 1.0, -1.0).astype(np.float32)
+        got = _np(F.soft_margin_loss(paddle.to_tensor(x),
+                                     paddle.to_tensor(y)))
+        ref = torch.nn.functional.soft_margin_loss(_tt(x), _tt(y)).numpy()
+        assert np.allclose(got, ref, atol=1e-6)
+
+    def test_multi_label_soft_margin(self):
+        x = self.rng.standard_normal((6, 5)).astype(np.float32)
+        y = (self.rng.random((6, 5)) > 0.5).astype(np.float32)
+        got = _np(F.multi_label_soft_margin_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y)))
+        ref = torch.nn.functional.multilabel_soft_margin_loss(
+            _tt(x), _tt(y)).numpy()
+        assert np.allclose(got, ref, atol=1e-6)
+
+    def test_multi_margin(self):
+        x = self.rng.standard_normal((6, 5)).astype(np.float32)
+        y = self.rng.integers(0, 5, 6)
+        got = _np(F.multi_margin_loss(paddle.to_tensor(x),
+                                      paddle.to_tensor(y)))
+        ref = torch.nn.functional.multi_margin_loss(
+            _tt(x), torch.tensor(y, dtype=torch.long)).numpy()
+        assert np.allclose(got, ref, atol=1e-6)
+
+    def test_triplet_with_distance(self):
+        a = self.rng.standard_normal((6, 8)).astype(np.float32)
+        p = self.rng.standard_normal((6, 8)).astype(np.float32)
+        n = self.rng.standard_normal((6, 8)).astype(np.float32)
+        for swap in (False, True):
+            got = _np(F.triplet_margin_with_distance_loss(
+                paddle.to_tensor(a), paddle.to_tensor(p),
+                paddle.to_tensor(n), swap=swap))
+            ref = torch.nn.functional.triplet_margin_with_distance_loss(
+                _tt(a), _tt(p), _tt(n), swap=swap).numpy()
+            assert np.allclose(got, ref, atol=1e-5), swap
+
+
+class TestLayersVsTorch:
+    def setup_method(self, m):
+        self.rng = np.random.default_rng(1)
+
+    def test_bilinear(self):
+        paddle.seed(0)
+        layer = nn.Bilinear(4, 5, 3)
+        x1 = self.rng.standard_normal((6, 4)).astype(np.float32)
+        x2 = self.rng.standard_normal((6, 5)).astype(np.float32)
+        got = _np(layer(paddle.to_tensor(x1), paddle.to_tensor(x2)))
+        w = _np(layer.weight)
+        b = _np(layer.bias)
+        ref = np.einsum("bi,oij,bj->bo", x1, w, x2) + b
+        assert np.allclose(got, ref, atol=1e-5)
+
+    def test_softmax2d_logsigmoid(self):
+        x = self.rng.standard_normal((2, 3, 4, 4)).astype(np.float32)
+        got = _np(nn.Softmax2D()(paddle.to_tensor(x)))
+        ref = torch.nn.Softmax2d()(_tt(x)).numpy()
+        assert np.allclose(got, ref, atol=1e-6)
+        got2 = _np(nn.LogSigmoid()(paddle.to_tensor(x)))
+        ref2 = torch.nn.LogSigmoid()(_tt(x)).numpy()
+        assert np.allclose(got2, ref2, atol=1e-6)
+
+    def test_zeropad2d(self):
+        x = self.rng.standard_normal((1, 2, 3, 3)).astype(np.float32)
+        got = _np(F.zeropad2d(paddle.to_tensor(x), [1, 2, 0, 1]))
+        ref = torch.nn.functional.pad(_tt(x), (1, 2, 0, 1)).numpy()
+        assert np.allclose(got, ref)
+
+    def test_feature_alpha_dropout(self):
+        paddle.seed(2)
+        layer = nn.FeatureAlphaDropout(0.5)
+        layer.train()
+        x = paddle.to_tensor(np.ones((4, 8, 3, 3), np.float32))
+        out = _np(layer(x))
+        # whole channels share one value (dropped or kept)
+        per_chan = out.reshape(4, 8, -1)
+        assert np.allclose(per_chan.std(-1), 0.0, atol=1e-6)
+        assert len(np.unique(per_chan[:, :, 0].round(4))) == 2
+        layer.eval()
+        assert np.allclose(_np(layer(x)), 1.0)
+
+    def test_max_unpool2d_roundtrip(self):
+        x = self.rng.standard_normal((1, 2, 8, 8)).astype(np.float32)
+        pooled, idx = F.max_pool2d(paddle.to_tensor(x), 2, 2,
+                                   return_mask=True)
+        up = F.max_unpool2d(pooled, idx, 2, 2)
+        ref_p, ref_i = torch.nn.functional.max_pool2d(
+            _tt(x), 2, 2, return_indices=True)
+        ref = torch.nn.functional.max_unpool2d(ref_p, ref_i, 2, 2).numpy()
+        assert np.allclose(_np(up), ref, atol=1e-6)
+
+    def test_fractional_max_pool(self):
+        x = self.rng.standard_normal((1, 2, 9, 9)).astype(np.float32)
+        layer = nn.FractionalMaxPool2D(4, random_u=0.5)
+        out = _np(layer(paddle.to_tensor(x)))
+        assert out.shape == (1, 2, 4, 4)
+        # every output is a max over a window -> must appear in the input
+        for v in out.ravel():
+            assert np.any(np.isclose(x, v))
+
+    def test_fractional_max_pool_mask_and_kernel(self):
+        # regression: return_mask/kernel_size were silently ignored
+        x = self.rng.standard_normal((1, 1, 9, 9)).astype(np.float32)
+        layer = nn.FractionalMaxPool2D(4, kernel_size=2, random_u=0.3,
+                                       return_mask=True)
+        out, mask = layer(paddle.to_tensor(x))
+        o, m = _np(out), _np(mask)
+        assert o.shape == (1, 1, 4, 4) and m.shape == (1, 1, 4, 4)
+        # the mask indexes the flat input and recovers the output values
+        flat = x.reshape(1, 1, -1)
+        picked = np.take_along_axis(flat, m.reshape(1, 1, -1).astype(int),
+                                    -1).reshape(o.shape)
+        assert np.allclose(picked, o)
+
+    def test_max_unpool2d_overlapping_windows(self):
+        # regression: stride < kernel duplicated scatter indices; the
+        # unpool must write v once, not k*v
+        x = np.zeros((1, 1, 3, 3), np.float32)
+        x[0, 0, 1, 1] = 7.0  # max of all four 2x2 windows
+        pooled, idx = F.max_pool2d(paddle.to_tensor(x), 2, 1,
+                                   return_mask=True)
+        up = _np(F.max_unpool2d(pooled, idx, 2, 1))
+        ref_p, ref_i = torch.nn.functional.max_pool2d(
+            _tt(x), 2, 1, return_indices=True)
+        ref = torch.nn.functional.max_unpool2d(ref_p, ref_i, 2, 1).numpy()
+        assert np.allclose(up, ref)
+        assert up[0, 0, 1, 1] == 7.0  # not 28.0
+
+    def test_adaptive_log_softmax(self):
+        paddle.seed(3)
+        layer = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 10])
+        x = paddle.to_tensor(
+            self.rng.standard_normal((6, 16)).astype(np.float32))
+        y = paddle.to_tensor(self.rng.integers(0, 20, 6))
+        out, loss = layer(x, y)
+        lp = _np(layer.log_prob(x))
+        assert lp.shape == (6, 20)
+        # rows are log-distributions
+        assert np.allclose(np.exp(lp).sum(-1), 1.0, atol=1e-4)
+        assert np.allclose(_np(out),
+                           lp[np.arange(6), _np(y).astype(int)], atol=1e-5)
+        assert np.isclose(float(loss), -_np(out).mean(), atol=1e-6)
+        # trains
+        g = paddle.grad(loss, layer.head_weight)[0]
+        assert np.isfinite(_np(g)).all()
