@@ -181,14 +181,21 @@ class FractionalMaxPool2D(Layer):
         self.return_mask = return_mask
 
     def _bounds(self, n_in, n_out, u):
-        # Graham's pseudo-random sequence: a_i = ceil(alpha*(i+u)) with
-        # alpha = n_in/n_out guarantees increments in {floor(a), ceil(a)}
+        # Graham's pseudo-random sequence a_i = ceil(alpha*(i+u)), then
+        # clamped so every window is NON-EMPTY and ends exactly at n_in
+        # (the raw sequence can hit n_in early, which would leave the last
+        # window(s) empty and poison the max with -inf)
         alpha = n_in / n_out
         import numpy as np
         idx = np.arange(n_out + 1)
         b = np.ceil(alpha * (idx + u)).astype(int)
         b[0] = 0
         b[-1] = n_in
+        # forward: strictly increasing; backward: leave >= 1 per window
+        for i in range(1, n_out):
+            b[i] = max(b[i], b[i - 1] + 1)
+        for i in range(n_out - 1, 0, -1):
+            b[i] = min(b[i], b[i + 1] - 1)
         return b
 
     def forward(self, x):

@@ -178,6 +178,38 @@ class Tensor:
     def pin_memory(self):
         return self
 
+    def cuda(self, device_id=None, blocking=True):
+        """ref: Tensor.cuda — maps to the default accelerator here."""
+        return Tensor(jax.device_put(self._value, jax.devices()[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def element_size(self):
+        return int(jnp.dtype(self._value.dtype).itemsize)
+
+    def dim(self):
+        return self._value.ndim
+
+    ndimension = dim
+
+    def contiguous(self):
+        return self  # jax arrays have no strided views
+
+    def is_contiguous(self):
+        return True
+
+    def apply_(self, func):
+        """ref: Tensor.apply_ — elementwise python callable, in place.
+        Host-evaluated like the reference (documented as slow there too)."""
+        import numpy as np
+        host = np.asarray(self._value)
+        self._value = jnp.asarray(np.vectorize(func)(host),
+                                  dtype=self._value.dtype)
+        return self
+
+    def apply(self, func):
+        out = Tensor(self._value, stop_gradient=True)
+        return out.apply_(func)
+
     # -- python protocol ----------------------------------------------------
     def __len__(self):
         if not self._value.shape:

@@ -366,3 +366,103 @@ def log_normal(mean=1.0, std=2.0, shape=None, name=None):
     return apply_op(lambda a: jnp.exp(mean + std * a), g)
 
 
+
+
+def _c(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+# ---------------------------------------------------------------------------
+# round-2 long-tail additions (ref: python/paddle/tensor/math.py)
+# ---------------------------------------------------------------------------
+def nextafter(x, y, name=None):
+    return apply_op(jnp.nextafter, _c(x), _c(y))
+
+
+def xlogy(x, y, name=None):
+    from jax.scipy import special as jss
+    return apply_op(jss.xlogy, _c(x), _c(y))
+
+
+def i0e(x, name=None):
+    from jax.scipy import special as jss
+    return apply_op(jss.i0e, _c(x))
+
+
+def igamma(a, x, name=None):
+    """Upper regularized incomplete gamma (paddle's igamma = Q(a, x))."""
+    from jax.scipy import special as jss
+    return apply_op(jss.gammaincc, _c(a), _c(x))
+
+
+def igammac(a, x, name=None):
+    """Lower regularized incomplete gamma (paddle's igammac = P(a, x))."""
+    from jax.scipy import special as jss
+    return apply_op(jss.gammainc, _c(a), _c(x))
+
+
+def gammainc(a, x, name=None):
+    from jax.scipy import special as jss
+    return apply_op(jss.gammainc, _c(a), _c(x))
+
+
+def gammaincc(a, x, name=None):
+    from jax.scipy import special as jss
+    return apply_op(jss.gammaincc, _c(a), _c(x))
+
+
+def signbit(x, name=None):
+    return apply_op(jnp.signbit, _c(x))
+
+
+def isreal(x, name=None):
+    return apply_op(jnp.isreal, _c(x))
+
+
+def vdot(x, y, name=None):
+    return apply_op(jnp.vdot, _c(x), _c(y))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """ref: paddle.renorm — rescale slices along `axis` whose p-norm
+    exceeds max_norm down to exactly max_norm."""
+    def f(a):
+        red = tuple(i for i in range(a.ndim) if i != axis)
+        norms = jnp.sum(jnp.abs(a) ** p, axis=red, keepdims=True) ** (1 / p)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return a * factor
+    return apply_op(f, _c(x))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """ref: paddle.combinations — r-combinations of a 1-D tensor's
+    elements (static index grid; host-precomputed like the reference)."""
+    import itertools as _it
+    import numpy as np
+    t = _c(x)
+    n = int(t.shape[0])
+    gen = (_it.combinations_with_replacement(range(n), r)
+           if with_replacement else _it.combinations(range(n), r))
+    idx = np.array(list(gen), dtype=np.int32).reshape(-1, r)
+    return apply_op(lambda a: a[idx], t)
+
+
+def cartesian_prod(*tensors, name=None):
+    """ref: paddle.cartesian_prod — 1-D result for a single input, like
+    the reference."""
+    ts = [_c(t) for t in tensors]
+    if len(ts) == 1:
+        return apply_op(lambda a: a.reshape(-1), ts[0])
+
+    def f(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return apply_op(f, *ts)
+
+
+__all__ += [
+    "nextafter", "xlogy", "i0e", "igamma", "igammac", "gammainc",
+    "gammaincc", "signbit", "isreal", "vdot", "renorm", "combinations",
+    "cartesian_prod",
+]

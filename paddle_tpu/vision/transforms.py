@@ -5,6 +5,7 @@ via ToTensor, matching the reference's conventions.
 """
 from __future__ import annotations
 
+import math
 import numbers
 import random
 
@@ -281,3 +282,350 @@ class ContrastTransform(BaseTransform):
         mean = img.mean()
         out = (img.astype(np.float32) - mean) * f + mean
         return np.clip(out, 0, 255).astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+# ---------------------------------------------------------------------------
+# round-2 long-tail transforms (ref: python/paddle/vision/transforms/
+# transforms.py + functional.py). Host-side numpy like the rest of this
+# module — transforms run in the input pipeline, not on the TPU.
+# ---------------------------------------------------------------------------
+def adjust_brightness(img, brightness_factor):
+    """ref: F.adjust_brightness."""
+    out = np.asarray(img).astype(np.float32) * float(brightness_factor)
+    a = np.asarray(img)
+    return np.clip(out, 0, 255).astype(a.dtype) if a.dtype == np.uint8 \
+        else out
+
+
+def adjust_contrast(img, contrast_factor):
+    """ref: F.adjust_contrast."""
+    a = np.asarray(img)
+    mean = a.astype(np.float32).mean()
+    out = (a.astype(np.float32) - mean) * float(contrast_factor) + mean
+    return np.clip(out, 0, 255).astype(a.dtype) if a.dtype == np.uint8 \
+        else out
+
+
+def adjust_hue(img, hue_factor):
+    """ref: F.adjust_hue — hue rotation via HSV round trip."""
+    assert -0.5 <= hue_factor <= 0.5
+    a = np.asarray(img).astype(np.float32)
+    scale = 255.0 if np.asarray(img).dtype == np.uint8 else 1.0
+    rgb = a / scale if scale != 1.0 else a
+    # rgb<->hsv (vectorized, channels-last)
+    maxc = rgb.max(-1)
+    minc = rgb.min(-1)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    dd = np.maximum(d, 1e-12)
+    h = np.where(maxc == r, ((g - b) / dd) % 6,
+                 np.where(maxc == g, (b - r) / dd + 2, (r - g) / dd + 4))
+    h = np.where(d == 0, 0.0, h) / 6.0
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6).astype(int)
+    f = h * 6 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = (i % 6)[..., None]  # broadcast against the stacked channel dim
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    out = out * scale if scale != 1.0 else out
+    adt = np.asarray(img).dtype
+    return np.clip(out, 0, 255).astype(adt) if adt == np.uint8 else out
+
+
+def to_grayscale(img, num_output_channels=1):
+    """ref: F.to_grayscale (ITU-R 601-2 luma)."""
+    a = np.asarray(img).astype(np.float32)
+    gray = a[..., 0] * 0.299 + a[..., 1] * 0.587 + a[..., 2] * 0.114
+    out = np.repeat(gray[..., None], num_output_channels, -1)
+    adt = np.asarray(img).dtype
+    return np.clip(out, 0, 255).astype(adt) if adt == np.uint8 else out
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """ref: F.rotate — inverse-map nearest/bilinear resample (numpy).
+    expand=True enlarges the canvas to contain the whole rotated image."""
+    a = np.asarray(img)
+    h, w = a.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    th = np.deg2rad(angle)
+    cos, sin = np.cos(th), np.sin(th)
+    if expand:
+        oh = int(math.ceil(abs(h * cos) + abs(w * sin)))
+        ow = int(math.ceil(abs(w * cos) + abs(h * sin)))
+        ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
+    else:
+        oh, ow = h, w
+        ocy, ocx = cy, cx
+    yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    xs = cos * (xx - ocx) + sin * (yy - ocy) + cx
+    ys = -sin * (xx - ocx) + cos * (yy - ocy) + cy
+    if interpolation == "bilinear":
+        x0 = np.floor(xs).astype(int)
+        y0 = np.floor(ys).astype(int)
+        wx = xs - x0
+        wy = ys - y0
+
+        def g(yi, xi):
+            valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            yi = np.clip(yi, 0, h - 1)
+            xi = np.clip(xi, 0, w - 1)
+            px = a[yi, xi].astype(np.float32)
+            return np.where(valid[..., None] if a.ndim == 3 else valid,
+                            px, float(fill))
+        out = (g(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+               + g(y0, x0 + 1) * ((1 - wy) * wx)[..., None]
+               + g(y0 + 1, x0) * (wy * (1 - wx))[..., None]
+               + g(y0 + 1, x0 + 1) * (wy * wx)[..., None]) \
+            if a.ndim == 3 else None
+        if out is None:
+            out = (g(y0, x0) * (1 - wy) * (1 - wx)
+                   + g(y0, x0 + 1) * (1 - wy) * wx
+                   + g(y0 + 1, x0) * wy * (1 - wx)
+                   + g(y0 + 1, x0 + 1) * wy * wx)
+    else:
+        xi = np.round(xs).astype(int)
+        yi = np.round(ys).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yi = np.clip(yi, 0, h - 1)
+        xi = np.clip(xi, 0, w - 1)
+        out = a[yi, xi].astype(np.float32)
+        mask = valid[..., None] if a.ndim == 3 else valid
+        out = np.where(mask, out, float(fill))
+    return np.clip(out, 0, 255).astype(a.dtype) if a.dtype == np.uint8 \
+        else out.astype(a.dtype if a.dtype != np.uint8 else np.float32)
+
+
+class SaturationTransform(BaseTransform):
+    """ref: transforms.SaturationTransform."""
+
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = to_grayscale(img, 3).astype(np.float32)
+        out = img.astype(np.float32) * f + gray * (1 - f)
+        return np.clip(out, 0, 255).astype(img.dtype) \
+            if img.dtype == np.uint8 else out
+
+
+class HueTransform(BaseTransform):
+    """ref: transforms.HueTransform."""
+
+    def __init__(self, value, keys=None):
+        assert 0 <= value <= 0.5
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class Grayscale(BaseTransform):
+    """ref: transforms.Grayscale."""
+
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    """ref: transforms.RandomRotation."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """ref: transforms.RandomErasing — erase a random rectangle.
+    value='random' fills with gaussian noise like the reference; the
+    `inplace` flag is accepted (this numpy pipeline always copies)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if random.random() > self.prob:
+            return img
+        a = np.array(img, copy=True)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = math.exp(random.uniform(math.log(self.ratio[0]),
+                                         math.log(self.ratio[1])))
+            eh = int(round(math.sqrt(target * ar)))
+            ew = int(round(math.sqrt(target / ar)))
+            if eh < h and ew < w:
+                top = random.randint(0, h - eh)
+                left = random.randint(0, w - ew)
+                patch_shape = (eh, ew) + a.shape[2:]
+                if isinstance(self.value, str):  # 'random'
+                    noise = np.random.standard_normal(patch_shape)
+                    if a.dtype == np.uint8:
+                        noise = np.clip(noise * 255, 0, 255)
+                    a[top:top + eh, left:left + ew] = noise.astype(a.dtype)
+                else:
+                    a[top:top + eh, left:left + ew] = self.value
+                return a
+        return a
+
+
+class RandomAffine(BaseTransform):
+    """ref: transforms.RandomAffine — one inverse-map affine resample
+    covering rotation + translation + scale + shear."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale_range = scale
+        if shear is not None and isinstance(shear, (int, float)):
+            shear = (-abs(shear), abs(shear))
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        a = np.asarray(img)
+        h, w = a.shape[:2]
+        angle = math.radians(random.uniform(*self.degrees))
+        s = (random.uniform(*self.scale_range)
+             if self.scale_range is not None else 1.0)
+        shx = (math.radians(random.uniform(*self.shear))
+               if self.shear is not None else 0.0)
+        tx = (random.uniform(-self.translate[0], self.translate[0]) * w
+              if self.translate is not None else 0.0)
+        ty = (random.uniform(-self.translate[1], self.translate[1]) * h
+              if self.translate is not None else 0.0)
+        cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if self.center is None \
+            else (self.center[1], self.center[0])
+        # forward matrix M = T(c) R S Shear T(-c) + t; we resample with its
+        # inverse so every output pixel pulls from the source (fill beyond)
+        cos, sin = math.cos(angle), math.sin(angle)
+        M = np.array([[cos, -sin + cos * math.tan(shx)],
+                      [sin, cos + sin * math.tan(shx)]]) * s
+        Minv = np.linalg.inv(M)
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        dx = xx - cx - tx
+        dy = yy - cy - ty
+        xs = Minv[0, 0] * dx + Minv[0, 1] * dy + cx
+        ys = Minv[1, 0] * dx + Minv[1, 1] * dy + cy
+        xi = np.round(xs).astype(int)
+        yi = np.round(ys).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yi = np.clip(yi, 0, h - 1)
+        xi = np.clip(xi, 0, w - 1)
+        out = a[yi, xi]
+        mask = valid[..., None] if a.ndim == 3 else valid
+        out = np.where(mask, out, self.fill)
+        return out.astype(a.dtype)
+
+
+class RandomPerspective(BaseTransform):
+    """ref: transforms.RandomPerspective — random 4-point projective warp
+    (inverse-map nearest resample)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    @staticmethod
+    def _homography(src, dst):
+        A = []
+        for (x, y), (u, v) in zip(src, dst):
+            A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+            A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+        A = np.asarray(A, np.float64)
+        b = np.asarray(dst, np.float64).reshape(-1)
+        h8 = np.linalg.solve(A, b)
+        return np.append(h8, 1.0).reshape(3, 3)
+
+    def _apply_image(self, img):
+        if random.random() > self.prob:
+            return img
+        a = np.asarray(img)
+        h, w = a.shape[:2]
+        d = self.distortion_scale
+        dx = lambda: random.uniform(0, d * w / 2)  # noqa: E731
+        dy = lambda: random.uniform(0, d * h / 2)  # noqa: E731
+        dst = [(dx(), dy()), (w - 1 - dx(), dy()),
+               (w - 1 - dx(), h - 1 - dy()), (dx(), h - 1 - dy())]
+        src = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        # inverse map: output pixel -> source position
+        M = self._homography(dst, src)
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        ones = np.ones_like(xx)
+        pts = np.stack([xx, yy, ones], 0).reshape(3, -1)
+        mapped = M @ pts
+        xs = (mapped[0] / mapped[2]).reshape(h, w)
+        ys = (mapped[1] / mapped[2]).reshape(h, w)
+        xi = np.round(xs).astype(int)
+        yi = np.round(ys).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yi = np.clip(yi, 0, h - 1)
+        xi = np.clip(xi, 0, w - 1)
+        out = a[yi, xi]
+        mask = valid[..., None] if a.ndim == 3 else valid
+        out = np.where(mask, out, self.fill)
+        return out.astype(a.dtype)
+
+
+class ToPILImage(BaseTransform):
+    """ref: transforms.ToPILImage."""
+
+    def __init__(self, mode=None, keys=None):
+        self.mode = mode
+
+    def _apply_image(self, img):
+        from PIL import Image
+        a = np.asarray(img)
+        if a.dtype != np.uint8:
+            a = np.clip(a * 255 if a.max() <= 1.0 else a, 0,
+                        255).astype(np.uint8)
+        if a.ndim == 3 and a.shape[0] in (1, 3) and a.shape[-1] not in (1, 3):
+            a = np.transpose(a, (1, 2, 0))  # CHW -> HWC
+        if a.ndim == 3 and a.shape[-1] == 1:
+            a = a[..., 0]
+        return Image.fromarray(a, mode=self.mode)
+
+
+AdjustBrightness = BrightnessTransform
+AdjustContrast = ContrastTransform

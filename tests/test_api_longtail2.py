@@ -1,0 +1,171 @@
+"""Round-2 API sweep: long-tail math ops (vs scipy), Tensor convenience
+methods, vision transforms."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import transforms as T
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+t = paddle.to_tensor
+
+
+class TestMathLongtail:
+    def test_special_vs_scipy(self):
+        import scipy.special as sp
+        assert np.allclose(_np(paddle.xlogy(t(0.0), t(0.0))), 0.0)
+        assert np.allclose(_np(paddle.xlogy(t(2.0), t(3.0))),
+                           2 * math.log(3), atol=1e-6)
+        assert np.allclose(_np(paddle.igamma(t(2.0), t(1.0))),
+                           sp.gammaincc(2.0, 1.0), atol=1e-6)
+        assert np.allclose(_np(paddle.igammac(t(2.0), t(1.0))),
+                           sp.gammainc(2.0, 1.0), atol=1e-6)
+        assert np.allclose(_np(paddle.i0e(t(1.5))), sp.i0e(1.5), atol=1e-6)
+        assert np.allclose(_np(paddle.nextafter(t(1.0), t(2.0))),
+                           np.nextafter(np.float32(1), np.float32(2)))
+
+    def test_combinatorics(self):
+        c = _np(paddle.combinations(t([1.0, 2.0, 3.0]), 2))
+        assert np.allclose(c, [[1, 2], [1, 3], [2, 3]])
+        cr = _np(paddle.combinations(t([1.0, 2.0]), 2,
+                                     with_replacement=True))
+        assert np.allclose(cr, [[1, 1], [1, 2], [2, 2]])
+        cp = _np(paddle.cartesian_prod(t([1.0, 2.0]), t([3.0, 4.0])))
+        assert np.allclose(cp, [[1, 3], [1, 4], [2, 3], [2, 4]])
+
+    def test_renorm_signbit_vdot(self):
+        x = t(np.array([[3.0, 4.0], [6.0, 8.0]], np.float32))
+        r = _np(paddle.renorm(x, 2.0, 0, 5.0))
+        assert np.allclose(np.linalg.norm(r, axis=1), [5.0, 5.0])
+        # rows under the bound untouched
+        r2 = _np(paddle.renorm(x, 2.0, 0, 100.0))
+        assert np.allclose(r2, _np(x))
+        assert bool(_np(paddle.signbit(t(-1.0))))
+        assert not bool(_np(paddle.signbit(t(1.0))))
+        assert np.allclose(_np(paddle.vdot(t([1.0, 2.0]), t([3.0, 4.0]))),
+                           11.0)
+        assert not bool(_np(paddle.isreal(t(1j))).item()) \
+            if hasattr(_np(paddle.isreal(t(1j))), "item") else True
+
+    def test_tensor_method_binding(self):
+        x = t([0.5])
+        assert hasattr(x, "xlogy") and hasattr(x, "nextafter")
+        assert np.allclose(_np(x.xlogy(t([2.0]))), 0.5 * math.log(2),
+                           atol=1e-6)
+
+
+class TestTensorConvenience:
+    def test_sizes(self):
+        x = t(np.zeros((2, 3), np.float32))
+        assert x.element_size() == 4
+        assert x.dim() == 2 and x.ndimension() == 2
+        assert x.contiguous() is x
+        assert x.is_contiguous()
+
+    def test_cuda_alias(self):
+        x = t([1.0]).cuda()
+        assert np.allclose(_np(x), 1.0)
+
+    def test_apply_(self):
+        x = t(np.array([1.0, 2.0], np.float32))
+        x.apply_(lambda v: v * 10)
+        assert np.allclose(_np(x), [10.0, 20.0])
+        y = t(np.array([1.0], np.float32))
+        z = y.apply(lambda v: v + 1)
+        assert np.allclose(_np(z), 2.0)
+        assert np.allclose(_np(y), 1.0)  # original untouched
+
+
+class TestTransformsLongtail:
+    def setup_method(self, m):
+        rng = np.random.default_rng(0)
+        self.img = rng.integers(0, 256, (16, 16, 3)).astype(np.uint8)
+
+    def test_grayscale(self):
+        g = T.Grayscale(3)(self.img)
+        assert g.shape == (16, 16, 3)
+        assert np.allclose(g[..., 0], g[..., 1])
+        ref = (self.img[..., 0] * 0.299 + self.img[..., 1] * 0.587
+               + self.img[..., 2] * 0.114)
+        assert np.abs(g[..., 0].astype(float) - ref).max() <= 1.0
+
+    def test_rotate_identity_and_90(self):
+        assert np.allclose(T.rotate(self.img, 0.0), self.img)
+        r90 = T.rotate(self.img.astype(np.float32), 90.0)
+        assert np.allclose(r90, np.rot90(self.img, -1).astype(np.float32))
+
+    def test_hue_identity_and_range(self):
+        assert np.abs(T.adjust_hue(self.img, 0.0).astype(int)
+                      - self.img.astype(int)).max() <= 2
+        h = T.HueTransform(0.4)(self.img)
+        assert h.dtype == np.uint8 and h.shape == self.img.shape
+
+    def test_random_transforms_preserve_shape(self):
+        for tr in (T.RandomRotation(25), T.RandomErasing(prob=1.0),
+                   T.SaturationTransform(0.5),
+                   T.RandomAffine(10, translate=(0.1, 0.1),
+                                  scale=(0.8, 1.2)),
+                   T.RandomPerspective(prob=1.0)):
+            out = tr(self.img)
+            assert out.shape == self.img.shape, type(tr).__name__
+
+    def test_erasing_erases(self):
+        e = T.RandomErasing(prob=1.0, value=7)(self.img + 10)
+        assert (e == 7).any()
+
+    def test_to_pil(self):
+        pil = T.ToPILImage()(self.img)
+        assert pil.size == (16, 16)
+        back = np.asarray(pil)
+        assert np.allclose(back, self.img)
+
+    def test_rotate_expand(self):
+        # regression: expand=True was ignored
+        out = T.rotate(self.img, 45.0, expand=True)
+        assert out.shape[0] > 16 and out.shape[1] > 16
+        # all original content present: mean magnitude preserved-ish
+        assert out.max() == self.img.max()
+
+    def test_affine_translation_fills_not_wraps(self):
+        # regression: translation used np.roll (wraparound)
+        img = np.full((16, 16, 3), 200, np.uint8)
+        tr = T.RandomAffine(degrees=(0, 0), translate=(0.5, 0.5), fill=0)
+        random_found_fill = False
+        for _ in range(8):
+            out = tr(img)
+            if (out == 0).any():
+                random_found_fill = True
+                # no wraparound: every non-filled pixel is 200
+                assert set(np.unique(out)) <= {0, 200}
+        assert random_found_fill
+
+    def test_affine_shear_applied(self):
+        img = np.zeros((17, 17), np.float32)
+        img[:, 8] = 1.0  # vertical line: shear about the center tilts it
+        out = T.RandomAffine(degrees=(0, 0), shear=(30, 30))(img)
+        assert not np.allclose(out, img)  # sheared, not ignored
+
+    def test_erasing_random_value(self):
+        # regression: value='random' crashed
+        e = T.RandomErasing(prob=1.0, value="random")(self.img)
+        assert e.shape == self.img.shape
+
+    def test_fractional_pool_never_minus_inf(self):
+        import paddle_tpu.nn as nn
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((1, 1, 9, 9)).astype(np.float32)
+        # regression: u in the upper range made the last window empty
+        for u in (0.3, 0.5, 0.7, 0.95):
+            out = _np(nn.FractionalMaxPool2D(4, random_u=u)(
+                paddle.to_tensor(x)))
+            assert np.isfinite(out).all(), u
+
+    def test_cartesian_prod_single_input_1d(self):
+        out = _np(paddle.cartesian_prod(t([1.0, 2.0, 3.0])))
+        assert out.shape == (3,)
