@@ -250,7 +250,8 @@ def main():
         batch = args.batch or batch
         model = GPTForCausalLM(_resolve_config(
             cfg, max_position_embeddings=1024, hidden_dropout_prob=0.0,
-            attention_probs_dropout_prob=0.0, use_flash_attention=on_tpu))
+            attention_probs_dropout_prob=0.0,
+            use_flash_attention=on_tpu and not args.no_flash))
         model.eval()
         rng = np.random.default_rng(0)
         vocab = model.config.vocab_size

@@ -31,7 +31,7 @@ def cast(x, dtype):
     """ref: paddle.cast."""
     return x.astype(dtype)
 
-__version__ = "0.2.0"
+from .version import full_version as __version__  # noqa: E402
 
 
 def _lazy_import():
@@ -66,3 +66,8 @@ from . import onnx  # noqa: E402,F401
 from . import hub  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from . import compat  # noqa: E402,F401
+from . import device  # noqa: E402,F401
+from . import version  # noqa: E402,F401
+from .framework import (  # noqa: E402,F401
+    get_rng_state, set_rng_state, get_cuda_rng_state, set_cuda_rng_state,
+)

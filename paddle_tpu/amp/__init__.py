@@ -212,3 +212,14 @@ class GradScaler:
 
 
 from . import debugging  # noqa: F401,E402
+
+
+def is_bfloat16_supported(device=None):
+    """ref: paddle.amp.is_bfloat16_supported — always true on TPU/XLA."""
+    return True
+
+
+def is_float16_supported(device=None):
+    """ref: paddle.amp.is_float16_supported — XLA supports f16 math,
+    though bf16 is the native TPU dtype."""
+    return True

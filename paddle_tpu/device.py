@@ -1,0 +1,91 @@
+"""paddle.device namespace (ref: python/paddle/device/__init__.py).
+
+Device management maps to jax's device list: `set_device`/`get_device`
+select the default placement; the cuda submodule exposes the reference
+names against the accelerator actually present (TPU here) so ported
+scripts keep working — `paddle.device.cuda.synchronize()` on TPU
+synchronizes the async dispatch queue.
+"""
+from __future__ import annotations
+
+import jax
+
+from .framework import get_device, set_device  # noqa: F401
+
+__all__ = ["get_device", "set_device", "get_all_device_type",
+           "get_available_device", "get_available_custom_device",
+           "is_compiled_with_cuda", "is_compiled_with_rocm",
+           "is_compiled_with_custom_device", "cuda", "synchronize",
+           "device_count"]
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()
+            if d.platform not in ("cpu", "gpu")]
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_custom_device(device_type="tpu"):
+    return any(d.platform == device_type for d in jax.devices())
+
+
+def device_count():
+    return len(jax.devices())
+
+
+def synchronize(device=None):
+    """Block until all dispatched work on the device is done."""
+    import jax.numpy as jnp
+    # a trivial computation + sync flushes the async queue
+    jnp.zeros(()).block_until_ready()
+
+
+class _CudaNamespace:
+    """`paddle.device.cuda` parity against the accelerator present."""
+
+    @staticmethod
+    def device_count():
+        return len([d for d in jax.devices() if d.platform != "cpu"])
+
+    @staticmethod
+    def synchronize(device=None):
+        return synchronize(device)
+
+    @staticmethod
+    def empty_cache():
+        # XLA's allocator manages HBM; nothing to flush
+        return None
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return int(stats.get("peak_bytes_in_use", 0))
+
+    @staticmethod
+    def memory_allocated(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return int(stats.get("bytes_in_use", 0))
+
+    @staticmethod
+    def get_device_properties(device=None):
+        d = jax.devices()[0]
+        return {"name": str(d), "platform": d.platform,
+                "memory_stats": d.memory_stats() or {}}
+
+
+cuda = _CudaNamespace()

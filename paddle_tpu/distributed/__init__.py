@@ -28,3 +28,47 @@ from . import validate  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from .launch_mod import launch, spawn  # noqa: F401
+
+
+def get_group(id=0):
+    """ref: paddle.distributed.get_group — the mesh IS the group here;
+    returns a lightweight view of the global device set."""
+    import jax
+
+    class _Group:
+        def __init__(self):
+            self.ranks = list(range(jax.device_count()))
+            self.nranks = jax.device_count()
+            self.rank = get_rank()
+            self.id = id
+
+        def __repr__(self):
+            return f"Group(id={self.id}, nranks={self.nranks})"
+    return _Group()
+
+
+def destroy_process_group(group=None):
+    """ref: paddle.distributed.destroy_process_group — XLA collectives
+    are compiled into programs, not a live process group; nothing to tear
+    down (jax.distributed.shutdown exists for multi-host)."""
+    return None
+
+
+class rpc:
+    """paddle.distributed.rpc gate: RPC-based parameter-server training is
+    a CPU-cluster pattern the reference supports; on TPU pods the
+    equivalent scale-out is SPMD over the Mesh (see docs/distributed.md).
+    Every entry point raises with that pointer."""
+
+    @staticmethod
+    def _gate(*a, **k):
+        raise NotImplementedError(
+            "paddle.distributed.rpc (parameter-server RPC) is not part of "
+            "the TPU design: scale out with jax.sharding.Mesh + GSPMD "
+            "(docs/distributed.md). For multi-host control-plane needs use "
+            "jax.distributed.initialize / paddle_tpu.distributed.launch.")
+
+    init_rpc = _gate
+    rpc_sync = _gate
+    rpc_async = _gate
+    shutdown = _gate

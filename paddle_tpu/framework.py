@@ -276,3 +276,21 @@ def finfo(dtype):
     out.smallest_normal = float(inf.smallest_normal)
     out.dtype = str(inf.dtype)
     return out
+
+
+def get_rng_state(device=None):
+    """ref: paddle.get_rng_state — snapshot of the global generator."""
+    g = _default_generator
+    return {"seed": g.initial_seed(), "counter": g._counter}
+
+
+def set_rng_state(state, device=None):
+    """ref: paddle.set_rng_state."""
+    g = _default_generator
+    g.manual_seed(int(state["seed"]))
+    g._counter = int(state.get("counter", 0))
+
+
+# the reference's CUDA-specific variants map to the same global generator
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state

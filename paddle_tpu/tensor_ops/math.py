@@ -427,7 +427,8 @@ def renorm(x, p, axis, max_norm, name=None):
     """ref: paddle.renorm — rescale slices along `axis` whose p-norm
     exceeds max_norm down to exactly max_norm."""
     def f(a):
-        red = tuple(i for i in range(a.ndim) if i != axis)
+        ax = axis % a.ndim  # accept negative axes
+        red = tuple(i for i in range(a.ndim) if i != ax)
         norms = jnp.sum(jnp.abs(a) ** p, axis=red, keepdims=True) ** (1 / p)
         factor = jnp.where(norms > max_norm,
                            max_norm / jnp.maximum(norms, 1e-12), 1.0)

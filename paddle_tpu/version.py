@@ -1,0 +1,29 @@
+"""paddle.version parity (ref: python/paddle/version/__init__.py)."""
+from __future__ import annotations
+
+full_version = "0.2.0"
+major = "0"
+minor = "2"
+patch = "0"
+rc = "0"
+cuda_version = "False"   # the reference reports a string here
+cudnn_version = "False"
+tpu = True
+commit = "unknown"
+with_pip = True
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"tpu: {tpu}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -267,8 +267,7 @@ class BrightnessTransform(BaseTransform):
         if self.value == 0:
             return img
         f = random.uniform(max(0, 1 - self.value), 1 + self.value)
-        out = img.astype(np.float32) * f
-        return np.clip(out, 0, 255).astype(img.dtype) if img.dtype == np.uint8 else out
+        return adjust_brightness(img, f)
 
 
 class ContrastTransform(BaseTransform):
@@ -279,9 +278,7 @@ class ContrastTransform(BaseTransform):
         if self.value == 0:
             return img
         f = random.uniform(max(0, 1 - self.value), 1 + self.value)
-        mean = img.mean()
-        out = (img.astype(np.float32) - mean) * f + mean
-        return np.clip(out, 0, 255).astype(img.dtype) if img.dtype == np.uint8 else out
+        return adjust_contrast(img, f)
 
 
 # ---------------------------------------------------------------------------
@@ -349,6 +346,38 @@ def to_grayscale(img, num_output_channels=1):
     return np.clip(out, 0, 255).astype(adt) if adt == np.uint8 else out
 
 
+def _inverse_map_sample(a, xs, ys, interpolation="nearest", fill=0):
+    """Sample source image `a` at float positions (ys, xs) (one per output
+    pixel); out-of-bounds positions take `fill`. Shared by rotate /
+    RandomAffine / RandomPerspective."""
+    h, w = a.shape[:2]
+
+    def gather(yi, xi):
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yic = np.clip(yi, 0, h - 1)
+        xic = np.clip(xi, 0, w - 1)
+        px = a[yic, xic].astype(np.float32)
+        mask = valid[..., None] if a.ndim == 3 else valid
+        return np.where(mask, px, float(fill))
+
+    if interpolation == "bilinear":
+        x0 = np.floor(xs).astype(int)
+        y0 = np.floor(ys).astype(int)
+        wx = (xs - x0)
+        wy = (ys - y0)
+        if a.ndim == 3:
+            wx = wx[..., None]
+            wy = wy[..., None]
+        out = (gather(y0, x0) * (1 - wy) * (1 - wx)
+               + gather(y0, x0 + 1) * (1 - wy) * wx
+               + gather(y0 + 1, x0) * wy * (1 - wx)
+               + gather(y0 + 1, x0 + 1) * wy * wx)
+    else:
+        out = gather(np.round(ys).astype(int), np.round(xs).astype(int))
+    return np.clip(out, 0, 255).astype(a.dtype) if a.dtype == np.uint8 \
+        else out.astype(a.dtype)
+
+
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
     """ref: F.rotate — inverse-map nearest/bilinear resample (numpy).
@@ -369,40 +398,7 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
     xs = cos * (xx - ocx) + sin * (yy - ocy) + cx
     ys = -sin * (xx - ocx) + cos * (yy - ocy) + cy
-    if interpolation == "bilinear":
-        x0 = np.floor(xs).astype(int)
-        y0 = np.floor(ys).astype(int)
-        wx = xs - x0
-        wy = ys - y0
-
-        def g(yi, xi):
-            valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
-            yi = np.clip(yi, 0, h - 1)
-            xi = np.clip(xi, 0, w - 1)
-            px = a[yi, xi].astype(np.float32)
-            return np.where(valid[..., None] if a.ndim == 3 else valid,
-                            px, float(fill))
-        out = (g(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
-               + g(y0, x0 + 1) * ((1 - wy) * wx)[..., None]
-               + g(y0 + 1, x0) * (wy * (1 - wx))[..., None]
-               + g(y0 + 1, x0 + 1) * (wy * wx)[..., None]) \
-            if a.ndim == 3 else None
-        if out is None:
-            out = (g(y0, x0) * (1 - wy) * (1 - wx)
-                   + g(y0, x0 + 1) * (1 - wy) * wx
-                   + g(y0 + 1, x0) * wy * (1 - wx)
-                   + g(y0 + 1, x0 + 1) * wy * wx)
-    else:
-        xi = np.round(xs).astype(int)
-        yi = np.round(ys).astype(int)
-        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
-        yi = np.clip(yi, 0, h - 1)
-        xi = np.clip(xi, 0, w - 1)
-        out = a[yi, xi].astype(np.float32)
-        mask = valid[..., None] if a.ndim == 3 else valid
-        out = np.where(mask, out, float(fill))
-    return np.clip(out, 0, 255).astype(a.dtype) if a.dtype == np.uint8 \
-        else out.astype(a.dtype if a.dtype != np.uint8 else np.float32)
+    return _inverse_map_sample(a, xs, ys, interpolation, fill)
 
 
 class SaturationTransform(BaseTransform):
@@ -505,7 +501,8 @@ class RandomErasing(BaseTransform):
 
 class RandomAffine(BaseTransform):
     """ref: transforms.RandomAffine — one inverse-map affine resample
-    covering rotation + translation + scale + shear."""
+    covering rotation + translation + scale + shear (2- or 4-element
+    shear ranges like the reference)."""
 
     def __init__(self, degrees, translate=None, scale=None, shear=None,
                  interpolation="nearest", fill=0, center=None, keys=None):
@@ -516,7 +513,7 @@ class RandomAffine(BaseTransform):
         self.scale_range = scale
         if shear is not None and isinstance(shear, (int, float)):
             shear = (-abs(shear), abs(shear))
-        self.shear = shear
+        self.shear = None if shear is None else list(shear)
         self.interpolation = interpolation
         self.fill = fill
         self.center = center
@@ -527,34 +524,31 @@ class RandomAffine(BaseTransform):
         angle = math.radians(random.uniform(*self.degrees))
         s = (random.uniform(*self.scale_range)
              if self.scale_range is not None else 1.0)
-        shx = (math.radians(random.uniform(*self.shear))
-               if self.shear is not None else 0.0)
+        shx = shy = 0.0
+        if self.shear is not None:
+            shx = math.radians(random.uniform(self.shear[0], self.shear[1]))
+            if len(self.shear) == 4:
+                shy = math.radians(random.uniform(self.shear[2],
+                                                  self.shear[3]))
         tx = (random.uniform(-self.translate[0], self.translate[0]) * w
               if self.translate is not None else 0.0)
         ty = (random.uniform(-self.translate[1], self.translate[1]) * h
               if self.translate is not None else 0.0)
         cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if self.center is None \
             else (self.center[1], self.center[0])
-        # forward matrix M = T(c) R S Shear T(-c) + t; we resample with its
-        # inverse so every output pixel pulls from the source (fill beyond)
+        # forward M = R(angle) @ Shear(shx, shy) scaled by s, about the
+        # center, plus translation; resample with the inverse map
         cos, sin = math.cos(angle), math.sin(angle)
-        M = np.array([[cos, -sin + cos * math.tan(shx)],
-                      [sin, cos + sin * math.tan(shx)]]) * s
+        S = np.array([[1.0, math.tan(shx)], [math.tan(shy), 1.0]])
+        R = np.array([[cos, -sin], [sin, cos]])
+        M = (R @ S) * s
         Minv = np.linalg.inv(M)
         yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
         dx = xx - cx - tx
         dy = yy - cy - ty
         xs = Minv[0, 0] * dx + Minv[0, 1] * dy + cx
         ys = Minv[1, 0] * dx + Minv[1, 1] * dy + cy
-        xi = np.round(xs).astype(int)
-        yi = np.round(ys).astype(int)
-        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
-        yi = np.clip(yi, 0, h - 1)
-        xi = np.clip(xi, 0, w - 1)
-        out = a[yi, xi]
-        mask = valid[..., None] if a.ndim == 3 else valid
-        out = np.where(mask, out, self.fill)
-        return out.astype(a.dtype)
+        return _inverse_map_sample(a, xs, ys, self.interpolation, self.fill)
 
 
 class RandomPerspective(BaseTransform):
@@ -565,6 +559,7 @@ class RandomPerspective(BaseTransform):
                  interpolation="nearest", fill=0, keys=None):
         self.prob = prob
         self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
         self.fill = fill
 
     @staticmethod
@@ -597,15 +592,7 @@ class RandomPerspective(BaseTransform):
         mapped = M @ pts
         xs = (mapped[0] / mapped[2]).reshape(h, w)
         ys = (mapped[1] / mapped[2]).reshape(h, w)
-        xi = np.round(xs).astype(int)
-        yi = np.round(ys).astype(int)
-        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
-        yi = np.clip(yi, 0, h - 1)
-        xi = np.clip(xi, 0, w - 1)
-        out = a[yi, xi]
-        mask = valid[..., None] if a.ndim == 3 else valid
-        out = np.where(mask, out, self.fill)
-        return out.astype(a.dtype)
+        return _inverse_map_sample(a, xs, ys, self.interpolation, self.fill)
 
 
 class ToPILImage(BaseTransform):

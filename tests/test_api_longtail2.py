@@ -169,3 +169,23 @@ class TestTransformsLongtail:
     def test_cartesian_prod_single_input_1d(self):
         out = _np(paddle.cartesian_prod(t([1.0, 2.0, 3.0])))
         assert out.shape == (3,)
+
+    def test_renorm_negative_axis(self):
+        # regression: negative axis computed one global norm
+        x = t(np.array([[3.0, 4.0], [6.0, 8.0]], np.float32))
+        r_pos = _np(paddle.renorm(x, 2.0, 1, 5.0))
+        r_neg = _np(paddle.renorm(x, 2.0, -1, 5.0))
+        assert np.allclose(r_pos, r_neg)
+        assert np.allclose(np.linalg.norm(r_neg, axis=0),
+                           np.minimum(np.linalg.norm(_np(x), axis=0), 5.0))
+
+    def test_affine_four_element_shear_and_bilinear(self):
+        img = np.zeros((17, 17), np.float32)
+        img[:, 8] = 1.0
+        out = T.RandomAffine(degrees=(0, 0), shear=[-20, 20, -20, 20],
+                             interpolation="bilinear")(img)
+        assert out.shape == img.shape
+        p = T.RandomPerspective(prob=1.0, interpolation="bilinear")(
+            np.random.default_rng(0).integers(0, 255, (16, 16, 3))
+            .astype(np.uint8))
+        assert p.shape == (16, 16, 3)

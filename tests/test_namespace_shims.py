@@ -1,0 +1,58 @@
+"""paddle.device / version / rng-state / distributed group / amp caps."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestDevice:
+    def test_enumeration(self):
+        assert "cpu" in paddle.device.get_all_device_type()
+        devs = paddle.device.get_available_device()
+        assert len(devs) == paddle.device.device_count() >= 1
+
+    def test_cuda_namespace(self):
+        paddle.device.cuda.synchronize()
+        paddle.device.cuda.empty_cache()
+        assert paddle.device.cuda.memory_allocated() >= 0
+        props = paddle.device.cuda.get_device_properties()
+        assert "platform" in props
+
+    def test_compiled_flags(self):
+        assert paddle.device.is_compiled_with_cuda() is False
+
+
+class TestRngState:
+    def test_roundtrip(self):
+        st = paddle.get_rng_state()
+        a = np.asarray(paddle.rand([8]).numpy())
+        _ = paddle.rand([8])  # advance further
+        paddle.set_rng_state(st)
+        b = np.asarray(paddle.rand([8]).numpy())
+        assert np.allclose(a, b)
+
+    def test_cuda_aliases(self):
+        st = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(st)
+
+
+class TestDistributedShims:
+    def test_group(self):
+        g = paddle.distributed.get_group()
+        assert g.nranks == 8  # the virtual CPU mesh
+        assert paddle.distributed.destroy_process_group() is None
+
+    def test_rpc_gate(self):
+        with pytest.raises(NotImplementedError, match="Mesh"):
+            paddle.distributed.rpc.init_rpc("worker0")
+
+
+class TestVersionAmp:
+    def test_version(self):
+        assert paddle.version.full_version == paddle.__version__
+        paddle.version.show()
+        assert paddle.version.cuda() == "False"
+
+    def test_amp_caps(self):
+        assert paddle.amp.is_bfloat16_supported()
+        assert paddle.amp.is_float16_supported()
