@@ -32,6 +32,39 @@ TPU_PEAK_FLOPS = 197e12
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+    _Watchdog.pet()
+
+
+class _Watchdog:
+    """If the remote TPU backend wedges (observed 2026-07-30: a stalled
+    terminal-side compile hangs even jax.devices()), fail fast with a
+    diagnostic instead of hanging the driver until its own timeout."""
+
+    _last = time.monotonic()
+    LIMIT_S = 900  # 15 min without any progress
+
+    @classmethod
+    def pet(cls):
+        cls._last = time.monotonic()
+
+    @classmethod
+    def start(cls):
+        import os
+        import threading
+
+        def watch():
+            while True:
+                time.sleep(30)
+                idle = time.monotonic() - cls._last
+                if idle > cls.LIMIT_S:
+                    print(
+                        f"bench watchdog: no progress for {idle:.0f}s — "
+                        "TPU backend unresponsive (see BENCHLOG.md "
+                        "decode-path incident); aborting",
+                        file=sys.stderr, flush=True)
+                    os._exit(3)
+
+        threading.Thread(target=watch, daemon=True).start()
 
 
 def count_params(model):
@@ -113,6 +146,7 @@ def run(eng, batch, seq, steps, warmup, scan_steps=0):
         for c in range(calls):
             params, buffers, opt_state, l = multi(
                 params, buffers, opt_state, np.int32(eng._step + (c + 1) * k))
+            _Watchdog.pet()
         float(l)
         dt = time.perf_counter() - t0
         # donation deleted the engine's old arrays: rebind so any later
@@ -124,6 +158,7 @@ def run(eng, batch, seq, steps, warmup, scan_steps=0):
     t0 = time.perf_counter()
     for i in range(steps):
         loss, _ = eng.train_batch([ids], [labels])
+        _Watchdog.pet()  # dispatch is async: a healthy backend returns fast
     # the param-donation chain makes the last loss depend on every step, so
     # one final sync times the whole window
     float(loss)
@@ -178,6 +213,7 @@ def run_ernie(eng, batch, seq, steps, warmup):
     t0 = time.perf_counter()
     for _ in range(steps):
         loss, _ = eng.train_batch([ids], [labels, nsp])
+        _Watchdog.pet()
     float(loss)
     return batch * seq * steps / (time.perf_counter() - t0)
 
@@ -209,11 +245,13 @@ def run_resnet(eng, batch, steps, warmup, hw=224):
     t0 = time.perf_counter()
     for _ in range(steps):
         loss, _ = eng.train_batch([x], [y])
+        _Watchdog.pet()
     float(loss)
     return batch * steps / (time.perf_counter() - t0)
 
 
 def main():
+    _Watchdog.start()
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--steps", type=int, default=None)
@@ -263,6 +301,7 @@ def main():
         reps = 3
         for _ in range(reps):
             out = generate(model, prompt, max_new_tokens=new_tok)
+            _Watchdog.pet()
         float(jnp.sum(out._value if hasattr(out, "_value") else out))
         dt = (time.perf_counter() - t0) / reps
         print(json.dumps({
