@@ -13,7 +13,9 @@ from .stat import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from . import linalg  # noqa: F401
-from .linalg import matmul, dot, t, bmm, dist  # noqa: F401
+from .linalg import (  # noqa: F401
+    cdist, lu_unpack, matmul, matrix_exp, dot, ormqr, t, bmm, dist,
+)
 from ._bind import bind_tensor_methods
 
 bind_tensor_methods()

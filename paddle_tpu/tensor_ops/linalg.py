@@ -252,3 +252,38 @@ def ormqr(x, tau, other, left=True, transpose=False, name=None):
 def corrcoef_alias(x, rowvar=True, name=None):
     from .stat import corrcoef
     return corrcoef(x, rowvar=rowvar)
+
+
+# ---------------------------------------------------------------------------
+# round-2 long-tail additions (ref: python/paddle/tensor/linalg.py).
+# matrix_exp / lu_unpack / ormqr already exist above — only cdist is new;
+# the others just gained top-level `paddle.*` exports.
+# ---------------------------------------------------------------------------
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """ref: paddle.cdist — pairwise p-norm distances [.., N, M].
+
+    p == 2 uses the matmul formulation (MXU-friendly) unless the caller
+    picked the donot_use_mm mode (which exists exactly to avoid the
+    cancellation of ||a||^2+||b||^2-2ab for near-coincident points)."""
+    use_mm = (p == 2.0
+              and not compute_mode.startswith("donot_use_mm"))
+
+    def f(a, b):
+        if use_mm:
+            a2 = jnp.sum(a * a, -1)[..., :, None]
+            b2 = jnp.sum(b * b, -1)[..., None, :]
+            ab = a @ jnp.swapaxes(b, -1, -2)
+            s = jnp.maximum(a2 + b2 - 2 * ab, 0.0)
+            # grad-safe sqrt: d/ds sqrt(0) is inf; mask zeros so
+            # coincident points (the diagonal of cdist(x, x)) backprop 0
+            pos = s > 0
+            return jnp.where(pos, jnp.sqrt(jnp.where(pos, s, 1.0)), 0.0)
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), -1)
+        return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+    return apply_op(f, _t(x), _t(y))
+
+
+__all__ += ["cdist"]

@@ -560,3 +560,67 @@ def block_diag(inputs, name=None):
 
 def tolist(x):
     return x.tolist()
+
+
+# ---------------------------------------------------------------------------
+# round-2 long-tail additions (ref: python/paddle/tensor/manipulation.py)
+# ---------------------------------------------------------------------------
+def unflatten(x, axis, shape, name=None):
+    """ref: paddle.unflatten — expand one axis into `shape`."""
+    def f(a):
+        ax = axis % a.ndim
+        shp = tuple(int(s) for s in shape)
+        return a.reshape(a.shape[:ax] + shp + a.shape[ax + 1:])
+    return apply_op(f, _t(x))
+
+
+def index_fill(x, index, axis, value, name=None):
+    """ref: paddle.index_fill."""
+    def f(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[idx.astype(jnp.int32)].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+    return apply_op(f, _t(x), _t(index))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """ref: paddle.slice_scatter."""
+    def f(a, v):
+        import builtins
+        sl = [builtins.slice(None)] * a.ndim  # paddle.slice shadows builtin
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            sl[ax] = builtins.slice(int(st), int(en), int(sd))
+        return a.at[tuple(sl)].set(v)
+    return apply_op(f, _t(x), _t(value))
+
+
+def column_stack(x, name=None):
+    ts = [_t(v) for v in x]
+    return apply_op(lambda *arrs: jnp.column_stack(arrs), *ts)
+
+
+def row_stack(x, name=None):
+    ts = [_t(v) for v in x]
+    return apply_op(lambda *arrs: jnp.vstack(arrs), *ts)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return apply_op(lambda a: tuple(jnp.hsplit(a, num_or_indices)), _t(x))
+
+
+def vsplit(x, num_or_indices, name=None):
+    return apply_op(lambda a: tuple(jnp.vsplit(a, num_or_indices)), _t(x))
+
+
+def dsplit(x, num_or_indices, name=None):
+    return apply_op(lambda a: tuple(jnp.dsplit(a, num_or_indices)), _t(x))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return apply_op(
+        lambda a: tuple(jnp.array_split(a, num_or_indices, axis=axis)),
+        _t(x))
+
+
+__all__ += ["unflatten", "index_fill", "slice_scatter", "column_stack",
+            "row_stack", "hsplit", "vsplit", "dsplit", "tensor_split"]

@@ -467,3 +467,7 @@ __all__ += [
     "gammaincc", "signbit", "isreal", "vdot", "renorm", "combinations",
     "cartesian_prod",
 ]
+
+
+trapz = trapezoid  # torch-style alias the reference also exposes
+__all__ += ["trapz"]

@@ -164,3 +164,17 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
                        fweights=fw, aweights=aw)
     args = [w for w in (fweights, aweights) if w is not None]
     return apply_op(f, _t(x), *args)
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    """ref: paddle.histogram_bin_edges."""
+    def f(a):
+        lo, hi = (float(min), float(max))
+        if lo == 0 and hi == 0:
+            lo = jnp.min(a)
+            hi = jnp.max(a)
+        return jnp.linspace(lo, hi, int(bins) + 1)
+    return apply_op(f, _t(x))
+
+
+__all__ += ["histogram_bin_edges"]

@@ -1,0 +1,118 @@
+"""Round-2 API sweep 3: cdist/matrix_exp/lu_unpack/ormqr + manip/stat
+long tail."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+t = paddle.to_tensor
+
+
+def _np(x):
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
+class TestLinalgLongtail:
+    def setup_method(self, m):
+        self.rng = np.random.default_rng(0)
+
+    def test_cdist(self):
+        a = self.rng.standard_normal((5, 3)).astype(np.float32)
+        b = self.rng.standard_normal((4, 3)).astype(np.float32)
+        ref2 = np.sqrt(((a[:, None] - b[None]) ** 2).sum(-1))
+        assert np.allclose(_np(paddle.cdist(t(a), t(b))), ref2, atol=1e-4)
+        assert np.allclose(_np(paddle.cdist(t(a), t(b), p=1.0)),
+                           np.abs(a[:, None] - b[None]).sum(-1), atol=1e-5)
+        assert np.allclose(
+            _np(paddle.cdist(t(a), t(b), p=float("inf"))),
+            np.abs(a[:, None] - b[None]).max(-1), atol=1e-5)
+
+    def test_cdist_donot_mm_and_grad_safety(self):
+        # regression 1: donot_use_mm modes must take the exact path
+        a = (np.array([[1e4, 0.0], [1e4, 0.1]], np.float32))
+        exact = _np(paddle.cdist(t(a), t(a),
+                                 compute_mode="donot_use_mm_for_euclid_dist"))
+        assert np.allclose(exact[0, 1], 0.1, atol=1e-5)
+        # regression 2: coincident points must backprop 0, not NaN
+        x = t(np.array([[0.0, 0.0], [1.0, 1.0]], np.float32),
+              stop_gradient=False)
+        d = paddle.cdist(x, x)
+        g = paddle.grad(d.sum(), x)[0]
+        assert np.isfinite(_np(g)).all()
+
+    def test_matrix_exp(self):
+        import scipy.linalg
+        m = self.rng.standard_normal((3, 3)).astype(np.float32) * 0.3
+        assert np.allclose(_np(paddle.matrix_exp(t(m))),
+                           scipy.linalg.expm(m), atol=1e-4)
+
+    def test_lu_unpack_roundtrip(self):
+        from paddle_tpu.tensor_ops.linalg import lu as plu
+        M = self.rng.standard_normal((4, 4)).astype(np.float32)
+        out = plu(t(M))
+        LU, piv = _np(out[0]), _np(out[1])
+        P, L, U = [_np(v) for v in paddle.lu_unpack(t(LU), t(piv))]
+        assert np.allclose(P @ L @ U, M, atol=1e-4)
+        assert np.allclose(np.tril(L, -1) + np.eye(4), L, atol=1e-6)
+        assert np.allclose(np.triu(U), U, atol=1e-6)
+
+    def test_ormqr(self):
+        from scipy.linalg.lapack import sgeqrf
+        M = self.rng.standard_normal((4, 4)).astype(np.float32)
+        a, tau, _, _ = sgeqrf(M)
+        other = self.rng.standard_normal((4, 2)).astype(np.float32)
+        got = _np(paddle.ormqr(t(a), t(tau), t(other)))
+        q = np.linalg.qr(M)[0]
+        # Q @ other, up to the sign convention difference between lapack
+        # and np.linalg.qr columns
+        ref = q @ other
+        assert got.shape == ref.shape
+        col_match = np.allclose(np.abs(got), np.abs(ref), atol=1e-3)
+        assert col_match
+        # transpose=True gives Q^T @ other: Q^T Q = I check
+        qt_q = _np(paddle.ormqr(t(a), t(tau),
+                                paddle.ormqr(t(a), t(tau), t(other)),
+                                transpose=True))
+        assert np.allclose(qt_q, other, atol=1e-3)
+
+
+class TestManipStatLongtail:
+    def test_unflatten_index_fill(self):
+        x = t(np.arange(12, dtype=np.float32).reshape(3, 4))
+        assert tuple(paddle.unflatten(x, 1, [2, 2]).shape) == (3, 2, 2)
+        assert tuple(paddle.unflatten(x, -1, [4, 1]).shape) == (3, 4, 1)
+        fi = _np(paddle.index_fill(x, t(np.array([0, 2])), 0, -1.0))
+        assert (fi[0] == -1).all() and (fi[2] == -1).all()
+        assert (fi[1] == np.arange(4, 8)).all()
+
+    def test_stacks_and_splits(self):
+        x = t(np.arange(12, dtype=np.float32).reshape(3, 4))
+        cs = _np(paddle.column_stack([t(np.ones(3, np.float32)),
+                                      t(np.zeros(3, np.float32))]))
+        assert cs.shape == (3, 2)
+        rs = _np(paddle.row_stack([t(np.ones(4, np.float32)),
+                                   t(np.zeros(4, np.float32))]))
+        assert rs.shape == (2, 4)
+        sp = paddle.tensor_split(t(np.arange(10, dtype=np.float32)), 3)
+        assert [tuple(s.shape) for s in sp] == [(4,), (3,), (3,)]
+        assert tuple(paddle.hsplit(x, 2)[0].shape) == (3, 2)
+        assert tuple(paddle.vsplit(x, 3)[0].shape) == (1, 4)
+        x3 = t(np.zeros((2, 3, 4), np.float32))
+        assert tuple(paddle.dsplit(x3, 2)[0].shape) == (2, 3, 2)
+
+    def test_slice_scatter(self):
+        x = t(np.arange(12, dtype=np.float32).reshape(3, 4))
+        ss = _np(paddle.slice_scatter(x, t(np.zeros((3, 2), np.float32)),
+                                      [1], [1], [3], [1]))
+        assert (ss[:, 1:3] == 0).all()
+        assert (ss[:, 0] == [0, 4, 8]).all()
+
+    def test_histogram_bin_edges_trapz(self):
+        hb = _np(paddle.histogram_bin_edges(t(np.array([0.0, 1.0])),
+                                            bins=4))
+        assert np.allclose(hb, [0, 0.25, 0.5, 0.75, 1.0])
+        hb2 = _np(paddle.histogram_bin_edges(t(np.array([5.0])), bins=2,
+                                             min=1, max=3))
+        assert np.allclose(hb2, [1, 2, 3])
+        assert np.allclose(
+            _np(paddle.trapz(t(np.array([0.0, 1.0, 2.0])))), 2.0)
