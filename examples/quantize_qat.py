@@ -1,0 +1,53 @@
+"""Quantization-aware training -> int8 serving, end to end.
+
+Run:  python examples/quantize_qat.py
+"""
+try:
+    import paddle_tpu  # noqa: F401 (pip install -e . makes this work)
+except ModuleNotFoundError:  # running from a source checkout
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import quantization as Q
+from paddle_tpu.hapi.engine import Engine
+
+
+def main():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 4))
+
+    qat = Q.QAT()            # default: int8, EMA activation scales,
+    qat.quantize(net)        # per-channel weight scales
+    net.train()
+
+    eng = Engine(net, loss=paddle.nn.CrossEntropyLoss(),
+                 optimizer=paddle.optimizer.Adam(
+                     5e-3, parameters=net.parameters()))
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((512, 32)).astype("float32")
+    y = (X[:, :8].sum(-1) > 0).astype("int64") + 2 * (X[:, 0] > 0)
+    for step in range(60):
+        loss, _ = eng.train_batch([paddle.to_tensor(X)],
+                                  [paddle.to_tensor(y)])
+        if step % 20 == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+
+    net.eval()
+    fq_acc = (np.asarray(net(paddle.to_tensor(X)).numpy()).argmax(-1)
+              == y).mean()
+
+    qat.convert(net)         # int8 weights + scales; int8 x int8 matmul
+    int8_acc = (np.asarray(net(paddle.to_tensor(X)).numpy()).argmax(-1)
+                == y).mean()
+    print(f"fake-quant acc {fq_acc:.3f} -> int8 serving acc {int8_acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -263,26 +263,36 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
           name=None):
     """ref: paddle.cdist — pairwise p-norm distances [.., N, M].
 
-    p == 2 uses the matmul formulation (MXU-friendly) unless the caller
-    picked the donot_use_mm mode (which exists exactly to avoid the
-    cancellation of ||a||^2+||b||^2-2ab for near-coincident points)."""
-    use_mm = (p == 2.0
-              and not compute_mode.startswith("donot_use_mm"))
+    p == 2 uses the matmul formulation (MXU-friendly) when the mode asks
+    for it — always for use_mm_for_euclid_dist, only for feature dims
+    > 25 in the default if_necessary mode (reference semantics: small
+    dims keep the exact path, dodging ||a||^2+||b||^2-2ab cancellation);
+    never for donot_use_mm. p == 0 is hamming; p == inf is max."""
+    def _safe_root(s, power):
+        # d/ds s^power is inf at 0 — mask zeros so coincident points
+        # backprop 0, not NaN
+        pos = s > 0
+        return jnp.where(pos, jnp.where(pos, s, 1.0) ** power, 0.0)
 
     def f(a, b):
+        dim = a.shape[-1]
+        use_mm = p == 2.0 and (
+            compute_mode == "use_mm_for_euclid_dist"
+            or (compute_mode == "use_mm_for_euclid_dist_if_necessary"
+                and dim > 25))
         if use_mm:
             a2 = jnp.sum(a * a, -1)[..., :, None]
             b2 = jnp.sum(b * b, -1)[..., None, :]
             ab = a @ jnp.swapaxes(b, -1, -2)
-            s = jnp.maximum(a2 + b2 - 2 * ab, 0.0)
-            # grad-safe sqrt: d/ds sqrt(0) is inf; mask zeros so
-            # coincident points (the diagonal of cdist(x, x)) backprop 0
-            pos = s > 0
-            return jnp.where(pos, jnp.sqrt(jnp.where(pos, s, 1.0)), 0.0)
+            return _safe_root(jnp.maximum(a2 + b2 - 2 * ab, 0.0), 0.5)
         d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 0.0:
+            return jnp.sum((d != 0).astype(a.dtype), -1)
         if p == float("inf"):
             return jnp.max(jnp.abs(d), -1)
-        return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+        if p == 2.0:
+            return _safe_root(jnp.sum(d * d, -1), 0.5)
+        return _safe_root(jnp.sum(jnp.abs(d) ** p, -1), 1.0 / p)
     return apply_op(f, _t(x), _t(y))
 
 

@@ -173,6 +173,11 @@ def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
         if lo == 0 and hi == 0:
             lo = jnp.min(a)
             hi = jnp.max(a)
+        # numpy semantics: a collapsed range expands by +-0.5 so the bins
+        # have nonzero width even for constant input
+        same = hi <= lo
+        lo = jnp.where(same, lo - 0.5, lo)
+        hi = jnp.where(same, hi + 0.5, hi)
         return jnp.linspace(lo, hi, int(bins) + 1)
     return apply_op(f, _t(x))
 

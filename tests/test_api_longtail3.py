@@ -27,6 +27,40 @@ class TestLinalgLongtail:
             _np(paddle.cdist(t(a), t(b), p=float("inf"))),
             np.abs(a[:, None] - b[None]).max(-1), atol=1e-5)
 
+    def test_cdist_p0_and_constant_bins(self):
+        # regression: p=0 crashed; constant input gave zero-width bins
+        a = np.array([[1.0, 2.0, 3.0]], np.float32)
+        b = np.array([[1.0, 0.0, 3.0], [1.0, 2.0, 3.0]], np.float32)
+        h = _np(paddle.cdist(t(a), t(b), p=0.0))
+        assert np.allclose(h, [[1.0, 0.0]])
+        hb = _np(paddle.histogram_bin_edges(t(np.array([2.0, 2.0])),
+                                            bins=4))
+        assert hb[0] < hb[-1]  # expanded, not degenerate
+        assert np.allclose(hb, np.histogram_bin_edges(
+            np.array([2.0, 2.0]), bins=4))
+
+    def test_cdist_default_mode_small_dim_exact(self):
+        # regression: default if_necessary mode must keep small dims on
+        # the exact path (no ||a||^2-cancellation)
+        a = np.array([[1e4, 0.0], [1e4, 0.1]], np.float32)
+        d = _np(paddle.cdist(t(a), t(a)))
+        assert np.allclose(d[0, 1], 0.1, atol=1e-5)
+        # exact path must also be grad-safe at coincident points
+        x = t(a, stop_gradient=False)
+        g = paddle.grad(paddle.cdist(x, x,
+                        compute_mode="donot_use_mm_for_euclid_dist").sum(),
+                        x)[0]
+        assert np.isfinite(_np(g)).all()
+        # big dims take the mm path and agree with the exact one
+        rng = np.random.default_rng(1)
+        big = rng.standard_normal((4, 32)).astype(np.float32)
+        mm = _np(paddle.cdist(t(big), t(big)))
+        exact = _np(paddle.cdist(t(big), t(big),
+                    compute_mode="donot_use_mm_for_euclid_dist"))
+        # fp32 cancellation noise (~1e-2 near zero) is inherent to the mm
+        # formulation — the very reason the exact mode exists
+        assert np.allclose(mm, exact, atol=5e-2)
+
     def test_cdist_donot_mm_and_grad_safety(self):
         # regression 1: donot_use_mm modes must take the exact path
         a = (np.array([[1e4, 0.0], [1e4, 0.1]], np.float32))
