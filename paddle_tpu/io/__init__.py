@@ -8,6 +8,7 @@ from .sampler import (  # noqa: F401
     SequenceSampler, SubsetRandomSampler, WeightedRandomSampler,
 )
 from .dataloader import (  # noqa: F401
-    DataLoader, default_collate_fn, device_prefetch,
+    DataLoader, default_collate_fn, default_convert_fn, device_prefetch,
+    get_worker_info,
 )
 from .checkpoint import CheckpointManager  # noqa: F401

@@ -206,3 +206,28 @@ def device_prefetch(iterable, sharding=None, size=2):
             yield buf.popleft()
     finally:
         buf.clear()
+
+
+def get_worker_info():
+    """ref: paddle.io.get_worker_info — returns None outside a worker
+    process. The TPU DataLoader prefetches on ONE producer thread (the
+    C++ ring buffer parallelizes at the buffer level, not via worker
+    processes), so dataset code always runs in the main process and the
+    reference's `if get_worker_info() is None: iterate everything`
+    guard degenerates correctly."""
+    return None
+
+
+def default_convert_fn(batch):
+    """ref: paddle.io.dataloader.collate.default_convert_fn — convert
+    without batching. namedtuples rebuild field-wise like the
+    reference."""
+    if isinstance(batch, tuple) and hasattr(batch, "_fields"):
+        return type(batch)(*(default_convert_fn(b) for b in batch))
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(default_convert_fn(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: default_convert_fn(v) for k, v in batch.items()}
+    if isinstance(batch, (int, float)):
+        return np.asarray(batch)
+    return batch

@@ -264,10 +264,11 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
     """ref: paddle.cdist — pairwise p-norm distances [.., N, M].
 
     p == 2 uses the matmul formulation (MXU-friendly) when the mode asks
-    for it — always for use_mm_for_euclid_dist, only for feature dims
-    > 25 in the default if_necessary mode (reference semantics: small
-    dims keep the exact path, dodging ||a||^2+||b||^2-2ab cancellation);
-    never for donot_use_mm. p == 0 is hamming; p == inf is max."""
+    for it — always for use_mm_for_euclid_dist, only when either input
+    has > 25 rows in the default if_necessary mode (reference semantics:
+    small point sets keep the exact path, dodging ||a||^2+||b||^2-2ab
+    cancellation); never for donot_use_mm. p == 0 is hamming; p == inf
+    is max."""
     def _safe_root(s, power):
         # d/ds s^power is inf at 0 — mask zeros so coincident points
         # backprop 0, not NaN
@@ -275,11 +276,15 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
         return jnp.where(pos, jnp.where(pos, s, 1.0) ** power, 0.0)
 
     def f(a, b):
-        dim = a.shape[-1]
+        # reference heuristic: if_necessary switches to mm when either
+        # ROW count exceeds 25 (speed dominates); small point sets keep
+        # the exact path regardless of feature dim
+        n_rows = a.shape[-2]
+        m_rows = b.shape[-2]
         use_mm = p == 2.0 and (
             compute_mode == "use_mm_for_euclid_dist"
             or (compute_mode == "use_mm_for_euclid_dist_if_necessary"
-                and dim > 25))
+                and (n_rows > 25 or m_rows > 25)))
         if use_mm:
             a2 = jnp.sum(a * a, -1)[..., :, None]
             b2 = jnp.sum(b * b, -1)[..., None, :]

@@ -168,6 +168,9 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
 
 def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
     """ref: paddle.histogram_bin_edges."""
+    if float(max) < float(min):
+        raise ValueError("histogram_bin_edges: max must be larger than min")
+
     def f(a):
         lo, hi = (float(min), float(max))
         if lo == 0 and hi == 0:
