@@ -17,7 +17,10 @@ from .framework import (  # noqa: F401
     get_device, set_device, device_count, is_compiled_with_cuda,
     is_compiled_with_tpu, in_dynamic_mode, rng_scope, iinfo, finfo,
 )
-from .autograd import no_grad, enable_grad, is_grad_enabled, set_grad_enabled, grad  # noqa: F401
+from .autograd import (  # noqa: F401
+    no_grad, enable_grad, is_grad_enabled, set_grad_enabled, grad,
+    jacobian, hessian,
+)
 from .tensor import Tensor, to_tensor  # noqa: F401
 from .tensor_ops import *  # noqa: F401,F403
 from .tensor_ops import linalg  # noqa: F401

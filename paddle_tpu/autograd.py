@@ -506,3 +506,108 @@ class PyLayer(metaclass=PyLayerMeta):
         out = f(*arrs)
         return jax.tree_util.tree_map(lambda a: Tensor(a, stop_gradient=False),
                                       out)
+
+
+# ---------------------------------------------------------------------------
+# functional autograd API (ref: python/paddle/autograd/functional.py +
+# paddle.incubate.autograd, exposed as paddle_tpu.incubate.autograd too):
+# jacobian / hessian / jvp / vjp built on jax's transforms — exact,
+# composable, jit-compatible. Tensor<->array pytree plumbing reuses
+# functional_transforms._unwrap/_wrap.
+# ---------------------------------------------------------------------------
+def _check_fn_flags(create_graph, where):
+    if create_graph:
+        raise NotImplementedError(
+            f"{where}: create_graph=True is not supported on this API — "
+            "compose jax transforms via paddle_tpu.functional_grad / "
+            "paddle_tpu.value_and_grad for higher-order pipelines")
+
+
+def _wrap_fn(func):
+    """Lift a Tensor-level callable to a jnp-level one."""
+    from .functional_transforms import _unwrap
+    from .tensor import Tensor
+
+    def jf(*arrs):
+        ts = [Tensor(a, stop_gradient=False) for a in arrs]
+        return _unwrap(func(*ts))
+    return jf
+
+
+def _input_arrays(xs):
+    from .functional_transforms import _unwrap
+    multi = isinstance(xs, (list, tuple))
+    arrs = _unwrap(list(xs) if multi else [xs])
+    return multi, arrs
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """ref: paddle.autograd.jacobian — J[i, j] = d out_i / d x_j."""
+    from .functional_transforms import _wrap
+    _check_fn_flags(create_graph, "jacobian")
+    multi, arrs = _input_arrays(xs)
+    jf = _wrap_fn(func)
+    jac = jax.jacrev(lambda *a: jf(*a), argnums=tuple(range(len(arrs))))(
+        *arrs)
+    out = _wrap(jac)
+    if not multi:
+        return out[0] if isinstance(out, tuple) else out
+    return out
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """ref: paddle.autograd.hessian — for a SCALAR-output func."""
+    from .functional_transforms import _wrap
+    _check_fn_flags(create_graph, "hessian")
+    multi, arrs = _input_arrays(xs)
+    jf = _wrap_fn(func)
+
+    def scalar(*a):
+        return jnp.reshape(jf(*a), ())
+    hes = jax.hessian(scalar, argnums=tuple(range(len(arrs))))(*arrs)
+    out = _wrap(hes)
+    if not multi:
+        return out[0][0] if isinstance(out, tuple) else out
+    return out
+
+
+def jvp(func, xs, v=None, create_graph=False, allow_unused=False):
+    """ref: paddle.incubate.autograd.jvp -> (outputs, jvp_result)."""
+    from .functional_transforms import _unwrap, _wrap
+    _check_fn_flags(create_graph, "jvp")
+    multi, arrs = _input_arrays(xs)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrs)
+    else:
+        tangents = tuple(_unwrap(list(v) if isinstance(v, (list, tuple))
+                                 else [v]))
+    jf = _wrap_fn(func)
+    out, tangent_out = jax.jvp(lambda *a: jf(*a), tuple(arrs), tangents)
+    return _wrap(out), _wrap(tangent_out)
+
+
+def vjp(func, xs, v=None, create_graph=False, allow_unused=False):
+    """ref: paddle.incubate.autograd.vjp -> (outputs, vjp_result)."""
+    from .functional_transforms import _unwrap, _wrap
+    _check_fn_flags(create_graph, "vjp")
+    multi, arrs = _input_arrays(xs)
+    jf = _wrap_fn(func)
+    out, pullback = jax.vjp(lambda *a: jf(*a), *arrs)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        # cotangent must mirror the OUTPUT pytree structure exactly
+        cot_arrays = _unwrap(v)
+        out_flat, out_tree = jax.tree_util.tree_flatten(out)
+        cot_flat = jax.tree_util.tree_leaves(cot_arrays)
+        if len(cot_flat) != len(out_flat):
+            raise ValueError(
+                f"vjp: cotangent has {len(cot_flat)} leaves but the "
+                f"output has {len(out_flat)}")
+        cot = jax.tree_util.tree_unflatten(out_tree, cot_flat)
+    grads = pullback(cot)
+    outs_t = _wrap(out)
+    grads_w = [_wrap(g) for g in grads]
+    if not multi:
+        return outs_t, grads_w[0]
+    return outs_t, grads_w

@@ -7,6 +7,10 @@ from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from .ema import ExponentialMovingAverage  # noqa: F401
 from . import nn  # noqa: F401
 
+
+class autograd:  # noqa: N801  (namespace parity: paddle.incubate.autograd)
+    from ..autograd import hessian, jacobian, jvp, vjp
+
 EMA = ExponentialMovingAverage
 
 __all__ = ["LookAhead", "ModelAverage", "ExponentialMovingAverage", "EMA",

@@ -218,7 +218,23 @@ def _resolve_attr(attr, default_initializer, is_bias=False):
     elif isinstance(attr, Initializer):
         init = attr
     if init is None:
+        # reference precedence: explicit initializer > GLOBAL initializer
+        # (fires for bare attrs and ParamAttr(name=...) alike) > layer
+        # default > built-in default
+        init = _GLOBAL_INIT["bias" if is_bias else "weight"]
+    if init is None:
         init = default_initializer
     if init is None:
         init = Constant(0.0) if is_bias else XavierUniform()
     return init, name, trainable
+
+
+_GLOBAL_INIT = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """ref: paddle.nn.initializer.set_global_initializer — default
+    initializers used by create_parameter when no attr is given. Pass
+    (None, None) to restore the built-in defaults."""
+    _GLOBAL_INIT["weight"] = weight_init
+    _GLOBAL_INIT["bias"] = bias_init

@@ -1,0 +1,105 @@
+"""paddle.autograd functional API (jacobian/hessian/jvp/vjp) +
+set_global_initializer."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import jvp, vjp
+
+t = paddle.to_tensor
+
+
+def _np(x):
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
+class TestFunctionalAutograd:
+    def test_jacobian_elementwise(self):
+        x = t(np.array([1.0, 2.0, 3.0], np.float32))
+        J = _np(paddle.jacobian(lambda v: v ** 2, x))
+        assert np.allclose(J, np.diag([2.0, 4.0, 6.0]), atol=1e-5)
+
+    def test_jacobian_matrix_fn(self):
+        A = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        x = t(np.array([1.0, 1.0], np.float32))
+        J = _np(paddle.jacobian(lambda v: t(A) @ v, x))
+        assert np.allclose(J, A, atol=1e-5)
+
+    def test_jacobian_multi_input(self):
+        x = t(np.array([1.0, 2.0], np.float32))
+        y = t(np.array([3.0, 4.0], np.float32))
+        Jx, Jy = paddle.jacobian(lambda a, b: a * b, [x, y])
+        assert np.allclose(_np(Jx), np.diag([3.0, 4.0]), atol=1e-5)
+        assert np.allclose(_np(Jy), np.diag([1.0, 2.0]), atol=1e-5)
+
+    def test_hessian(self):
+        x = t(np.array([1.0, 2.0, 3.0], np.float32))
+        H = _np(paddle.hessian(lambda v: (v ** 3).sum(), x))
+        assert np.allclose(H, np.diag([6.0, 12.0, 18.0]), atol=1e-4)
+        # quadratic form: H = A + A^T
+        A = np.array([[2.0, 1.0], [0.0, 3.0]], np.float32)
+        z = t(np.ones(2, np.float32))
+        H2 = _np(paddle.hessian(lambda v: (v * (t(A) @ v)).sum(), z))
+        assert np.allclose(H2, A + A.T, atol=1e-4)
+
+    def test_incubate_namespace_and_flags(self):
+        import paddle_tpu as paddle
+        x = t(np.array([2.0], np.float32))
+        out, g = paddle.incubate.autograd.vjp(lambda v: v * v, x)
+        assert np.allclose(_np(g), [4.0])
+        with pytest.raises(NotImplementedError):
+            paddle.jacobian(lambda v: v, x, create_graph=True)
+
+    def test_global_init_fires_for_named_paramattr(self):
+        # regression: ParamAttr(name=...) without an initializer must
+        # still pick up the global initializer
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.initializer import (Constant, ParamAttr,
+                                               set_global_initializer)
+        set_global_initializer(Constant(0.25))
+        try:
+            fc = nn.Linear(3, 2, weight_attr=ParamAttr(name="w"))
+            assert np.allclose(_np(fc.weight), 0.25)
+        finally:
+            set_global_initializer(None, None)
+
+    def test_vjp_multi_output_structure(self):
+        # regression: list-output func with list cotangent crashed on a
+        # pytree-structure mismatch
+        x = t(np.array([1.0, 2.0], np.float32))
+        out, g = vjp(lambda v: [v * v, v + 1],
+                     x, [t(np.ones(2, np.float32)),
+                         t(np.zeros(2, np.float32))])
+        assert np.allclose(_np(g), [2.0, 4.0])
+
+    def test_jvp_vjp(self):
+        x = t(np.array([1.0, 2.0, 3.0], np.float32))
+        out, tang = jvp(lambda v: v * v, x, t(np.ones(3, np.float32)))
+        assert np.allclose(_np(out), [1.0, 4.0, 9.0])
+        assert np.allclose(_np(tang), [2.0, 4.0, 6.0])
+        out, grads = vjp(lambda v: v * v, x)
+        assert np.allclose(_np(grads), [2.0, 4.0, 6.0])
+        # custom cotangent
+        _, g2 = vjp(lambda v: v * v, x, t(np.array([1.0, 0.0, 2.0],
+                                                   np.float32)))
+        assert np.allclose(_np(g2), [2.0, 0.0, 12.0])
+
+
+class TestGlobalInitializer:
+    def test_overrides_defaults_not_explicit(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.initializer import (Constant, ParamAttr,
+                                               set_global_initializer)
+        set_global_initializer(Constant(0.5), Constant(-0.1))
+        try:
+            fc = nn.Linear(3, 2)
+            assert np.allclose(_np(fc.weight), 0.5)
+            assert np.allclose(_np(fc.bias), -0.1)
+            # explicit attr wins over the global
+            fc2 = nn.Linear(3, 2,
+                            weight_attr=ParamAttr(initializer=Constant(9.0)))
+            assert np.allclose(_np(fc2.weight), 9.0)
+        finally:
+            set_global_initializer(None, None)
+        fc3 = nn.Linear(3, 2)
+        assert not np.allclose(_np(fc3.weight), 0.5)
