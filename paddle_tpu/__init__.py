@@ -74,3 +74,32 @@ from . import version  # noqa: E402,F401
 from .framework import (  # noqa: E402,F401
     get_rng_state, set_rng_state, get_cuda_rng_state, set_cuda_rng_state,
 )
+
+
+# reference top-level aliases completing the namespace sweep
+from .tensor_ops.linalg import cond, norm  # noqa: E402,F401
+from .tensor_ops.linalg import inv as inverse  # noqa: E402,F401
+from .tensor_ops.linalg import matmul as mm, mv  # noqa: E402,F401
+from .tensor_ops import concat as cat  # noqa: E402,F401
+
+
+def numel(x):
+    """ref: paddle.numel — element count as a 0-d int64 Tensor (delegates
+    to Tensor.size)."""
+    from .tensor import Tensor as _T
+    import jax.numpy as _jnp
+    return _T(_jnp.asarray(int(x.size), _jnp.int64))
+
+
+def rank(x):
+    """ref: paddle.rank — ndim as a 0-d Tensor."""
+    from .tensor import Tensor as _T
+    import jax.numpy as _jnp
+    return _T(_jnp.asarray(x.ndim, _jnp.int64))
+
+
+def shape(x):
+    """ref: paddle.shape — runtime shape as an int tensor."""
+    from .tensor import Tensor as _T
+    import jax.numpy as _jnp
+    return _T(_jnp.asarray([int(s) for s in x.shape], _jnp.int64))

@@ -302,3 +302,11 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
 
 
 __all__ += ["cdist"]
+
+
+def mv(x, vec, name=None):
+    """ref: paddle.mv — matrix @ vector."""
+    return apply_op(lambda a, v: a @ v, _t(x), _t(vec))
+
+
+__all__ += ["mv"]

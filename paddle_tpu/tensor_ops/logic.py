@@ -80,3 +80,20 @@ def where(condition, x=None, y=None, name=None):
         return nonzero(condition, as_tuple=True)
     return apply_op(lambda c, a, b: jnp.where(c.astype(bool), a, b),
                     _t(condition), x, y)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    """ref: paddle.all."""
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_op(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), _t(x),
+                    differentiable=False)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    """ref: paddle.any."""
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_op(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), _t(x),
+                    differentiable=False)
+
+
+__all__ += ["all", "any"]

@@ -1,0 +1,42 @@
+"""Top-level namespace aliases from the round-2 completeness sweep."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+t = paddle.to_tensor
+
+
+def _np(x):
+    return np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+
+
+class TestAliases:
+    def test_all_any(self):
+        assert bool(_np(paddle.all(t(np.array([True, True])))))
+        assert not bool(_np(paddle.all(t(np.array([True, False])))))
+        assert bool(_np(paddle.any(t(np.array([False, True])))))
+        m = t(np.array([[True, False], [True, True]]))
+        assert list(_np(paddle.all(m, axis=0))) == [True, False]
+        assert _np(paddle.any(m, axis=1, keepdim=True)).shape == (2, 1)
+
+    def test_linalg_aliases(self):
+        x = t(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        assert np.allclose(_np(paddle.inverse(x)) @ _np(x), np.eye(2),
+                           atol=1e-5)
+        assert np.allclose(_np(paddle.mm(x, x)), _np(x) @ _np(x))
+        assert np.allclose(_np(paddle.mv(x, t(np.array([1.0, 1.0],
+                                                       np.float32)))),
+                           [3.0, 7.0])
+        assert float(_np(paddle.norm(x))) > 0
+        assert float(_np(paddle.cond(x))) > 0
+
+    def test_shape_introspection(self):
+        x = t(np.zeros((2, 3), np.float32))
+        assert int(_np(paddle.numel(x))) == 6
+        assert int(_np(paddle.rank(x))) == 2
+        assert list(_np(paddle.shape(x))) == [2, 3]
+
+    def test_cat(self):
+        x = t(np.ones((2, 2), np.float32))
+        assert tuple(paddle.cat([x, x]).shape) == (4, 2)
+        assert tuple(paddle.cat([x, x], axis=1).shape) == (2, 4)
