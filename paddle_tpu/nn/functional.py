@@ -1525,3 +1525,54 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
                                                    v.reshape(n, c, h * w))
         return flat.reshape(n, c, oh, ow)
     return apply_op(f, _t(x), _t(indices))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """ref: F.bilinear — out[k] = x1 @ W[k] @ x2 (+ b[k])."""
+    args = [_t(x1), _t(x2), _t(weight)]
+    if bias is not None:
+        args.append(_t(bias))
+
+    def f(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        return out + bb[0] if bb else out
+    return apply_op(f, *args)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """ref: F.fractional_max_pool2d — functional mirror of
+    nn.FractionalMaxPool2D (stateless; draws boundaries per call)."""
+    from .layers_extra import FractionalMaxPool2D
+    layer = FractionalMaxPool2D(output_size, kernel_size=kernel_size,
+                                random_u=random_u, return_mask=return_mask)
+    return layer(x)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """ref: F.feature_alpha_dropout — channel-wise alpha dropout."""
+    from .layers_extra import FeatureAlphaDropout
+    layer = FeatureAlphaDropout(p)
+    layer.train() if training else layer.eval()
+    return layer(x)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """ref: F.npair_loss (Sohn 2016): softmax CE over anchor-positive
+    similarities + l2 on the embeddings."""
+    def f(a, p, y):
+        sim = a @ p.T                                # [B, B]
+        y = y.reshape(-1)
+        same = (y[:, None] == y[None, :]).astype(sim.dtype)
+        tgt = same / jnp.sum(same, -1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=-1)
+        ce = -jnp.mean(jnp.sum(tgt * logp, -1))
+        # reference scaling: l2loss = (mean||a||^2 + mean||p||^2) * 0.25
+        reg = l2_reg * 0.25 * (jnp.mean(jnp.sum(a * a, -1))
+                               + jnp.mean(jnp.sum(p * p, -1)))
+        return ce + reg
+    return apply_op(f, _t(anchor), _t(positive), _t(labels))
+
+
+__all__ += ["bilinear", "fractional_max_pool2d", "feature_alpha_dropout",
+            "npair_loss"]

@@ -109,12 +109,7 @@ class Bilinear(Layer):
                                               attr=bias_attr, is_bias=True)
 
     def forward(self, x1, x2):
-        if self.bias is not None:
-            return apply_op(
-                lambda a, b, w, bb: jnp.einsum("bi,oij,bj->bo", a, w, b)
-                + bb, _t(x1), _t(x2), self.weight, self.bias)
-        return apply_op(lambda a, b, w: jnp.einsum("bi,oij,bj->bo", a, w, b),
-                        _t(x1), _t(x2), self.weight)
+        return F.bilinear(x1, x2, self.weight, self.bias)
 
 
 class Softmax2D(Layer):

@@ -180,3 +180,64 @@ class TestLayersVsTorch:
         # trains
         g = paddle.grad(loss, layer.head_weight)[0]
         assert np.isfinite(_np(g)).all()
+
+
+class TestFunctionalMirrors:
+    def test_bilinear_functional(self):
+        rng = np.random.default_rng(7)
+        x1 = rng.standard_normal((4, 3)).astype(np.float32)
+        x2 = rng.standard_normal((4, 5)).astype(np.float32)
+        w = rng.standard_normal((2, 3, 5)).astype(np.float32)
+        b = rng.standard_normal(2).astype(np.float32)
+        got = _np(F.bilinear(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                             paddle.to_tensor(w), paddle.to_tensor(b)))
+        ref = torch.nn.functional.bilinear(
+            _tt(x1), _tt(x2), _tt(w), _tt(b)).numpy()
+        assert np.allclose(got, ref, atol=1e-4)
+
+    def test_fractional_pool_functional(self):
+        rng = np.random.default_rng(8)
+        img = paddle.to_tensor(
+            rng.standard_normal((1, 2, 9, 9)).astype(np.float32))
+        out, mask = F.fractional_max_pool2d(img, 4, random_u=0.4,
+                                            return_mask=True)
+        assert tuple(out.shape) == (1, 2, 4, 4)
+        assert tuple(mask.shape) == (1, 2, 4, 4)
+
+    def test_feature_alpha_dropout_functional(self):
+        paddle.seed(9)
+        x = paddle.to_tensor(np.ones((2, 8, 3, 3), np.float32))
+        out = _np(F.feature_alpha_dropout(x, 0.5, training=True))
+        per_chan = out.reshape(2, 8, -1)
+        assert np.allclose(per_chan.std(-1), 0.0, atol=1e-6)
+        assert np.allclose(
+            _np(F.feature_alpha_dropout(x, 0.5, training=False)), 1.0)
+
+    def test_npair_loss_reference_reg_scaling(self):
+        # regression: reg divided by 2 instead of the reference's *0.25;
+        # with identical logits across the batch the CE term is constant
+        # log(B) for one class... use the closed single-sample form:
+        ones = paddle.to_tensor(np.ones((1, 1), np.float32))
+        y = paddle.to_tensor(np.array([0]))
+        l = float(F.npair_loss(ones, ones, y, l2_reg=0.002))
+        # CE = 0 (single row softmax), reg = 0.002*0.25*(1+1) = 0.001
+        assert np.isclose(l, 0.001, atol=1e-6)
+
+    def test_npair_loss(self):
+        rng = np.random.default_rng(10)
+        a = paddle.to_tensor(rng.standard_normal((6, 8)).astype(np.float32),
+                             stop_gradient=False)
+        p = paddle.to_tensor(rng.standard_normal((6, 8)).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 0, 1, 1, 2, 2]))
+        l = F.npair_loss(a, p, y)
+        assert np.isfinite(float(l))
+        g = paddle.grad(l, a)[0]
+        assert np.isfinite(_np(g)).all()
+        # perfectly separated similarities should give lower loss than
+        # anti-separated ones
+        emb = np.eye(6, 8, dtype=np.float32) * 10
+        good = float(F.npair_loss(paddle.to_tensor(emb),
+                                  paddle.to_tensor(emb), y))
+        bad = float(F.npair_loss(paddle.to_tensor(emb),
+                                 paddle.to_tensor(-emb), y))
+        assert good < bad
