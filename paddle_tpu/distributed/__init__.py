@@ -15,9 +15,9 @@ from .mesh import (  # noqa: F401
     DeviceMesh, get_mesh, set_mesh, ProcessMesh,
 )
 from .collective import (  # noqa: F401
-    all_gather, all_reduce, alltoall, alltoall_single, barrier, broadcast,
-    new_group, recv, reduce, reduce_scatter, scatter, send, split_group,
-    ReduceOp, wait,
+    all_gather, all_gather_object, all_reduce, broadcast_object_list, alltoall, alltoall_single,
+    barrier, broadcast, new_group, recv, reduce, reduce_scatter, scatter,
+    send, split_group, ReduceOp, wait,
 )
 from .sharding_api import (  # noqa: F401
     shard_tensor, shard_layer, Shard, Replicate, Partial, reshard,
@@ -72,3 +72,26 @@ class rpc:
     rpc_sync = _gate
     rpc_async = _gate
     shutdown = _gate
+
+
+class stream:
+    """ref: paddle.distributed.stream.* — stream-bound collectives.
+
+    XLA's async dispatch IS the stream: collectives are compiled into the
+    program and overlap automatically, so these alias the sync API
+    (group and op forward through unchanged)."""
+
+    @staticmethod
+    def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+                   use_calc_stream=False):
+        return all_reduce(tensor, op=op, group=group)
+
+    @staticmethod
+    def all_gather(tensor_list, tensor, group=None, sync_op=True,
+                   use_calc_stream=False):
+        return all_gather(tensor_list, tensor, group=group)
+
+    @staticmethod
+    def broadcast(tensor, src=0, group=None, sync_op=True,
+                  use_calc_stream=False):
+        return broadcast(tensor, src=src, group=group)

@@ -132,6 +132,12 @@ def all_gather_object(object_list, obj, group=None):
     return object_list
 
 
+def broadcast_object_list(object_list, src=0, group=None):
+    """ref: paddle.distributed.broadcast_object_list — no-op under the
+    single-controller model (every rank already holds src's objects)."""
+    return object_list
+
+
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     ax = _axis(group)

@@ -56,3 +56,23 @@ class TestVersionAmp:
     def test_amp_caps(self):
         assert paddle.amp.is_bfloat16_supported()
         assert paddle.amp.is_float16_supported()
+
+
+class TestDistributedExtras:
+    def test_object_collectives(self):
+        import paddle_tpu.distributed as dist
+        lst = []
+        dist.all_gather_object(lst, {"k": 7})
+        assert lst[0]["k"] == 7
+        objs = ["a", "b"]
+        assert dist.broadcast_object_list(objs) is objs
+
+    def test_stream_namespace(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        dist.stream.all_reduce(x)
+        out = []
+        dist.stream.all_gather(out, x)
+        dist.stream.broadcast(x, 0)
+        assert np.allclose(np.asarray(x.numpy()), 1.0)
