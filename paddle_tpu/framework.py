@@ -294,3 +294,23 @@ def set_rng_state(state, device=None):
 # the reference's CUDA-specific variants map to the same global generator
 get_cuda_rng_state = get_rng_state
 set_cuda_rng_state = set_rng_state
+
+
+class LazyGuard:
+    """ref: paddle.LazyGuard gate — delayed parameter materialization is a
+    Program-era feature for CPU-bound giant-model init. The TPU path
+    constructs params as jax arrays whose initializers are already lazy
+    device computations (no host round trip), and sharded construction
+    belongs to `shard_model` + the Engine's placement; a distinct lazy
+    mode would add staging complexity with no TPU win. Using it raises
+    with that recipe."""
+
+    def __enter__(self):
+        raise NotImplementedError(
+            "LazyGuard: construct the model normally (param init is "
+            "already device-lazy under XLA) and use "
+            "paddle_tpu.distributed.fleet.mpu.shard_model(model, mesh) "
+            "for sharded placement of large models")
+
+    def __exit__(self, *a):
+        return False

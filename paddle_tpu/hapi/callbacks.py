@@ -7,7 +7,7 @@ import time
 
 import numpy as np
 
-__all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+__all__ = ["WandbCallback", "Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "LRScheduler", "EarlyStopping", "VisualDL", "ReduceLROnPlateau",
            "config_callbacks"]
 
@@ -224,8 +224,12 @@ class VisualDL(Callback):
         os.makedirs(self.log_dir, exist_ok=True)
         self._f = open(os.path.join(self.log_dir, "events.jsonl"), "a")
 
-    def on_train_batch_end(self, step, logs=None):
+    def _write(self, rec):
         import json
+        if self._f is not None:
+            self._f.write(json.dumps(rec) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
         self._step += 1
         rec = {"step": self._step, "wall": time.time()}
         for k, v in (logs or {}).items():
@@ -233,7 +237,7 @@ class VisualDL(Callback):
                 v = v[0]
             if isinstance(v, numbers.Number):
                 rec[k] = float(v)
-        self._f.write(json.dumps(rec) + "\n")
+        self._write(rec)
 
     def on_train_end(self, logs=None):
         if self._f:
@@ -299,3 +303,57 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     lst.set_params({"epochs": epochs, "steps": steps, "verbose": verbose,
                     "metrics": metrics or []})
     return lst
+
+
+class WandbCallback(Callback):
+    """ref: paddle.callbacks.WandbCallback — logs to Weights & Biases when
+    the `wandb` package is installed; otherwise falls back to the JSONL
+    tracer (same schema as VisualDL) so the metrics are never lost."""
+
+    def __init__(self, project=None, name=None, dir=None, **kwargs):
+        super().__init__()
+        self._wandb = None
+        self._fallback = None
+        try:
+            import wandb
+            self._wandb = wandb
+            self._init_kwargs = dict(project=project, name=name, dir=dir,
+                                     **kwargs)
+        except ImportError:
+            self._fallback = VisualDL(log_dir=dir or "./wandb_fallback")
+
+    @staticmethod
+    def _scalars(logs):
+        out = {}
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)) and v:
+                v = v[0]
+            if isinstance(v, (int, float)):
+                out[k] = float(v)
+        return out
+
+    def on_train_begin(self, logs=None):
+        if self._wandb is not None:
+            self._run = self._wandb.init(**self._init_kwargs)
+        elif self._fallback is not None:
+            self._fallback.on_train_begin(logs)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._fallback is not None:
+            self._fallback.on_train_batch_end(step, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        scalars = self._scalars(logs)
+        if self._wandb is not None:
+            self._run.log({f"train/{k}": v for k, v in scalars.items()})
+        elif self._fallback is not None:
+            # VisualDL records per batch; emit an explicit epoch record so
+            # epoch-level metrics land in the JSONL too
+            self._fallback._write({"event": "epoch", "epoch": epoch,
+                                   **scalars})
+
+    def on_train_end(self, logs=None):
+        if self._wandb is not None:
+            self._run.finish()
+        elif self._fallback is not None:
+            self._fallback.on_train_end(logs)

@@ -22,7 +22,7 @@ from ..nn.layer import Layer, functional_call
 from ..tensor import Tensor
 
 __all__ = ["to_static", "save", "load", "InputSpec", "not_to_static",
-           "TranslatedLayer"]
+           "TranslatedLayer", "enable_to_static"]
 
 
 class InputSpec:
@@ -59,6 +59,10 @@ class StaticFunction:
         self._compiled = {}
 
     def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED[0]:
+            # enable_to_static(False): run the original eagerly (the
+            # captured fn is the pre-replacement bound forward for layers)
+            return self._fn(*args, **kwargs)
         layer = self._layer
         if layer is not None:
             params, buffers = layer.raw_state()
@@ -219,3 +223,14 @@ def get_hlo(layer_or_fn, *example_inputs, stage="stablehlo",
 
 
 __all__.append("get_hlo")
+
+
+_TO_STATIC_ENABLED = [True]
+
+
+def enable_to_static(flag: bool):
+    """ref: paddle.jit.enable_to_static — globally toggle to_static; when
+    off, decorated functions run eagerly (debugging parity)."""
+    _TO_STATIC_ENABLED[0] = bool(flag)
+
+

@@ -76,3 +76,57 @@ class TestDistributedExtras:
         dist.stream.all_gather(out, x)
         dist.stream.broadcast(x, 0)
         assert np.allclose(np.asarray(x.numpy()), 1.0)
+
+
+class TestJitLrCallbackExtras:
+    def test_linear_lr(self):
+        from paddle_tpu.optimizer.lr import LinearLR
+        s = LinearLR(0.1, total_steps=4, start_factor=0.5)
+        vals = [s()]
+        for _ in range(4):
+            s.step()
+            vals.append(s())
+        assert np.isclose(vals[0], 0.05)
+        assert np.isclose(vals[-1], 0.1)
+        # holds at end_factor past total_steps
+        s.step()
+        assert np.isclose(s(), 0.1)
+
+    def test_enable_to_static_toggle(self):
+        import jax
+
+        @paddle.jit.to_static
+        def f(x):
+            return x * 2
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        assert np.allclose(np.asarray(f(x).numpy()), 2.0)
+        paddle.jit.enable_to_static(False)
+        try:
+            out = f(x)  # eager path
+            assert np.allclose(np.asarray(out.numpy()), 2.0)
+        finally:
+            paddle.jit.enable_to_static(True)
+
+    def test_wandb_callback_fallback_records_metrics(self, tmp_path):
+        # regression: the fallback wrote an empty file and list-valued
+        # logs (Model.fit's format) were dropped entirely
+        import json
+        import os
+        from paddle_tpu.hapi.callbacks import WandbCallback
+        cbk = WandbCallback(project="p", dir=str(tmp_path))
+        assert cbk.model is None and cbk.params == {}  # base init ran
+        cbk.on_train_begin({})
+        cbk.on_train_batch_end(0, {"loss": [0.7]})
+        cbk.on_epoch_end(0, {"loss": [0.5], "acc": 0.9})
+        cbk.on_train_end({})
+        path = os.path.join(str(tmp_path), "events.jsonl")
+        recs = [json.loads(l) for l in open(path)]
+        assert any(r.get("loss") == 0.7 for r in recs)
+        assert any(r.get("event") == "epoch" and r.get("loss") == 0.5
+                   and r.get("acc") == 0.9 for r in recs)
+
+    def test_lazy_guard_gate(self):
+        with pytest.raises(NotImplementedError, match="shard_model"):
+            with paddle.LazyGuard():
+                pass
