@@ -174,16 +174,26 @@ class Generator:
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
-        self._key = jax.random.PRNGKey(int(seed))
+        # LAZY key creation: PRNGKey allocates a device array, and the
+        # module-level default generator must not touch the device at
+        # `import paddle_tpu` time (a wedged remote backend would hang
+        # the import; also keeps array-only imports fast)
+        self._key = None
         self._counter = 0
         return self
 
     def initial_seed(self) -> int:
         return self._seed
 
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self._seed)
+        return self._key
+
     def next_key(self):
         self._counter += 1
-        return jax.random.fold_in(self._key, self._counter)
+        return jax.random.fold_in(self.key, self._counter)
 
 
 _default_generator = Generator(int(os.environ.get("PADDLE_TPU_SEED", "0")))

@@ -1,0 +1,19 @@
+"""`import paddle_tpu` must not touch any device: a wedged remote backend
+(observed 2026-07-30) must not be able to hang the import, and array-free
+users shouldn't pay backend init."""
+import subprocess
+import sys
+
+
+def test_import_performs_no_device_ops():
+    code = (
+        "import jax\n"
+        "import jax._src.xla_bridge as xb\n"
+        "def boom(*a, **k):\n"
+        "    raise RuntimeError('DEVICE TOUCHED AT IMPORT')\n"
+        "xb.backends = boom\n"
+        "import paddle_tpu\n"
+        "print('CLEAN')\n")
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=240, cwd=".")
+    assert "CLEAN" in r.stdout, r.stderr[-2000:]
