@@ -35,6 +35,22 @@ class TestExamples:
         out = capsys.readouterr().out
         assert "int8 serving acc" in out
 
+    def test_deploy_runs(self, capsys, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        import os
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath("examples"))))
+        old = sys.argv
+        sys.argv = ["deploy_stablehlo.py"]
+        try:
+            runpy.run_path(os.path.join(os.path.dirname(__file__), "..",
+                                        "examples", "deploy_stablehlo.py"),
+                           run_name="__main__")
+        finally:
+            sys.argv = old
+        assert "exported + reloaded" in capsys.readouterr().out
+
     @pytest.mark.slow
     def test_bert_runs(self, capsys):
         _run("finetune_bert.py")
