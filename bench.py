@@ -241,14 +241,14 @@ def run_ernie(eng, batch, seq, steps, warmup):
     return batch * seq * steps / (time.perf_counter() - t0)
 
 
-def build_resnet_engine(amp):
+def build_resnet_engine(amp, s2d=False):
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.hapi.engine import Engine
     from paddle_tpu.vision.models import resnet50
 
     paddle.seed(0)
-    model = resnet50(num_classes=1000)
+    model = resnet50(num_classes=1000, s2d_stem=s2d)
     model.train()
     opt = paddle.optimizer.Momentum(0.1, momentum=0.9,
                                     parameters=model.parameters())
@@ -346,8 +346,8 @@ def worker_resnet(args, on_tpu):
     batch = args.batch or batch
     steps = args.steps or steps
     log(f"bench: resnet50 batch={batch} hw={hw} steps={steps} "
-        f"backend={jax.default_backend()} amp={amp}")
-    eng = build_resnet_engine(amp)
+        f"backend={jax.default_backend()} amp={amp} s2d={args.s2d}")
+    eng = build_resnet_engine(amp, s2d=args.s2d)
     tput = run_resnet(eng, batch, steps, warmup, hw)
     # 4.1 GFLOP fwd inference at 224px, x3 for fwd+bwd; scaled for
     # smaller images
@@ -363,7 +363,7 @@ def worker_resnet(args, on_tpu):
         if on_tpu else None,
         "mfu": round(tput * flops_per_img / TPU_PEAK_FLOPS, 4)
         if on_tpu else None,
-        "batch": batch, "image": hw,
+        "batch": batch, "image": hw, "s2d_stem": args.s2d,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -616,6 +616,9 @@ def main():
                          "batches)")
     ap.add_argument("--moment-dtype", default=None,
                     help="Adam moment dtype override (e.g. bfloat16)")
+    ap.add_argument("--s2d", action="store_true",
+                    help="resnet50: MLPerf space-to-depth stem (exactly "
+                         "equivalent 4x4/s1 conv over 12 channels)")
     ap.add_argument("--scan-steps", type=int, default=0,
                     help="run K optimizer steps per compiled call "
                          "(lax.scan) to amortize dispatch latency")
@@ -668,10 +671,12 @@ def main():
             passthrough.append("--no-flash")
         if args.recompute:
             passthrough.append("--recompute")
+        if args.s2d:
+            passthrough.append("--s2d")
         if args.scan_steps:
             passthrough += ["--scan-steps", str(args.scan_steps)]
     elif any(v is not None for v in overrides.values()) or args.no_flash \
-            or args.recompute or args.scan_steps:
+            or args.recompute or args.scan_steps or args.s2d:
         print("[bench] ignoring per-workload flags in full-suite mode "
               "(use --model to tune one workload)", file=sys.stderr,
               flush=True)

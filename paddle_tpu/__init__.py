@@ -64,6 +64,7 @@ from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
 from . import audio  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
+from . import geometric  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import onnx  # noqa: E402,F401
 from . import hub  # noqa: E402,F401

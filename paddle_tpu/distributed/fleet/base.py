@@ -125,6 +125,19 @@ class Fleet:
 
     def init(self, role_maker=None, is_collective=True, strategy=None,
              log_level="INFO"):
+        if role_maker is not None or not is_collective:
+            # ref: paddle/fluid/distributed/ps/ — the parameter-server mode
+            # (CPU PS hosting TB-scale sparse embeddings for recsys).
+            # Deliberately descoped on TPU (SURVEY §2.6): a CPU-side PS
+            # would bypass the ICI fabric entirely.
+            raise NotImplementedError(
+                "fleet parameter-server mode (role_maker / "
+                "is_collective=False) is not supported on the TPU backend. "
+                "Migration: shard embedding tables over the mesh instead — "
+                "paddle_tpu.distributed.fleet.mpu.VocabParallelEmbedding "
+                "for tensor-parallel vocab sharding, or ZeRO-3 "
+                "(group_sharded_parallel(level='p_g_os')) to partition "
+                "all parameters including embeddings over dp.")
         init_parallel_env()
         self._strategy = strategy or DistributedStrategy()
         hc = self._strategy.hybrid_configs

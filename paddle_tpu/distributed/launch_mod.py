@@ -1,26 +1,75 @@
 """Multi-host launch (ref: python/paddle/distributed/launch).
 
-The reference spawns one worker per GPU. JAX is single-controller per host:
-launch() initializes jax.distributed across hosts from env vars
-(PADDLE_TPU_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID or TPU pod metadata)
-then runs the training function once per host.
+The reference spawns one worker per GPU and wires them up over env vars
+(PADDLE_MASTER / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID). JAX is
+single-controller per host: launch() initializes jax.distributed across
+hosts from env vars — ours (PADDLE_TPU_COORDINATOR / _NUM_PROCESSES /
+_PROCESS_ID), the reference's names for drop-in script parity, or TPU
+pod metadata auto-detection — then runs the training function once per
+host.
 """
 from __future__ import annotations
 
 import os
 
-import jax
+
+def parse_env(environ=None):
+    """Resolve the multi-host wiring from environment variables.
+
+    Returns a dict:
+      mode: 'explicit' (coordinator given) | 'tpu_pod' (pod metadata,
+            jax auto-detects) | 'single' (no distributed env)
+      coordinator_address / num_processes / process_id for 'explicit'.
+
+    Precedence: PADDLE_TPU_* (ours) > PADDLE_* (reference parity:
+    PADDLE_MASTER, PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ID) > TPU pod
+    metadata (TPU_WORKER_HOSTNAMES / MEGASCALE_COORDINATOR_ADDRESS).
+    ref: python/paddle/distributed/launch/context/node.py env wiring.
+    """
+    env = os.environ if environ is None else environ
+    # family precedence is WHOLESALE: mixing coordinator from one launcher
+    # with world-size from another (stale exports) would hang initialize()
+    # waiting for peers that never come
+    if env.get("PADDLE_TPU_COORDINATOR"):
+        coord = env["PADDLE_TPU_COORDINATOR"]
+        num = env.get("PADDLE_TPU_NUM_PROCESSES", "1")
+        pid = env.get("PADDLE_TPU_PROCESS_ID", "0")
+    else:
+        coord = env.get("PADDLE_MASTER")
+        num = env.get("PADDLE_TRAINERS_NUM", "1")
+        pid = env.get("PADDLE_TRAINER_ID", "0")
+    if coord:
+        try:
+            num_i, pid_i = int(num), int(pid)
+        except ValueError as e:
+            raise ValueError(
+                f"malformed distributed env: num_processes={num!r} "
+                f"process_id={pid!r} (must be integers)") from e
+        if not 0 <= pid_i < num_i:
+            raise ValueError(
+                f"process_id {pid_i} out of range for num_processes "
+                f"{num_i} (PADDLE_TRAINER_ID must be 0-based)")
+        return {"mode": "explicit", "coordinator_address": coord,
+                "num_processes": num_i, "process_id": pid_i}
+    if env.get("TPU_WORKER_HOSTNAMES") or \
+            env.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        return {"mode": "tpu_pod"}
+    return {"mode": "single"}
 
 
 def launch(fn=None, args=()):
-    coord = os.environ.get("PADDLE_TPU_COORDINATOR")
-    if coord:
+    """Initialize jax.distributed per parse_env(), then run `fn` once on
+    this host (single-controller: the mesh covers every local device)."""
+    import jax
+
+    cfg = parse_env()
+    if cfg["mode"] == "explicit":
         jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=int(os.environ.get("PADDLE_TPU_NUM_PROCESSES", "1")),
-            process_id=int(os.environ.get("PADDLE_TPU_PROCESS_ID", "0")))
-    elif os.environ.get("TPU_WORKER_HOSTNAMES"):
-        jax.distributed.initialize()  # auto-detect on TPU pods
+            coordinator_address=cfg["coordinator_address"],
+            num_processes=cfg["num_processes"],
+            process_id=cfg["process_id"])
+    elif cfg["mode"] == "tpu_pod":
+        jax.distributed.initialize()  # auto-detect from pod metadata
     if fn is not None:
         return fn(*args)
 

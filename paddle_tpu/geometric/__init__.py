@@ -1,0 +1,160 @@
+"""paddle.geometric parity — graph message passing on TPU.
+
+ref: python/paddle/geometric/math.py (segment_sum/mean/max/min) and
+python/paddle/geometric/message_passing/send_recv.py (send_u_recv,
+send_ue_recv, send_uv).
+
+TPU-first design: everything lowers to `jax.ops.segment_*`, which XLA
+compiles to sorted-scatter HLO — no atomics (the reference's CUDA
+kernels rely on atomicAdd; TPU has none, and XLA's scatter emits a
+deterministic combiner instead, so results are bit-reproducible).
+Under `jit`, pass `out_size` (static) — the output row count must be a
+compile-time constant on TPU; eager calls may omit it and we read
+`max(ids)+1` off-device, matching the reference's dynamic behavior.
+
+Empty-segment semantics match the reference: rows with no incoming
+messages are 0 (the reference's CUDA kernels memset the output), not
+the -inf/+inf identities jax uses for max/min.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import apply_op
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _ids(x):
+    a = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if not jnp.issubdtype(a.dtype, jnp.integer):
+        raise TypeError(f"segment/index ids must be integer, got {a.dtype}")
+    return a.astype(jnp.int32)
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    # eager path: one device->host sync, same dynamic semantics as the
+    # reference; under jit this raises a ConcretizationError on purpose —
+    # pass out_size there (TPU needs static shapes)
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+def _reduce(msg, ids, n, op):
+    """Scatter-reduce `msg` rows into `n` output rows by `ids`, with the
+    reference's empty-segment semantics (rows receiving nothing are 0 —
+    the CUDA kernels memset the output; jax's max/min identities are
+    ±inf, and its mean would be 0/0)."""
+    if op == "sum":
+        return jax.ops.segment_sum(msg, ids, num_segments=n)
+    counts = jax.ops.segment_sum(jnp.ones(ids.shape, jnp.int32), ids,
+                                 num_segments=n)
+    if op == "mean":
+        s = jax.ops.segment_sum(msg, ids, num_segments=n)
+        denom = jnp.maximum(counts, 1).astype(msg.dtype)
+        return s / denom.reshape((-1,) + (1,) * (msg.ndim - 1))
+    out = (jax.ops.segment_max if op == "max" else jax.ops.segment_min)(
+        msg, ids, num_segments=n)
+    mask = (counts > 0).reshape((-1,) + (1,) * (out.ndim - 1))
+    return jnp.where(mask, out, jnp.zeros_like(out))
+
+
+def _segment(op, data, segment_ids, out_size, name=None):
+    ids = _ids(segment_ids)
+    n = _num_segments(ids, out_size)
+    return apply_op(lambda a: _reduce(a, ids, n, op), _t(data))
+
+
+def segment_sum(data, segment_ids, out_size=None, name=None):
+    """ref: paddle.geometric.segment_sum — sum rows of `data` grouped by
+    `segment_ids` into `max(id)+1` (or `out_size`) output rows."""
+    return _segment("sum", data, segment_ids, out_size, name)
+
+
+def segment_mean(data, segment_ids, out_size=None, name=None):
+    """ref: paddle.geometric.segment_mean (empty segments -> 0)."""
+    return _segment("mean", data, segment_ids, out_size, name)
+
+
+def segment_max(data, segment_ids, out_size=None, name=None):
+    """ref: paddle.geometric.segment_max (empty segments -> 0)."""
+    return _segment("max", data, segment_ids, out_size, name)
+
+
+def segment_min(data, segment_ids, out_size=None, name=None):
+    """ref: paddle.geometric.segment_min (empty segments -> 0)."""
+    return _segment("min", data, segment_ids, out_size, name)
+
+
+_REDUCES = ("sum", "mean", "max", "min")
+_MESSAGES = ("add", "sub", "mul", "div")
+
+
+def _combine(xs, ye, message_op):
+    if message_op == "add":
+        return xs + ye
+    if message_op == "sub":
+        return xs - ye
+    if message_op == "mul":
+        return xs * ye
+    if message_op == "div":
+        return xs / ye
+    raise ValueError(f"message_op must be one of {_MESSAGES}")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """ref: paddle.geometric.send_u_recv — gather node features at
+    `src_index`, scatter-reduce them to `dst_index` rows.
+    out[i] = reduce_{e: dst[e]==i} x[src[e]]."""
+    if reduce_op not in _REDUCES:
+        raise ValueError(f"reduce_op must be one of {_REDUCES}")
+    src = _ids(src_index)
+    dst = _ids(dst_index)
+    n = out_size
+    if n is None:
+        xa = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        n = xa.shape[0]
+    return apply_op(
+        lambda a: _reduce(jnp.take(a, src, axis=0), dst, n, reduce_op),
+        _t(x))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """ref: paddle.geometric.send_ue_recv — combine source-node features
+    with edge features (`message_op`), then scatter-reduce to dst rows.
+    out[i] = reduce_{e: dst[e]==i} (x[src[e]] message_op y[e])."""
+    if reduce_op not in _REDUCES:
+        raise ValueError(f"reduce_op must be one of {_REDUCES}")
+    src = _ids(src_index)
+    dst = _ids(dst_index)
+    n = out_size
+    if n is None:
+        xa = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        n = xa.shape[0]
+    return apply_op(
+        lambda a, e: _reduce(_combine(jnp.take(a, src, axis=0), e,
+                                      message_op), dst, n, reduce_op),
+        _t(x), _t(y))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """ref: paddle.geometric.send_uv — per-edge message from both
+    endpoints: out[e] = x[src[e]] message_op y[dst[e]]."""
+    src = _ids(src_index)
+    dst = _ids(dst_index)
+
+    def fn(a, b):
+        return _combine(jnp.take(a, src, axis=0),
+                        jnp.take(b, dst, axis=0), message_op)
+
+    return apply_op(fn, _t(x), _t(y))

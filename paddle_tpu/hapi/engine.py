@@ -64,8 +64,13 @@ class Engine:
             return arrs
         from jax.sharding import NamedSharding, PartitionSpec
         sh = NamedSharding(self.mesh, PartitionSpec("dp"))
+        ndp = self.mesh.shape["dp"]
+        # ragged batches (eval's last DataLoader batch without drop_last)
+        # can't split over dp — fall back to replicated for those rather
+        # than raising mid-epoch
         return jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, sh) if hasattr(a, "ndim") and a.ndim >= 1
+            lambda a: jax.device_put(a, sh)
+            if hasattr(a, "ndim") and a.ndim >= 1 and a.shape[0] % ndp == 0
             else a, arrs)
 
     # ------------------------------------------------------------------
@@ -210,9 +215,11 @@ class Engine:
             self.network.eval()
         if self._eval_fn is None:
             self._eval_fn = self._build_eval_fn()
+        # shard the eval batch over dp exactly like train_batch — else
+        # Model.evaluate/predict on a dp mesh silently runs replicated
         outs, loss_v = self._eval_fn(self._params, self._buffers,
-                                     _unwrap(list(inputs)),
-                                     _unwrap(list(labels)))
+                                     self._shard_batch(_unwrap(list(inputs))),
+                                     self._shard_batch(_unwrap(list(labels))))
         return loss_v, outs
 
     def predict_batch(self, inputs):

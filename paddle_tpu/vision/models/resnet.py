@@ -9,7 +9,8 @@ from ... import nn
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "resnet152", "wide_resnet50_2", "wide_resnet101_2", "resnext50_32x4d",
-           "resnext101_32x4d", "resnext101_64x4d", "resnext152_64x4d"]
+           "resnext101_32x4d", "resnext101_64x4d", "resnext152_64x4d",
+           "SpaceToDepthStem", "space_to_depth", "s2d_weights_from_7x7"]
 
 
 class BasicBlock(nn.Layer):
@@ -68,9 +69,69 @@ class BottleneckBlock(nn.Layer):
         return self.relu(out + identity)
 
 
+def space_to_depth(x, block_size):
+    """[B,C,H,W] -> [B, C*b*b, H/b, W/b]; channel index = (c, di, dj).
+    Pure reshape/transpose — free under XLA (layout change only)."""
+    b = int(block_size)
+    B, C, H, W = x.shape
+    x = x.reshape([B, C, H // b, b, W // b, b])
+    x = x.transpose([0, 1, 3, 5, 2, 4])
+    return x.reshape([B, C * b * b, H // b, W // b])
+
+
+class SpaceToDepthStem(nn.Layer):
+    """MLPerf-TPU-style replacement for the 7x7/s2 stem conv.
+
+    The 7x7 stride-2 conv on a 3-channel input is the worst op in the
+    network for the MXU: C_in=3 wastes 125/128 of the contraction lanes
+    and stride 2 halves window reuse. Packing 2x2 pixel blocks into
+    channels (space-to-depth) turns it into an exactly-equivalent 4x4
+    stride-1 conv over 12 input channels — 4x the lane utilization, no
+    strided access. Equivalence: pad the 7x7 kernel to 8x8 (one zero row
+    on top, one zero col on the left), then regroup taps by pixel parity;
+    `s2d_weights_from_7x7` performs that mapping so reference-trained
+    weights load exactly.
+    ref: MLPerf ResNet TPU recipes (conv0 space-to-depth);
+    python/paddle/vision/models/resnet.py keeps the plain 7x7.
+    """
+
+    def __init__(self, out_channels=64):
+        super().__init__()
+        self.conv = nn.Conv2D(12, out_channels, 4, stride=1,
+                              padding=[2, 1, 2, 1], bias_attr=False)
+
+    def forward(self, x):
+        h, w = x.shape[2], x.shape[3]
+        if h % 2 or w % 2:
+            raise ValueError(
+                f"SpaceToDepthStem needs even input H/W (got {h}x{w}): the "
+                "2x2 pixel packing has no exact 7x7/s2 equivalent on odd "
+                "sizes — pad the input or use the default stem "
+                "(s2d_stem=False)")
+        return self.conv(space_to_depth(x, 2))
+
+
+def s2d_weights_from_7x7(w7):
+    """Convert a [O,3,7,7] stem kernel to the exactly-equivalent
+    [O,12,4,4] space-to-depth kernel (see SpaceToDepthStem)."""
+    import numpy as np
+    w7 = np.asarray(w7)
+    o = w7.shape[0]
+    w = np.zeros((o, 12, 4, 4), w7.dtype)
+    for c in range(3):
+        for di in range(2):
+            for dj in range(2):
+                for p in range(4):
+                    for q in range(4):
+                        u, v = 2 * p + di - 1, 2 * q + dj - 1
+                        if 0 <= u < 7 and 0 <= v < 7:
+                            w[:, c * 4 + di * 2 + dj, p, q] = w7[:, c, u, v]
+    return w
+
+
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, s2d_stem=False):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -83,8 +144,11 @@ class ResNet(nn.Layer):
         self.inplanes = 64
         self.dilation = 1
 
-        self.conv1 = nn.Conv2D(3, self.inplanes, kernel_size=7, stride=2,
-                               padding=3, bias_attr=False)
+        if s2d_stem:
+            self.conv1 = SpaceToDepthStem(self.inplanes)
+        else:
+            self.conv1 = nn.Conv2D(3, self.inplanes, kernel_size=7, stride=2,
+                                   padding=3, bias_attr=False)
         self.bn1 = self._norm_layer(self.inplanes)
         self.relu = nn.ReLU()
         self.maxpool = nn.MaxPool2D(kernel_size=3, stride=2, padding=1)

@@ -16,7 +16,8 @@ from ..tensor import Tensor
 __all__ = ["Compose", "ToTensor", "Resize", "RandomHorizontalFlip",
            "RandomVerticalFlip", "Normalize", "Transpose", "CenterCrop",
            "RandomCrop", "RandomResizedCrop", "Pad", "BrightnessTransform",
-           "ContrastTransform", "to_tensor", "normalize", "resize",
+           "ContrastTransform", "SaturationTransform", "HueTransform",
+           "ColorJitter", "to_tensor", "normalize", "resize",
            "hflip", "vflip", "center_crop", "crop", "pad"]
 
 
@@ -428,6 +429,30 @@ class HueTransform(BaseTransform):
         if self.value == 0:
             return img
         return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """ref: transforms.ColorJitter — randomly jitter brightness, contrast,
+    saturation and hue, applying the four constituent transforms in a
+    random order per call (matches the reference's _get_param shuffle)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.brightness = float(brightness)
+        self.contrast = float(contrast)
+        self.saturation = float(saturation)
+        self.hue = float(hue)
+        self._parts = [BrightnessTransform(self.brightness),
+                       ContrastTransform(self.contrast),
+                       SaturationTransform(self.saturation),
+                       HueTransform(self.hue)]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        random.shuffle(order)
+        for i in order:
+            img = self._parts[i]._apply_image(np.asarray(img))
+        return img
 
 
 class Grayscale(BaseTransform):

@@ -214,3 +214,14 @@ class TestRNGStateTracker:
         # global stream: identical rows on all ranks
         for r in glob[1:]:
             np.testing.assert_allclose(r, glob[0])
+
+
+def test_fleet_ps_mode_gated():
+    """SURVEY §2.6 descope: parameter-server mode raises a loud gate with
+    a TPU migration recipe instead of silently pretending to work."""
+    import pytest as _pytest
+    from paddle_tpu.distributed import fleet
+    with _pytest.raises(NotImplementedError, match="parameter-server"):
+        fleet.init(role_maker=object())
+    with _pytest.raises(NotImplementedError, match="VocabParallelEmbedding"):
+        fleet.init(is_collective=False)

@@ -1,0 +1,235 @@
+"""Fault-tolerance drills (VERDICT r2 next #8).
+
+Drill 1: SIGKILL a Model.fit mid-training, resume from the rolling
+per-epoch checkpoint, and require the resumed loss curve to continue the
+uninterrupted golden run exactly (params + optimizer moments + LR
+schedule + step counter all restored; per-step rng derives from the step
+counter, so determinism carries across the kill).
+
+Drill 2: elastic re-mesh — an 8-way ZeRO-sharded (orbax) checkpoint is
+restored onto a 4-device mesh in a separate process and training
+continues with the same losses.
+
+ref parity: fleet elastic / paddle.distributed.fleet.utils.fs recovery
+story; checkpoints via io/checkpoint.py CheckpointManager.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_FIT_SCRIPT = r"""
+import sys, os, json, glob
+sys.path.insert(0, __REPO__)
+import _cpu_env
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.hapi.callbacks import Callback, ModelCheckpoint
+
+mode, ckdir, out = sys.argv[1], sys.argv[2], sys.argv[3]
+TOTAL = 10
+
+paddle.seed(0)
+net = paddle.nn.Sequential(paddle.nn.Linear(8, 32), paddle.nn.Tanh(),
+                           paddle.nn.Linear(32, 4))
+model = paddle.Model(net)
+sched = paddle.optimizer.lr.StepDecay(0.05, step_size=3, gamma=0.5)
+model.prepare(paddle.optimizer.AdamW(sched, parameters=net.parameters()),
+              paddle.nn.CrossEntropyLoss())
+
+rng = np.random.default_rng(0)
+X = rng.standard_normal((16, 8)).astype('float32')
+Y = rng.integers(0, 4, (16,)).astype('int64')
+ds = paddle.io.TensorDataset([X, Y])
+
+start = 0
+if mode == 'resume':
+    # an epoch checkpoint is "complete" iff both files landed (the kill
+    # can land between the .pdparams and .pdopt writes)
+    done = sorted(int(os.path.basename(p)[:-len('.pdparams')])
+                  for p in glob.glob(os.path.join(ckdir, '*.pdparams'))
+                  if os.path.exists(p[:-len('.pdparams')] + '.pdopt'))
+    assert done, 'no complete checkpoint to resume from'
+    start = done[-1] + 1
+    model.load(os.path.join(ckdir, str(done[-1])))
+
+losses = {}
+
+class Rec(Callback):
+    def on_epoch_end(self, epoch, logs=None):
+        g = start + epoch  # global epoch number
+        l = logs['loss']
+        losses[g] = float(l[0] if isinstance(l, (list, tuple)) else l)
+        print(f'EPOCH {g} {losses[g]}', flush=True)
+
+class Saver(Callback):
+    def on_epoch_end(self, epoch, logs=None):
+        os.makedirs(ckdir, exist_ok=True)
+        self.model.save(os.path.join(ckdir, str(start + epoch)))
+
+class Pacer(Callback):
+    # victim-only: stretch epochs to real-workload timescales so the
+    # parent's SIGKILL lands mid-fit, not after a suspiciously fast finish
+    def on_epoch_begin(self, epoch, logs=None):
+        import time as _t
+        _t.sleep(0.4)
+
+cbs = [Rec()] + ([Saver(), Pacer()] if mode in ('victim',) else [])
+model.fit(ds, epochs=TOTAL - start, batch_size=16, verbose=0, callbacks=cbs,
+          shuffle=False)
+with open(out, 'w') as f:
+    json.dump(losses, f)
+"""
+
+
+def _run_fit(tmp, mode, timeout=240, kill_at=None):
+    script = tmp / f"fit_{mode}.py"
+    script.write_text(_FIT_SCRIPT.replace("__REPO__", repr(_REPO)))
+    ckdir = str(tmp / "ck")
+    out = str(tmp / f"losses_{mode}.json")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), mode, ckdir, out],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=_REPO)
+    killed = False
+    t0 = time.time()
+    lines = []
+    for line in proc.stdout:
+        lines.append(line)
+        if kill_at is not None and line.startswith(f"EPOCH {kill_at} "):
+            time.sleep(0.2)  # let the epoch's checkpoint land, then die
+            proc.send_signal(signal.SIGKILL)
+            killed = True
+            break
+        if time.time() - t0 > timeout:
+            proc.kill()
+            raise TimeoutError("".join(lines[-20:]))
+    proc.wait(timeout=timeout)
+    if not killed and proc.returncode != 0:
+        raise RuntimeError("".join(lines[-30:]))
+    return out, killed
+
+
+def test_kill_mid_fit_resume_loss_continuity(tmp_path):
+    golden_out, _ = _run_fit(tmp_path, "golden")
+    golden = {int(k): v for k, v in json.load(open(golden_out)).items()}
+    assert len(golden) == 10
+
+    _, killed = _run_fit(tmp_path, "victim", kill_at=4)
+    assert killed, "victim was supposed to be SIGKILLed mid-fit"
+    assert not os.path.exists(str(tmp_path / "losses_victim.json")), \
+        "victim survived to the end — the kill happened too late"
+
+    resume_out, _ = _run_fit(tmp_path, "resume")
+    resumed = {int(k): v for k, v in json.load(open(resume_out)).items()}
+    # resumed run must continue the golden curve from the checkpoint on:
+    # same params, moments, LR-schedule position and step-derived rng
+    assert min(resumed) == 5, resumed
+    for e in sorted(resumed):
+        np.testing.assert_allclose(
+            resumed[e], golden[e], rtol=1e-5, atol=1e-7,
+            err_msg=f"loss diverged at epoch {e}: resume broke exactness")
+
+
+_ZERO_SCRIPT = r"""
+import sys, os, json
+sys.path.insert(0, __REPO__)
+import _cpu_env
+import jax, jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+import paddle_tpu as paddle
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+from paddle_tpu.hapi.engine import Engine
+from paddle_tpu.io.checkpoint import CheckpointManager
+
+mode, ckdir, out = sys.argv[1], sys.argv[2], sys.argv[3]
+ndev = len(jax.devices())
+
+def build():
+    paddle.seed(7)
+    net = paddle.nn.Sequential(paddle.nn.Linear(16, 64), paddle.nn.ReLU(),
+                               paddle.nn.Linear(64, 8))
+    opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+    mesh = Mesh(np.array(jax.devices()), ('dp',))
+    net, opt, _ = group_sharded_parallel(net, opt, level='os_g', mesh=mesh)
+    eng = Engine(net, loss=paddle.nn.CrossEntropyLoss(), optimizer=opt,
+                 mesh=mesh)
+    return eng
+
+def data(step):
+    rng = np.random.default_rng(100 + step)
+    x = rng.standard_normal((16, 16)).astype('float32')
+    y = rng.integers(0, 8, (16,)).astype('int64')
+    return jnp.asarray(x), jnp.asarray(y)
+
+eng = build()
+mgr = CheckpointManager(ckdir, sharded=True)
+losses = []
+if mode == 'save':
+    for s in range(3):
+        x, y = data(s)
+        loss, _ = eng.train_batch([x], [y])
+        losses.append(float(loss))
+    mgr.save(3, {'params': eng._params, 'opt': eng._opt_state,
+                 'step': eng._step})
+    mgr.wait()
+    for s in range(3, 5):   # golden continuation on THIS mesh
+        x, y = data(s)
+        loss, _ = eng.train_batch([x], [y])
+        losses.append(float(loss))
+else:  # restore onto the current (different-size) mesh
+    x0, y0 = data(0)
+    loss0, _ = eng.train_batch([x0], [y0])  # materialize opt state/shardings
+    target = {'params': eng._params, 'opt': eng._opt_state, 'step': 0}
+    st = mgr.restore(target=target)
+    eng._params = st['params']
+    eng._opt_state = st['opt']
+    eng._step = st['step']
+    eng.network.load_raw_state(eng._params, eng._buffers)
+    eng._train_fn = None  # rebuild for the restored placements
+    for s in range(3, 5):
+        x, y = data(s)
+        loss, _ = eng.train_batch([x], [y])
+        losses.append(float(loss))
+    # proof of re-sharding: a moment leaf lives on this smaller mesh
+    leaf = jax.tree_util.tree_leaves(eng._opt_state['m'])[0]
+    assert len(leaf.sharding.mesh.devices.flatten()) == ndev, \
+        (leaf.sharding, ndev)
+with open(out, 'w') as f:
+    json.dump({'ndev': ndev, 'losses': losses}, f)
+"""
+
+
+def _run_zero(tmp, mode, ndev, timeout=300):
+    script = tmp / f"zero_{mode}_{ndev}.py"
+    script.write_text(_ZERO_SCRIPT.replace("__REPO__", repr(_REPO)))
+    out = str(tmp / f"zero_{mode}_{ndev}.json")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={ndev}"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, str(script), mode, str(tmp / "zck"), out],
+        capture_output=True, text=True, timeout=timeout, cwd=_REPO, env=env)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    return json.load(open(out))
+
+
+def test_elastic_remesh_zero_8_to_4(tmp_path):
+    """8-way ZeRO checkpoint restored onto a 4-device mesh: orbax restores
+    each array straight onto the new NamedSharding (per-shard reads, no
+    full-host gather) and the continued loss curve matches the 8-way one
+    (dp mean-loss math is mesh-size invariant over the same global
+    batch)."""
+    saved = _run_zero(tmp_path, "save", 8)
+    restored = _run_zero(tmp_path, "restore", 4)
+    assert restored["ndev"] == 4
+    np.testing.assert_allclose(restored["losses"], saved["losses"][3:],
+                               rtol=1e-4, atol=1e-5)

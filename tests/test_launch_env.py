@@ -1,0 +1,123 @@
+"""launch_mod multi-host env wiring — mocked env + mocked
+jax.distributed.initialize (VERDICT r2 next #9: the one distributed file
+with zero tests).
+
+ref parity: python/paddle/distributed/launch env conventions
+(PADDLE_MASTER / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID).
+"""
+import pytest
+
+from paddle_tpu.distributed import launch_mod
+
+
+def test_parse_env_single():
+    assert launch_mod.parse_env({}) == {"mode": "single"}
+
+
+def test_parse_env_explicit_ours():
+    cfg = launch_mod.parse_env({
+        "PADDLE_TPU_COORDINATOR": "10.0.0.1:1234",
+        "PADDLE_TPU_NUM_PROCESSES": "4",
+        "PADDLE_TPU_PROCESS_ID": "2",
+    })
+    assert cfg == {"mode": "explicit",
+                   "coordinator_address": "10.0.0.1:1234",
+                   "num_processes": 4, "process_id": 2}
+
+
+def test_parse_env_reference_names():
+    """Reference scripts exporting PADDLE_MASTER etc. work unchanged."""
+    cfg = launch_mod.parse_env({
+        "PADDLE_MASTER": "host0:8090",
+        "PADDLE_TRAINERS_NUM": "16",
+        "PADDLE_TRAINER_ID": "7",
+    })
+    assert cfg == {"mode": "explicit", "coordinator_address": "host0:8090",
+                   "num_processes": 16, "process_id": 7}
+
+
+def test_parse_env_ours_wins_over_reference():
+    cfg = launch_mod.parse_env({
+        "PADDLE_TPU_COORDINATOR": "a:1", "PADDLE_MASTER": "b:2",
+        "PADDLE_TPU_NUM_PROCESSES": "2", "PADDLE_TRAINERS_NUM": "8",
+        "PADDLE_TPU_PROCESS_ID": "1", "PADDLE_TRAINER_ID": "5",
+    })
+    assert cfg["coordinator_address"] == "a:1"
+    assert cfg["num_processes"] == 2
+    assert cfg["process_id"] == 1
+
+
+def test_parse_env_tpu_pod_metadata():
+    assert launch_mod.parse_env(
+        {"TPU_WORKER_HOSTNAMES": "w0,w1"})["mode"] == "tpu_pod"
+    assert launch_mod.parse_env(
+        {"MEGASCALE_COORDINATOR_ADDRESS": "c:99"})["mode"] == "tpu_pod"
+
+
+def test_parse_env_defaults():
+    cfg = launch_mod.parse_env({"PADDLE_TPU_COORDINATOR": "c:1"})
+    assert cfg["num_processes"] == 1 and cfg["process_id"] == 0
+
+
+@pytest.mark.parametrize("bad", [
+    {"PADDLE_TPU_COORDINATOR": "c:1", "PADDLE_TPU_NUM_PROCESSES": "x"},
+    {"PADDLE_TPU_COORDINATOR": "c:1", "PADDLE_TPU_PROCESS_ID": "three"},
+])
+def test_parse_env_malformed_ints(bad):
+    with pytest.raises(ValueError, match="malformed"):
+        launch_mod.parse_env(bad)
+
+
+def test_parse_env_pid_out_of_range():
+    with pytest.raises(ValueError, match="out of range"):
+        launch_mod.parse_env({"PADDLE_TPU_COORDINATOR": "c:1",
+                              "PADDLE_TPU_NUM_PROCESSES": "4",
+                              "PADDLE_TPU_PROCESS_ID": "4"})
+
+
+def test_launch_calls_initialize(monkeypatch):
+    import jax
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setenv("PADDLE_TPU_COORDINATOR", "coord:7777")
+    monkeypatch.setenv("PADDLE_TPU_NUM_PROCESSES", "2")
+    monkeypatch.setenv("PADDLE_TPU_PROCESS_ID", "1")
+    got = launch_mod.launch(lambda a, b: a + b, args=(1, 2))
+    assert got == 3
+    assert calls == [{"coordinator_address": "coord:7777",
+                      "num_processes": 2, "process_id": 1}]
+
+
+def test_launch_single_skips_initialize(monkeypatch):
+    import jax
+
+    def boom(**kw):
+        raise AssertionError("initialize must not be called single-host")
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    for v in ("PADDLE_TPU_COORDINATOR", "PADDLE_MASTER",
+              "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(v, raising=False)
+    assert launch_mod.launch(lambda: 42) == 42
+
+
+def test_launch_tpu_pod_autodetect(monkeypatch):
+    import jax
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.delenv("PADDLE_TPU_COORDINATOR", raising=False)
+    monkeypatch.delenv("PADDLE_MASTER", raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1,w2,w3")
+    launch_mod.launch()
+    assert calls == [{}]
+
+
+def test_parse_env_no_family_mixing():
+    """Stale reference-family exports must not leak into a PADDLE_TPU_*
+    launch (a mixed world-size hangs initialize)."""
+    cfg = launch_mod.parse_env({
+        "PADDLE_TPU_COORDINATOR": "c:1",
+        "PADDLE_TRAINERS_NUM": "8", "PADDLE_TRAINER_ID": "3",
+    })
+    assert cfg["num_processes"] == 1 and cfg["process_id"] == 0

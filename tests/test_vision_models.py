@@ -105,3 +105,54 @@ def test_pretrained_raises():
 def test_squeezenet_bad_version_raises():
     with pytest.raises(ValueError):
         M.SqueezeNet(version="1_0")
+
+
+def test_s2d_stem_exactly_equals_7x7():
+    """SpaceToDepthStem with converted weights reproduces the 7x7/s2 conv
+    bit-for-bit (MLPerf conv0 space-to-depth equivalence)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models.resnet import (SpaceToDepthStem,
+                                                 s2d_weights_from_7x7)
+    from paddle_tpu import nn
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((2, 3, 32, 32)).astype('float32'))
+    conv7 = nn.Conv2D(3, 16, 7, stride=2, padding=3, bias_attr=False)
+    stem = SpaceToDepthStem(16)
+    stem.conv.weight.set_value(
+        s2d_weights_from_7x7(conv7.weight.numpy()))
+    ref = conv7(x).numpy()
+    got = stem(x).numpy()
+    assert ref.shape == got.shape == (2, 16, 16, 16)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_s2d_stem_trains():
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.hapi.engine import Engine
+    from paddle_tpu.vision.models import resnet18
+    paddle.seed(0)
+    net = resnet18(num_classes=10, s2d_stem=True)
+    net.train()
+    eng = Engine(net, loss=paddle.nn.CrossEntropyLoss(),
+                 optimizer=paddle.optimizer.Momentum(
+                     0.05, parameters=net.parameters()))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 3, 64, 64)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (2,)))
+    l0, _ = eng.train_batch([x], [y])
+    l1, _ = eng.train_batch([x], [y])
+    assert np.isfinite(float(l0)) and np.isfinite(float(l1))
+
+
+def test_s2d_stem_rejects_odd_sizes():
+    import numpy as np
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models.resnet import SpaceToDepthStem
+    stem = SpaceToDepthStem(8)
+    x = paddle.to_tensor(np.zeros((1, 3, 33, 32), np.float32))
+    with pytest.raises(ValueError, match="even input"):
+        stem(x)

@@ -1,0 +1,155 @@
+"""Decode-path bisection probes (VERDICT r2 next #2).
+
+Round 2's decode attempt wedged the shared TPU terminal: the first
+`generate()` compile (prefill + lax.scan of single-token steps +
+flash_decode) hung >9.5 min and took the tunnel down (BENCHLOG.md
+"Decode-path incident"). This tool isolates WHICH piece hangs, with
+every stage in its own killable subprocess under a hard timeout, so a
+hung compile costs one child process — never the terminal:
+
+  stage 1  flash_decode kernel alone        (AOT lower + compile + run)
+  stage 2  scan decode, use_flash=False     (jnp attention in the scan)
+  stage 3  full generate() with flash       (the thing that hung)
+
+Run on the TPU terminal:  python tools/decode_probe.py
+Each stage prints PASS/FAIL(timeout) + seconds; results feed BENCHLOG.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+STAGES = {}
+
+
+def stage(name):
+    def deco(fn):
+        STAGES[name] = fn
+        return fn
+    return deco
+
+
+@stage("kernel")
+def probe_kernel():
+    """flash_decode alone: [B,1,H,D] query vs a padded KV cache."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import flash_decode
+    b, s, h, d = 8, 1024, 12, 64
+    interp = jax.default_backend() != "tpu"
+    q = jnp.ones((b, 1, h, d), jnp.bfloat16)
+    k = jnp.ones((b, s, h, d), jnp.bfloat16)
+    v = jnp.ones((b, s, h, d), jnp.bfloat16)
+    lens = jnp.full((b,), 64, jnp.int32)
+    t0 = time.perf_counter()
+    lowered = jax.jit(
+        lambda *a: flash_decode(*a, interpret=interp)).lower(q, k, v, lens)
+    print(f"lowered in {time.perf_counter()-t0:.1f}s", flush=True)
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    print(f"compiled in {time.perf_counter()-t0:.1f}s", flush=True)
+    t0 = time.perf_counter()
+    out = compiled(q, k, v, lens)
+    s0 = float(jnp.sum(out.astype(jnp.float32)))
+    print(f"ran in {time.perf_counter()-t0:.1f}s sum={s0}", flush=True)
+
+
+@stage("scan_noflash")
+def probe_scan_noflash():
+    """generate() with use_flash_attention=False: isolates the KV-cache
+    lax.scan + dynamic_update_slice structure from the Pallas kernel."""
+    _generate_probe(use_flash=False)
+
+
+@stage("full")
+def probe_full():
+    """The round-2 killer: generate() with the flash decode kernel."""
+    _generate_probe(use_flash=True)
+
+
+def _generate_probe(use_flash):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+    from paddle_tpu.nlp.generation import generate
+    cfg = "gpt2-en" if jax.default_backend() == "tpu" else "gpt-tiny"
+    batch, new_tok = (8, 32) if jax.default_backend() == "tpu" else (2, 8)
+    model = GPTForCausalLM(_resolve_config(
+        cfg, max_position_embeddings=1024, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, use_flash_attention=use_flash))
+    model.eval()
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(
+        rng.integers(0, model.config.vocab_size, (batch, 64)), jnp.int32)
+    t0 = time.perf_counter()
+    out = generate(model, prompt, max_new_tokens=new_tok)
+    arr = out._value if hasattr(out, "_value") else out
+    float(jnp.sum(arr))
+    dt = time.perf_counter() - t0
+    print(f"generate({cfg}, flash={use_flash}) compile+run {dt:.1f}s",
+          flush=True)
+    t0 = time.perf_counter()
+    out = generate(model, prompt, max_new_tokens=new_tok)
+    arr = out._value if hasattr(out, "_value") else out
+    float(jnp.sum(arr))
+    dt2 = time.perf_counter() - t0
+    print(f"warm decode: {batch * new_tok / dt2:.1f} tok/s "
+          f"({dt2 / new_tok * 1e3:.2f} ms/step)", flush=True)
+
+
+def run_stage_child(name):
+    # in-child watchdog: the orchestrator SIGKILLs too, but a self-exit
+    # gives a cleaner diagnostic when only the backend (not python) hangs
+    def watch():
+        time.sleep(STAGE_TIMEOUT - 5)
+        print(f"[{name}] in-child watchdog fired", file=sys.stderr,
+              flush=True)
+        os._exit(9)
+    threading.Thread(target=watch, daemon=True).start()
+    STAGES[name]()
+
+
+STAGE_TIMEOUT = int(os.environ.get("DECODE_PROBE_TIMEOUT", "600"))
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        run_stage_child(sys.argv[2])
+        return
+    order = sys.argv[1:] or ["kernel", "scan_noflash", "full"]
+    results = {}
+    for name in order:
+        print(f"=== stage {name} (timeout {STAGE_TIMEOUT}s) ===", flush=True)
+        t0 = time.monotonic()
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child", name],
+            start_new_session=True)
+        try:
+            rc = proc.wait(timeout=STAGE_TIMEOUT)
+            results[name] = {"ok": rc == 0, "rc": rc,
+                             "seconds": round(time.monotonic() - t0, 1)}
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+            results[name] = {"ok": False, "rc": "timeout",
+                             "seconds": round(time.monotonic() - t0, 1)}
+        print(f"=== stage {name}: {results[name]} ===", flush=True)
+        if not results[name]["ok"]:
+            print("stopping: a hung/failed stage can leave the backend "
+                  "wedged — reprobe before trusting later stages",
+                  file=sys.stderr, flush=True)
+            break
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()
