@@ -28,6 +28,8 @@ from . import validate  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from .launch_mod import launch, spawn  # noqa: F401
+from .quantized import quantized_all_reduce  # noqa: F401
+from . import quantized  # noqa: F401
 
 
 def get_group(id=0):

@@ -125,7 +125,11 @@ class Fleet:
 
     def init(self, role_maker=None, is_collective=True, strategy=None,
              log_level="INFO"):
-        if role_maker is not None or not is_collective:
+        # a role_maker is fine as long as it's the collective idiom
+        # (PaddleCloudRoleMaker(is_collective=True)); only the PS path gates
+        rm_collective = getattr(role_maker, "_is_collective", None)
+        if (role_maker is not None and rm_collective is False) or \
+                not is_collective:
             # ref: paddle/fluid/distributed/ps/ — the parameter-server mode
             # (CPU PS hosting TB-scale sparse embeddings for recsys).
             # Deliberately descoped on TPU (SURVEY §2.6): a CPU-side PS

@@ -59,19 +59,28 @@ class Engine:
     def sync_to_layer(self):
         self.network.load_raw_state(self._params, self._buffers)
 
-    def _shard_batch(self, arrs):
+    def _shard_batch(self, arrs, allow_ragged=False):
         if self.mesh is None or "dp" not in self.mesh.axis_names:
             return arrs
         from jax.sharding import NamedSharding, PartitionSpec
         sh = NamedSharding(self.mesh, PartitionSpec("dp"))
         ndp = self.mesh.shape["dp"]
-        # ragged batches (eval's last DataLoader batch without drop_last)
-        # can't split over dp — fall back to replicated for those rather
-        # than raising mid-epoch
-        return jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, sh)
-            if hasattr(a, "ndim") and a.ndim >= 1 and a.shape[0] % ndp == 0
-            else a, arrs)
+
+        def place(a):
+            if not (hasattr(a, "ndim") and a.ndim >= 1):
+                return a
+            if a.shape[0] % ndp == 0:
+                return jax.device_put(a, sh)
+            if allow_ragged:
+                # eval's last DataLoader batch (no drop_last): run it
+                # replicated rather than raising mid-epoch
+                return a
+            raise ValueError(
+                f"training batch dim {a.shape[0]} is not divisible by the "
+                f"dp mesh axis ({ndp}): every train step would silently "
+                "lose data parallelism. Use a divisible batch_size or "
+                "drop_last=True.")
+        return jax.tree_util.tree_map(place, arrs)
 
     # ------------------------------------------------------------------
     def _build_train_fn(self):
@@ -217,9 +226,10 @@ class Engine:
             self._eval_fn = self._build_eval_fn()
         # shard the eval batch over dp exactly like train_batch — else
         # Model.evaluate/predict on a dp mesh silently runs replicated
-        outs, loss_v = self._eval_fn(self._params, self._buffers,
-                                     self._shard_batch(_unwrap(list(inputs))),
-                                     self._shard_batch(_unwrap(list(labels))))
+        outs, loss_v = self._eval_fn(
+            self._params, self._buffers,
+            self._shard_batch(_unwrap(list(inputs)), allow_ragged=True),
+            self._shard_batch(_unwrap(list(labels)), allow_ragged=True))
         return loss_v, outs
 
     def predict_batch(self, inputs):

@@ -218,10 +218,13 @@ class TestRNGStateTracker:
 
 def test_fleet_ps_mode_gated():
     """SURVEY §2.6 descope: parameter-server mode raises a loud gate with
-    a TPU migration recipe instead of silently pretending to work."""
+    a TPU migration recipe; the COLLECTIVE role_maker idiom still works."""
     import pytest as _pytest
     from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.fleet.base import PaddleCloudRoleMaker
     with _pytest.raises(NotImplementedError, match="parameter-server"):
-        fleet.init(role_maker=object())
+        fleet.init(role_maker=PaddleCloudRoleMaker(is_collective=False))
     with _pytest.raises(NotImplementedError, match="VocabParallelEmbedding"):
         fleet.init(is_collective=False)
+    # reference collective idiom must NOT be gated
+    fleet.init(role_maker=PaddleCloudRoleMaker(is_collective=True))

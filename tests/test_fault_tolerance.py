@@ -15,9 +15,11 @@ story; checkpoints via io/checkpoint.py CheckpointManager.
 """
 import json
 import os
+import queue
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
@@ -101,16 +103,33 @@ def _run_fit(tmp, mode, timeout=240, kill_at=None):
     killed = False
     t0 = time.time()
     lines = []
-    for line in proc.stdout:
+    # reader thread: a child that wedges BEFORE printing anything must
+    # still hit the timeout (a bare `for line in proc.stdout` would block
+    # the test forever — the exact wedge class this suite drills)
+    q = queue.Queue()
+
+    def _pump():
+        for ln in proc.stdout:
+            q.put(ln)
+        q.put(None)
+    th = threading.Thread(target=_pump, daemon=True)
+    th.start()
+    while True:
+        try:
+            line = q.get(timeout=max(0.1, timeout - (time.time() - t0)))
+        except Exception:
+            line = "__timeout__"
+        if line == "__timeout__" or time.time() - t0 > timeout:
+            proc.kill()
+            raise TimeoutError("".join(lines[-20:]))
+        if line is None:
+            break
         lines.append(line)
         if kill_at is not None and line.startswith(f"EPOCH {kill_at} "):
             time.sleep(0.2)  # let the epoch's checkpoint land, then die
             proc.send_signal(signal.SIGKILL)
             killed = True
             break
-        if time.time() - t0 > timeout:
-            proc.kill()
-            raise TimeoutError("".join(lines[-20:]))
     proc.wait(timeout=timeout)
     if not killed and proc.returncode != 0:
         raise RuntimeError("".join(lines[-30:]))

@@ -185,3 +185,20 @@ def test_eval_batch_ragged_falls_back_replicated():
     y = jnp.asarray(rng.integers(0, 8, (10,)))
     loss, outs = eng.eval_batch([x], [y])
     assert np.isfinite(float(loss))
+
+
+def test_train_batch_ragged_raises_loudly():
+    """A non-dp-divisible TRAIN batch must fail with a clear error, not
+    silently drop data parallelism (review fix)."""
+    import pytest as _pytest
+    mesh = _mesh()
+    net = _model()
+    eng = Engine(net, loss=paddle.nn.CrossEntropyLoss(),
+                 optimizer=paddle.optimizer.AdamW(
+                     1e-2, parameters=net.parameters()),
+                 mesh=mesh)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((10, 16)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 8, (10,)))
+    with _pytest.raises(ValueError, match="not divisible by the dp"):
+        eng.train_batch([x], [y])
