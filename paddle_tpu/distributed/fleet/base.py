@@ -125,10 +125,13 @@ class Fleet:
 
     def init(self, role_maker=None, is_collective=True, strategy=None,
              log_level="INFO"):
-        # a role_maker is fine as long as it's the collective idiom
-        # (PaddleCloudRoleMaker(is_collective=True)); only the PS path gates
+        # a role_maker is fine only when it's explicitly the collective
+        # idiom (PaddleCloudRoleMaker(is_collective=True)); anything else —
+        # including custom role makers without the attribute — is treated
+        # as PS intent and gated loudly rather than silently running the
+        # wrong training mode
         rm_collective = getattr(role_maker, "_is_collective", None)
-        if (role_maker is not None and rm_collective is False) or \
+        if (role_maker is not None and rm_collective is not True) or \
                 not is_collective:
             # ref: paddle/fluid/distributed/ps/ — the parameter-server mode
             # (CPU PS hosting TB-scale sparse embeddings for recsys).

@@ -112,7 +112,21 @@ class Model:
             accumulate_grad_batches=1, num_iters=None):
         from ..io import DataLoader, Dataset
         assert train_data is not None
+        eng = self._ensure_engine()
         if isinstance(train_data, Dataset):
+            mesh = getattr(eng, "mesh", None)
+            last = len(train_data) % batch_size
+            if (not drop_last and mesh is not None
+                    and "dp" in mesh.axis_names
+                    and last and last % mesh.shape["dp"]):
+                # a ragged final batch can't split over dp and the Engine
+                # refuses to silently train unsharded — same policy as the
+                # reference's DistributedBatchSampler, which pads/drops
+                warnings.warn(
+                    f"fit on a dp mesh: dataset length {len(train_data)} "
+                    f"is not divisible by batch_size {batch_size}; "
+                    "dropping the last ragged batch (drop_last=True)")
+                drop_last = True
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,
                                       num_workers=num_workers)

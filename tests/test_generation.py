@@ -60,3 +60,125 @@ def test_static_cache_prefill_matches_full_forward():
     np.testing.assert_allclose(np.asarray(got._value),
                                np.asarray(want._value),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_top_p_masks_tail():
+    from paddle_tpu.nlp.generation import _mask_top_p
+    logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0, -5.0]])
+    out = np.asarray(_mask_top_p(logits, 0.6))
+    # softmax([3,2,1,0,-5]) ~ [.66,.24,.09,...]: 0.66 >= 0.6 -> only top kept
+    assert np.isfinite(out[0, 0])
+    assert not np.isfinite(out[0, 2:]).any()
+    # top_p=1.0 keeps everything
+    full = np.asarray(_mask_top_p(logits, 1.0))
+    assert np.isfinite(full).all()
+
+
+def test_top_p_decode_valid_and_deterministic_seed():
+    m = _model()
+    ids = Tensor(jnp.asarray([[5, 17, 3, 42]], jnp.int32))
+    a = generate(m, ids, max_new_tokens=6, temperature=1.0, top_p=0.9,
+                 seed=7)
+    b = generate(m, ids, max_new_tokens=6, temperature=1.0, top_p=0.9,
+                 seed=7)
+    np.testing.assert_array_equal(np.asarray(a._value), np.asarray(b._value))
+    assert (np.asarray(a._value) < 97).all()
+
+
+def test_repetition_penalty_suppresses_repeats():
+    from paddle_tpu.nlp.generation import _apply_repetition_penalty
+    logits = jnp.asarray([[2.0, -1.0, 0.5]])
+    seen = jnp.asarray([[True, True, False]])
+    out = np.asarray(_apply_repetition_penalty(logits, seen, 2.0))
+    np.testing.assert_allclose(out, [[1.0, -2.0, 0.5]])
+
+
+def test_eos_early_stop_pads_tail():
+    """Once a row emits eos, the remainder of that row is pad."""
+    m = _model()
+    ids = Tensor(jnp.asarray([[5, 17, 3, 42]], jnp.int32))
+    # find what greedy emits, then rerun declaring that token as eos
+    base = np.asarray(generate(m, ids, max_new_tokens=6,
+                               temperature=0.0)._value)
+    eos = int(base[0, 4])  # first generated token
+    out = np.asarray(generate(m, ids, max_new_tokens=6, temperature=0.0,
+                              eos_token_id=eos, pad_token_id=0)._value)
+    assert out[0, 4] == eos
+    assert (out[0, 5:] == 0).all()
+
+
+def test_beam_search_beats_or_equals_greedy_logprob():
+    """Beam search's selected sequence must score >= greedy's under the
+    model (same start, same length, sum log p) — the defining property."""
+    m = _model()
+    ids = jnp.asarray([[5, 17, 3, 42]], jnp.int32)
+    T = 5
+
+    def seq_logprob(full):
+        params, buffers = m.raw_state()
+        from paddle_tpu.nn.layer import functional_call
+        out = functional_call(m, params, buffers, Tensor(full))
+        logits = out[0] if isinstance(out, tuple) else out
+        logits = logits._value if hasattr(logits, "_value") else logits
+        lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
+        tgt = full[:, 1:]
+        pick = jnp.take_along_axis(lp, tgt[:, :, None], -1)[:, :, 0]
+        return float(pick[:, -T:].sum())
+
+    greedy = generate(m, ids, max_new_tokens=T, temperature=0.0)
+    beam = generate(m, ids, max_new_tokens=T, num_beams=4,
+                    length_penalty=0.0)
+    lp_g = seq_logprob(np.asarray(greedy._value))
+    lp_b = seq_logprob(np.asarray(beam._value))
+    assert lp_b >= lp_g - 1e-4, (lp_b, lp_g)
+
+
+def test_beam_search_shapes_and_batch():
+    m = _model()
+    ids = jnp.asarray([[5, 17, 3], [2, 8, 11]], jnp.int32)
+    out = generate(m, ids, max_new_tokens=4, num_beams=3)
+    assert np.asarray(out._value).shape == (2, 7)
+    assert (np.asarray(out._value)[:, :3] == np.asarray(ids)).all()
+
+
+def test_model_generate_delegates_advanced_options():
+    m = _model()
+    ids = Tensor(jnp.asarray([[5, 17, 3]], jnp.int32))
+    out = m.generate(ids, max_new_tokens=4, num_beams=3)
+    assert np.asarray(out._value).shape == (1, 7)
+    out2 = m.generate(ids, max_new_tokens=4, temperature=1.0, top_p=0.8,
+                      seed=3)
+    assert np.asarray(out2._value).shape == (1, 7)
+
+
+def test_sampling_strategy_actually_samples():
+    """decode_strategy='sampling' with no filters must NOT be argmax
+    (review fix: pure temperature sampling was unreachable)."""
+    m = _model()
+    ids = Tensor(jnp.asarray([[5, 17, 3, 42]], jnp.int32))
+    greedy = np.asarray(generate(m, ids, max_new_tokens=8,
+                                 temperature=0.0)._value)
+    outs = [np.asarray(generate(m, ids, max_new_tokens=8, temperature=1.5,
+                                decode_strategy="sampling",
+                                seed=s)._value) for s in range(4)]
+    assert any(not np.array_equal(o, greedy) for o in outs)
+    assert any(not np.array_equal(outs[0], o) for o in outs[1:])
+
+
+def test_beam_rejects_topk_topp():
+    import pytest
+    m = _model()
+    ids = Tensor(jnp.asarray([[5, 17, 3]], jnp.int32))
+    with pytest.raises(ValueError, match="beam_search"):
+        generate(m, ids, num_beams=3, top_k=5)
+
+
+def test_beam_one_equals_greedy():
+    m = _model()
+    ids = jnp.asarray([[5, 17, 3, 42]], jnp.int32)
+    g = np.asarray(generate(m, ids, max_new_tokens=5,
+                            temperature=0.0)._value)
+    b1 = np.asarray(generate(m, ids, max_new_tokens=5,
+                             decode_strategy="beam_search",
+                             num_beams=1)._value)
+    np.testing.assert_array_equal(g, b1)
