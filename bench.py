@@ -522,8 +522,10 @@ def _flush_partial(results, probe):
 
 def orchestrate(workloads, args, passthrough):
     smoke = args.smoke
-    probe_timeout = 240 if smoke else 600
-    work_timeout = 600 if smoke else 1800
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT",
+                                       240 if smoke else 600))
+    work_timeout = int(os.environ.get("BENCH_WORK_TIMEOUT",
+                                      600 if smoke else 1800))
 
     rc, probe, err, dt = _spawn(["--worker", "probe"]
                                 + (["--smoke"] if smoke else []),

@@ -122,6 +122,13 @@ class Model:
                 # a ragged final batch can't split over dp and the Engine
                 # refuses to silently train unsharded — same policy as the
                 # reference's DistributedBatchSampler, which pads/drops
+                if len(train_data) < batch_size:
+                    raise ValueError(
+                        f"fit on a dp mesh: dataset length "
+                        f"{len(train_data)} < batch_size {batch_size} and "
+                        f"not divisible by dp={mesh.shape['dp']} — "
+                        "dropping the ragged batch would train zero steps. "
+                        "Lower batch_size or pad the dataset.")
                 warnings.warn(
                     f"fit on a dp mesh: dataset length {len(train_data)} "
                     f"is not divisible by batch_size {batch_size}; "

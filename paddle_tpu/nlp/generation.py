@@ -136,7 +136,9 @@ def build_decode_fn(model, max_new_tokens, temperature=1.0, top_k=0,
                 pick = jax.random.categorical(key, vals)
                 return jnp.take_along_axis(
                     cand, pick[:, None], axis=-1)[:, 0]
-            return jax.random.categorical(key, _mask_top_p(last, top_p))
+            if top_p < 1.0:
+                last = _mask_top_p(last, top_p)
+            return jax.random.categorical(key, last)
 
         def step(carry, _):
             cache, idx, last, key, done, seen = carry

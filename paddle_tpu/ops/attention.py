@@ -67,12 +67,21 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, kv_lens=None,
 
 def flash_decode(q, k_cache, v_cache, kv_lens, sm_scale=None):
     """Single-query decode against a padded KV cache ([B, 1, H, D] x
-    [B, S, H, D] + kv_lens [B]). Pallas on TPU, jnp fallback elsewhere."""
+    [B, S, H, D] + kv_lens [B]). Pallas on TPU (opt-in), jnp elsewhere.
+
+    The Pallas decode kernel is gated behind PADDLE_TPU_FLASH_DECODE=1:
+    its first Mosaic compile inside a scanned decode program hung the
+    shared TPU terminal in round 2 (BENCHLOG "decode-path incident") and
+    it is not yet hardware-proven (tools/decode_probe.py bisects it in
+    killable subprocesses). Decode attention is HBM-bandwidth-bound, so
+    the jnp path is a safe default; flip the env once the probe passes."""
+    import os
     d = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     sk = k_cache.shape[1]
-    if (_platform() == "tpu" and d in _PALLAS_HEAD_DIMS
+    if (os.environ.get("PADDLE_TPU_FLASH_DECODE") == "1"
+            and _platform() == "tpu" and d in _PALLAS_HEAD_DIMS
             and sk % _PALLAS_MIN_SEQ == 0):
         from .pallas.flash_attention import flash_decode as pallas_decode
         return pallas_decode(q, k_cache, v_cache, kv_lens,

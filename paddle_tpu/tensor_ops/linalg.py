@@ -250,7 +250,6 @@ def ormqr(x, tau, other, left=True, transpose=False, name=None):
 
 
 def corrcoef_alias(x, rowvar=True, name=None):
-    from .stat import corrcoef
     return corrcoef(x, rowvar=rowvar)
 
 
@@ -310,3 +309,19 @@ def mv(x, vec, name=None):
 
 
 __all__ += ["mv"]
+
+
+# reference namespace parity: paddle.linalg.corrcoef / paddle.linalg.cov
+# are the canonical homes (the stats module implements them)
+def corrcoef(x, rowvar=True, name=None):
+    from .stat import corrcoef as _impl
+    return _impl(x, rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    from .stat import cov as _impl
+    return _impl(x, rowvar=rowvar, ddof=ddof, fweights=fweights,
+                 aweights=aweights)
+
+
+__all__ += ["corrcoef", "cov"]

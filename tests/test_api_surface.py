@@ -1,0 +1,221 @@
+"""SURVEY §2 API-surface probe: every name the inventory claims must
+resolve (r2's ColorJitter was listed but absent — an AttributeError no
+test caught; this file makes that class of gap impossible to miss).
+
+Existence-only by design: numerics live in the per-family test files.
+"""
+import importlib
+
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _resolve(path):
+    obj = paddle
+    for part in path.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+TENSOR_OPS = (
+    # §2.1 creation
+    "to_tensor zeros ones full arange linspace eye empty zeros_like "
+    "ones_like full_like empty_like rand randn randint normal uniform "
+    # §2.1 math
+    "add subtract multiply divide matmul pow sqrt rsqrt exp log abs floor "
+    "ceil round clip sum mean max min prod cumsum argmax argmin maximum "
+    "minimum sign square reciprocal remainder mod floor_divide log2 log10 "
+    "log1p expm1 sin cos tan asin acos atan atan2 sinh cosh tanh erf "
+    "logsumexp isnan isinf isfinite nanmean nansum trunc frac lerp addmm "
+    "outer inner dot cross trace diag kron logcumsumexp amax amin "
+    # §2.1 logic/compare
+    "equal not_equal less_than less_equal greater_than greater_equal "
+    "logical_and logical_or logical_not logical_xor allclose isclose "
+    "equal_all where "
+    # §2.1 manipulation
+    "reshape transpose concat stack split chunk squeeze unsqueeze flatten "
+    "tile expand broadcast_to gather gather_nd scatter scatter_nd_add "
+    "index_select index_put masked_select masked_fill flip roll unbind "
+    "repeat_interleave take_along_axis put_along_axis as_strided slice "
+    "strided_slice unique sort argsort topk searchsorted bucketize nonzero "
+    "tril triu diagflat rot90 moveaxis swapaxes unfold "
+    # §2.1 stats/random + misc
+    "std var median quantile kthvalue mode histogram bincount multinomial "
+    "bernoulli poisson randperm seed einsum cast "
+    # §2.12 long tail
+    "nextafter xlogy signbit isreal vdot renorm combinations "
+    "cartesian_prod cdist trapz unflatten index_fill slice_scatter "
+    "column_stack row_stack hsplit vsplit dsplit tensor_split lu_unpack "
+    "matrix_exp"
+).split()
+
+LINALG = ("norm inv det slogdet svd qr eig eigh eigvals eigvalsh cholesky "
+          "cholesky_solve lstsq lu matrix_power matrix_rank pinv solve "
+          "triangular_solve cond corrcoef cov householder_product "
+          "multi_dot").split()
+
+NN = ("Layer Linear Conv1D Conv2D Conv3D Conv2DTranspose Embedding "
+      "BatchNorm1D BatchNorm2D BatchNorm3D LayerNorm GroupNorm RMSNorm "
+      "SyncBatchNorm Dropout Dropout2D AlphaDropout MaxPool1D MaxPool2D "
+      "AvgPool1D AvgPool2D AdaptiveAvgPool2D AdaptiveMaxPool2D "
+      "FractionalMaxPool2D Upsample Pad1D Pad2D Pad3D PixelShuffle Flatten "
+      "Unfold Bilinear Softmax2D LogSigmoid AdaptiveLogSoftmaxWithLoss "
+      "ReLU ReLU6 GELU Silu Swish Sigmoid Tanh Softmax LogSoftmax LeakyReLU "
+      "PReLU ELU SELU CELU GLU Hardswish Hardsigmoid Hardtanh Mish "
+      "Softplus Softshrink Softsign Tanhshrink ThresholdedReLU Maxout "
+      "CrossEntropyLoss MSELoss L1Loss SmoothL1Loss NLLLoss BCELoss "
+      "BCEWithLogitsLoss KLDivLoss CosineEmbeddingLoss MarginRankingLoss "
+      "HingeEmbeddingLoss CTCLoss TripletMarginLoss PoissonNLLLoss "
+      "HuberLoss GaussianNLLLoss MultiLabelSoftMarginLoss SoftMarginLoss "
+      "MultiMarginLoss TripletMarginWithDistanceLoss MultiHeadAttention "
+      "TransformerEncoder TransformerEncoderLayer TransformerDecoder "
+      "TransformerDecoderLayer Transformer SimpleRNN LSTM GRU LSTMCell "
+      "GRUCell SimpleRNNCell Sequential").split()
+
+NN_FUNCTIONAL = ("relu gelu silu sigmoid tanh softmax log_softmax "
+                 "scaled_dot_product_attention one_hot cosine_similarity "
+                 "normalize pairwise_distance pixel_shuffle grid_sample "
+                 "affine_grid conv2d linear embedding dropout layer_norm "
+                 "batch_norm max_pool2d avg_pool2d interpolate pad "
+                 "cross_entropy mse_loss zeropad2d max_unpool2d").split()
+
+OPTIMIZER = ("SGD Momentum Adam AdamW Adamax Adagrad Adadelta RMSProp Lamb "
+             "Rprop NAdam RAdam LBFGS").split()
+
+LR = ("NoamDecay ExponentialDecay NaturalExpDecay InverseTimeDecay "
+      "PolynomialDecay LinearWarmup PiecewiseDecay CosineAnnealingDecay "
+      "StepDecay MultiStepDecay LambdaDecay ReduceOnPlateau OneCycleLR "
+      "CyclicLR CosineAnnealingWarmRestarts LinearLR LRScheduler").split()
+
+DISTRIBUTED = ("init_parallel_env get_rank get_world_size all_reduce "
+               "all_gather reduce_scatter broadcast scatter reduce "
+               "alltoall alltoall_single send recv barrier new_group "
+               "shard_tensor shard_layer launch spawn DataParallel "
+               "quantized_all_reduce").split()
+
+DISTRIBUTION = ("Normal Uniform Beta Dirichlet Gamma Exponential Laplace "
+                "LogNormal Gumbel Cauchy StudentT Bernoulli Categorical "
+                "Multinomial Geometric Poisson Binomial Independent "
+                "TransformedDistribution kl_divergence register_kl").split()
+
+VISION_MODELS = ("LeNet resnet18 resnet34 resnet50 resnet101 resnet152 "
+                 "vgg16 vgg19 mobilenet_v1 mobilenet_v2 mobilenet_v3_small "
+                 "mobilenet_v3_large googlenet inception_v3 densenet121 "
+                 "shufflenet_v2_x0_25 squeezenet1_0 alexnet "
+                 "wide_resnet50_2 resnext50_32x4d SpaceToDepthStem").split()
+
+VISION_TRANSFORMS = ("Compose Resize RandomCrop CenterCrop "
+                     "RandomHorizontalFlip RandomVerticalFlip Normalize "
+                     "ToTensor ColorJitter RandomResizedCrop Pad "
+                     "BrightnessTransform ContrastTransform "
+                     "SaturationTransform HueTransform Grayscale "
+                     "RandomRotation RandomErasing RandomAffine "
+                     "RandomPerspective").split()
+
+IO = ("Dataset IterableDataset TensorDataset ConcatDataset Subset "
+      "random_split Sampler SequenceSampler RandomSampler "
+      "WeightedRandomSampler BatchSampler DistributedBatchSampler "
+      "DataLoader").split()
+
+GEOMETRIC = ("segment_sum segment_mean segment_max segment_min send_u_recv "
+             "send_ue_recv send_uv").split()
+
+FFT = ("fft ifft rfft irfft hfft ihfft fft2 ifft2 fftn ifftn fftfreq "
+       "rfftfreq fftshift ifftshift").split()
+
+TOP = ("Model summary flops save load grad no_grad seed Tensor "
+       "to_tensor einsum iinfo finfo").split()
+
+
+@pytest.mark.parametrize("name", TENSOR_OPS)
+def test_tensor_op_exists(name):
+    assert _resolve(name) is not None
+
+
+@pytest.mark.parametrize("name", LINALG)
+def test_linalg_exists(name):
+    assert getattr(paddle.linalg, name) is not None
+
+
+@pytest.mark.parametrize("name", NN)
+def test_nn_exists(name):
+    assert getattr(paddle.nn, name) is not None
+
+
+@pytest.mark.parametrize("name", NN_FUNCTIONAL)
+def test_nn_functional_exists(name):
+    assert getattr(paddle.nn.functional, name) is not None
+
+
+@pytest.mark.parametrize("name", OPTIMIZER)
+def test_optimizer_exists(name):
+    assert getattr(paddle.optimizer, name) is not None
+
+
+@pytest.mark.parametrize("name", LR)
+def test_lr_exists(name):
+    assert getattr(paddle.optimizer.lr, name) is not None
+
+
+@pytest.mark.parametrize("name", DISTRIBUTED)
+def test_distributed_exists(name):
+    assert getattr(paddle.distributed, name) is not None
+
+
+@pytest.mark.parametrize("name", DISTRIBUTION)
+def test_distribution_exists(name):
+    assert getattr(paddle.distribution, name) is not None
+
+
+@pytest.mark.parametrize("name", VISION_MODELS)
+def test_vision_model_exists(name):
+    from paddle_tpu.vision import models
+    assert getattr(models, name) is not None
+
+
+@pytest.mark.parametrize("name", VISION_TRANSFORMS)
+def test_vision_transform_exists(name):
+    from paddle_tpu.vision import transforms
+    assert getattr(transforms, name) is not None
+
+
+@pytest.mark.parametrize("name", IO)
+def test_io_exists(name):
+    from paddle_tpu import io
+    assert getattr(io, name) is not None
+
+
+@pytest.mark.parametrize("name", GEOMETRIC)
+def test_geometric_exists(name):
+    assert getattr(paddle.geometric, name) is not None
+
+
+@pytest.mark.parametrize("name", FFT)
+def test_fft_exists(name):
+    assert getattr(paddle.fft, name) is not None
+
+
+@pytest.mark.parametrize("name", TOP)
+def test_top_level_exists(name):
+    assert _resolve(name) is not None
+
+
+def test_amp_jit_static_namespaces():
+    assert paddle.amp.auto_cast and paddle.amp.GradScaler
+    assert paddle.amp.decorate
+    assert paddle.jit.to_static and paddle.jit.save and paddle.jit.load
+    assert paddle.static.InputSpec
+    assert paddle.sparse is not None and paddle.audio is not None
+    assert paddle.signal.stft and paddle.signal.istft
+    from paddle_tpu.vision import ops as vops
+    for n in ("nms", "box_iou", "roi_align", "roi_pool", "box_coder",
+              "yolo_box", "deform_conv2d", "distribute_fpn_proposals"):
+        assert getattr(vops, n) is not None
+    from paddle_tpu import metric
+    for n in ("Accuracy", "Precision", "Recall", "Auc"):
+        assert getattr(metric, n) is not None
+    from paddle_tpu.hapi import callbacks
+    for n in ("ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+              "LRScheduler", "ReduceLROnPlateau"):
+        assert getattr(callbacks, n) is not None

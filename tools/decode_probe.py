@@ -68,7 +68,9 @@ def probe_scan_noflash():
 
 @stage("full")
 def probe_full():
-    """The round-2 killer: generate() with the flash decode kernel."""
+    """The round-2 killer: generate() with the flash decode kernel
+    (explicitly un-gated for this isolated child)."""
+    os.environ["PADDLE_TPU_FLASH_DECODE"] = "1"
     _generate_probe(use_flash=True)
 
 
