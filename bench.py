@@ -305,11 +305,19 @@ def worker_decode(args, on_tpu):
     cfg = args.config or cfg
     batch = args.batch or batch
     use_flash = on_tpu and not args.no_flash
+    # the Pallas decode kernel additionally sits behind an env gate (see
+    # ops/attention.py flash_decode) — report what actually ran
+    flash_kernel = (use_flash and
+                    os.environ.get("PADDLE_TPU_FLASH_DECODE") == "1")
     model = GPTForCausalLM(_resolve_config(
         cfg, max_position_embeddings=1024, hidden_dropout_prob=0.0,
         attention_probs_dropout_prob=0.0,
         use_flash_attention=use_flash))
     model.eval()
+    if args.weight_only:
+        from paddle_tpu.nn.quant import quantize_for_serving
+        n = quantize_for_serving(model, weight_dtype=args.weight_only)
+        log(f"weight-only {args.weight_only}: {n} layers converted")
     rng = np.random.default_rng(0)
     vocab = model.config.vocab_size
     prompt = jnp.asarray(rng.integers(0, vocab, (batch, 64)), jnp.int32)
@@ -332,7 +340,8 @@ def worker_decode(args, on_tpu):
         "vs_baseline": None,
         "config": cfg, "batch": batch, "new_tokens": new_tok,
         "ms_per_step": round(dt / new_tok * 1e3, 2),
-        "flash": use_flash,
+        "flash": use_flash, "flash_kernel": flash_kernel,
+        "weight_only": args.weight_only,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -621,6 +630,9 @@ def main():
     ap.add_argument("--s2d", action="store_true",
                     help="resnet50: MLPerf space-to-depth stem (exactly "
                          "equivalent 4x4/s1 conv over 12 channels)")
+    ap.add_argument("--weight-only", choices=("int8", "int4"), default=None,
+                    help="decode: serve with weight-only-quantized linears "
+                         "(HBM-bandwidth lever)")
     ap.add_argument("--scan-steps", type=int, default=0,
                     help="run K optimizer steps per compiled call "
                          "(lax.scan) to amortize dispatch latency")
@@ -651,6 +663,9 @@ def main():
         workloads = ["decode"]
     elif args.model:
         workloads = [args.model]
+        if args.weight_only and args.model != "decode":
+            ap.error("--weight-only applies to decode serving only "
+                     "(use --decode)")
     elif args.smoke and not args.all:
         workloads = ["gpt"]
     else:
@@ -664,7 +679,8 @@ def main():
     passthrough = []
     overrides = {"--steps": args.steps, "--batch": args.batch,
                  "--seq": args.seq, "--config": args.config,
-                 "--moment-dtype": args.moment_dtype}
+                 "--moment-dtype": args.moment_dtype,
+                 "--weight-only": args.weight_only}
     if len(workloads) == 1:
         for flag, val in overrides.items():
             if val is not None:

@@ -55,3 +55,4 @@ from .layers_rnn import (  # noqa: F401
 )
 from . import utils_mod as utils  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from . import quant  # noqa: E402,F401

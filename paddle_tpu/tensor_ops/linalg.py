@@ -17,7 +17,7 @@ __all__ = [
     "eigh", "eigvals", "eigvalsh", "cholesky", "cholesky_solve",
     "cholesky_inverse", "lstsq", "lu", "lu_unpack", "matrix_power",
     "matrix_rank", "pinv", "solve", "triangular_solve", "multi_dot",
-    "householder_product", "matrix_exp", "ormqr", "corrcoef_alias",
+    "householder_product", "matrix_exp", "ormqr",
 ]
 
 
@@ -249,8 +249,7 @@ def ormqr(x, tau, other, left=True, transpose=False, name=None):
     return apply_op(f, q, _t(other))
 
 
-def corrcoef_alias(x, rowvar=True, name=None):
-    return corrcoef(x, rowvar=rowvar)
+
 
 
 # ---------------------------------------------------------------------------

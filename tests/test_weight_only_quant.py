@@ -1,0 +1,124 @@
+"""paddle.nn.quant weight-only serving path (ref:
+python/paddle/nn/quant/quantized_linear.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn.quant import (WeightOnlyLinear, llm_int8_linear,
+                                 weight_dequantize, weight_only_linear,
+                                 weight_quantize)
+
+
+def _w(k=64, n=32, seed=0):
+    return np.random.default_rng(seed).standard_normal((k, n)).astype(
+        np.float32)
+
+
+@pytest.mark.parametrize("algo,bits", [("weight_only_int8", 127),
+                                       ("weight_only_int4", 7)])
+def test_quant_dequant_roundtrip_error_bound(algo, bits):
+    w = _w()
+    q, s = weight_quantize(paddle.to_tensor(w), algo)
+    back = weight_dequantize(q, s, algo).numpy()
+    # absmax per channel / bits is the max quantization step
+    step = np.abs(w).max(0) / bits
+    assert (np.abs(back - w) <= step / 2 + 1e-6).all()
+
+
+def test_int4_packing_halves_rows():
+    w = _w(64, 32)
+    q8, _ = weight_quantize(paddle.to_tensor(w), "weight_only_int8")
+    q4, _ = weight_quantize(paddle.to_tensor(w), "weight_only_int4")
+    assert q8.shape == [64, 32] and q4.shape == [32, 32]
+    assert q4.numpy().dtype == np.int8
+
+
+def test_int4_odd_k_rejected():
+    with pytest.raises(ValueError, match="even K"):
+        weight_quantize(paddle.to_tensor(_w(63, 8)), "weight_only_int4")
+
+
+@pytest.mark.parametrize("dtype,rtol", [("int8", 2e-2), ("int4", 2e-1)])
+def test_weight_only_linear_close_to_fp(dtype, rtol):
+    w = _w()
+    x = np.random.default_rng(1).standard_normal((4, 64)).astype(np.float32)
+    bias = np.random.default_rng(2).standard_normal(32).astype(np.float32)
+    algo = f"weight_only_{dtype}"
+    q, s = weight_quantize(paddle.to_tensor(w), algo)
+    y = weight_only_linear(paddle.to_tensor(x), q,
+                           paddle.to_tensor(bias), s, dtype).numpy()
+    ref = x @ w + bias
+    assert np.abs(y - ref).max() / np.abs(ref).max() < rtol
+
+
+def test_llm_int8_linear_matches_weight_only():
+    w = _w()
+    x = np.random.default_rng(3).standard_normal((2, 64)).astype(np.float32)
+    q, s = weight_quantize(paddle.to_tensor(w), "weight_only_int8")
+    a = llm_int8_linear(paddle.to_tensor(x), q, None, s).numpy()
+    b = weight_only_linear(paddle.to_tensor(x), q, None, s, "int8").numpy()
+    np.testing.assert_allclose(a, b)
+
+
+@pytest.mark.parametrize("dtype", ["int8", "int4"])
+def test_weight_only_module_from_linear(dtype):
+    paddle.seed(0)
+    lin = paddle.nn.Linear(64, 32)
+    m = WeightOnlyLinear.from_linear(lin, weight_dtype=dtype)
+    x = paddle.to_tensor(
+        np.random.default_rng(4).standard_normal((3, 64)).astype(np.float32))
+    ref = lin(x).numpy()
+    got = m(x).numpy()
+    tol = 3e-2 if dtype == "int8" else 3e-1
+    assert np.abs(got - ref).max() / max(np.abs(ref).max(), 1e-6) < tol
+    # weights really stored int8 (half the rows when int4-packed)
+    assert m.qweight.numpy().dtype == np.int8
+    rows = 32 if dtype == "int4" else 64
+    assert m.qweight.shape == [rows, 32]
+
+
+def test_weight_only_linear_state_dict_roundtrip():
+    paddle.seed(0)
+    lin = paddle.nn.Linear(16, 8)
+    m = WeightOnlyLinear.from_linear(lin, weight_dtype="int8")
+    sd = m.state_dict()
+    m2 = WeightOnlyLinear(16, 8, weight_dtype="int8")
+    m2.set_state_dict(sd)
+    x = paddle.to_tensor(np.ones((2, 16), np.float32))
+    np.testing.assert_allclose(m(x).numpy(), m2(x).numpy())
+
+
+def test_quantize_for_serving_gpt_decode():
+    """Convert a whole GPT for serving: logits stay close and the jitted
+    KV-cache decode still runs on the converted model."""
+    from paddle_tpu.nlp import GPTForCausalLM, GPTConfig
+    from paddle_tpu.nlp.generation import generate
+    from paddle_tpu.nn.quant import quantize_for_serving
+    paddle.seed(0)
+    cfg = dict(vocab_size=97, hidden_size=32, num_hidden_layers=2,
+               num_attention_heads=4, max_position_embeddings=64,
+               hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+               use_flash_attention=False)
+    m = GPTForCausalLM(GPTConfig(**cfg))
+    m.eval()
+    ids = paddle.to_tensor(np.asarray([[5, 17, 3, 42]], np.int32))
+    ref = m(ids)
+    ref = (ref[0] if isinstance(ref, tuple) else ref).numpy()
+    n = quantize_for_serving(m, weight_dtype="int8")
+    assert n >= 2 * 4, n  # qkv/out/fc1/fc2 per block at least
+    got = m(ids)
+    got = (got[0] if isinstance(got, tuple) else got).numpy()
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    assert rel < 0.1, rel
+    out = generate(m, ids, max_new_tokens=4, temperature=0.0)
+    assert np.asarray(out._value).shape == (1, 8)
+
+
+def test_quantize_for_serving_counts_and_idempotent():
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    from paddle_tpu.nn.quant import quantize_for_serving
+    assert quantize_for_serving(net) == 2
+    assert quantize_for_serving(net) == 0  # already converted

@@ -1,0 +1,125 @@
+"""One-command TPU measurement campaign for when the axon tunnel is up.
+
+Runs, in order of scoreboard value, each piece subprocess-isolated so a
+wedge costs one stage (results land incrementally in campaign_out/):
+
+  1. backend probe (tiny matmul)                 -> probe.json
+  2. bench full suite (gpt, ernie, resnet50,     -> bench_full.json
+     gpt-1.3b) — the BENCH_r03 shape
+  3. resnet50 --s2d A/B                          -> bench_resnet_s2d.json
+  4. gpt moment_dtype=bfloat16 A/B               -> bench_gpt_bf16m.json
+  5. decode bisection probes (kernel/scan/full)  -> decode_probe.json
+  6. decode bench (safe jnp path)                -> bench_decode.json
+  7. fusion audit (gpt + resnet optimized HLO)   -> fusion_audit.md
+
+Usage: python tools/tpu_campaign.py [--skip N,M] [--only N]
+Each stage prints PASS/FAIL + seconds; stop/resume freely — stages are
+independent. After a FAIL the campaign reprobes the backend and stops
+if the terminal is wedged (leaving earlier artifacts intact).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "campaign_out")
+
+
+def run(cmd, timeout, log_name, env_extra=None):
+    os.makedirs(OUT, exist_ok=True)
+    log_path = os.path.join(OUT, log_name)
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.monotonic()
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(cmd, cwd=REPO, stdout=log,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True, env=env)
+        try:
+            rc = proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+            rc = "timeout"
+    dt = round(time.monotonic() - t0, 1)
+    tail = open(log_path).read()[-400:]
+    return rc, dt, tail
+
+
+def last_json(log_name):
+    try:
+        for line in reversed(open(os.path.join(OUT, log_name)).readlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                return json.loads(line)
+    except (OSError, json.JSONDecodeError):
+        pass
+    return None
+
+
+PY = sys.executable
+
+STAGES = [
+    ("probe", [PY, "bench.py", "--worker", "probe"], 600, {}),
+    ("bench_full", [PY, "bench.py"], 7200, {}),
+    ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
+     2400, {}),
+    ("bench_gpt_bf16m", [PY, "bench.py", "--model", "gpt",
+                         "--moment-dtype", "bfloat16"], 2400, {}),
+    ("decode_probe", [PY, "tools/decode_probe.py"], 2400, {}),
+    ("bench_decode", [PY, "bench.py", "--decode"], 2400, {}),
+    ("fusion_audit", [PY, "tools/fusion_audit.py", "--out",
+                      "campaign_out/fusion_audit.md"], 3600, {}),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", default=None,
+                    help="comma-separated stage names to run")
+    ap.add_argument("--skip", default="",
+                    help="comma-separated stage names to skip")
+    args = ap.parse_args()
+    only = set(args.only.split(",")) if args.only else None
+    skip = set(args.skip.split(",")) if args.skip else set()
+    scale = float(os.environ.get("CAMPAIGN_TIMEOUT_SCALE", "1"))
+    summary = {}
+    for name, cmd, timeout, env in STAGES:
+        timeout = max(10, int(timeout * scale))
+        if (only and name not in only) or name in skip:
+            continue
+        print(f"=== {name} (timeout {timeout}s) ===", flush=True)
+        rc, dt, tail = run(cmd, timeout, f"{name}.log", env)
+        parsed = last_json(f"{name}.log")
+        ok = rc == 0
+        summary[name] = {"ok": ok, "rc": rc, "seconds": dt,
+                         "result": parsed}
+        print(f"=== {name}: rc={rc} {dt}s "
+              f"{json.dumps(parsed) if parsed else tail[-150:]!r} ===",
+              flush=True)
+        with open(os.path.join(OUT, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=1)
+        if not ok and name != "probe":
+            rc2, _, _ = run([PY, "bench.py", "--worker", "probe"], 600,
+                            "reprobe.log")
+            if rc2 != 0:
+                print("backend wedged after failure — stopping campaign "
+                      "(earlier artifacts kept)", flush=True)
+                break
+        if name == "probe" and not ok:
+            print("backend unreachable — campaign aborted", flush=True)
+            break
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()
