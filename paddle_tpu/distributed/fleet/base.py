@@ -37,6 +37,10 @@ class DistributedStrategy:
         self.gradient_merge_configs = {"k_steps": 1}
         self.lamb = False
         self.dgc = False
+        # r3 TPU lever: store Adam moments in bf16 with stochastic
+        # rounding (halves optimizer HBM state traffic; see
+        # optimizer.py moment_dtype)
+        self.bf16_moments = False
         self.find_unused_parameters = False
 
     def __repr__(self):
@@ -190,6 +194,23 @@ class Fleet:
     def distributed_optimizer(self, optimizer, strategy=None):
         strategy = strategy or self._strategy
         optimizer._fleet_strategy = strategy
+        if strategy is not None and getattr(strategy, "bf16_moments", False):
+            import jax.numpy as jnp
+            from ...optimizer.optimizer import Adam
+            # NAdam/RAdam subclass Adam but override update() without the
+            # stochastic-rounding store path — a hasattr probe would
+            # accept them and silently keep fp32 moments after step 1
+            if not (isinstance(optimizer, Adam)
+                    and type(optimizer).update is Adam.update):
+                raise ValueError(
+                    f"strategy.bf16_moments: {type(optimizer).__name__} "
+                    "has no reduced-precision moment support (Adam/AdamW "
+                    "only)")
+            if optimizer._func_state is not None:
+                raise RuntimeError(
+                    "strategy.bf16_moments must be applied before the "
+                    "first optimizer step (state already materialized)")
+            optimizer._moment_dtype = jnp.dtype(jnp.bfloat16)
         if strategy is not None and strategy.sharding:
             # fleet sharding stage 1/2/3 → GroupSharded/ZeRO placement
             # (ref: DygraphShardingOptimizer selection in fleet.init)

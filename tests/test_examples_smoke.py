@@ -30,6 +30,11 @@ class TestExamples:
         _run("vae_distribution.py")
         assert "final:" in capsys.readouterr().out
 
+    def test_serve_generation_runs(self, capsys):
+        _run("serve_generation.py")
+        assert "served-model continuation correct: True" in \
+            capsys.readouterr().out
+
     def test_quantize_runs(self, capsys):
         _run("quantize_qat.py")
         out = capsys.readouterr().out

@@ -101,3 +101,31 @@ def test_bf16_moments_engine_step():
 def test_invalid_moment_dtype_rejected():
     with pytest.raises(ValueError):
         paddle.optimizer.Adam(parameters=[], moment_dtype="float16")
+
+
+def test_fleet_strategy_bf16_moments():
+    """DistributedStrategy.bf16_moments wires moment_dtype through
+    fleet.distributed_optimizer (ref: strategy-driven optimizer config)."""
+    from paddle_tpu.distributed import fleet
+    strat = fleet.DistributedStrategy()
+    strat.bf16_moments = True
+    fleet.init(is_collective=True, strategy=strat)
+    try:
+        layer = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(parameters=layer.parameters())
+        opt = fleet.fleet_obj.distributed_optimizer(opt)
+        st = opt.init_state({"w": jnp.zeros((4, 4), jnp.float32)})
+        assert st["m"]["w"].dtype == jnp.bfloat16
+
+        sgd = paddle.optimizer.SGD(parameters=layer.parameters())
+        with pytest.raises(ValueError, match="Adam"):
+            fleet.fleet_obj.distributed_optimizer(sgd)
+        # NAdam subclasses Adam but lacks the rounding store path — must
+        # be rejected, not silently fp32 (review fix)
+        nadam = paddle.optimizer.NAdam(parameters=layer.parameters())
+        with pytest.raises(ValueError, match="Adam"):
+            fleet.fleet_obj.distributed_optimizer(nadam)
+    finally:
+        # the fleet singleton is process-wide: restore a default strategy
+        fleet.init(is_collective=True,
+                   strategy=fleet.DistributedStrategy())
