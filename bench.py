@@ -666,6 +666,9 @@ def main():
         if args.weight_only and args.model != "decode":
             ap.error("--weight-only applies to decode serving only "
                      "(use --decode)")
+        if args.moment_dtype and args.model not in ("gpt", "gpt-1.3b"):
+            ap.error("--moment-dtype applies to the gpt training "
+                     "workloads only")
     elif args.smoke and not args.all:
         workloads = ["gpt"]
     else:

@@ -51,6 +51,14 @@ class Engine:
         self._eval_fn = None
         self._pred_fn = None
         self._rng_key = jax.random.PRNGKey(0)
+        # gradient accumulation (two extra jitted programs, built lazily)
+        self._grad_fn = None
+        self._apply_fn = None
+        self._acc_grads = None
+        self._micro_count = 0
+        # optimizer updates, NOT microbatches: Adam's bias correction
+        # must see the number of update() calls
+        self._opt_step = 0
 
     # ------------------------------------------------------------------
     def sync_from_layer(self):
@@ -83,31 +91,67 @@ class Engine:
         return jax.tree_util.tree_map(place, arrs)
 
     # ------------------------------------------------------------------
+    def _trainable_keys(self):
+        # frozen (trainable=False) params are closed over as constants of
+        # the step — they get no grads and no optimizer update (parity with
+        # the eager Optimizer.step's p.trainable filter)
+        return {n for n, p in self.network.named_parameters() if p.trainable}
+
+    def _grad_shardings(self, trainable_keys):
+        """GroupSharded/ZeRO stage 2+: constraints that make XLA lower
+        the dp grad-sum to reduce-scatter (None when not sharding)."""
+        gs = getattr(self.optimizer, "_group_sharded", None)
+        if gs is None or not gs.shard_grads:
+            return None
+        from jax.sharding import NamedSharding
+        from ..distributed.fleet.sharding import constraint_specs
+        live_arrs = {k: v for k, v in self._params.items()
+                     if k in trainable_keys}
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(gs.mesh, s),
+            constraint_specs(live_arrs, gs.mesh, gs.axis))
+
+    @staticmethod
+    def _make_loss_fn(network, loss_layer, amp_dt, frozen, buffers,
+                      inputs, labels, rng):
+        """The forward+loss closure shared by the fused train step and
+        the accumulation grad step (single source of truth for the AMP
+        cast and buffer-dtype-restore logic)."""
+        def loss_fn(p):
+            run_p = {**frozen, **p}
+            run_in = inputs
+            if amp_dt is not None:
+                cast = jax.tree_util.tree_map(
+                    lambda a: a.astype(amp_dt)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                    (run_p, list(inputs)))
+                run_p, run_in = cast
+            outs, new_buf = functional_call(
+                network, run_p, buffers, *run_in, rng=rng, mutable=True)
+            if amp_dt is not None:
+                # keep running stats at their original dtype so the step
+                # signature is stable (no recompile) and stats stay fp32
+                new_buf = jax.tree_util.tree_map(
+                    lambda n, o: n.astype(o.dtype)
+                    if hasattr(n, "astype") else n, new_buf, buffers)
+            outs_t = outs if isinstance(outs, (list, tuple)) else [outs]
+            if loss_layer is not None:
+                l = loss_layer(*outs_t, *labels)
+            else:
+                l = outs_t[0]
+            l_arr = l._value if isinstance(l, Tensor) else l
+            return l_arr.astype(jnp.float32), (_unwrap(outs), new_buf)
+        return loss_fn
+
     def _build_train_fn(self):
         network = self.network
         loss_layer = self.loss
         opt = self.optimizer
         clip = getattr(opt, "_grad_clip", None)
         amp_dt = self.amp_dtype
-
-        # frozen (trainable=False) params are closed over as constants of
-        # the step — they get no grads and no optimizer update (parity with
-        # the eager Optimizer.step's p.trainable filter)
-        trainable_keys = {n for n, p in network.named_parameters()
-                          if p.trainable}
-
-        # GroupSharded/ZeRO stage 2+: constrain grads to their shard
-        # placement so XLA lowers the dp grad-sum to reduce-scatter
-        gs = getattr(opt, "_group_sharded", None)
-        grad_shardings = None
-        if gs is not None and gs.shard_grads:
-            from jax.sharding import NamedSharding
-            from ..distributed.fleet.sharding import constraint_specs
-            live_arrs = {k: v for k, v in self._params.items()
-                         if k in trainable_keys}
-            grad_shardings = jax.tree_util.tree_map(
-                lambda s: NamedSharding(gs.mesh, s),
-                constraint_specs(live_arrs, gs.mesh, gs.axis))
+        trainable_keys = self._trainable_keys()
+        grad_shardings = self._grad_shardings(trainable_keys)
+        make_loss_fn = self._make_loss_fn
 
         def train_step(params, buffers, opt_state, lr, step_i, rng, inputs,
                        labels):
@@ -118,32 +162,8 @@ class Engine:
             frozen = {k: v for k, v in params.items()
                       if k not in trainable_keys}
             live = {k: v for k, v in params.items() if k in trainable_keys}
-
-            def loss_fn(p):
-                run_p = {**frozen, **p}
-                run_in = inputs
-                if amp_dt is not None:
-                    cast = jax.tree_util.tree_map(
-                        lambda a: a.astype(amp_dt)
-                        if jnp.issubdtype(a.dtype, jnp.floating) else a,
-                        (run_p, list(inputs)))
-                    run_p, run_in = cast
-                outs, new_buf = functional_call(
-                    network, run_p, buffers, *run_in, rng=rng, mutable=True)
-                if amp_dt is not None:
-                    # keep running stats at their original dtype so the step
-                    # signature is stable (no recompile) and stats stay fp32
-                    new_buf = jax.tree_util.tree_map(
-                        lambda n, o: n.astype(o.dtype)
-                        if hasattr(n, "astype") else n, new_buf, buffers)
-                outs_t = outs if isinstance(outs, (list, tuple)) else [outs]
-                if loss_layer is not None:
-                    l = loss_layer(*outs_t, *labels)
-                else:
-                    l = outs_t[0]
-                l_arr = l._value if isinstance(l, Tensor) else l
-                return l_arr.astype(jnp.float32), (_unwrap(outs), new_buf)
-
+            loss_fn = make_loss_fn(network, loss_layer, amp_dt, frozen,
+                                   buffers, inputs, labels, rng)
             (loss_v, (outs, new_buf)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(live)
             if grad_shardings is not None:
@@ -157,6 +177,126 @@ class Engine:
 
         donate = (0, 1, 2) if self.donate else ()
         return jax.jit(train_step, donate_argnums=donate)
+
+    def _build_accum_fns(self):
+        """Gradient accumulation as TWO compiled programs (ref: the
+        reference's gradient_merge / accumulate_steps): `grad_fn` runs
+        forward+backward for one microbatch and adds into a donated
+        fp32 accumulator; `apply_fn` averages, clips and applies the
+        optimizer once per k microbatches. Splitting keeps each program
+        static — no data-dependent 'is this the k-th call' inside jit."""
+        network = self.network
+        loss_layer = self.loss
+        opt = self.optimizer
+        clip = getattr(opt, "_grad_clip", None)
+        amp_dt = self.amp_dtype
+        trainable_keys = self._trainable_keys()
+        grad_shardings = self._grad_shardings(trainable_keys)
+        make_loss_fn = self._make_loss_fn
+
+        def grad_step(params, buffers, acc, step_i, rng, inputs, labels):
+            rng = jax.random.fold_in(rng, step_i)
+            frozen = {k: v for k, v in params.items()
+                      if k not in trainable_keys}
+            live = {k: v for k, v in params.items() if k in trainable_keys}
+            loss_fn = make_loss_fn(network, loss_layer, amp_dt, frozen,
+                                   buffers, inputs, labels, rng)
+            (loss_v, (outs, new_buf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(live)
+            grads32 = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
+            if grad_shardings is not None:
+                # keep the fp32 accumulator sharded too — a replicated
+                # accumulator would undo ZeRO-2's memory win
+                grads32 = jax.lax.with_sharding_constraint(
+                    grads32, grad_shardings)
+            acc_out = jax.tree_util.tree_map(
+                lambda a, g: a + g, acc, grads32)
+            return acc_out, new_buf, loss_v, outs
+
+        def apply_step(params, opt_state, acc, n_micro, lr, step_i):
+            frozen = {k: v for k, v in params.items()
+                      if k not in trainable_keys}
+            live = {k: v for k, v in params.items() if k in trainable_keys}
+            grads = jax.tree_util.tree_map(
+                lambda a, p: (a / n_micro).astype(p.dtype), acc, live)
+            if clip is not None:
+                grads = clip.apply(grads)
+            new_live, new_opt = opt.update(live, grads, opt_state,
+                                           lr, step_i)
+            return {**frozen, **new_live}, new_opt
+
+        grad_jit = jax.jit(grad_step,
+                           donate_argnums=(2,) if self.donate else ())
+        apply_jit = jax.jit(apply_step,
+                            donate_argnums=(0, 1, 2) if self.donate else ())
+        return grad_jit, apply_jit
+
+    def _ensure_opt_state(self):
+        """Lazy optimizer-state init shared by the fused and accumulation
+        paths — including the set_state_dict pending-leaves restore."""
+        if self._opt_state is not None:
+            return
+        trainable = {n: self._params[n]
+                     for n, p in self.network.named_parameters()
+                     if p.trainable and n in self._params}
+        self._opt_state = self.optimizer.init_state(trainable)
+        pending = getattr(self.optimizer, "_pending_state_leaves", None)
+        if pending is not None:
+            leaves, treedef = jax.tree_util.tree_flatten(self._opt_state)
+            if len(pending) == len(leaves):
+                self._opt_state = jax.tree_util.tree_unflatten(
+                    treedef, pending)
+            self.optimizer._pending_state_leaves = None
+        self._apply_zero_placement()
+
+    def train_batch_accum(self, inputs, labels, apply_update):
+        """One microbatch of gradient accumulation; pass
+        apply_update=True on the last microbatch to run the optimizer on
+        the averaged gradients. Returns (loss, outs, applied)."""
+        if self.network.training is False:
+            self.network.train()
+        self._ensure_opt_state()
+        if self._grad_fn is None:
+            self._grad_fn, self._apply_fn = self._build_accum_fns()
+        in_arrs = self._shard_batch(_unwrap(list(inputs)))
+        lab_arrs = self._shard_batch(_unwrap(list(labels)))
+        self._step += 1
+        if self._acc_grads is None:
+            # zeros-init at window start keeps grad_step a single trace
+            # (an acc=None variant would be a second compiled program)
+            trainable_keys = self._trainable_keys()
+            self._acc_grads = {
+                k: jnp.zeros(v.shape, jnp.float32)
+                for k, v in self._params.items() if k in trainable_keys}
+        self._acc_grads, self._buffers, loss_v, outs = self._grad_fn(
+            self._params, self._buffers, self._acc_grads,
+            np.int32(self._step), self._rng_key, in_arrs, lab_arrs)
+        self._micro_count += 1
+        applied = False
+        if apply_update:
+            applied = self._apply_accum()
+        return loss_v, outs, applied
+
+    def _apply_accum(self):
+        if not self._micro_count or self._acc_grads is None:
+            return False
+        lr = np.float32(self._lr_now())
+        self._opt_step += 1
+        self._params, self._opt_state = self._apply_fn(
+            self._params, self._opt_state, self._acc_grads,
+            np.float32(self._micro_count), lr, np.int32(self._opt_step))
+        self._acc_grads = None
+        self._micro_count = 0
+        if self.donate:
+            self.network.load_raw_state(self._params, self._buffers)
+        return True
+
+    def flush_accum(self):
+        """Apply any partially-accumulated window (epoch end, early stop,
+        num_iters cutoff) so tail microbatch gradients are never dropped
+        or leaked into the next fit. Returns True if an update ran."""
+        return self._apply_accum()
 
     def _build_eval_fn(self):
         network = self.network
@@ -187,19 +327,7 @@ class Engine:
         """One optimizer step. inputs/labels: lists of Tensors/arrays."""
         if self.network.training is False:
             self.network.train()
-        if self._opt_state is None:
-            trainable = {n: self._params[n]
-                         for n, p in self.network.named_parameters()
-                         if p.trainable and n in self._params}
-            self._opt_state = self.optimizer.init_state(trainable)
-            pending = getattr(self.optimizer, "_pending_state_leaves", None)
-            if pending is not None:
-                leaves, treedef = jax.tree_util.tree_flatten(self._opt_state)
-                if len(pending) == len(leaves):
-                    self._opt_state = jax.tree_util.tree_unflatten(
-                        treedef, pending)
-                self.optimizer._pending_state_leaves = None
-            self._apply_zero_placement()
+        self._ensure_opt_state()
         if self._train_fn is None:
             self._train_fn = self._build_train_fn()
         in_arrs = self._shard_batch(_unwrap(list(inputs)))
@@ -208,6 +336,9 @@ class Engine:
         # instead of costing standalone device ops each step
         lr = np.float32(self._lr_now())
         self._step += 1
+        # the fused step passes _step as the optimizer step, so keep the
+        # update counter in lockstep for any later accumulation window
+        self._opt_step = self._step
         (self._params, self._buffers, self._opt_state, loss_v,
          outs) = self._train_fn(self._params, self._buffers, self._opt_state,
                                 lr, np.int32(self._step), self._rng_key,
@@ -251,11 +382,15 @@ class Engine:
 
     # state ------------------------------------------------------------
     def opt_state_dict(self):
-        return {"state": self._opt_state, "step": self._step}
+        return {"state": self._opt_state, "step": self._step,
+                "opt_step": self._opt_step}
 
     def load_opt_state_dict(self, d):
         self._opt_state = d["state"]
         self._step = d["step"]
+        # older checkpoints predate the separate update counter; the
+        # fused path kept it == step
+        self._opt_step = d.get("opt_step", d["step"])
         # resume path: re-apply ZeRO placement and rebuild the step so the
         # baked-in grad constraints match the (re)placed params
         if getattr(self.optimizer, "_group_sharded", None) is not None:

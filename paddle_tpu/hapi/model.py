@@ -79,6 +79,25 @@ class Model:
         loss = float(np.asarray(loss_v))
         return ([loss], metrics_out) if metrics_out else [loss]
 
+    def _train_batch_accum(self, inputs, labels, apply):
+        """Gradient-accumulation microbatch (fit's accumulate_grad_batches
+        path — ref: gradient_merge / accumulate_steps). The LR scheduler
+        steps only on real optimizer updates."""
+        eng = self._ensure_engine()
+        loss_v, outs, applied = eng.train_batch_accum(
+            _to_list(inputs), _to_list(labels), apply_update=apply)
+        if applied:
+            self._lr_step_after_update()
+        metrics_out = self._update_metrics(outs, labels)
+        loss = float(np.asarray(loss_v))
+        return ([loss], metrics_out) if metrics_out else [loss]
+
+    def _lr_step_after_update(self):
+        from ..optimizer.lr import LRScheduler, ReduceOnPlateau
+        if isinstance(self._optimizer._lr, LRScheduler) and \
+                not isinstance(self._optimizer._lr, ReduceOnPlateau):
+            self._optimizer._lr.step()
+
     def eval_batch(self, inputs, labels=None):
         eng = self._ensure_engine()
         loss_v, outs = eng.eval_batch(_to_list(inputs), _to_list(labels))
@@ -168,13 +187,24 @@ class Model:
                     break
                 cbks.on_batch_begin("train", step, logs)
                 ins, labs = self._split_batch(batch)
-                out = self.train_batch(ins, labs)
+                if accumulate_grad_batches > 1:
+                    out = self._train_batch_accum(
+                        ins, labs,
+                        apply=(step + 1) % accumulate_grad_batches == 0)
+                else:
+                    out = self.train_batch(ins, labs)
                 logs = self._make_logs(out)
                 logs["batch_size"] = len(np.asarray(ins[0]._value)) \
                     if isinstance(ins[0], Tensor) else batch_size
                 cbks.on_batch_end("train", step, logs)
                 if self.stop_training:
                     break
+            if accumulate_grad_batches > 1:
+                # tail microbatches (epoch end / early stop / num_iters):
+                # apply the partial window instead of dropping it or
+                # leaking it into the next epoch
+                if eng.flush_accum():
+                    self._lr_step_after_update()
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch % eval_freq == 0
                                             or epoch == epochs - 1):
@@ -299,7 +329,8 @@ class Model:
             return
         _save(self.network.state_dict(), path + ".pdparams")
         if self._optimizer is not None and self._engine is not None:
-            opt = {"engine_step": self._engine._step}
+            opt = {"engine_step": self._engine._step,
+                   "opt_step": self._engine._opt_step}
             import jax
             if self._engine._opt_state is not None:
                 leaves, _ = jax.tree_util.tree_flatten(self._engine._opt_state)
@@ -325,6 +356,7 @@ class Model:
                 self._optimizer is not None:
             blob = _load(opt_path)
             eng._step = blob.get("engine_step", 0)
+            eng._opt_step = blob.get("opt_step", eng._step)
             if "leaves" in blob and eng._opt_state is None and \
                     self._optimizer is not None:
                 eng._opt_state = self._optimizer.init_state(eng._params)

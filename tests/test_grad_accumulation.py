@@ -1,0 +1,197 @@
+"""Gradient accumulation (ref: fleet gradient_merge / hapi
+accumulate_grad_batches — which was a silent no-op until r3).
+
+Defining property: k accumulated microbatches of size m must produce the
+SAME parameter update as one batch of size k*m (mean-loss semantics make
+the averaged microbatch grads equal the big-batch grad).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi.engine import Engine
+
+
+def _net():
+    paddle.seed(3)
+    return paddle.nn.Sequential(paddle.nn.Linear(8, 32), paddle.nn.Tanh(),
+                                paddle.nn.Linear(32, 4))
+
+
+def _data(n=32):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 8)).astype(np.float32)
+    y = rng.integers(0, 4, (n,)).astype(np.int64)
+    return x, y
+
+
+def _engine(net, lr=0.05):
+    return Engine(net, loss=paddle.nn.CrossEntropyLoss(),
+                  optimizer=paddle.optimizer.AdamW(
+                      lr, weight_decay=0.01, parameters=net.parameters()))
+
+
+def test_accum_k_micro_equals_one_big_batch():
+    x, y = _data(32)
+    # reference: one step on the full batch
+    net_a = _net()
+    eng_a = _engine(net_a)
+    eng_a.train_batch([jnp.asarray(x)], [jnp.asarray(y)])
+    # accumulation: 4 microbatches of 8, applied on the last
+    net_b = _net()
+    eng_b = _engine(net_b)
+    for i in range(4):
+        sl = slice(8 * i, 8 * (i + 1))
+        loss, outs, applied = eng_b.train_batch_accum(
+            [jnp.asarray(x[sl])], [jnp.asarray(y[sl])],
+            apply_update=(i == 3))
+        assert applied == (i == 3)
+    for k in eng_a._params:
+        np.testing.assert_allclose(
+            np.asarray(eng_a._params[k]), np.asarray(eng_b._params[k]),
+            rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_accum_multiple_windows_trains():
+    net = _net()
+    eng = _engine(net, lr=0.02)
+    x, y = _data(32)
+    losses = []
+    for epoch in range(8):
+        for i in range(4):
+            sl = slice(8 * i, 8 * (i + 1))
+            loss, _, _ = eng.train_batch_accum(
+                [jnp.asarray(x[sl])], [jnp.asarray(y[sl])],
+                apply_update=(i == 3))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_fit_accumulate_grad_batches_no_longer_noop():
+    """Model.fit(accumulate_grad_batches=k) must step the optimizer
+    len(loader)/k times, not len(loader) times."""
+    x, y = _data(32)
+    net = _net()
+    model = paddle.Model(net)
+    sched = paddle.optimizer.lr.StepDecay(0.05, step_size=1, gamma=0.5)
+    model.prepare(paddle.optimizer.AdamW(sched,
+                                         parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss())
+    ds = paddle.io.TensorDataset([x, y])
+    model.fit(ds, epochs=1, batch_size=8, shuffle=False, verbose=0,
+              accumulate_grad_batches=4)
+    # 4 microbatches -> exactly ONE lr-scheduler step
+    assert sched.last_epoch == 1, sched.last_epoch
+
+
+def test_accum_respects_grad_clip():
+    net = _net()
+    clip = paddle.nn.ClipGradByGlobalNorm(1e-8)  # crushes every update
+    eng = Engine(net, loss=paddle.nn.CrossEntropyLoss(),
+                 optimizer=paddle.optimizer.SGD(
+                     1.0, parameters=net.parameters(), grad_clip=clip))
+    x, y = _data(8)
+    before = {k: np.asarray(v).copy() for k, v in eng._params.items()}
+    eng.train_batch_accum([jnp.asarray(x)], [jnp.asarray(y)],
+                          apply_update=True)
+    for k, v in eng._params.items():
+        assert np.abs(np.asarray(v) - before[k]).max() < 1e-6, k
+
+
+def test_fit_accum_flushes_tail_window():
+    """A partial window at epoch end must be applied, not dropped: 4
+    microbatches with k=3 -> 2 optimizer updates (3+1), not 1."""
+    x, y = _data(32)
+    net = _net()
+    model = paddle.Model(net)
+    sched = paddle.optimizer.lr.StepDecay(0.05, step_size=1, gamma=0.5)
+    model.prepare(paddle.optimizer.AdamW(sched,
+                                         parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss())
+    ds = paddle.io.TensorDataset([x, y])
+    model.fit(ds, epochs=1, batch_size=8, shuffle=False, verbose=0,
+              accumulate_grad_batches=3)
+    assert sched.last_epoch == 2, sched.last_epoch
+    eng = model._engine
+    assert eng._micro_count == 0 and eng._acc_grads is None
+
+
+def test_accum_resume_preserves_opt_step(tmp_path):
+    """Model.save/load keeps the optimizer-update counter: Adam's bias
+    correction must not restart at step 1 with warm moments."""
+    x, y = _data(16)
+    net = _net()
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.AdamW(0.01,
+                                         parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss())
+    ds = paddle.io.TensorDataset([x, y])
+    model.fit(ds, epochs=3, batch_size=8, shuffle=False, verbose=0,
+              accumulate_grad_batches=2)
+    saved_opt_step = model._engine._opt_step
+    assert saved_opt_step == 3  # 2 micro -> 1 update per epoch
+    model.save(str(tmp_path / "ck"))
+    net2 = _net()
+    m2 = paddle.Model(net2)
+    m2.prepare(paddle.optimizer.AdamW(0.01, parameters=net2.parameters()),
+               paddle.nn.CrossEntropyLoss())
+    m2.load(str(tmp_path / "ck"))
+    assert m2._engine._opt_step == saved_opt_step
+
+
+def test_fit_accum_reports_metrics():
+    from paddle_tpu.metric import Accuracy
+    x, y = _data(16)
+    net = _net()
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.AdamW(0.01,
+                                         parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss(), Accuracy())
+    out = model._train_batch_accum([paddle.to_tensor(x)],
+                                   [paddle.to_tensor(y)], apply=True)
+    assert isinstance(out, tuple) and len(out) == 2  # (loss, metrics)
+
+
+def test_accum_with_zero2_sharding():
+    """Accumulation composes with GroupSharded ZeRO-2: same losses as
+    unsharded accumulation, and the fp32 accumulator stays dp-sharded
+    (not replicated — the review-flagged memory hazard)."""
+    from jax.sharding import Mesh, NamedSharding
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    x, y = _data(32)
+
+    def run(sharded):
+        net = _net()
+        opt = paddle.optimizer.AdamW(1e-2, parameters=net.parameters())
+        if sharded:
+            net, opt, _ = group_sharded_parallel(net, opt, level="os_g",
+                                                 mesh=mesh)
+        eng = Engine(net, loss=paddle.nn.CrossEntropyLoss(),
+                     optimizer=opt, mesh=mesh if sharded else None)
+        losses = []
+        for w in range(2):
+            for i in range(2):
+                sl = slice(16 * i, 16 * (i + 1))
+                loss, _, _ = eng.train_batch_accum(
+                    [jnp.asarray(x[sl])], [jnp.asarray(y[sl])],
+                    apply_update=(i == 1))
+            losses.append(float(loss))
+        return losses, eng
+
+    ref, _ = run(False)
+    got, eng = run(True)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # mid-window accumulator leaves must carry a dp sharding
+    for i in range(1):
+        loss, _, _ = eng.train_batch_accum(
+            [jnp.asarray(x[:16])], [jnp.asarray(y[:16])],
+            apply_update=False)
+    leaves = [l for l in jax.tree_util.tree_leaves(eng._acc_grads)
+              if hasattr(l, "sharding") and l.ndim >= 1
+              and max(l.shape) % 8 == 0]
+    assert leaves
+    assert any(isinstance(l.sharding, NamedSharding)
+               and "dp" in jax.tree_util.tree_leaves(tuple(l.sharding.spec))
+               for l in leaves), "accumulator not sharded over dp"
