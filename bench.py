@@ -158,8 +158,11 @@ def run(eng, batch, seq, steps, warmup, scan_steps=0):
         def multi(params, buffers, opt_state, step0):
             def body(carry, i):
                 p, b, s = carry
+                # rng-step and opt-step advance together here (no
+                # accumulation inside the bench window)
                 p, b, s, l, _ = fn(p, b, s, np.float32(eng._lr_now()),
-                                   step0 + i, key, [ids], [labels])
+                                   step0 + i, step0 + i, key,
+                                   [ids], [labels])
                 return (p, b, s), l
             (p, b, s), ls = jax.lax.scan(
                 body, (params, buffers, opt_state),
@@ -663,18 +666,21 @@ def main():
         workloads = ["decode"]
     elif args.model:
         workloads = [args.model]
-        if args.weight_only and args.model != "decode":
-            ap.error("--weight-only applies to decode serving only "
-                     "(use --decode)")
-        if args.moment_dtype and args.model not in ("gpt", "gpt-1.3b"):
-            ap.error("--moment-dtype applies to the gpt training "
-                     "workloads only")
     elif args.smoke and not args.all:
         workloads = ["gpt"]
     else:
         # headline first: a later hang can't erase the number that
         # matters. 1.3B runs LAST (newest path = highest wedge risk).
         workloads = ["gpt", "ernie", "resnet50", "gpt-1.3b"]
+
+    # flags that only one workload family reads: reject elsewhere instead
+    # of silently benching the default config under a tuned-looking name
+    if args.weight_only and workloads != ["decode"]:
+        ap.error("--weight-only applies to decode serving only "
+                 "(use --decode)")
+    if args.moment_dtype and not set(workloads) <= {"gpt", "gpt-1.3b"}:
+        ap.error("--moment-dtype applies to the gpt training "
+                 "workloads only")
 
     # per-workload tuning flags only make sense for a single explicit
     # workload — forwarding them to the whole suite would silently bench

@@ -153,11 +153,14 @@ class Engine:
         grad_shardings = self._grad_shardings(trainable_keys)
         make_loss_fn = self._make_loss_fn
 
-        def train_step(params, buffers, opt_state, lr, step_i, rng, inputs,
-                       labels):
+        def train_step(params, buffers, opt_state, lr, step_i, opt_step_i,
+                       rng, inputs, labels):
             # per-step randomness folds from a CONSTANT base key inside the
             # compiled step — splitting on the host would cost device ops
-            # (and, on a remote backend, round trips) every iteration
+            # (and, on a remote backend, round trips) every iteration.
+            # step_i counts CALLS (unique rng per batch); opt_step_i counts
+            # optimizer UPDATES (Adam bias correction) — they differ once
+            # gradient accumulation has run in the same session.
             rng = jax.random.fold_in(rng, step_i)
             frozen = {k: v for k, v in params.items()
                       if k not in trainable_keys}
@@ -172,7 +175,7 @@ class Engine:
             if clip is not None:
                 grads = clip.apply(grads)
             new_live, new_opt = opt.update(live, grads, opt_state,
-                                           lr, step_i)
+                                           lr, opt_step_i)
             return {**frozen, **new_live}, new_buf, new_opt, loss_v, outs
 
         donate = (0, 1, 2) if self.donate else ()
@@ -264,11 +267,20 @@ class Engine:
         self._step += 1
         if self._acc_grads is None:
             # zeros-init at window start keeps grad_step a single trace
-            # (an acc=None variant would be a second compiled program)
+            # (an acc=None variant would be a second compiled program).
+            # Under ZeRO the zeros are created ON their grad shardings —
+            # a replicated fp32 accumulator would cost full-model memory
+            # per device, the exact thing stage 2 shards away
             trainable_keys = self._trainable_keys()
-            self._acc_grads = {
-                k: jnp.zeros(v.shape, jnp.float32)
-                for k, v in self._params.items() if k in trainable_keys}
+            shardings = self._grad_shardings(trainable_keys)
+            self._acc_grads = {}
+            for k, v in self._params.items():
+                if k not in trainable_keys:
+                    continue
+                z = jnp.zeros(v.shape, jnp.float32)
+                if shardings is not None and k in shardings:
+                    z = jax.device_put(z, shardings[k])
+                self._acc_grads[k] = z
         self._acc_grads, self._buffers, loss_v, outs = self._grad_fn(
             self._params, self._buffers, self._acc_grads,
             np.int32(self._step), self._rng_key, in_arrs, lab_arrs)
@@ -328,6 +340,10 @@ class Engine:
         if self.network.training is False:
             self.network.train()
         self._ensure_opt_state()
+        if self._micro_count:
+            # a pending accumulation window must not leak into (or be
+            # invalidated by) a fused step — apply the partial window now
+            self._apply_accum()
         if self._train_fn is None:
             self._train_fn = self._build_train_fn()
         in_arrs = self._shard_batch(_unwrap(list(inputs)))
@@ -336,12 +352,11 @@ class Engine:
         # instead of costing standalone device ops each step
         lr = np.float32(self._lr_now())
         self._step += 1
-        # the fused step passes _step as the optimizer step, so keep the
-        # update counter in lockstep for any later accumulation window
-        self._opt_step = self._step
+        self._opt_step += 1
         (self._params, self._buffers, self._opt_state, loss_v,
          outs) = self._train_fn(self._params, self._buffers, self._opt_state,
-                                lr, np.int32(self._step), self._rng_key,
+                                lr, np.int32(self._step),
+                                np.int32(self._opt_step), self._rng_key,
                                 in_arrs, lab_arrs)
         # donation deleted the old param/buffer jax arrays: rebind the live
         # Parameter tensors to the new ones so direct network access (eager
@@ -391,8 +406,15 @@ class Engine:
         # older checkpoints predate the separate update counter; the
         # fused path kept it == step
         self._opt_step = d.get("opt_step", d["step"])
-        # resume path: re-apply ZeRO placement and rebuild the step so the
-        # baked-in grad constraints match the (re)placed params
+        # a restored state invalidates any half-accumulated window
+        self._acc_grads = None
+        self._micro_count = 0
+        # resume path: re-apply ZeRO placement and rebuild the compiled
+        # programs so baked-in grad constraints / frozen-param constants
+        # match the (re)placed params — the accumulation programs bake
+        # the same state as the fused one
         if getattr(self.optimizer, "_group_sharded", None) is not None:
             self._apply_zero_placement()
             self._train_fn = None
+            self._grad_fn = None
+            self._apply_fn = None

@@ -72,10 +72,7 @@ class Model:
         loss_v, outs = eng.train_batch(_to_list(inputs), _to_list(labels))
         metrics_out = self._update_metrics(outs, labels)
         # advance lr scheduler per-step like the reference's hapi loop
-        from ..optimizer.lr import LRScheduler, ReduceOnPlateau
-        if isinstance(self._optimizer._lr, LRScheduler) and \
-                not isinstance(self._optimizer._lr, ReduceOnPlateau):
-            self._optimizer._lr.step()
+        self._lr_step_after_update()
         loss = float(np.asarray(loss_v))
         return ([loss], metrics_out) if metrics_out else [loss]
 
@@ -359,7 +356,13 @@ class Model:
             eng._opt_step = blob.get("opt_step", eng._step)
             if "leaves" in blob and eng._opt_state is None and \
                     self._optimizer is not None:
-                eng._opt_state = self._optimizer.init_state(eng._params)
+                # trainable-only, matching _ensure_opt_state — including
+                # frozen params here would grow the treedef and break the
+                # leaf-count match below
+                trainable = {n: eng._params[n]
+                             for n, p in self.network.named_parameters()
+                             if p.trainable and n in eng._params}
+                eng._opt_state = self._optimizer.init_state(trainable)
             if "leaves" in blob and eng._opt_state is not None:
                 import jax
                 leaves, treedef = jax.tree_util.tree_flatten(eng._opt_state)

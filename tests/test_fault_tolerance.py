@@ -212,6 +212,7 @@ else:  # restore onto the current (different-size) mesh
     eng._params = st['params']
     eng._opt_state = st['opt']
     eng._step = st['step']
+    eng._opt_step = st['step']  # update counter: fused path keeps ==step
     eng.network.load_raw_state(eng._params, eng._buffers)
     eng._train_fn = None  # rebuild for the restored placements
     for s in range(3, 5):

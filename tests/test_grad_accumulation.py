@@ -195,3 +195,18 @@ def test_accum_with_zero2_sharding():
     assert any(isinstance(l.sharding, NamedSharding)
                and "dp" in jax.tree_util.tree_leaves(tuple(l.sharding.spec))
                for l in leaves), "accumulator not sharded over dp"
+
+
+def test_mixed_fused_and_accum_paths():
+    """Mixing train_batch with a pending accumulation window: the window
+    flushes first (no stale-grad leak), and the optimizer update counter
+    stays a true update count across both paths."""
+    net = _net()
+    eng = _engine(net)
+    x, y = _data(16)
+    eng.train_batch_accum([jnp.asarray(x[:8])], [jnp.asarray(y[:8])],
+                          apply_update=False)
+    assert eng._micro_count == 1
+    eng.train_batch([jnp.asarray(x[8:])], [jnp.asarray(y[8:])])
+    assert eng._micro_count == 0 and eng._acc_grads is None
+    assert eng._opt_step == 2  # flush + fused update

@@ -122,7 +122,7 @@ def gpt_step(tiny=False):
     # materialize opt state + the jitted fn exactly as train_batch would
     eng.train_batch([ids], [labels])
     fn = eng._train_fn
-    return (lambda p, b, o, lr, st, key: fn(p, b, o, lr, st, key,
+    return (lambda p, b, o, lr, st, key: fn(p, b, o, lr, st, st, key,
                                             [ids], [labels]),
             (eng._params, eng._buffers, eng._opt_state,
              np.float32(1e-4), np.int32(2), eng._rng_key))
@@ -141,7 +141,8 @@ def resnet_step(tiny=False, s2d=False):
     y = jnp.asarray(rng.integers(0, 1000, (batch,)))
     eng.train_batch([x], [y])
     fn = eng._train_fn
-    return (lambda p, b, o, lr, st, key: fn(p, b, o, lr, st, key, [x], [y]),
+    return (lambda p, b, o, lr, st, key: fn(p, b, o, lr, st, st, key,
+                                            [x], [y]),
             (eng._params, eng._buffers, eng._opt_state,
              np.float32(0.1), np.int32(2), eng._rng_key))
 
