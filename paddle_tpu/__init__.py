@@ -74,8 +74,31 @@ from . import device  # noqa: E402,F401
 from . import version  # noqa: E402,F401
 from .framework import (  # noqa: E402,F401
     get_rng_state, set_rng_state, get_cuda_rng_state, set_cuda_rng_state,
-    LazyGuard,
+    LazyGuard, disable_static, enable_static, is_compiled_with_xpu,
+    is_compiled_with_rocm,
 )
+from .hapi import callbacks  # noqa: E402,F401  (ref: paddle.callbacks)
+from .distributed.parallel import DataParallel  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
+
+
+def batch(reader, batch_size, drop_last=False):
+    """ref: paddle.batch — legacy reader decorator (pre-DataLoader
+    scripts): wraps a sample generator into a batch generator."""
+    if int(batch_size) <= 0:
+        raise ValueError(
+            f"batch_size should be a positive value, got {batch_size}")
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
 
 
 # reference top-level aliases completing the namespace sweep

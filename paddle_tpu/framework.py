@@ -155,8 +155,35 @@ def is_compiled_with_cuda() -> bool:
     return False
 
 
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
 def is_compiled_with_tpu() -> bool:
     return any(d.platform == "tpu" for d in jax.devices())
+
+
+def disable_static(place=None):
+    """ref: paddle.disable_static — enter dygraph. This framework is
+    always dynamic-over-XLA, so this is a no-op kept for the countless
+    reference scripts that call it at startup."""
+    return None
+
+
+def enable_static():
+    """ref: paddle.enable_static — the static Program/Executor mode.
+    Deliberately not supported (SURVEY §2.12 static shim): trace with
+    @paddle.jit.to_static and export StableHLO via paddle.jit.save
+    instead; paddle.static.InputSpec works unchanged."""
+    raise NotImplementedError(
+        "static-graph mode is not supported on the TPU backend. "
+        "Migration: decorate with @paddle.jit.to_static (InputSpec "
+        "supported) and use paddle.jit.save/load for deployment — "
+        "see paddle_tpu.static for the shim and recipes.")
 
 
 def device_count() -> int:

@@ -1,0 +1,31 @@
+"""paddle.inference gate (ref: paddle/fluid/inference — the C++
+Predictor/AnalysisConfig serving stack).
+
+The reference's inference library loads a static Program and runs it
+through a C++ predictor with TensorRT/ONNX backends. The TPU-native
+deployment path is StableHLO: `paddle.jit.save(layer, path)` exports a
+portable, codeless artifact that `paddle.jit.load(path)` (or any
+StableHLO runtime) executes — see examples/deploy_stablehlo.py for the
+full train -> export -> codeless-reload -> serve flow, and
+paddle_tpu.nn.quant / paddle_tpu.quantization for int8 serving.
+"""
+from __future__ import annotations
+
+__all__ = ["Config", "create_predictor"]
+
+_RECIPE = (
+    "paddle.inference's C++ Predictor is not part of the TPU backend. "
+    "Migration: export with paddle.jit.save(layer, path) (StableHLO + "
+    "params; works without model code on reload) and serve via "
+    "paddle.jit.load(path) — examples/deploy_stablehlo.py is the "
+    "end-to-end recipe. For int8 serving see "
+    "paddle_tpu.nn.quant.quantize_for_serving.")
+
+
+class Config:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_RECIPE)
+
+
+def create_predictor(*a, **k):
+    raise NotImplementedError(_RECIPE)

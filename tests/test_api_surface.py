@@ -219,3 +219,29 @@ def test_amp_jit_static_namespaces():
     for n in ("ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
               "LRScheduler", "ReduceLROnPlateau"):
         assert getattr(callbacks, n) is not None
+
+
+def test_reference_top_level_mode_and_legacy_apis():
+    """Round-3 sweep: names every ported reference script touches."""
+    import pytest
+    assert paddle.disable_static() is None  # dygraph no-op
+    with pytest.raises(NotImplementedError, match="to_static"):
+        paddle.enable_static()
+    assert paddle.is_compiled_with_xpu() is False
+    assert paddle.is_compiled_with_rocm() is False
+    assert paddle.callbacks.ModelCheckpoint is not None
+    assert paddle.DataParallel is not None
+    with pytest.raises(NotImplementedError, match="jit.save"):
+        paddle.inference.Config("model")
+    # legacy reader decorator
+    b = paddle.batch(lambda: iter(range(7)), batch_size=3)
+    got = list(b())
+    assert got == [[0, 1, 2], [3, 4, 5], [6]]
+    b2 = paddle.batch(lambda: iter(range(7)), batch_size=3, drop_last=True)
+    assert list(b2()) == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_batch_rejects_nonpositive_size():
+    import pytest
+    with pytest.raises(ValueError, match="positive"):
+        paddle.batch(lambda: iter([]), batch_size=0)
