@@ -310,6 +310,14 @@ class Engine:
         or leaked into the next fit. Returns True if an update ran."""
         return self._apply_accum()
 
+    def reset_accum_window(self):
+        """Drop any half-accumulated gradient window WITHOUT applying it.
+        Call after restoring params/opt state from a checkpoint: grads
+        computed against the pre-restore parameters must not be averaged
+        into the first post-restore update."""
+        self._acc_grads = None
+        self._micro_count = 0
+
     def _build_eval_fn(self):
         network = self.network
         loss_layer = self.loss
@@ -406,9 +414,7 @@ class Engine:
         # older checkpoints predate the separate update counter; the
         # fused path kept it == step
         self._opt_step = d.get("opt_step", d["step"])
-        # a restored state invalidates any half-accumulated window
-        self._acc_grads = None
-        self._micro_count = 0
+        self.reset_accum_window()
         # resume path: re-apply ZeRO placement and rebuild the compiled
         # programs so baked-in grad constraints / frozen-param constants
         # match the (re)placed params — the accumulation programs bake

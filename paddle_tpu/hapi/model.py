@@ -348,6 +348,7 @@ class Model:
                 warnings.warn(f"unexpected keys: {unexpected}")
         eng = self._ensure_engine()
         eng.sync_from_layer()
+        eng.reset_accum_window()
         opt_path = path + ".pdopt"
         if not reset_optimizer and os.path.exists(opt_path) and \
                 self._optimizer is not None:

@@ -76,12 +76,24 @@ def _cache_fwd(model, params, buffers, tok, cache, idx):
     return _logits(logits_t), new_cache
 
 
-def _seen_from_prompt(ids, vocab_size):
+def _seen_from_prompt(ids, vocab_size, pad_token_id=None):
     """[B, V] bool presence mask — scatter, not a [B, S0, V] one-hot
-    (which would be ~400MB transient at GPT-3 vocab/prompt sizes)."""
+    (which would be ~400MB transient at GPT-3 vocab/prompt sizes).
+
+    Prompt occurrences of pad_token_id are excluded: left-padded prompts
+    (often pad==eos in GPT configs) must not leave the pad/eos logit
+    permanently repetition-penalized, which would bias against
+    termination. Limitation: without an attention mask we cannot tell a
+    genuine prompt token that happens to equal pad_token_id from
+    padding, so those are exempt too; tokens EMITTED during decode are
+    penalized regardless of id (the scan update masks on `done`, not on
+    token identity)."""
     b = ids.shape[0]
-    return jnp.zeros((b, vocab_size), jnp.bool_).at[
+    seen = jnp.zeros((b, vocab_size), jnp.bool_).at[
         jnp.arange(b)[:, None], ids].set(True)
+    if pad_token_id is not None:
+        seen = seen.at[:, pad_token_id].set(False)
+    return seen
 
 
 def build_decode_fn(model, max_new_tokens, temperature=1.0, top_k=0,
@@ -119,8 +131,8 @@ def build_decode_fn(model, max_new_tokens, temperature=1.0, top_k=0,
         logits, cache = fwd(ids, cache, 0)
         last = logits[:, -1, :].astype(jnp.float32)
         track_seen = repetition_penalty != 1.0
-        seen = _seen_from_prompt(ids, cfg.vocab_size) if track_seen \
-            else None
+        seen = _seen_from_prompt(ids, cfg.vocab_size, pad_token_id) \
+            if track_seen else None
 
         def sample(last, key, seen):
             if track_seen:
@@ -149,8 +161,13 @@ def build_decode_fn(model, max_new_tokens, temperature=1.0, top_k=0,
                                 nxt)
                 done = done | (nxt == eos_token_id)
             if track_seen:
-                seen = seen | jax.nn.one_hot(nxt, cfg.vocab_size,
-                                             dtype=jnp.bool_)
+                # only live rows mark their emission: finished rows emit
+                # pad filler which must not accrue repetition penalty
+                # (a genuinely emitted token equal to pad_token_id on a
+                # live row IS still penalized)
+                seen = seen | (jax.nn.one_hot(nxt, cfg.vocab_size,
+                                              dtype=jnp.bool_)
+                               & ~done[:, None])
             logits, cache = fwd(nxt[:, None], cache, idx)
             return (cache, idx + 1, logits[:, -1, :].astype(jnp.float32),
                     key, done, seen), nxt
@@ -208,8 +225,9 @@ def build_beam_decode_fn(model, max_new_tokens, num_beams,
             lambda a: jnp.repeat(a, k, axis=0), cache)
         last = jnp.repeat(logits[:, -1, :].astype(jnp.float32), k,
                           axis=0)                      # [B*K, V]
-        seen0 = (jnp.repeat(_seen_from_prompt(ids, v), k, axis=0)
-                 .reshape(b, k, v) if track_seen else None)
+        seen0 = (jnp.repeat(_seen_from_prompt(ids, v, pad_token_id), k,
+                            axis=0).reshape(b, k, v)
+                 if track_seen else None)
 
         scores0 = jnp.tile(
             jnp.asarray([0.0] + [-jnp.inf] * (k - 1), jnp.float32), (b, 1))
@@ -253,7 +271,10 @@ def build_beam_decode_fn(model, max_new_tokens, num_beams,
             if track_seen:
                 seen = jnp.take_along_axis(seen, beam_idx[:, :, None],
                                            axis=1)
-                seen = seen | jax.nn.one_hot(tok, v, dtype=jnp.bool_)
+                # frozen beams continue with pad filler — mask them out
+                # of the seen update so pad/eos never accrues penalty
+                seen = seen | (jax.nn.one_hot(tok, v, dtype=jnp.bool_)
+                               & ~done[:, :, None])
             logits, cache = fwd(tok.reshape(b * k, 1), cache, idx)
             return (cache, idx + 1, logits[:, -1, :].astype(jnp.float32),
                     top_val, seqs, done, seen), None

@@ -165,11 +165,12 @@ def quantize_for_serving(model, weight_dtype="int8", min_features=1):
     from .layers_common import Linear
 
     eligible = [Linear]
+    parallel_types = ()
     try:
         from ..distributed.fleet.mpu import (ColumnParallelLinear,
                                              RowParallelLinear, axis_bound)
-        for cls in (ColumnParallelLinear, RowParallelLinear):
-            eligible.append(cls)
+        parallel_types = (ColumnParallelLinear, RowParallelLinear)
+        eligible.extend(parallel_types)
     except ImportError:  # pragma: no cover
         axis_bound = lambda _axis: False  # noqa: E731
     eligible = tuple(eligible)
@@ -183,7 +184,7 @@ def quantize_for_serving(model, weight_dtype="int8", min_features=1):
                 continue
             if type(sub) in (WeightOnlyLinear,):
                 continue
-            if isinstance(sub, eligible) and type(sub) is not Linear \
+            if isinstance(sub, parallel_types) \
                     and axis_bound(getattr(sub, "mp_axis", "mp")):
                 raise ValueError(
                     f"cannot weight-only-quantize {type(sub).__name__} "

@@ -37,10 +37,15 @@ def _sround_bf16(x32, key):
     ref parity: paddle.optimizer.adamw multi_precision / master-weight
     path (python/paddle/optimizer/adamw.py) — same goal (reduced-precision
     state with fp32 math), TPU-native mechanism."""
-    bits = jax.lax.bitcast_convert_type(x32.astype(jnp.float32), jnp.uint32)
+    x32 = x32.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(x32, jnp.uint32)
     noise = jax.random.bits(key, x32.shape, jnp.uint16).astype(jnp.uint32)
-    return jax.lax.bitcast_convert_type(
+    rounded = jax.lax.bitcast_convert_type(
         ((bits + noise) >> 16).astype(jnp.uint16), jnp.bfloat16)
+    # non-finite bit patterns must bypass the noise add: inf + payload
+    # truncates to NaN, and uint32 wraparound on negative-NaN patterns
+    # flips the sign bit — keep a diverged run's inf recoverable
+    return jnp.where(jnp.isfinite(x32), rounded, x32.astype(jnp.bfloat16))
 
 
 def _store_moment(x32, dtype, key):

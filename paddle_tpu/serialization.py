@@ -17,17 +17,57 @@ from .tensor import Tensor
 
 _MAGIC = b"PTPU1\n"
 
+# npz only round-trips native numpy dtypes: ml_dtypes arrays (bfloat16,
+# float8_*) reload as void ("|V2") which JAX rejects — store their raw
+# bits under a same-width uint view plus a dtype tag instead
+try:
+    import ml_dtypes as _mld
+    _EXT_DTYPES = {}
+    for _n in ("bfloat16", "float8_e4m3fn", "float8_e5m2",
+               "float8_e4m3b11fnuz", "int4", "uint4"):
+        try:
+            _dt = np.dtype(getattr(_mld, _n))
+            _EXT_DTYPES[_dt] = np.dtype(f"uint{8 * _dt.itemsize}")
+        except (AttributeError, TypeError):
+            pass
+except ImportError:  # pragma: no cover
+    _mld = None
+    _EXT_DTYPES = {}
+
+
+def _store_array(a, arrays):
+    key = f"t{len(arrays)}"
+    bits = _EXT_DTYPES.get(a.dtype)
+    if bits is not None:
+        # .reshape: numpy's view() promotes 0-d arrays of user-defined
+        # dtypes to (1,) — pin the original shape
+        arrays[key] = np.ascontiguousarray(a).view(bits).reshape(a.shape)
+        return key, a.dtype.name
+    arrays[key] = a
+    return key, None
+
+
+def _restore_array(arr, dtype_name):
+    if dtype_name is not None:
+        dt = np.dtype(getattr(_mld, dtype_name))
+        return arr.view(dt).reshape(arr.shape)
+    return arr
+
 
 def _pack(obj, arrays, path=""):
     if isinstance(obj, Tensor):
-        key = f"t{len(arrays)}"
-        arrays[key] = np.asarray(obj._value)
-        return {"__tensor__": key,
+        key, ext = _store_array(np.asarray(obj._value), arrays)
+        spec = {"__tensor__": key,
                 "stop_gradient": obj.stop_gradient}
+        if ext:
+            spec["dtype"] = ext
+        return spec
     if isinstance(obj, (np.ndarray, jnp.ndarray)):
-        key = f"t{len(arrays)}"
-        arrays[key] = np.asarray(obj)
-        return {"__ndarray__": key}
+        key, ext = _store_array(np.asarray(obj), arrays)
+        spec = {"__ndarray__": key}
+        if ext:
+            spec["dtype"] = ext
+        return spec
     if isinstance(obj, dict):
         return {"__dict__": {k: _pack(v, arrays) for k, v in obj.items()}}
     if isinstance(obj, (list, tuple)):
@@ -38,12 +78,12 @@ def _pack(obj, arrays, path=""):
 
 def _unpack(spec, arrays, return_numpy=False):
     if "__tensor__" in spec:
-        arr = arrays[spec["__tensor__"]]
+        arr = _restore_array(arrays[spec["__tensor__"]], spec.get("dtype"))
         if return_numpy:
             return arr
         return Tensor(jnp.asarray(arr), stop_gradient=spec.get("stop_gradient", True))
     if "__ndarray__" in spec:
-        return arrays[spec["__ndarray__"]]
+        return _restore_array(arrays[spec["__ndarray__"]], spec.get("dtype"))
     if "__dict__" in spec:
         return {k: _unpack(v, arrays, return_numpy)
                 for k, v in spec["__dict__"].items()}
