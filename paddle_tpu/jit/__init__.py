@@ -22,7 +22,7 @@ from ..nn.layer import Layer, functional_call
 from ..tensor import Tensor
 
 __all__ = ["to_static", "save", "load", "InputSpec", "not_to_static",
-           "TranslatedLayer", "enable_to_static"]
+           "TranslatedLayer", "enable_to_static", "dy2static"]
 
 
 class InputSpec:
@@ -54,22 +54,58 @@ class StaticFunction:
 
     def __init__(self, fn, input_spec=None, layer=None, full_graph=True):
         self._fn = fn
-        self._layer = layer
+        self._orig_fn = fn            # pristine original; _fn may be
+        self._layer = layer           # swapped for a dy2static rewrite
         self._input_spec = input_spec
         self._compiled = {}
+        self._tracing = False
+        self._ast_tried = False
 
     def __call__(self, *args, **kwargs):
         if not _TO_STATIC_ENABLED[0]:
-            # enable_to_static(False): run the original eagerly (the
-            # captured fn is the pre-replacement bound forward for layers)
+            # enable_to_static(False): run the ORIGINAL eagerly (never a
+            # dy2static rewrite — this is the debugging escape hatch)
+            return self._orig_fn(*args, **kwargs)
+        if self._tracing:
+            # re-entered from inside our own trace (a to_static Layer's
+            # forward is dispatched through this wrapper): run the
+            # captured fn so tracing flows through it
             return self._fn(*args, **kwargs)
+        from . import dy2static as _d2s
+        try:
+            self._tracing = True
+            return self._run_compiled(args, kwargs)
+        except _d2s._TRACE_ERRORS as e:
+            self._tracing = False
+            if not self._ast_tried:
+                # dy2static fallback (ref: python/paddle/jit/dy2static):
+                # lower simple tensor-dependent if/while to lax.cond /
+                # lax.while_loop and retry the trace once; on ANY retry
+                # failure restore the original so the wrapper is never
+                # left pointing at a broken rewrite
+                self._ast_tried = True
+                new_fn = _d2s.transform_function(self._fn)
+                if new_fn is not None:
+                    self._fn = new_fn
+                    self._compiled.clear()
+                    try:
+                        return self.__call__(*args, **kwargs)
+                    except Exception:
+                        self._fn = self._orig_fn
+                        self._compiled.clear()
+                        raise
+            raise _d2s.ControlFlowError(
+                _d2s.describe_site(self._orig_fn)) from e
+        finally:
+            self._tracing = False
+
+    def _run_compiled(self, args, kwargs):
         layer = self._layer
         if layer is not None:
             params, buffers = layer.raw_state()
             training = layer.training
 
             def pure(p, b, key, *a):
-                from .. import framework
                 out, new_b = functional_call(layer, p, b, *a, rng=key,
                                              mutable=True)
                 return _unwrap(out), new_b
@@ -234,3 +270,5 @@ def enable_to_static(flag: bool):
     _TO_STATIC_ENABLED[0] = bool(flag)
 
 
+
+from . import dy2static  # noqa: E402  (public: paddle.jit.dy2static)

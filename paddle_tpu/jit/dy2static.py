@@ -1,0 +1,518 @@
+"""dy2static: tensor-dependent Python control flow under @to_static.
+
+ref parity: python/paddle/jit/dy2static/ — the reference AST-rewrites
+data-dependent Python `if`/`while` into cond/while_loop ops inside its
+static Program. The TPU-native substrate is `jax.jit` tracing, where
+tensor-dependent Python branching raises a tracer-concretization error.
+This module gives that failure a Paddle-voiced story in two stages:
+
+1. AST fallback: when a traced forward hits a concretization error,
+   `transform_function` rewrites simple `if`/`while` statements (no
+   return/break/continue inside) into `convert_ifelse` /
+   `convert_while_loop` calls that lower to `lax.cond` /
+   `lax.while_loop` when the predicate is a tracer — and the trace is
+   retried once. Plain `and`/`or`/`not` inside the tested condition are
+   mapped to `logical_and`/`logical_or`/`logical_not`.
+2. Actionable error: anything the transform can't lower re-raises as
+   `ControlFlowError` naming the function and source location with the
+   lax.cond / lax.while_loop / jnp.where migration recipe (instead of a
+   raw JAX TracerBoolConversionError).
+
+The convert_* operators are also public API, mirroring the reference's
+convert_operators module, so users can call them directly.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+import types
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["convert_ifelse", "convert_while_loop", "convert_logical_and",
+           "convert_logical_or", "convert_logical_not", "ControlFlowError",
+           "transform_function", "UNDEFINED"]
+
+
+class _Undefined:
+    """Sentinel for a name with no binding before a converted branch."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<UNDEFINED>"
+
+
+UNDEFINED = _Undefined()
+
+# static pytree node: lax.cond/while_loop carries treat UNDEFINED as
+# structure, so "assigned in neither branch yet" round-trips for free,
+# while "assigned in only ONE branch" surfaces as a treedef mismatch we
+# convert into an actionable ControlFlowError
+jax.tree_util.register_pytree_node(
+    _Undefined, lambda u: ((), None), lambda aux, ch: UNDEFINED)
+
+_RECIPE = """\
+Tensor-dependent Python control flow cannot be traced into one XLA
+program. Rewrite the data-dependent branch with compiled control flow:
+  - value select:     y = paddle.where(cond, a, b)          (jnp.where)
+  - if/else blocks:   jax.lax.cond(pred, true_fn, false_fn, operand)
+  - while loops:      jax.lax.while_loop(cond_fn, body_fn, init)
+  - bounded for:      jax.lax.fori_loop / jax.lax.scan
+or hoist the condition to a Python value (config flag, shape, .item()
+outside the jitted region). paddle_tpu auto-lowers simple if/while
+statements; this one could not be lowered (returns/breaks inside a
+tensor-dependent branch, or mismatched variables across branches)."""
+
+
+class ControlFlowError(RuntimeError):
+    """Raised when @to_static meets un-lowerable data-dependent control
+    flow (ref: dy2static's transformation errors, same role)."""
+
+    def __init__(self, where, detail=""):
+        msg = f"to_static: data-dependent control flow in {where}"
+        if detail:
+            msg += f"\n{detail}"
+        super().__init__(msg + "\n" + _RECIPE)
+
+
+def _raw(x):
+    from ..tensor import Tensor
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_tracer(x):
+    x = _raw(x)
+    return isinstance(x, jax.core.Tracer)
+
+
+def _canon(tree):
+    """Uniform carry representation across branches/iterations: one
+    branch may bind a variable to a Tensor (layer output) and the other
+    to a raw jnp array (arithmetic on a traced input), and a Tensor's
+    stop_gradient flag lives in its pytree aux — either way the two
+    branch treedefs mismatch under lax.cond. Canonical form: raw arrays,
+    with stop_gradient=True materialized as in-graph lax.stop_gradient
+    (the semantics move into the program, the structure is uniform).
+    Code after a converted block therefore sees jnp arrays, which share
+    the Tensor method surface that is legal under tracing."""
+    from ..tensor import Tensor
+
+    def leaf(v):
+        if isinstance(v, Tensor):
+            val = v._value
+            if v.stop_gradient and isinstance(val, jax.core.Tracer):
+                val = jax.lax.stop_gradient(val)
+            return val
+        return v
+    return jax.tree_util.tree_map(
+        leaf, tree, is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def convert_ifelse(pred, true_fn, false_fn, init):
+    """`if pred: ... else: ...` over carried values `init` (tuple).
+
+    Tracer pred -> lax.cond (both branches traced); Python pred -> only
+    the taken branch runs. ref: convert_operators.convert_ifelse."""
+    pred = _raw(pred)
+    if not _is_tracer(pred):
+        return true_fn(init) if pred else false_fn(init)
+    try:
+        return jax.lax.cond(jnp.asarray(pred).reshape(()),
+                            lambda c: _canon(true_fn(c)),
+                            lambda c: _canon(false_fn(c)), _canon(init))
+    except TypeError as e:
+        raise ControlFlowError(
+            "a converted `if` statement",
+            "the two branches produce different variables or dtypes "
+            f"(both must bind the same tensors): {e}") from e
+
+
+def convert_while_loop(cond_fn, body_fn, init):
+    """`while cond: ...` over carried values `init` (tuple).
+
+    Tracer condition -> lax.while_loop (carry shapes fixed); Python
+    condition -> ordinary loop. ref: convert_operators.convert_while_loop."""
+    first = cond_fn(init)
+    if not _is_tracer(first):
+        carry = init
+        cond = first
+        while cond:
+            carry = body_fn(carry)
+            cond = cond_fn(carry)
+        return carry
+    try:
+        return jax.lax.while_loop(
+            lambda c: jnp.asarray(_raw(cond_fn(c))).reshape(()),
+            lambda c: _canon(body_fn(c)), _canon(init))
+    except TypeError as e:
+        raise ControlFlowError(
+            "a converted `while` loop",
+            "the loop body changes the shape/dtype/variables of the "
+            f"carried state (it must stay fixed): {e}") from e
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_tracer(lhs):
+        return jnp.logical_and(jnp.asarray(_raw(lhs)),
+                               jnp.asarray(_raw(rhs_fn())))
+    return lhs and rhs_fn()          # Python short-circuit preserved
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if _is_tracer(lhs):
+        return jnp.logical_or(jnp.asarray(_raw(lhs)),
+                              jnp.asarray(_raw(rhs_fn())))
+    return lhs or rhs_fn()
+
+
+def convert_logical_not(x):
+    if _is_tracer(x):
+        return jnp.logical_not(jnp.asarray(_raw(x)))
+    return not x
+
+
+def _init_carry(local_vars, names):
+    return tuple(local_vars.get(n, UNDEFINED) for n in names)
+
+
+# ---------------------------------------------------------------------
+# AST transformation
+# ---------------------------------------------------------------------
+
+class _Escape(ast.NodeVisitor):
+    """Does a statement list contain return/break/continue/raise at this
+    control level (not inside a nested function/loop)? Such a block can't
+    become a lax.cond branch: both branches are traced unconditionally,
+    so a data-dependent `raise` would fire at trace time for every
+    input, and returns/breaks change control flow outside the block."""
+
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_Break(self, node):
+        self.found = True
+
+    def visit_Continue(self, node):
+        self.found = True
+
+    def visit_Raise(self, node):
+        self.found = True
+
+    def visit_Assert(self, node):
+        self.found = True            # assert lowers to a conditional raise
+
+    def visit_FunctionDef(self, node):
+        pass                          # nested defs own their returns
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _has_escape(stmts):
+    v = _Escape()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+class _AssignedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)
+
+    def visit_AsyncFunctionDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned(nodes):
+    v = _AssignedNames()
+    for n in nodes:
+        v.visit(n)
+    return v.names
+
+
+class _LoadedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+
+
+def _loaded(node):
+    v = _LoadedNames()
+    v.visit(node)
+    return v.names
+
+
+class _BoolOpInTest(ast.NodeTransformer):
+    """`a and b` / `a or b` / `not a` inside a tested condition ->
+    convert_logical_* (tracer-aware, short-circuit kept for Python
+    values via lambdas)."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("__ptu_and" if isinstance(node.op, ast.And) else "__ptu_or")
+        expr = node.values[0]
+        for v in node.values[1:]:
+            expr = ast.Call(
+                func=ast.Name(id=fn, ctx=ast.Load()),
+                args=[ast.Lambda(args=_empty_args(), body=expr),
+                      ast.Lambda(args=_empty_args(), body=v)],
+                keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=ast.Name(id="__ptu_not", ctx=ast.Load()),
+                            args=[node.operand], keywords=[])
+        return node
+
+
+def _empty_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+class _CtrlFlow(ast.NodeTransformer):
+    """Rewrite simple If/While into convert_ifelse/convert_while_loop."""
+
+    def __init__(self, fn_locals):
+        self.fn_locals = fn_locals    # names local to the function
+        self.changed = False
+        self._n = 0
+
+    # -- helpers -------------------------------------------------------
+    def _carry_tuple(self, names, ctx):
+        return ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ctx()) for n in names], ctx=ctx())
+
+    def _branch_def(self, fname, names, body):
+        """def fname(vals): (names) = vals; <body>; return (names)"""
+        stmts = []
+        if names:
+            stmts.append(ast.Assign(
+                targets=[self._carry_tuple(names, ast.Store)],
+                value=ast.Name(id="__ptu_vals", ctx=ast.Load())))
+        stmts.extend(body)
+        stmts.append(ast.Return(value=self._carry_tuple(names, ast.Load)))
+        return ast.FunctionDef(
+            name=fname,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg="__ptu_vals")],
+                vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+                defaults=[]),
+            body=stmts, decorator_list=[], returns=None)
+
+    def _init_call(self, names):
+        return ast.Call(
+            func=ast.Name(id="__ptu_init", ctx=ast.Load()),
+            args=[ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                           args=[], keywords=[]),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load())],
+            keywords=[])
+
+    # -- If ------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node               # can't lower; runtime error speaks
+        names = sorted((_assigned(node.body) | _assigned(node.orelse))
+                       & self.fn_locals)
+        self._n += 1
+        self.changed = True
+        tname, fname = f"__ptu_true_{self._n}", f"__ptu_false_{self._n}"
+        test = _BoolOpInTest().visit(node.test)
+        out = [
+            self._branch_def(tname, names, node.body),
+            self._branch_def(fname, names,
+                             node.orelse or [ast.Pass()]),
+            ast.Assign(
+                targets=[self._carry_tuple(names, ast.Store)]
+                if names else
+                [ast.Name(id=f"__ptu_void_{self._n}", ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Name(id="__ptu_ifelse", ctx=ast.Load()),
+                    args=[test,
+                          ast.Name(id=tname, ctx=ast.Load()),
+                          ast.Name(id=fname, ctx=ast.Load()),
+                          self._init_call(names)],
+                    keywords=[])),
+        ]
+        return out
+
+    # -- While ---------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or node.orelse:
+            return node
+        names = sorted((_assigned(node.body) | _loaded(node.test))
+                       & self.fn_locals)
+        self._n += 1
+        self.changed = True
+        cname, bname = f"__ptu_cond_{self._n}", f"__ptu_body_{self._n}"
+        test = _BoolOpInTest().visit(node.test)
+        cond_def = self._branch_def(cname, names, [])
+        cond_def.body[-1] = ast.Return(value=test)
+        body_def = self._branch_def(bname, names, node.body)
+        out = [
+            cond_def,
+            body_def,
+            ast.Assign(
+                targets=[self._carry_tuple(names, ast.Store)]
+                if names else
+                [ast.Name(id=f"__ptu_void_{self._n}", ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Name(id="__ptu_while", ctx=ast.Load()),
+                    args=[ast.Name(id=cname, ctx=ast.Load()),
+                          ast.Name(id=bname, ctx=ast.Load()),
+                          self._init_call(names)],
+                    keywords=[])),
+        ]
+        return out
+
+
+def _function_locals(fn_node):
+    names = {a.arg for a in fn_node.args.args}
+    names |= {a.arg for a in fn_node.args.posonlyargs}
+    names |= {a.arg for a in fn_node.args.kwonlyargs}
+    if fn_node.args.vararg:
+        names.add(fn_node.args.vararg.arg)
+    if fn_node.args.kwarg:
+        names.add(fn_node.args.kwarg.arg)
+    names |= _assigned(fn_node.body)
+    return names
+
+
+def _is_to_static_deco(node):
+    """Match @to_static / @paddle.jit.to_static(...) decorators so only
+    they are stripped from the recompiled function."""
+    target = node.func if isinstance(node, ast.Call) else node
+    if isinstance(target, ast.Attribute):
+        return target.attr == "to_static"
+    return isinstance(target, ast.Name) and target.id == "to_static"
+
+
+class _ZeroArgSuper(ast.NodeTransformer):
+    """`super()` relies on the compiler-provided __class__ cell, which an
+    exec-compiled module-level def doesn't have — rewrite to the two-arg
+    form using the original closure's __class__ and the first param."""
+
+    def __init__(self, self_name):
+        self.self_name = self_name
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name) and node.func.id == "super"
+                and not node.args and not node.keywords):
+            node.args = [ast.Name(id="__ptu_class__", ctx=ast.Load()),
+                         ast.Name(id=self.self_name, ctx=ast.Load())]
+        return node
+
+
+def transform_function(fn):
+    """AST-rewrite `fn` lowering simple if/while to converted control
+    flow. Returns the new function, or None if nothing was (or could
+    be) rewritten. Bound methods come back re-bound.
+
+    Limitation: closure variables are snapshotted at transform time; a
+    free variable rebound later in the enclosing scope keeps its
+    transform-time value inside the rewritten function."""
+    bound_self = getattr(fn, "__self__", None)
+    raw_fn = fn.__func__ if bound_self is not None else fn
+    try:
+        src = textwrap.dedent(inspect.getsource(raw_fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fn_node = tree.body[0]
+    if not isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    fn_node.decorator_list = [d for d in fn_node.decorator_list
+                              if not _is_to_static_deco(d)]
+    closure_vars = {}
+    if raw_fn.__closure__:
+        try:
+            closure_vars = {
+                n: c.cell_contents for n, c in
+                zip(raw_fn.__code__.co_freevars, raw_fn.__closure__)}
+        except ValueError:            # an unfilled cell — can't snapshot
+            return None
+    if "super" in _loaded(fn_node):
+        cls_cell = closure_vars.get("__class__")
+        if cls_cell is None or not fn_node.args.args:
+            return None               # zero-arg super() unrewritable
+        closure_vars["__ptu_class__"] = cls_cell
+        fn_node = _ZeroArgSuper(fn_node.args.args[0].arg).visit(fn_node)
+    tr = _CtrlFlow(_function_locals(fn_node))
+    new_node = tr.visit(fn_node)
+    if not tr.changed:
+        return None
+    mod = ast.Module(body=[new_node], type_ignores=[])
+    ast.fix_missing_locations(mod)
+    glb = dict(raw_fn.__globals__)
+    glb.update(closure_vars)
+    glb.update({
+        "__ptu_ifelse": convert_ifelse,
+        "__ptu_while": convert_while_loop,
+        "__ptu_and": convert_logical_and,
+        "__ptu_or": convert_logical_or,
+        "__ptu_not": convert_logical_not,
+        "__ptu_init": _init_carry,
+    })
+    code = compile(mod, filename=f"<dy2static {raw_fn.__qualname__}>",
+                   mode="exec")
+    ns = {}
+    exec(code, glb, ns)
+    new_fn = ns[fn_node.name]
+    new_fn.__dy2static__ = True
+    if bound_self is not None:
+        return types.MethodType(new_fn, bound_self)
+    return new_fn
+
+
+_TRACE_ERRORS = (jax.errors.ConcretizationTypeError,
+                 jax.errors.TracerBoolConversionError,
+                 jax.errors.TracerIntegerConversionError,
+                 jax.errors.TracerArrayConversionError)
+
+
+def describe_site(fn):
+    """'forward of MyNet (file.py:42)' for error messages."""
+    raw = getattr(fn, "__func__", fn)
+    try:
+        file = inspect.getsourcefile(raw)
+        _, line = inspect.getsourcelines(raw)
+        return f"{raw.__qualname__} ({file}:{line})"
+    except (OSError, TypeError):
+        return getattr(raw, "__qualname__", repr(raw))

@@ -1,0 +1,315 @@
+"""Dy2static control-flow story (ref: python/paddle/jit/dy2static/,
+convert_operators.py).
+
+The reference AST-transforms data-dependent Python if/while into
+cond/while_loop ops. Here @to_static traces with jax.jit; on a tracer-
+concretization failure it AST-rewrites simple if/while into
+lax.cond/lax.while_loop and retries once; anything un-lowerable raises
+a paddle_tpu-voiced ControlFlowError naming the function with the
+lax.cond / while_loop / jnp.where migration recipe.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.dy2static import (ControlFlowError, convert_ifelse,
+                                      convert_while_loop,
+                                      convert_logical_and,
+                                      convert_logical_or, UNDEFINED)
+
+
+def _x(v):
+    return paddle.to_tensor(np.asarray(v, np.float32))
+
+
+# ---------- to_static(Layer) basic path (regression: recursed) --------
+
+class _Plain(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(4, 4)
+
+    def forward(self, x):
+        return self.fc(x) * 2
+
+
+def test_to_static_layer_runs_and_matches_eager():
+    paddle.seed(0)
+    net = _Plain()
+    x = _x(np.ones((2, 4)))
+    eager = net(x).numpy()
+    st = paddle.jit.to_static(net)
+    np.testing.assert_allclose(st(x).numpy(), eager, rtol=1e-6)
+
+
+# ---------- auto-lowered if / while ------------------------------------
+
+class _Branchy(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(4, 4)
+
+    def forward(self, x):
+        if x.sum() > 0:
+            y = self.fc(x)
+        else:
+            y = x * 0.5
+        return y
+
+
+def test_tensor_if_lowered_to_cond_both_branches():
+    paddle.seed(1)
+    net = _Branchy()
+    st = paddle.jit.to_static(net)
+    xp = _x(np.ones((2, 4)))
+    xn = _x(-np.ones((2, 4)))
+    got_pos = st(xp).numpy()
+    got_neg = st(xn).numpy()
+    # eager references
+    paddle.seed(1)
+    ref_net = _Branchy()
+    np.testing.assert_allclose(got_pos, ref_net.fc(xp).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(got_neg, (xn * 0.5).numpy(), rtol=1e-6)
+
+
+def test_tensor_while_lowered_to_while_loop():
+    @paddle.jit.to_static
+    def count_pos(x):
+        i = 0
+        while (x > 0).sum() > i:
+            i = i + 1
+        return i
+
+    out = count_pos(_x([1.0, 2.0, -1.0, 3.0]))
+    assert int(np.asarray(out.numpy() if hasattr(out, "numpy") else out)) == 3
+
+
+def test_nested_if_inside_while():
+    @paddle.jit.to_static
+    def f(x):
+        i = 0
+        acc = x * 0.0
+        while i < 3:
+            if x.sum() > 0:
+                acc = acc + x
+            else:
+                acc = acc - x
+            i = i + 1
+        return acc
+
+    np.testing.assert_allclose(
+        f(_x([1.0, 2.0])).numpy(), [3.0, 6.0], rtol=1e-6)
+    np.testing.assert_allclose(
+        f(_x([-1.0, -2.0])).numpy(), [3.0, 6.0], rtol=1e-6)
+
+
+def test_boolop_in_condition_converted():
+    @paddle.jit.to_static
+    def f(x):
+        if (x.sum() > 0) and (x.max() < 10):
+            y = x * 2.0
+        else:
+            y = x * 3.0
+        return y
+
+    np.testing.assert_allclose(f(_x([1.0, 2.0])).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(f(_x([1.0, 20.0])).numpy(), [3.0, 60.0])
+
+
+# ---------- un-lowerable patterns speak paddle_tpu ---------------------
+
+class _EarlyReturn(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(4, 4)
+
+    def forward(self, x):
+        if x.sum() > 0:
+            return self.fc(x)
+        return x
+
+
+def test_return_in_tensor_branch_raises_actionable_error():
+    paddle.seed(2)
+    st = paddle.jit.to_static(_EarlyReturn())
+    with pytest.raises(ControlFlowError) as ei:
+        st(_x(np.ones((2, 4))))
+    msg = str(ei.value)
+    assert "forward" in msg          # names the function
+    assert "lax.cond" in msg         # migration recipe
+    assert "while_loop" in msg
+    assert "where" in msg
+
+
+def test_one_sided_assignment_raises_actionable_error():
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0        # y undefined in else-branch
+        return y
+
+    with pytest.raises(ControlFlowError):
+        f(_x([1.0, 2.0]))
+
+
+def test_tensor_range_raises_actionable_error():
+    @paddle.jit.to_static
+    def f(x):
+        acc = x.sum() * 0
+        for i in range(int(x.sum())):
+            acc = acc + i
+        return acc
+
+    with pytest.raises(ControlFlowError) as ei:
+        f(_x([3.0]))
+    assert "fori_loop" in str(ei.value)
+
+
+def test_raise_in_tensor_branch_not_lowered():
+    """A data-dependent `raise` must NOT become a lax.cond branch: both
+    branches trace unconditionally, so the raise would fire for every
+    input. It must surface as ControlFlowError, not a spurious
+    ValueError."""
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() < 0:
+            raise ValueError("negative input")
+        y = x * 2.0
+        return y
+
+    with pytest.raises(ControlFlowError):
+        f(_x([1.0, 2.0]))            # positive input — raise must not fire
+
+
+class _Base(paddle.nn.Layer):
+    def forward(self, x):
+        return x + 1.0
+
+
+class _Sub(_Base):
+    def forward(self, x):
+        h = super().forward(x)
+        if h.sum() > 0:
+            y = h * 2.0
+        else:
+            y = h * 3.0
+        return y
+
+
+def test_zero_arg_super_rewritten():
+    st = paddle.jit.to_static(_Sub())
+    np.testing.assert_allclose(st(_x([1.0, 2.0])).numpy(), [4.0, 6.0])
+    np.testing.assert_allclose(st(_x([-4.0, -4.0])).numpy(), [-9.0, -9.0])
+
+
+def _plus_one(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrap(*a, **k):
+        return fn(*a, **k) + 1.0
+    return wrap
+
+
+def test_stacked_decorator_preserved_through_rewrite():
+    @paddle.jit.to_static
+    @_plus_one
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x * 3.0
+        return y
+
+    np.testing.assert_allclose(f(_x([1.0])).numpy(), [3.0])   # 2x + 1
+    np.testing.assert_allclose(f(_x([-1.0])).numpy(), [-2.0])  # 3x + 1
+
+
+def test_enable_to_static_false_uses_pristine_original():
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2.0
+        else:
+            y = x * 3.0
+        return y
+
+    f(_x([1.0]))                     # triggers the dy2static rewrite
+    paddle.jit.enable_to_static(False)
+    try:
+        out = f(_x([1.0]))           # eager: original source, concrete if
+        np.testing.assert_allclose(np.asarray(out.numpy() if
+                                   hasattr(out, "numpy") else out), [2.0])
+    finally:
+        paddle.jit.enable_to_static(True)
+
+
+# ---------- convert_* public operators ---------------------------------
+
+def test_convert_ifelse_python_pred_short_circuits():
+    calls = []
+
+    def t(v):
+        calls.append("t")
+        return (1,)
+
+    def f(v):
+        calls.append("f")
+        return (2,)
+
+    assert convert_ifelse(True, t, f, (0,)) == (1,)
+    assert calls == ["t"]            # false branch never ran
+
+
+def test_convert_ifelse_tracer_pred_uses_cond():
+    import jax
+
+    def run(x):
+        return convert_ifelse(x.sum() > 0,
+                              lambda c: (c[0] + 1.0,),
+                              lambda c: (c[0] - 1.0,), (x.sum(),))[0]
+
+    out = jax.jit(run)(jnp.asarray([2.0, 3.0]))
+    assert float(out) == 6.0
+
+
+def test_convert_while_loop_python_cond():
+    out = convert_while_loop(lambda c: c[0] < 5,
+                             lambda c: (c[0] + 2,), (0,))
+    assert out == (6,)
+
+
+def test_convert_logical_ops_short_circuit_python():
+    seen = []
+
+    def rhs():
+        seen.append(1)
+        return True
+
+    assert convert_logical_and(lambda: False, rhs) is False
+    assert seen == []                # short-circuit kept
+    assert convert_logical_or(lambda: True, rhs) is True
+    assert seen == []
+
+
+def test_enable_to_static_false_runs_original_eagerly():
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:              # fine eagerly: concrete values
+            return x * 2.0
+        return x
+
+    paddle.jit.enable_to_static(False)
+    try:
+        np.testing.assert_allclose(f(_x([1.0])).numpy(), [2.0])
+    finally:
+        paddle.jit.enable_to_static(True)
+
+
+def test_undefined_sentinel_is_singleton_static_node():
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten((UNDEFINED, 1.0))
+    assert leaves == [1.0]           # UNDEFINED is structure, not a leaf
+    back = jax.tree_util.tree_unflatten(treedef, leaves)
+    assert back[0] is UNDEFINED
