@@ -1,7 +1,21 @@
 """Text datasets (ref: python/paddle/text/datasets/{imdb,imikolov,
-uci_housing,wmt14}.py) — synthetic deterministic fallbacks, real-file
-loading when present."""
+uci_housing,wmt14,wmt16,conll05}.py).
+
+Each dataset parses its real on-disk format when `data_file` is given
+(Imdb: aclImdb tarball/dir; Conll05st: words+props column files; WMT16:
+tab-separated parallel corpus) and otherwise falls back to a
+deterministic synthetic set with the same sample shapes — this
+environment has no network egress, so the reference's auto-download
+path is replaced by explicit local files.
+"""
 from __future__ import annotations
+
+import gzip
+import io
+import os
+import re
+import tarfile
+from collections import Counter
 
 import numpy as np
 
@@ -16,12 +30,73 @@ def _rng(seed):
     return np.random.default_rng(seed)
 
 
+_TOKEN_RE = re.compile(r"[A-Za-z0-9']+")
+
+
+def _tokenize(text):
+    return [t.lower() for t in _TOKEN_RE.findall(text)]
+
+
+def _iter_aclimdb(data_file, mode):
+    """Yield (tokens, label) from the aclImdb layout — either the
+    original tarball or an extracted directory tree
+    `{root}/{mode}/{pos,neg}/*.txt`
+    (ref: python/paddle/text/datasets/imdb.py, which regex-matches the
+    same member paths inside the tarball)."""
+    want = re.compile(rf"(^|/)({re.escape(mode)})/(pos|neg)/.*\.txt$")
+    if os.path.isdir(data_file):
+        for sent, label in (("pos", 1), ("neg", 0)):
+            d = os.path.join(data_file, mode, sent)
+            if not os.path.isdir(d):
+                continue
+            for fname in sorted(os.listdir(d)):
+                if fname.endswith(".txt"):
+                    with open(os.path.join(d, fname),
+                              encoding="utf-8", errors="ignore") as f:
+                        yield _tokenize(f.read()), label
+    else:
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                mt = want.search(m.name)
+                if not mt or not m.isfile():
+                    continue
+                label = 1 if mt.group(3) == "pos" else 0
+                data = tf.extractfile(m).read().decode(
+                    "utf-8", errors="ignore")
+                yield _tokenize(data), label
+
+
 class Imdb(Dataset):
     """ref: paddle.text.Imdb — sentiment classification (word-id seqs,
-    0/1 labels)."""
+    0/1 labels).
 
-    def __init__(self, mode="train", cutoff=150, n_samples=2000, seq_len=64):
+    data_file: path to the aclImdb tarball or extracted directory; the
+    word dict is built from the requested split with frequency > cutoff
+    (reference's build_dict), ids ordered by descending frequency,
+    <unk> = len(dict). Without data_file: deterministic synthetic set
+    with the same shapes."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 n_samples=2000, seq_len=64):
         super().__init__()
+        self.mode = mode
+        if data_file is not None:
+            raw = list(_iter_aclimdb(data_file, mode))
+            if not raw:
+                raise ValueError(
+                    f"no {mode}/pos|neg/*.txt documents found in "
+                    f"{data_file} (expected aclImdb layout)")
+            freq = Counter(t for toks, _ in raw for t in toks)
+            kept = sorted((w for w, c in freq.items() if c > cutoff),
+                          key=lambda w: (-freq[w], w))
+            self.word_idx = {w: i for i, w in enumerate(kept)}
+            unk = len(self.word_idx)
+            self.word_idx["<unk>"] = unk
+            self.docs = [np.asarray([self.word_idx.get(t, unk)
+                                     for t in toks], np.int64)
+                         for toks, _ in raw]
+            self.labels = [label for _, label in raw]
+            return
         rng = _rng(0 if mode == "train" else 1)
         self.word_idx = {w: i + 1 for i, w in enumerate(_WORDS)}
         pos_w = [self.word_idx[w] for w in
@@ -125,20 +200,109 @@ class ViterbiDataset(Dataset):
         return len(self.x)
 
 
-class Conll05st(ViterbiDataset):
-    """ref: paddle.text.Conll05st — SRL sequence labeling. Synthetic
-    deterministic corpus with the reference's (tokens, predicate, tags)
-    sample shape."""
+def _open_maybe_gz(path):
+    if path.endswith(".gz"):
+        return io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8")
+    return open(path, encoding="utf-8")
 
-    def __init__(self, mode="train", vocab=800, n_tags=18, n_samples=1500,
-                 seq_len=30):
+
+def _read_col_sentences(path):
+    """Blank-line-separated sentences of whitespace-split columns —
+    the CoNLL column format."""
+    sents, cur = [], []
+    with _open_maybe_gz(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                if cur:
+                    sents.append(cur)
+                    cur = []
+                continue
+            cur.append(line.split())
+    if cur:
+        sents.append(cur)
+    return sents
+
+
+class Conll05st(ViterbiDataset):
+    """ref: paddle.text.Conll05st — SRL sequence labeling.
+
+    With data_file=(words_path, props_path) [.gz accepted], parses the
+    CoNLL-2005 column formats: `words` is one token per line, `props`
+    carries the predicate column plus one bracketed-argument column per
+    predicate; each (sentence, predicate) pair becomes one sample
+    (word_ids, predicate_position, BIO tag_ids), matching the
+    reference's per-predicate sample expansion
+    (python/paddle/text/datasets/conll05.py). Without data_file:
+    deterministic synthetic corpus with the same shapes."""
+
+    def __init__(self, data_file=None, mode="train", vocab=800, n_tags=18,
+                 n_samples=1500, seq_len=30):
+        if data_file is not None:
+            Dataset.__init__(self)
+            words_path, props_path = data_file
+            word_sents = _read_col_sentences(words_path)
+            prop_sents = _read_col_sentences(props_path)
+            if len(word_sents) != len(prop_sents):
+                raise ValueError(
+                    f"words/props sentence counts differ: "
+                    f"{len(word_sents)} vs {len(prop_sents)}")
+            freq = Counter(w[0].lower() for s in word_sents for w in s)
+            self.word_idx = {w: i for i, w in
+                             enumerate(sorted(freq, key=lambda w:
+                                              (-freq[w], w)))}
+            self.tag_idx = {}
+            self.x, self.pred, self.y = [], [], []
+            for ws, ps in zip(word_sents, prop_sents):
+                ids = np.asarray([self.word_idx[w[0].lower()] for w in ws],
+                                 np.int64)
+                n_preds = len(ps[0]) - 1
+                pred_rows = [i for i, row in enumerate(ps)
+                             if row[0] != "-"]
+                for k in range(n_preds):
+                    tags = self._bio_from_brackets(
+                        [row[k + 1] for row in ps])
+                    # the predicate is its column's (V*) span; fall back
+                    # to the k-th lemma row if the span is absent
+                    pred_pos = next(
+                        (i for i, t in enumerate(tags)
+                         if t in ("B-V", "I-V")),
+                        pred_rows[k] if k < len(pred_rows) else 0)
+                    tag_ids = np.asarray(
+                        [self.tag_idx.setdefault(t, len(self.tag_idx))
+                         for t in tags], np.int64)
+                    self.x.append(ids)
+                    self.pred.append(np.int64(pred_pos))
+                    self.y.append(tag_ids)
+            return
         super().__init__(mode=mode, vocab=vocab, n_tags=n_tags,
                          n_samples=n_samples, seq_len=seq_len)
         rng = _rng(10 if mode == "train" else 11)
         self.pred = rng.integers(0, seq_len, (n_samples,)).astype(np.int64)
 
+    @staticmethod
+    def _bio_from_brackets(col):
+        """CoNLL-2005 bracketed spans `(A0*`, `*`, `*)` -> BIO tags."""
+        tags, cur = [], None
+        for cell in col:
+            label = None
+            if "(" in cell:
+                label = cell[cell.index("(") + 1:].split("*")[0]
+                tags.append("B-" + label)
+                cur = label
+            elif cur is not None:
+                tags.append("I-" + cur)
+            else:
+                tags.append("O")
+            if ")" in cell:
+                cur = None
+        return tags
+
     def __getitem__(self, idx):
         return self.x[idx], self.pred[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
 
 
 class Movielens(Dataset):
@@ -174,10 +338,82 @@ class Movielens(Dataset):
 
 class WMT16(WMT14):
     """ref: paddle.text.WMT16 — same sample shape as WMT14 with BPE-sized
-    vocab defaults."""
+    vocab defaults.
 
-    def __init__(self, mode="train", src_dict_size=2000, trg_dict_size=2000,
-                 n_samples=2000, seq_len=24):
+    data_file: path to the corpus — a tab-separated parallel file
+    (`src<TAB>trg` per line, the reference tarball's member format), a
+    directory containing one named `{mode}`, or the tarball itself.
+    Dicts are built per side to src/trg_dict_size by descending
+    frequency with the reference's special ids <s>=0, <e>=1, <unk>=2
+    (python/paddle/text/datasets/wmt16.py). Samples are
+    (src_ids, trg_ids[:-1], trg_ids[1:]) with the target wrapped in
+    <s>...<e>."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=2000,
+                 trg_dict_size=2000, n_samples=2000, seq_len=24):
+        if data_file is not None:
+            Dataset.__init__(self)
+            pairs = self._read_pairs(data_file, mode)
+            if not pairs:
+                raise ValueError(f"no parallel '{mode}' lines found in "
+                                 f"{data_file}")
+            self.src_dict = self._build_dict(
+                (p[0] for p in pairs), src_dict_size)
+            self.trg_dict = self._build_dict(
+                (p[1] for p in pairs), trg_dict_size)
+            self.samples = []
+            for src_toks, trg_toks in pairs:
+                src = np.asarray([self.src_dict.get(t, self.UNK)
+                                  for t in src_toks], np.int64)
+                trg = np.asarray(
+                    [self.BOS] + [self.trg_dict.get(t, self.UNK)
+                                  for t in trg_toks] + [self.EOS],
+                    np.int64)
+                self.samples.append((src, trg[:-1], trg[1:]))
+            return
         super().__init__(mode=mode, dict_size=min(src_dict_size,
                                                   trg_dict_size),
                          n_samples=n_samples, seq_len=seq_len)
+
+    @staticmethod
+    def _read_pairs(data_file, mode):
+        def parse_lines(lines):
+            out = []
+            for line in lines:
+                if "\t" not in line:
+                    continue
+                src, trg = line.rstrip("\n").split("\t", 1)
+                if src and trg:
+                    out.append((src.split(), trg.split()))
+            return out
+
+        if os.path.isdir(data_file):
+            data_file = os.path.join(data_file, mode)
+        if not os.path.exists(data_file):
+            raise ValueError(
+                f"WMT16: no '{mode}' corpus at {data_file} (expected a "
+                "tab-separated parallel file, a directory containing "
+                f"one named '{mode}', or the reference tarball)")
+        if tarfile.is_tarfile(data_file):
+            with tarfile.open(data_file, "r:*") as tf:
+                for m in tf.getmembers():
+                    if m.isfile() and os.path.basename(m.name) == mode:
+                        data = tf.extractfile(m).read().decode("utf-8")
+                        return parse_lines(data.splitlines())
+            return []
+        with _open_maybe_gz(data_file) as f:
+            return parse_lines(f)
+
+    @classmethod
+    def _build_dict(cls, tok_seqs, dict_size):
+        freq = Counter(t for toks in tok_seqs for t in toks)
+        specials = {"<s>": cls.BOS, "<e>": cls.EOS, "<unk>": cls.UNK}
+        d = dict(specials)
+        for w in sorted(freq, key=lambda w: (-freq[w], w)):
+            if len(d) >= dict_size:
+                break
+            if w not in d:
+                d[w] = len(d)
+        return d

@@ -139,3 +139,132 @@ class SyntheticImageNet(Dataset):
 
     def __len__(self):
         return self.n
+
+
+# ---------------------------------------------------------------------
+# Folder datasets (ref: python/paddle/vision/datasets/folder.py)
+# ---------------------------------------------------------------------
+
+IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                    ".tif", ".tiff", ".webp")
+
+
+def image_load(path, backend=None):
+    """Default image loader. backend=None/'numpy' returns an HWC uint8
+    array (what this framework's numpy-based transforms consume);
+    backend='pil' returns the PIL Image (reference default backend).
+    ref: paddle.vision.image_load."""
+    from PIL import Image
+    with Image.open(path) as img:
+        img = img.convert("RGB")
+        if backend == "pil":
+            img.load()
+            return img
+        return np.asarray(img, dtype=np.uint8)
+
+
+def _has_valid_ext(path, extensions):
+    return path.lower().endswith(tuple(e.lower() for e in extensions))
+
+
+def _resolve_filter(extensions, is_valid_file):
+    """One validity predicate from the (extensions, is_valid_file) pair;
+    passing both is rejected like the reference does."""
+    if extensions is not None and is_valid_file is not None:
+        raise ValueError(
+            "both 'extensions' and 'is_valid_file' were given — pass "
+            "exactly one")
+    if is_valid_file is not None:
+        return is_valid_file, None
+    if extensions is None:
+        extensions = IMAGE_EXTENSIONS
+    return (lambda p: _has_valid_ext(p, extensions)), extensions
+
+
+def _iter_valid_files(directory, valid):
+    for root, _, files in sorted(os.walk(directory, followlinks=True)):
+        for fname in sorted(files):
+            path = os.path.join(root, fname)
+            if valid(path):
+                yield path
+
+
+def _make_samples(directory, class_to_idx, valid):
+    samples = []
+    for cls in sorted(class_to_idx):
+        cdir = os.path.join(directory, cls)
+        for path in _iter_valid_files(cdir, valid):
+            samples.append((path, class_to_idx[cls]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """Generic `root/class_x/xxx.ext` directory-tree dataset
+    (ref: paddle.vision.datasets.DatasetFolder — the workhorse for real
+    image training directories).
+
+    classes are the sorted sub-directory names of `root`; samples are
+    (path, class_index) pairs; __getitem__ returns (image, label) with
+    `transform` applied to the loaded image.
+    """
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        super().__init__()
+        self.root = root
+        self.transform = transform
+        self.loader = loader if loader is not None else image_load
+        valid, self.extensions = _resolve_filter(extensions, is_valid_file)
+        classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        if not classes:
+            raise RuntimeError(f"no class directories found under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = _make_samples(root, self.class_to_idx, valid)
+        if not self.samples:
+            raise RuntimeError(
+                f"found no valid files under {root}; supported "
+                f"extensions: {self.extensions}")
+        self.targets = [t for _, t in self.samples]
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Unlabeled flat image set: every image under `root`, recursively
+    (ref: paddle.vision.datasets.ImageFolder). __getitem__ returns
+    [image] (a one-element list, matching the reference)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        super().__init__()
+        self.root = root
+        self.transform = transform
+        self.loader = loader if loader is not None else image_load
+        valid, extensions = _resolve_filter(extensions, is_valid_file)
+        self.samples = list(_iter_valid_files(root, valid))
+        if not self.samples:
+            raise RuntimeError(
+                f"found no valid files under {root}; supported "
+                f"extensions: {extensions}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+__all__ += ["DatasetFolder", "ImageFolder", "image_load",
+            "IMAGE_EXTENSIONS"]

@@ -1,0 +1,315 @@
+"""Folder image datasets + real text-format parsers
+(ref: python/paddle/vision/datasets/folder.py,
+python/paddle/text/datasets/{imdb,conll05,wmt16}.py).
+"""
+import gzip
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision.datasets import (DatasetFolder, ImageFolder,
+                                        image_load, IMAGE_EXTENSIONS)
+from paddle_tpu.text.datasets import Imdb, Conll05st, WMT16
+
+
+# ---------------- fixtures ----------------
+
+def _make_image_tree(root, classes=("cat", "dog"), n=3, size=8):
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for ci, cls in enumerate(classes):
+        d = os.path.join(root, cls)
+        os.makedirs(d)
+        for i in range(n):
+            arr = np.full((size, size, 3), 40 * ci + i, np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"img_{i}.png"))
+        # a non-image file that must be skipped
+        with open(os.path.join(d, "notes.txt"), "w") as f:
+            f.write("skip me")
+    return root
+
+
+# ---------------- DatasetFolder ----------------
+
+def test_dataset_folder_classes_and_samples(tmp_path):
+    root = _make_image_tree(str(tmp_path / "train"))
+    ds = DatasetFolder(root)
+    assert ds.classes == ["cat", "dog"]
+    assert ds.class_to_idx == {"cat": 0, "dog": 1}
+    assert len(ds) == 6
+    assert ds.targets == [0, 0, 0, 1, 1, 1]
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and img.dtype == np.uint8
+    assert int(label) == 0
+    img, label = ds[5]
+    assert int(label) == 1
+
+
+def test_dataset_folder_with_transform(tmp_path):
+    from paddle_tpu.vision import transforms as T
+    root = _make_image_tree(str(tmp_path / "train"))
+    ds = DatasetFolder(root, transform=T.Compose([T.Resize(4),
+                                                  T.ToTensor()]))
+    img, _ = ds[0]
+    assert tuple(img.shape) == (3, 4, 4)     # CHW after ToTensor
+
+
+def test_dataset_folder_is_valid_file(tmp_path):
+    root = _make_image_tree(str(tmp_path / "train"))
+    ds = DatasetFolder(root, extensions=None,
+                       is_valid_file=lambda p: p.endswith("img_0.png"))
+    assert len(ds) == 2                      # one per class
+
+
+def test_dataset_folder_both_filters_rejected(tmp_path):
+    root = _make_image_tree(str(tmp_path / "train"))
+    with pytest.raises(ValueError, match="exactly one"):
+        DatasetFolder(root, extensions=(".png",),
+                      is_valid_file=lambda p: True)
+
+
+def test_wmt16_missing_mode_file_actionable(tmp_path):
+    d = tmp_path / "corpus"
+    os.makedirs(d)
+    _write_parallel(str(d / "train"))
+    with pytest.raises(ValueError, match="no 'dev' corpus"):
+        WMT16(data_file=str(d), mode="dev")
+
+
+def test_dataset_folder_empty_raises(tmp_path):
+    os.makedirs(tmp_path / "empty" / "cls")
+    with pytest.raises(RuntimeError, match="no valid files"):
+        DatasetFolder(str(tmp_path / "empty"))
+    with pytest.raises(RuntimeError, match="no class directories"):
+        DatasetFolder(str(tmp_path / "empty" / "cls"))
+
+
+def test_dataset_folder_in_dataloader(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import transforms as T
+    root = _make_image_tree(str(tmp_path / "train"))
+    ds = DatasetFolder(root, transform=T.ToTensor())
+    loader = paddle.io.DataLoader(ds, batch_size=3, shuffle=False)
+    xb, yb = next(iter(loader))
+    assert tuple(xb.shape) == (3, 3, 8, 8)
+    assert tuple(yb.shape) == (3,)
+
+
+# ---------------- ImageFolder ----------------
+
+def test_image_folder_flat_recursive(tmp_path):
+    root = _make_image_tree(str(tmp_path / "imgs"))
+    ds = ImageFolder(root)
+    assert len(ds) == 6
+    sample = ds[0]
+    assert isinstance(sample, list) and len(sample) == 1
+    assert sample[0].shape == (8, 8, 3)
+
+
+def test_image_load_backends(tmp_path):
+    from PIL import Image
+    p = str(tmp_path / "x.png")
+    Image.fromarray(np.zeros((5, 7, 3), np.uint8)).save(p)
+    arr = image_load(p)
+    assert arr.shape == (5, 7, 3) and arr.dtype == np.uint8
+    pil = image_load(p, backend="pil")
+    assert pil.size == (7, 5)
+
+
+# ---------------- Imdb (aclImdb layout) ----------------
+
+_DOCS = {
+    ("train", "pos"): ["a great great movie", "great fine ending"],
+    ("train", "neg"): ["a terrible terrible film", "boring bad plot"],
+    ("test", "pos"): ["great story"],
+    ("test", "neg"): ["awful pacing"],
+}
+
+
+def _make_aclimdb_dir(root):
+    for (mode, sent), docs in _DOCS.items():
+        d = os.path.join(root, mode, sent)
+        os.makedirs(d, exist_ok=True)
+        for i, doc in enumerate(docs):
+            with open(os.path.join(d, f"{i}_7.txt"), "w") as f:
+                f.write(doc)
+    return root
+
+
+def test_imdb_parses_directory(tmp_path):
+    root = _make_aclimdb_dir(str(tmp_path / "aclImdb"))
+    ds = Imdb(data_file=root, mode="train", cutoff=0)
+    assert len(ds) == 4
+    labels = sorted(int(ds[i][1]) for i in range(4))
+    assert labels == [0, 0, 1, 1]
+    # frequency-ordered dict: 'great' (3x) and 'terrible' (2x) precede
+    # singletons; every doc maps to in-vocab ids
+    assert ds.word_idx["great"] < ds.word_idx["boring"]
+    unk = ds.word_idx["<unk>"]
+    for i in range(4):
+        assert (np.asarray(ds[i][0]) < unk).all()
+
+
+def test_imdb_parses_tarball_and_cutoff(tmp_path):
+    root = _make_aclimdb_dir(str(tmp_path / "aclImdb"))
+    tar_path = str(tmp_path / "aclImdb_v1.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(root, arcname="aclImdb")
+    ds = Imdb(data_file=tar_path, mode="train", cutoff=1)
+    assert len(ds) == 4
+    # cutoff=1 keeps only words with freq > 1: great(3), terrible(2), a(2)
+    kept = set(ds.word_idx) - {"<unk>"}
+    assert kept == {"great", "terrible", "a"}
+    ds_test = Imdb(data_file=tar_path, mode="test", cutoff=0)
+    assert len(ds_test) == 2
+
+
+def test_imdb_synthetic_fallback_unchanged():
+    ds = Imdb(mode="train", n_samples=10)
+    x, y = ds[0]
+    assert x.dtype == np.int64 and int(y) in (0, 1)
+
+
+# ---------------- Conll05st (words + props column files) ----------------
+
+_WORDS_FILE = """\
+The
+cat
+chased
+mice
+.
+
+Dogs
+bark
+.
+"""
+
+# props: col0 = predicate lemma ('-' elsewhere), one arg column per
+# predicate with bracketed spans
+_PROPS_FILE = """\
+-\t(A0*
+-\t*)
+chase\t(V*)
+-\t(A1*)
+-\t*
+
+-\t(A0*)
+bark\t(V*)
+-\t*
+"""
+
+
+def _write_conll(tmp_path, gz=False):
+    wp = str(tmp_path / ("words.gz" if gz else "words"))
+    pp = str(tmp_path / ("props.gz" if gz else "props"))
+    if gz:
+        with gzip.open(wp, "wt") as f:
+            f.write(_WORDS_FILE)
+        with gzip.open(pp, "wt") as f:
+            f.write(_PROPS_FILE.replace("\\t", "\t"))
+    else:
+        with open(wp, "w") as f:
+            f.write(_WORDS_FILE)
+        with open(pp, "w") as f:
+            f.write(_PROPS_FILE.replace("\\t", "\t"))
+    return wp, pp
+
+
+def test_conll05st_parses_column_format(tmp_path):
+    wp, pp = _write_conll(tmp_path)
+    ds = Conll05st(data_file=(wp, pp))
+    assert len(ds) == 2                      # one predicate per sentence
+    ids, pred, tags = ds[0]
+    assert len(ids) == 5 and len(tags) == 5
+    assert int(pred) == 2                    # 'chased' is the V span
+    # BIO structure: A0 span covers 'The cat'
+    tag_names = {v: k for k, v in ds.tag_idx.items()}
+    decoded = [tag_names[int(t)] for t in np.asarray(tags)]
+    assert decoded[0] == "B-A0" and decoded[1] == "I-A0"
+    assert decoded[2] == "B-V"
+    assert decoded[3] == "B-A1"
+    ids2, pred2, tags2 = ds[1]
+    assert len(ids2) == 3 and int(pred2) == 1
+
+
+def test_conll05st_gz_and_mismatch(tmp_path):
+    wp, pp = _write_conll(tmp_path, gz=True)
+    ds = Conll05st(data_file=(wp, pp))
+    assert len(ds) == 2
+    # words/props length mismatch is a loud error
+    bad = str(tmp_path / "short_words")
+    with open(bad, "w") as f:
+        f.write("Just\none\n")
+    with pytest.raises(ValueError, match="sentence counts differ"):
+        Conll05st(data_file=(bad, pp))
+
+
+def test_conll05st_synthetic_fallback_unchanged():
+    ds = Conll05st(n_samples=5)
+    x, p, y = ds[0]
+    assert x.dtype == np.int64 and y.dtype == np.int64
+
+
+# ---------------- WMT16 (tab-separated parallel corpus) ----------------
+
+_PARALLEL = [
+    ("the cat sits", "die katze sitzt"),
+    ("the dog runs", "der hund rennt"),
+    ("a cat runs", "eine katze rennt"),
+]
+
+
+def _write_parallel(path):
+    with open(path, "w") as f:
+        for s, t in _PARALLEL:
+            f.write(f"{s}\t{t}\n")
+
+
+def test_wmt16_parses_tsv_file(tmp_path):
+    p = str(tmp_path / "train")
+    _write_parallel(p)
+    ds = WMT16(data_file=p, mode="train", src_dict_size=50,
+               trg_dict_size=50)
+    assert len(ds) == 3
+    src, trg_in, trg_next = ds[0]
+    # special ids per the reference: <s>=0 <e>=1 <unk>=2
+    assert ds.trg_dict["<s>"] == 0 and ds.trg_dict["<e>"] == 1
+    assert int(trg_in[0]) == 0               # target starts with <s>
+    assert int(trg_next[-1]) == 1            # and ends with <e>
+    np.testing.assert_array_equal(trg_in[1:], trg_next[:-1])
+    assert src.dtype == np.int64 and len(src) == 3
+
+
+def test_wmt16_dict_size_cap_and_unk(tmp_path):
+    p = str(tmp_path / "train")
+    _write_parallel(p)
+    ds = WMT16(data_file=p, mode="train", src_dict_size=4,
+               trg_dict_size=4)
+    assert len(ds.src_dict) == 4             # 3 specials + 1 real word
+    # highest-frequency source word wins the single real slot
+    assert "the" in ds.src_dict or "cat" in ds.src_dict
+    src, _, _ = ds[1]
+    assert (np.asarray(src) <= 3).all()      # everything else is <unk>
+
+def test_wmt16_parses_directory_and_tarball(tmp_path):
+    d = tmp_path / "corpus"
+    os.makedirs(d)
+    _write_parallel(str(d / "train"))
+    ds = WMT16(data_file=str(d), mode="train")
+    assert len(ds) == 3
+    tar_path = str(tmp_path / "wmt16.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as tf:
+        tf.add(str(d / "train"), arcname="wmt16/train")
+    ds2 = WMT16(data_file=tar_path, mode="train")
+    assert len(ds2) == 3
+    s1, _, _ = ds[0]
+    s2, _, _ = ds2[0]
+    np.testing.assert_array_equal(s1, s2)
+
+
+def test_wmt16_synthetic_fallback_unchanged():
+    ds = WMT16(mode="train", n_samples=5)
+    src, ti, tn = ds[0]
+    assert src.dtype == np.int64
