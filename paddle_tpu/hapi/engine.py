@@ -227,7 +227,12 @@ class Engine:
                 grads = clip.apply(grads)
             new_live, new_opt = opt.update(live, grads, opt_state,
                                            lr, step_i)
-            return {**frozen, **new_live}, new_opt
+            # return the accumulator ZEROED: the donated acc buffer gets
+            # an in-place output alias (no param-size dead donation — the
+            # source of the 'donated buffers were not usable' warning)
+            # and the next window starts from it without re-allocating
+            new_acc = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return {**frozen, **new_live}, new_opt, new_acc
 
         grad_jit = jax.jit(grad_step,
                            donate_argnums=(2,) if self.donate else ())
@@ -295,10 +300,14 @@ class Engine:
             return False
         lr = np.float32(self._lr_now())
         self._opt_step += 1
-        self._params, self._opt_state = self._apply_fn(
+        self._params, self._opt_state, new_acc = self._apply_fn(
             self._params, self._opt_state, self._acc_grads,
             np.float32(self._micro_count), lr, np.int32(self._opt_step))
-        self._acc_grads = None
+        # under donation, new_acc is the zeroed (still correctly
+        # ZeRO-sharded) accumulator aliased in place — keep it so the
+        # next window starts without re-allocating; without donation the
+        # retention would just pin an extra param-size fp32 buffer
+        self._acc_grads = new_acc if self.donate else None
         self._micro_count = 0
         if self.donate:
             self.network.load_raw_state(self._params, self._buffers)
@@ -307,8 +316,14 @@ class Engine:
     def flush_accum(self):
         """Apply any partially-accumulated window (epoch end, early stop,
         num_iters cutoff) so tail microbatch gradients are never dropped
-        or leaked into the next fit. Returns True if an update ran."""
-        return self._apply_accum()
+        or leaked into the next fit. Returns True if an update ran.
+
+        Also drops the retained zeroed accumulator: at a flush boundary
+        (fit exit, path switch) training may be followed by eval/serving,
+        where a param-size fp32 buffer held for reuse is pure overhead."""
+        applied = self._apply_accum()
+        self._acc_grads = None
+        return applied
 
     def reset_accum_window(self):
         """Drop any half-accumulated gradient window WITHOUT applying it.

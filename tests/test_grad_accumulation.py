@@ -32,6 +32,18 @@ def _engine(net, lr=0.05):
                       lr, weight_decay=0.01, parameters=net.parameters()))
 
 
+def _window_closed(eng):
+    """A closed window means no pending micro-grads: either no
+    accumulator at all, or one holding only zeros (apply_step returns it
+    zeroed in-place so the next window reuses the donated buffer)."""
+    if eng._micro_count != 0:
+        return False
+    if eng._acc_grads is None:
+        return True
+    return all(not np.asarray(l).any()
+               for l in jax.tree_util.tree_leaves(eng._acc_grads))
+
+
 def test_accum_k_micro_equals_one_big_batch():
     x, y = _data(32)
     # reference: one step on the full batch
@@ -114,7 +126,7 @@ def test_fit_accum_flushes_tail_window():
               accumulate_grad_batches=3)
     assert sched.last_epoch == 2, sched.last_epoch
     eng = model._engine
-    assert eng._micro_count == 0 and eng._acc_grads is None
+    assert _window_closed(eng)
 
 
 def test_accum_resume_preserves_opt_step(tmp_path):
@@ -208,5 +220,38 @@ def test_mixed_fused_and_accum_paths():
                           apply_update=False)
     assert eng._micro_count == 1
     eng.train_batch([jnp.asarray(x[8:])], [jnp.asarray(y[8:])])
-    assert eng._micro_count == 0 and eng._acc_grads is None
+    assert _window_closed(eng)
     assert eng._opt_step == 2  # flush + fused update
+
+
+def test_accum_no_unusable_donation_and_acc_aliased():
+    """The accumulation programs must not leak param-size dead
+    donations (r3 emitted 'Some donated buffers were not usable') and
+    the microstep must alias the fp32 accumulator in place — at 1.3B an
+    un-aliased accumulator is a 5+ GB copy per microbatch."""
+
+    import warnings
+    net = _net()
+    eng = _engine(net)
+    x, y = _data(16)
+    with warnings.catch_warnings(record=True) as ws:
+        warnings.simplefilter("always")
+        for w in range(2):
+            for i in range(2):
+                sl = slice(8 * i, 8 * (i + 1))
+                eng.train_batch_accum([jnp.asarray(x[sl])],
+                                      [jnp.asarray(y[sl])],
+                                      apply_update=(i == 1))
+    bad = [w for w in ws if "donated buffers" in str(w.message)]
+    assert not bad, [str(w.message) for w in bad]
+    # HLO audit: every accumulator leaf is input-output aliased in the
+    # grad microstep (no full-size accumulator copy in the program)
+    n_acc = len(jax.tree_util.tree_leaves(eng._acc_grads))
+    lowered = eng._grad_fn.lower(
+        eng._params, eng._buffers, eng._acc_grads, np.int32(1),
+        eng._rng_key, [jnp.asarray(x[:8])], [jnp.asarray(y[:8])])
+    txt = lowered.compile().as_text()
+    assert "input_output_alias" in txt, \
+        "grad microstep has no input_output_alias map"
+    n_alias = txt.count("may-alias") + txt.count("must-alias")
+    assert n_alias >= n_acc, (n_alias, n_acc)
