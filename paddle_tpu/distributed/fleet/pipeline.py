@@ -15,6 +15,11 @@ TPU-native design — the whole pipeline is ONE jitted SPMD program:
   p2p send/recv pairs). The S-1 extra ticks are the pipeline bubble —
   identical cost shape to the reference's warmup/drain; drained stages
   compute on zeros (SPMD lock-step means the FLOPs happen either way).
+- num_virtual_pipeline_stages / pipeline_apply(n_virtual=v) selects the
+  interleaved schedule: each device holds v chunks (global stage c*S + s)
+  and activations ride a ring ppermute, shrinking the bubble fraction to
+  (S-1)/(n_micro*v + S - 1) — see interleaved_schedule/pipeline_cost for
+  the tick math, which is what the CPU accounting tests pin down.
 - backward is jax.grad *through* the scan: ppermute transposes to the
   reverse shift. Schedule note: this compiles the FThenB dataflow; the
   reference's 1F1B is an op-ORDERING policy for memory, which under XLA
@@ -87,25 +92,142 @@ def _pipeline_local(stage_params, x, *, stage_fn, n_stages, n_micro,
     return outbuf.reshape((n_micro * mb,) + x.shape[1:])
 
 
-def pipeline_apply(mesh, stage_params, x, stage_fn: Callable, *,
-                   n_micro: int, axis: str = "pp", remat: bool = True):
-    """Run x through S pipeline stages laid over mesh axis `axis`.
+def interleaved_schedule(u: int, p: int, v: int):
+    """The interleaved ('virtual pipeline') schedule as pure math.
 
-    stage_params: pytree whose leaves have leading dim S (stack_stage_params)
+    A device at tick t works on diagonal u = t - device_index; the same
+    diagonal maps to the same (microbatch, chunk) on every device, so a
+    microbatch's chunk-c pass flows device 0 -> p-1 on consecutive
+    ticks, then wraps (ring ppermute) to device 0 as chunk c+1.
+    Microbatches run in groups of p; a device's local timeline tiles one
+    group's p*v chunk-slots back to back, so it is never double-booked.
+    Returns (micro_index, chunk_index); micro_index may be out of
+    [0, n_micro) — such slots are drain/warmup bubble.
+
+    ref parity: Megatron-style interleaved schedule of
+    fleet.meta_parallel pp_utils (num_virtual_pipeline_stages); total
+    ticks = ceil(m/p)*p*v + p - 1, i.e. bubble (p-1)/(m*v + p - 1) of
+    total at p | m — v times smaller than FThenB's (p-1)/(m + p - 1).
+    """
+    pv = p * v
+    k, q = divmod(u, pv)            # group, phase (floor semantics)
+    return k * p + (q % p), q // p
+
+
+def pipeline_cost(n_stages: int, n_micro: int, n_virtual: int = 1):
+    """Tick/FLOP accounting for the compiled schedules (CPU-checkable —
+    the hardware-independent part of the pipeline's cost model).
+
+    Returns ticks (scan length), chunk_time (fraction of a full stage
+    per tick), total_time in stage-time units, ideal_time, and
+    bubble_fraction = 1 - ideal/total."""
+    p, v, m = n_stages, n_virtual, n_micro
+    if v == 1:
+        ticks = m + p - 1
+    else:
+        groups = -(-m // p)
+        ticks = groups * p * v + p - 1
+    chunk_time = 1.0 / v
+    total = ticks * chunk_time
+    ideal = float(m)                # m stage-times per device
+    return {"ticks": ticks, "chunk_time": chunk_time,
+            "total_time": total, "ideal_time": ideal,
+            "bubble_fraction": 1.0 - ideal / total}
+
+
+def _pipeline_local_interleaved(stage_params, x, *, stage_fn, n_stages,
+                                n_chunks, n_micro, axis, remat):
+    """Interleaved virtual-stage schedule; runs INSIDE shard_map over
+    `axis`. stage_params leaves are the local [v, ...] chunk shards
+    (device s holds global stages c*p + s, c in [0, v))."""
+    p, v, m = n_stages, n_chunks, n_micro
+    s = jax.lax.axis_index(axis)
+    mb = x.shape[0] // m
+    micro = x.reshape((m, mb) + x.shape[1:])
+    f = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    ring = [(i, (i + 1) % p) for i in range(p)]
+    # ONE formula governs the compiled scan length and the CPU-tested
+    # cost model — they must not drift apart
+    n_ticks = pipeline_cost(p, m, v)["ticks"]
+    pv = p * v
+
+    def tick(carry, t):
+        act, outbuf = carry
+        u = t - s                   # diagonal; <0 during this device's warmup
+        k = jnp.floor_divide(u, pv)
+        q = jnp.mod(u, pv)          # floor semantics keep q >= 0
+        c = q // p                  # chunk this device runs now
+        j = k * p + (q % p)         # microbatch on the diagonal
+        live = jnp.logical_and(j >= 0, j < m)
+        jc = jnp.clip(j, 0, m - 1)
+        chunk = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, jnp.clip(c, 0, v - 1), axis=0),
+            stage_params)
+        inject = jnp.logical_and(jnp.logical_and(s == 0, c == 0), live)
+        act = jnp.where(inject, micro[jc], act)
+        out = f(chunk, act)
+        harvest = jnp.logical_and(
+            jnp.logical_and(s == p - 1, c == v - 1), live)
+        outbuf = outbuf.at[jc].set(jnp.where(harvest, out, outbuf[jc]))
+        nxt = jax.lax.ppermute(out, axis, ring) if p > 1 else out
+        return (nxt, outbuf), None
+
+    act0 = jax.lax.pcast(jnp.zeros_like(micro[0]), (axis,), to="varying")
+    outbuf0 = jax.lax.pcast(jnp.zeros_like(micro), (axis,), to="varying")
+    (_, outbuf), _ = jax.lax.scan(tick, (act0, outbuf0),
+                                  jnp.arange(n_ticks))
+    outbuf = jax.lax.psum(
+        jnp.where(s == p - 1, outbuf, jnp.zeros_like(outbuf)), axis)
+    return outbuf.reshape((m * mb,) + x.shape[1:])
+
+
+def pipeline_apply(mesh, stage_params, x, stage_fn: Callable, *,
+                   n_micro: int, axis: str = "pp", remat: bool = True,
+                   n_virtual: int = 1):
+    """Run x through the pipeline stages laid over mesh axis `axis`.
+
+    stage_params: pytree whose leaves have leading dim S_total
+    (stack_stage_params), where S_total = mesh.shape[axis] * n_virtual;
+    stage g's params sit at row g (stage-major).
     stage_fn: (params_one_stage, act) -> act, same act shape in/out
     x: [B, ...] global batch, B % n_micro == 0. Differentiable end to end.
-    """
-    n_stages = mesh.shape[axis]
+    n_virtual > 1 selects the interleaved schedule (each device holds
+    n_virtual chunks; bubble shrinks ~n_virtual-fold — see
+    pipeline_cost)."""
+    p = mesh.shape[axis]
     if x.shape[0] % n_micro:
         raise ValueError(f"batch {x.shape[0]} not divisible by "
                          f"n_micro {n_micro}")
+    if n_virtual > 1:
+        lead = {a.shape[0] for a in
+                jax.tree_util.tree_leaves(stage_params)}
+        if lead != {p * n_virtual}:
+            # jnp.take would silently clip out-of-range rows — a wrong
+            # stack size must fail loudly, not duplicate stages
+            raise ValueError(
+                f"stage_params leading dim must be p*n_virtual = "
+                f"{p * n_virtual} (p={p} devices x {n_virtual} chunks); "
+                f"got {sorted(lead)}")
+        # device-major re-rowing: shard_map splits the leading p*v dim
+        # contiguously, so device s must own rows [s*v, (s+1)*v) =
+        # its chunks (global stages c*p + s) in chunk order
+        import numpy as _np
+        perm = _np.asarray([c * p + s_ for s_ in range(p)
+                            for c in range(n_virtual)])
+        stage_params = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, perm, axis=0), stage_params)
+        local = functools.partial(
+            _pipeline_local_interleaved, stage_fn=stage_fn, n_stages=p,
+            n_chunks=n_virtual, n_micro=n_micro, axis=axis, remat=remat)
+    else:
+        local = functools.partial(
+            _pipeline_local, stage_fn=stage_fn, n_stages=p,
+            n_micro=n_micro, axis=axis, remat=remat)
     param_specs = jax.tree_util.tree_map(
         lambda a: P(axis, *([None] * (a.ndim - 1))), stage_params)
     fn = jax.shard_map(
-        functools.partial(_pipeline_local, stage_fn=stage_fn,
-                          n_stages=n_stages, n_micro=n_micro, axis=axis,
-                          remat=remat),
-        mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
+        local, mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
         axis_names=frozenset({axis}))
     return fn(stage_params, x)
 
@@ -165,6 +287,7 @@ class PipelineLayer(Layer):
         self.num_stages = num_stages
         self.loss_fn = loss_fn
         self.recompute = bool(recompute_interval)
+        self.num_virtual = int(num_virtual_pipeline_stages or 1)
         self._descs = layers
 
     def _stage_slices(self, n_stages):
@@ -188,7 +311,8 @@ class PipelineLayer(Layer):
             for blk in self.blocks:
                 x = blk(x)
             return x
-        n_stages = self.num_stages or mesh.shape["pp"]
+        p = mesh.shape["pp"]
+        n_stages = (self.num_stages or p) * self.num_virtual
         slices = self._stage_slices(n_stages)
         per = len(slices[0])
 
@@ -215,8 +339,9 @@ class PipelineLayer(Layer):
             per_stage = jax.tree_util.tree_unflatten(treedef, leaves)
             stacked = stack_stage_params(per_stage)
             return pipeline_apply(mesh, stacked, arr, stage_fn,
-                                  n_micro=n_micro or n_stages,
-                                  remat=self.recompute)
+                                  n_micro=n_micro or p,
+                                  remat=self.recompute,
+                                  n_virtual=self.num_virtual)
 
         if isinstance(x, Tensor):
             return apply_op(run, x, *leaves_t)

@@ -197,6 +197,8 @@ class Engine:
         grad_shardings = self._grad_shardings(trainable_keys)
         make_loss_fn = self._make_loss_fn
 
+        donate = self.donate
+
         def grad_step(params, buffers, acc, step_i, rng, inputs, labels):
             rng = jax.random.fold_in(rng, step_i)
             frozen = {k: v for k, v in params.items()
@@ -227,6 +229,10 @@ class Engine:
                 grads = clip.apply(grads)
             new_live, new_opt = opt.update(live, grads, opt_state,
                                            lr, step_i)
+            if not donate:
+                # nothing to alias into without donation — returning a
+                # zero tree would just be a param-size transient
+                return {**frozen, **new_live}, new_opt, None
             # return the accumulator ZEROED: the donated acc buffer gets
             # an in-place output alias (no param-size dead donation — the
             # source of the 'donated buffers were not usable' warning)
@@ -305,9 +311,10 @@ class Engine:
             np.float32(self._micro_count), lr, np.int32(self._opt_step))
         # under donation, new_acc is the zeroed (still correctly
         # ZeRO-sharded) accumulator aliased in place — keep it so the
-        # next window starts without re-allocating; without donation the
-        # retention would just pin an extra param-size fp32 buffer
-        self._acc_grads = new_acc if self.donate else None
+        # next window starts without re-allocating; without donation
+        # apply_step returns None (retention would just pin an extra
+        # param-size fp32 buffer)
+        self._acc_grads = new_acc
         self._micro_count = 0
         if self.donate:
             self.network.load_raw_state(self._params, self._buffers)
@@ -365,8 +372,10 @@ class Engine:
         self._ensure_opt_state()
         if self._micro_count:
             # a pending accumulation window must not leak into (or be
-            # invalidated by) a fused step — apply the partial window now
-            self._apply_accum()
+            # invalidated by) a fused step — apply the partial window now;
+            # flush_accum (not _apply_accum) so the path switch also
+            # drops the retained accumulator buffer
+            self.flush_accum()
         if self._train_fn is None:
             self._train_fn = self._build_train_fn()
         in_arrs = self._shard_batch(_unwrap(list(inputs)))

@@ -221,6 +221,10 @@ def test_mixed_fused_and_accum_paths():
     assert eng._micro_count == 1
     eng.train_batch([jnp.asarray(x[8:])], [jnp.asarray(y[8:])])
     assert _window_closed(eng)
+    # the path switch must DROP the accumulator, not retain it — a
+    # param-size fp32 buffer pinned through fused-path training would
+    # be pure overhead
+    assert eng._acc_grads is None
     assert eng._opt_step == 2  # flush + fused update
 
 
