@@ -82,7 +82,8 @@ def _init_attr(cfg):
     return ParamAttr(initializer=Normal(mean=0.0, std=cfg.initializer_range))
 
 
-from .modeling_utils import normalize_attention_mask as _normalize_mask
+from .modeling_utils import (FromPretrainedMixin,
+                             normalize_attention_mask as _normalize_mask)
 
 
 class BertSelfAttention(Layer):
@@ -191,7 +192,7 @@ class BertPooler(Layer):
         return self.act(self.dense(hidden[:, 0]))
 
 
-class BertModel(Layer):
+class BertModel(FromPretrainedMixin, Layer):
     """ref: bert/modeling.py BertModel — returns (sequence_output,
     pooled_output)."""
 
@@ -210,6 +211,7 @@ class BertModel(Layer):
     @classmethod
     def from_config_name(cls, name, **overrides):
         return cls(_resolve_config(name, **overrides))
+
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):
@@ -260,7 +262,7 @@ class BertPretrainingHeads(Layer):
                 self.seq_relationship(pooled_output))
 
 
-class BertForPretraining(Layer):
+class BertForPretraining(FromPretrainedMixin, Layer):
     """ref: BertForPretraining — MLM + NSP."""
 
     def __init__(self, config: BertConfig = None, **kwargs):
@@ -273,6 +275,7 @@ class BertForPretraining(Layer):
     @classmethod
     def from_config_name(cls, name, **overrides):
         return cls(_resolve_config(name, **overrides))
+
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):
@@ -314,7 +317,7 @@ class BertPretrainingCriterion(Layer):
         return mlm_loss + nsp_loss
 
 
-class _TaskHead(Layer):
+class _TaskHead(FromPretrainedMixin, Layer):
     """Shared scaffolding for encoder task heads: builds the backbone under
     the reference's attribute name (model.bert / model.ernie) so state-dict
     keys match, and exposes it uniformly as `self.backbone`. ERNIE heads in
@@ -341,6 +344,7 @@ class _TaskHead(Layer):
         num_labels = overrides.pop("num_labels", None)
         kw = {} if num_labels is None else {"num_labels": num_labels}
         return cls(cls._resolve(name, **overrides), **kw)
+
 
 
 class BertForMaskedLM(_TaskHead):

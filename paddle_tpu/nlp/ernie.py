@@ -25,6 +25,7 @@ from .bert import (BertConfig, BertEmbeddings, BertLayer,
                    BertForMaskedLM, BertForSequenceClassification,
                    BertForTokenClassification, BertForQuestionAnswering,
                    BertPretrainingCriterion, _init_attr, _normalize_mask)
+from .modeling_utils import FromPretrainedMixin
 
 
 @dataclass
@@ -93,7 +94,7 @@ class ErnieEmbeddings(BertEmbeddings):
         return self.dropout(self.layer_norm(e))
 
 
-class ErnieModel(Layer):
+class ErnieModel(FromPretrainedMixin, Layer):
     """ref: ernie/modeling.py ErnieModel — returns (sequence_output,
     pooled_output)."""
 
@@ -112,6 +113,7 @@ class ErnieModel(Layer):
     @classmethod
     def from_config_name(cls, name, **overrides):
         return cls(_resolve_config(name, **overrides))
+
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None, task_type_ids=None):

@@ -30,6 +30,7 @@ from ..tensor import Tensor
 from ..distributed.fleet.mpu import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy, parallel_matmul, annotate)
+from .modeling_utils import FromPretrainedMixin
 
 
 @dataclass
@@ -278,7 +279,7 @@ def _coerce_config(config, kwargs):
     return config
 
 
-class GPTModel(Layer):
+class GPTModel(FromPretrainedMixin, Layer):
     """ref: paddlenlp/transformers/gpt/modeling.py GPTModel."""
 
     def __init__(self, config: GPTConfig = None, **kwargs):
@@ -294,6 +295,7 @@ class GPTModel(Layer):
     @classmethod
     def from_config_name(cls, name, **overrides):
         return cls(_resolve_config(name, **overrides))
+
 
     def forward(self, input_ids, position_ids=None, attention_mask=None,
                 use_cache=False, cache=None, cache_index=None):
@@ -338,7 +340,7 @@ class GPTModel(Layer):
         return x
 
 
-class GPTForCausalLM(Layer):
+class GPTForCausalLM(FromPretrainedMixin, Layer):
     """GPTModel + tied vocab-parallel LM head (ref: GPTForCausalLM /
     GPTLMHeadModel in gpt/modeling.py)."""
 
@@ -350,6 +352,7 @@ class GPTForCausalLM(Layer):
     @classmethod
     def from_config_name(cls, name, **overrides):
         return cls(_resolve_config(name, **overrides))
+
 
     def forward(self, input_ids, position_ids=None, attention_mask=None,
                 use_cache=False, cache=None, cache_index=None):

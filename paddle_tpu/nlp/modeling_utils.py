@@ -27,3 +27,68 @@ def normalize_attention_mask(attention_mask):
     if m.dtype != jnp.bool_ and is_padding:
         m = m != 0
     return Tensor(m)
+
+
+def from_pretrained_impl(cls, resolve, name_or_path, pretrained_path=None,
+                         config_name=None, **overrides):
+    """PaddleNLP `Model.from_pretrained` parity for an offline
+    environment (ref: paddlenlp.transformers PretrainedModel
+    .from_pretrained, which downloads by name).
+
+    Accepted forms:
+      from_pretrained('bert-base-uncased')                -> config only;
+        weights need a local file, so this raises with the
+        convert-and-load recipe.
+      from_pretrained('bert-base-uncased',
+                      pretrained_path='bert.pdparams')    -> build from
+        the named config, then load the checkpoint (reference .pdparams
+        pickles or paddle_tpu saves both load).
+      from_pretrained('/path/ckpt.pdparams',
+                      config_name='bert-base-uncased')    -> same, with
+        the checkpoint path first.
+    """
+    import os
+    name = name_or_path
+    if os.path.exists(str(name_or_path)):
+        if pretrained_path is not None:
+            raise ValueError(
+                f"'{name_or_path}' is a checkpoint path AND "
+                f"pretrained_path='{pretrained_path}' was given — pass "
+                "exactly one weights source")
+        if not config_name:
+            raise ValueError(
+                f"'{name_or_path}' is a checkpoint path; also pass "
+                "config_name='<config>' so the architecture can be "
+                "built before loading the weights")
+        pretrained_path, name = str(name_or_path), config_name
+    model = cls(resolve(name, **overrides))
+    if pretrained_path is None:
+        raise NotImplementedError(
+            f"from_pretrained('{name}') needs a weights download, which "
+            "this offline environment cannot do. Recipe: in the "
+            "reference framework run `paddle.save(model.state_dict(), "
+            f"'{name}.pdparams')`, copy the file here, and call "
+            f"from_pretrained('{name}', pretrained_path='"
+            f"{name}.pdparams') — the .pdparams pickle loads directly "
+            "(paddle_tpu.compat.load_pdparams)")
+    from ..serialization import load_into
+    load_into(model, pretrained_path)
+    return model
+
+
+class FromPretrainedMixin:
+    """One from_pretrained for every model family: resolves the config
+    resolver from cls._resolve (task heads) or the defining module's
+    _resolve_config (backbones)."""
+
+    @classmethod
+    def from_pretrained(cls, name_or_path, pretrained_path=None,
+                        config_name=None, **overrides):
+        import sys
+        resolve = getattr(cls, "_resolve", None)
+        if resolve is None:
+            resolve = getattr(sys.modules[cls.__module__],
+                              "_resolve_config")
+        return from_pretrained_impl(cls, resolve, name_or_path,
+                                    pretrained_path, config_name,
+                                    **overrides)

@@ -126,3 +126,25 @@ def load(path, return_numpy=False, **configs):
     spec = pickle.loads(body[:idx])
     arrays = dict(np.load(io.BytesIO(body[idx + len(sep):]), allow_pickle=False))
     return _unpack(spec, arrays, return_numpy=return_numpy)
+
+
+def load_into(model, path, strict=True):
+    """Load a checkpoint file into a Layer: sniffs both paddle_tpu saves
+    and reference-framework .pdparams pickles (compat path). strict
+    refuses a partial load — missing parameters would silently stay at
+    their prior values. The check runs BEFORE any mutation, so a
+    refused load leaves the model untouched. Returns (missing,
+    unexpected) key lists."""
+    state = load(str(path))
+    if isinstance(state, dict) and set(state) >= {"params"} and \
+            all(k in ("params", "buffers", "specs") for k in state):
+        state = {**state.get("params", {}), **state.get("buffers", {})}
+    if strict:
+        missing = [k for k in model.state_dict() if k not in state]
+        if missing:
+            raise ValueError(
+                f"checkpoint {path} is missing parameters "
+                f"{missing[:8]}{'...' if len(missing) > 8 else ''} — "
+                "refusing a partial load (it would silently mix prior "
+                "and pretrained weights); pass strict=False to allow")
+    return model.set_state_dict(state)

@@ -1,15 +1,43 @@
 """Shared vision-model building blocks."""
 from __future__ import annotations
 
+import os
+
 from ... import nn
 
 
-def check_pretrained(pretrained):
-    """ref: the load_dygraph_pretrain path — this offline environment ships
-    no weight files, so fail fast instead of silently returning random
-    init."""
-    if pretrained:
-        raise NotImplementedError("no pretrained weights in this environment")
+def load_pretrained(model, pretrained, arch=None):
+    """The pretrained-weights story for the zoo factories
+    (ref: load_dygraph_pretrain in python/paddle/vision/models/*.py).
+
+    pretrained=False        -> random init, unchanged.
+    pretrained='ckpt.pdparams' -> load the checkpoint into the model:
+        both reference-framework .pdparams pickles (via compat) and
+        paddle_tpu saves are sniffed and accepted; every parameter must
+        match (strict — a partial load would silently mix random and
+        pretrained weights).
+    pretrained=True         -> loud gate: this offline environment has
+        no download path; the error documents the convert-and-load
+        recipe instead."""
+    if not pretrained:
+        return model
+    if isinstance(pretrained, (str, os.PathLike)):
+        from ...serialization import load_into
+        load_into(model, pretrained)
+        return model
+    name = arch or type(model).__name__
+    raise NotImplementedError(
+        f"pretrained=True needs a weights download, which this offline "
+        f"environment cannot do. Recipe: in the reference framework run "
+        f"`paddle.save({name}(pretrained=True).state_dict(), "
+        f"'{name}.pdparams')`, copy the file here, and pass "
+        f"pretrained='{name}.pdparams' — reference .pdparams pickles "
+        "load directly (see paddle_tpu.compat.load_pdparams)")
+
+
+# back-compat alias: factories now pass the built model through
+# load_pretrained; keep the old name importable
+check_pretrained = load_pretrained
 
 
 class ConvBNLayer(nn.Layer):

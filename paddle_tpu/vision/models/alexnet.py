@@ -2,7 +2,7 @@
 from __future__ import annotations
 
 from ... import nn
-from ._utils import check_pretrained
+from ._utils import load_pretrained
 
 __all__ = ["AlexNet", "alexnet"]
 
@@ -35,5 +35,4 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    check_pretrained(pretrained)
-    return AlexNet(**kwargs)
+    return load_pretrained(AlexNet(**kwargs), pretrained)

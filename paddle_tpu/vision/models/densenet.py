@@ -3,7 +3,7 @@ from __future__ import annotations
 
 from ...tensor_ops.manip import concat
 from ... import nn
-from ._utils import check_pretrained
+from ._utils import load_pretrained
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201", "densenet264"]
@@ -85,25 +85,20 @@ class DenseNet(nn.Layer):
 
 
 def densenet121(pretrained=False, **kw):
-    check_pretrained(pretrained)
-    return DenseNet(121, **kw)
+    return load_pretrained(DenseNet(121, **kw), pretrained)
 
 
 def densenet161(pretrained=False, **kw):
-    check_pretrained(pretrained)
-    return DenseNet(161, **kw)
+    return load_pretrained(DenseNet(161, **kw), pretrained)
 
 
 def densenet169(pretrained=False, **kw):
-    check_pretrained(pretrained)
-    return DenseNet(169, **kw)
+    return load_pretrained(DenseNet(169, **kw), pretrained)
 
 
 def densenet201(pretrained=False, **kw):
-    check_pretrained(pretrained)
-    return DenseNet(201, **kw)
+    return load_pretrained(DenseNet(201, **kw), pretrained)
 
 
 def densenet264(pretrained=False, **kw):
-    check_pretrained(pretrained)
-    return DenseNet(264, **kw)
+    return load_pretrained(DenseNet(264, **kw), pretrained)

@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from ...tensor_ops.manip import concat
 from ... import nn
-from ._utils import check_pretrained
+from ._utils import load_pretrained
 
 __all__ = ["GoogLeNet", "googlenet"]
 
@@ -95,5 +95,4 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
-    check_pretrained(pretrained)
-    return GoogLeNet(**kwargs)
+    return load_pretrained(GoogLeNet(**kwargs), pretrained)

@@ -7,7 +7,7 @@ from ... import nn
 __all__ = ["InceptionV3", "inception_v3"]
 
 
-from ._utils import ConvBNLayer as ConvBN, check_pretrained
+from ._utils import ConvBNLayer as ConvBN, load_pretrained
 
 
 class InceptionA(nn.Layer):
@@ -139,5 +139,4 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
-    check_pretrained(pretrained)
-    return InceptionV3(**kwargs)
+    return load_pretrained(InceptionV3(**kwargs), pretrained)

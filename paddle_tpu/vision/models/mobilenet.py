@@ -23,7 +23,7 @@ def _make_divisible(v, divisor=8, min_value=None):
     return new_v
 
 
-from ._utils import ConvBNLayer, check_pretrained
+from ._utils import ConvBNLayer, load_pretrained
 
 
 class DepthwiseSeparable(nn.Layer):
@@ -227,20 +227,16 @@ class MobileNetV3Large(_MobileNetV3):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    check_pretrained(pretrained)
-    return MobileNetV1(scale=scale, **kwargs)
+    return load_pretrained(MobileNetV1(scale=scale, **kwargs), pretrained)
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    check_pretrained(pretrained)
-    return MobileNetV2(scale=scale, **kwargs)
+    return load_pretrained(MobileNetV2(scale=scale, **kwargs), pretrained)
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    check_pretrained(pretrained)
-    return MobileNetV3Small(scale=scale, **kwargs)
+    return load_pretrained(MobileNetV3Small(scale=scale, **kwargs), pretrained)
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    check_pretrained(pretrained)
-    return MobileNetV3Large(scale=scale, **kwargs)
+    return load_pretrained(MobileNetV3Large(scale=scale, **kwargs), pretrained)

@@ -196,10 +196,9 @@ class ResNet(nn.Layer):
 
 
 def _resnet(block, depth, pretrained=False, **kwargs):
-    model = ResNet(block, depth, **kwargs)
-    if pretrained:
-        raise NotImplementedError("no pretrained weights in this environment")
-    return model
+    from ._utils import load_pretrained
+    return load_pretrained(ResNet(block, depth, **kwargs), pretrained,
+                           arch=f"resnet{depth}")
 
 
 def resnet18(pretrained=False, **kwargs):

@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from ...tensor_ops.manip import concat
 from ... import nn
-from ._utils import check_pretrained
+from ._utils import load_pretrained
 
 __all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
            "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
@@ -105,35 +105,28 @@ class ShuffleNetV2(nn.Layer):
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kw):
-    check_pretrained(pretrained)
-    return ShuffleNetV2(0.25, **kw)
+    return load_pretrained(ShuffleNetV2(0.25, **kw), pretrained)
 
 
 def shufflenet_v2_x0_33(pretrained=False, **kw):
-    check_pretrained(pretrained)
-    return ShuffleNetV2(0.33, **kw)
+    return load_pretrained(ShuffleNetV2(0.33, **kw), pretrained)
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kw):
-    check_pretrained(pretrained)
-    return ShuffleNetV2(0.5, **kw)
+    return load_pretrained(ShuffleNetV2(0.5, **kw), pretrained)
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kw):
-    check_pretrained(pretrained)
-    return ShuffleNetV2(1.0, **kw)
+    return load_pretrained(ShuffleNetV2(1.0, **kw), pretrained)
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kw):
-    check_pretrained(pretrained)
-    return ShuffleNetV2(1.5, **kw)
+    return load_pretrained(ShuffleNetV2(1.5, **kw), pretrained)
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kw):
-    check_pretrained(pretrained)
-    return ShuffleNetV2(2.0, **kw)
+    return load_pretrained(ShuffleNetV2(2.0, **kw), pretrained)
 
 
 def shufflenet_v2_swish(pretrained=False, **kw):
-    check_pretrained(pretrained)
-    return ShuffleNetV2(1.0, act="swish", **kw)
+    return load_pretrained(ShuffleNetV2(1.0, act="swish", **kw), pretrained)

@@ -3,7 +3,7 @@ from __future__ import annotations
 
 from ...tensor_ops.manip import concat
 from ... import nn
-from ._utils import check_pretrained
+from ._utils import load_pretrained
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
@@ -66,10 +66,8 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    check_pretrained(pretrained)
-    return SqueezeNet("1.0", **kwargs)
+    return load_pretrained(SqueezeNet("1.0", **kwargs), pretrained)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    check_pretrained(pretrained)
-    return SqueezeNet("1.1", **kwargs)
+    return load_pretrained(SqueezeNet("1.1", **kwargs), pretrained)

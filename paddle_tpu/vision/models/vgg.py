@@ -6,7 +6,7 @@ stacks are plain 3x3s — pure MXU work once XLA folds BN/ReLU in.
 from __future__ import annotations
 
 from ... import nn
-from ._utils import check_pretrained
+from ._utils import load_pretrained
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
 
@@ -62,8 +62,7 @@ class VGG(nn.Layer):
 
 
 def _vgg(cfg, batch_norm, pretrained=False, **kwargs):
-    check_pretrained(pretrained)
-    return VGG(make_layers(_CFGS[cfg], batch_norm), **kwargs)
+    return load_pretrained(VGG(make_layers(_CFGS[cfg], batch_norm), **kwargs), pretrained)
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):

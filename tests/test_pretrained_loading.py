@@ -1,0 +1,189 @@
+"""Pretrained-weight import recipe (ref: paddle.vision.models pretrained
+loading / paddlenlp PretrainedModel.from_pretrained).
+
+Offline story: reference checkpoints (.pdparams pickles) or paddle_tpu
+saves load via pretrained='path' / from_pretrained(..., pretrained_path=)
+with strict full-match semantics and forward parity; pretrained=True
+raises with the convert-and-load recipe.
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _ref_pdparams(state, path):
+    """Write a reference-framework-style .pdparams: a plain pickle of
+    {name: ndarray} (what paddle.save(state_dict) produces)."""
+    blob = {k: np.asarray(v._value if hasattr(v, "_value") else v)
+            for k, v in state.items()}
+    with open(path, "wb") as f:
+        pickle.dump(blob, f, protocol=2)
+
+
+def test_resnet18_pretrained_path_roundtrip(tmp_path):
+    from paddle_tpu.vision.models import resnet18
+    paddle.seed(0)
+    src = resnet18(num_classes=10)
+    src.eval()
+    ck = str(tmp_path / "resnet18.pdparams")
+    _ref_pdparams(src.state_dict(), ck)
+
+    paddle.seed(123)                     # different init
+    dst = resnet18(pretrained=ck, num_classes=10)
+    dst.eval()
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((2, 3, 32, 32))
+        .astype(np.float32))
+    np.testing.assert_allclose(dst(x).numpy(), src(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet18_pretrained_true_gives_recipe():
+    from paddle_tpu.vision.models import resnet18
+    with pytest.raises(NotImplementedError, match="pdparams"):
+        resnet18(pretrained=True)
+
+
+def test_vgg_pretrained_path(tmp_path):
+    from paddle_tpu.vision.models import vgg11
+    paddle.seed(1)
+    src = vgg11(num_classes=4)
+    src.eval()
+    ck = str(tmp_path / "vgg11.pdparams")
+    _ref_pdparams(src.state_dict(), ck)
+    paddle.seed(99)
+    dst = vgg11(pretrained=ck, num_classes=4)
+    dst.eval()
+    x = paddle.to_tensor(np.ones((1, 3, 32, 32), np.float32))
+    np.testing.assert_allclose(dst(x).numpy(), src(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pretrained_shape_mismatch_loud(tmp_path):
+    from paddle_tpu.vision.models import resnet18
+    paddle.seed(0)
+    src = resnet18(num_classes=10)
+    ck = str(tmp_path / "r18.pdparams")
+    _ref_pdparams(src.state_dict(), ck)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        resnet18(pretrained=ck, num_classes=7)   # head differs
+
+
+def test_pretrained_partial_checkpoint_refused(tmp_path):
+    from paddle_tpu.vision.models import resnet18
+    paddle.seed(0)
+    src = resnet18(num_classes=10)
+    state = dict(src.state_dict())
+    state.pop(sorted(state)[0])                  # drop one parameter
+    ck = str(tmp_path / "partial.pdparams")
+    _ref_pdparams(state, ck)
+    with pytest.raises(ValueError, match="partial load"):
+        resnet18(pretrained=ck, num_classes=10)
+
+
+def test_bert_base_from_pretrained_roundtrip(tmp_path):
+    from paddle_tpu.nlp import BertModel
+    paddle.seed(2)
+    src = BertModel.from_config_name(
+        "bert-base-uncased", num_hidden_layers=2, hidden_size=64,
+        num_attention_heads=4, intermediate_size=128, vocab_size=500,
+        max_position_embeddings=64)
+    src.eval()
+    ck = str(tmp_path / "bert.pdparams")
+    _ref_pdparams(src.state_dict(), ck)
+
+    paddle.seed(77)
+    dst = BertModel.from_pretrained(
+        "bert-base-uncased", pretrained_path=ck, num_hidden_layers=2,
+        hidden_size=64, num_attention_heads=4, intermediate_size=128,
+        vocab_size=500, max_position_embeddings=64)
+    dst.eval()
+    ids = paddle.to_tensor(np.arange(16, dtype=np.int64)[None, :] % 500)
+    seq_s, pool_s = src(ids)
+    seq_d, pool_d = dst(ids)
+    np.testing.assert_allclose(seq_d.numpy(), seq_s.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(pool_d.numpy(), pool_s.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bert_from_pretrained_path_first_form(tmp_path):
+    from paddle_tpu.nlp import BertModel
+    paddle.seed(3)
+    src = BertModel.from_config_name(
+        "bert-base-uncased", num_hidden_layers=1, hidden_size=32,
+        num_attention_heads=2, intermediate_size=64, vocab_size=200,
+        max_position_embeddings=32)
+    ck = str(tmp_path / "b.pdparams")
+    _ref_pdparams(src.state_dict(), ck)
+    dst = BertModel.from_pretrained(
+        ck, config_name="bert-base-uncased", num_hidden_layers=1,
+        hidden_size=32, num_attention_heads=2, intermediate_size=64,
+        vocab_size=200, max_position_embeddings=32)
+    assert dst.config.hidden_size == 32
+    # checkpoint path without a config name is an actionable error
+    with pytest.raises(ValueError, match="config_name"):
+        BertModel.from_pretrained(ck)
+
+
+def test_bert_from_pretrained_no_weights_recipe():
+    from paddle_tpu.nlp import BertModel
+    with pytest.raises(NotImplementedError, match="pdparams"):
+        BertModel.from_pretrained("bert-base-uncased",
+                                  num_hidden_layers=1, hidden_size=32,
+                                  num_attention_heads=2,
+                                  intermediate_size=64)
+
+
+def test_strict_refusal_leaves_model_untouched(tmp_path):
+    """The partial-load check must run BEFORE mutation: a refused load
+    may not leave the model half-overwritten."""
+    from paddle_tpu.serialization import load_into
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(6)
+    model = LeNet()
+    before = {k: np.asarray(v._value).copy()
+              for k, v in model.state_dict().items()}
+    paddle.seed(7)
+    other = LeNet()
+    state = dict(other.state_dict())
+    state.pop(sorted(state)[-1])
+    ck = str(tmp_path / "part.pdparams")
+    _ref_pdparams(state, ck)
+    with pytest.raises(ValueError, match="partial load"):
+        load_into(model, ck)
+    for k, v in model.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v._value), before[k])
+
+
+def test_from_pretrained_conflicting_sources(tmp_path):
+    from paddle_tpu.nlp import BertModel
+    ck = str(tmp_path / "a.pdparams")
+    _ref_pdparams({}, ck)
+    with pytest.raises(ValueError, match="exactly one"):
+        BertModel.from_pretrained(ck, pretrained_path="b.pdparams",
+                                  config_name="bert-tiny")
+
+
+def test_gpt_from_pretrained_exists():
+    from paddle_tpu.nlp import GPTForCausalLM
+    assert hasattr(GPTForCausalLM, "from_pretrained")
+
+
+def test_load_into_accepts_paddle_tpu_save(tmp_path):
+    """The same entry point loads our own save format (sniffed)."""
+    from paddle_tpu.serialization import load_into
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(4)
+    src = LeNet()
+    p = str(tmp_path / "lenet.pt")
+    paddle.save(src.state_dict(), p)
+    paddle.seed(55)
+    dst = LeNet()
+    load_into(dst, p)
+    for k, v in src.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v._value),
+                                      np.asarray(dst.state_dict()[k]._value))
