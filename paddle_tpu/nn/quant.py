@@ -9,9 +9,11 @@ TPU-native design: HBM bandwidth is the decode bottleneck, so halving /
 quartering weight bytes is the whole win. Weights are quantized
 per-output-channel (absmax), stored int8 — or int4 PACKED two nibbles
 per int8 byte (jnp has no int4 storage; the unpack is two shifts that
-XLA fuses into the consumer matmul's prologue). The matmul runs in the
-activation dtype (bf16 MXU) after an in-kernel dequant multiply; for
-true int8xint8 MXU serving see quantization.Int8InferLinear.
+XLA fuses into the consumer matmul's prologue). weight_only_linear runs
+the matmul in the activation dtype (bf16 MXU) after an in-kernel
+dequant multiply; llm_int8_linear runs a true dynamic int8xint8 MXU
+matmul with outlier decomposition (LLM.int8()); for CALIBRATED static
+activation scales see quantization.Int8InferLinear.
 """
 from __future__ import annotations
 
@@ -99,12 +101,91 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
 
 def llm_int8_linear(x, weight, bias=None, weight_scale=None,
                     threshold=6.0):
-    """ref: paddle.nn.quant.llm_int8_linear (LLM.int8() outlier scheme).
-    On TPU the MXU has no mixed int8/fp16 outlier path, and the
-    bandwidth win comes from the weight side alone — so this lowers to
-    the same fused dequant matmul; `threshold` is accepted for API
-    parity and unused (documented divergence)."""
-    return weight_only_linear(x, weight, bias, weight_scale, "int8")
+    """ref: paddle.nn.quant.llm_int8_linear — the REAL LLM.int8()
+    scheme (Dettmers et al.): dynamic per-row int8 quantization of the
+    activations, int8 x int8 -> int32 matmul (MXU-native via
+    dot_general preferred_element_type), and outlier feature
+    decomposition — input features whose batch absmax exceeds
+    `threshold` bypass quantization and run at full precision, which is
+    what keeps transformer activations (systematic outlier channels)
+    accurate under int8.
+
+    TPU-native divergence from the CUDA kernel: outlier columns are
+    handled by MASKING (zeroed in the int8 path, zeroed-complement in
+    the fp path) instead of gathering a data-dependent column subset —
+    shapes stay static under jit, which XLA requires; the fp outlier
+    matmul is therefore full-width and runs in the activation dtype.
+    Precision semantics match the paper; the compute saving of the
+    gathered form does not apply on TPU, where the win is the int8 MXU
+    path + halved weight HBM. Gradients are straight-through (the
+    dequant-matmul jacobian, like the STE fake-quant pattern in
+    paddle_tpu.quantization): quantization round/cast ops would
+    otherwise silently zero the tangent."""
+    from ..autograd import apply_op
+    wq = _arr(weight)                       # int8 [K, N]
+    ws = _arr(weight_scale)                 # [N]
+    b = None if bias is None else _arr(bias)
+
+    def f(a):
+        y = _llm_int8_mm(a, wq, ws, float(threshold))
+        if b is not None:
+            y = y + b.astype(y.dtype)
+        return y
+
+    return apply_op(f, x if isinstance(x, Tensor) else Tensor(_arr(x)))
+
+
+def _llm_int8_impl(af, wq, ws, threshold):
+    dt = af.dtype
+    a32 = af.astype(jnp.float32)
+    # outlier feature columns: batch absmax over all leading dims
+    amax = jnp.max(jnp.abs(a32), axis=tuple(range(a32.ndim - 1)))
+    outlier = amax > jnp.float32(threshold)              # [K]
+    a_reg = jnp.where(outlier, 0.0, a32)
+    # vector-wise (per-row) activation quantization
+    row_s = jnp.max(jnp.abs(a_reg), axis=-1, keepdims=True) / 127.0
+    row_s = jnp.maximum(row_s, jnp.float32(1e-8))
+    aq = jnp.clip(jnp.round(a_reg / row_s), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        aq, wq, (((aq.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)                # int32 [..., N]
+    # bare `ws` (not ws[None, :]) so a 1-D input keeps its rank
+    y = (acc.astype(jnp.float32) * row_s * ws.astype(jnp.float32)
+         ).astype(dt)
+    # outlier features at full precision, in the ACTIVATION dtype (bf16
+    # inputs keep the MXU fast path for this full-width matmul)
+    a_out = jnp.where(outlier, af, jnp.zeros((), dt))
+    w_f = wq.astype(dt) * ws[None, :].astype(dt)
+    return y + a_out @ w_f
+
+
+import functools  # noqa: E402
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _llm_int8_mm(af, wq, ws, threshold):
+    return _llm_int8_impl(af, wq, ws, threshold)
+
+
+def _llm_int8_mm_fwd(af, wq, ws, threshold):
+    return _llm_int8_impl(af, wq, ws, threshold), (wq, ws)
+
+
+def _llm_int8_mm_bwd(threshold, res, g):
+    wq, ws = res
+    dt = g.dtype                   # output dtype == activation dtype
+    # straight-through: jacobian of the dequantized matmul; frozen int8
+    # weight storage gets a zero cotangent (serving weights don't train)
+    w_f = wq.astype(dt) * ws[None, :].astype(dt)
+    ga = g @ w_f.T
+    import numpy as _np
+    from jax import dtypes as _dtypes
+    gwq = _np.zeros(wq.shape, _dtypes.float0) if not \
+        jnp.issubdtype(wq.dtype, jnp.floating) else jnp.zeros_like(wq)
+    return ga, gwq, jnp.zeros_like(ws)
+
+
+_llm_int8_mm.defvjp(_llm_int8_mm_fwd, _llm_int8_mm_bwd)
 
 
 class WeightOnlyLinear(Layer):

@@ -52,13 +52,84 @@ def test_weight_only_linear_close_to_fp(dtype, rtol):
     assert np.abs(y - ref).max() / np.abs(ref).max() < rtol
 
 
-def test_llm_int8_linear_matches_weight_only():
+def test_llm_int8_linear_accurate_without_outliers():
+    """Real LLM.int8(): per-row int8 activation quantization + int8x8
+    matmul. On well-behaved activations the result tracks the fp
+    reference within combined int8 quantization error."""
     w = _w()
-    x = np.random.default_rng(3).standard_normal((2, 64)).astype(np.float32)
+    x = np.random.default_rng(3).standard_normal((4, 64)).astype(np.float32)
     q, s = weight_quantize(paddle.to_tensor(w), "weight_only_int8")
-    a = llm_int8_linear(paddle.to_tensor(x), q, None, s).numpy()
-    b = weight_only_linear(paddle.to_tensor(x), q, None, s, "int8").numpy()
-    np.testing.assert_allclose(a, b)
+    y = llm_int8_linear(paddle.to_tensor(x), q, None, s).numpy()
+    ref = x @ w
+    assert np.abs(y - ref).max() / np.abs(ref).max() < 0.05
+
+
+def test_llm_int8_outlier_decomposition_recovers_accuracy():
+    """The point of the algorithm: activations with systematic outlier
+    channels destroy plain int8 quantization (the outlier dominates the
+    per-row scale); the decomposition runs those features at full
+    precision. threshold=inf disables it — error must drop sharply when
+    it is on."""
+    rng = np.random.default_rng(4)
+    w = (rng.standard_normal((64, 32)) * 0.1).astype(np.float32)
+    x = rng.standard_normal((4, 64)).astype(np.float32)
+    x[:, 7] *= 60.0                        # a classic outlier channel
+    q, s = weight_quantize(paddle.to_tensor(w), "weight_only_int8")
+    ref = x @ (np.asarray(q.numpy(), np.float32) * np.asarray(s.numpy())[None, :])
+    y_on = llm_int8_linear(paddle.to_tensor(x), q, None, s,
+                           threshold=6.0).numpy()
+    y_off = llm_int8_linear(paddle.to_tensor(x), q, None, s,
+                            threshold=1e9).numpy()
+    err_on = np.abs(y_on - ref).max()
+    err_off = np.abs(y_off - ref).max()
+    assert err_on < err_off / 4, (err_on, err_off)
+    assert err_on / np.abs(ref).max() < 0.05
+
+
+def test_llm_int8_ste_gradient_and_shapes():
+    """Straight-through gradients (the dequant-matmul jacobian — the
+    round/int-cast path would otherwise zero the tangent), 1-D inputs
+    keep their rank, and bf16 inputs stay bf16."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nn.quant import _llm_int8_mm
+    w = (_w() * 0.1).astype(np.float32)
+    q, s = weight_quantize(paddle.to_tensor(w), "weight_only_int8")
+    wq, ws = q._value, s._value
+    x = np.random.default_rng(7).standard_normal((4, 64)).astype(np.float32)
+
+    g = jax.grad(lambda a: jnp.sum(_llm_int8_mm(a, wq, ws, 6.0) ** 2))(
+        jnp.asarray(x))
+    w_f = np.asarray(wq, np.float32) * np.asarray(ws)[None, :]
+    ref_g = 2 * (x @ w_f) @ w_f.T
+    assert np.abs(np.asarray(g)).max() > 0          # not silently zero
+    assert np.abs(np.asarray(g) - ref_g).max() / np.abs(ref_g).max() < 0.02
+
+    assert _llm_int8_mm(jnp.asarray(x[0]), wq, ws, 6.0).shape == (32,)
+    assert _llm_int8_mm(jnp.asarray(x, jnp.bfloat16), wq, ws,
+                        6.0).dtype == jnp.bfloat16
+
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    out = llm_int8_linear(xt, q, None, s)
+    out.sum().backward()
+    assert np.abs(xt.grad.numpy()).max() > 0
+
+
+def test_llm_int8_linear_bias_and_jit():
+    import jax
+    w = _w()
+    x = np.random.default_rng(5).standard_normal((2, 64)).astype(np.float32)
+    b = np.random.default_rng(6).standard_normal((32,)).astype(np.float32)
+    q, s = weight_quantize(paddle.to_tensor(w), "weight_only_int8")
+
+    @jax.jit
+    def f(a):
+        return llm_int8_linear(paddle.to_tensor(a), q,
+                               paddle.to_tensor(b), s)._value
+
+    y = np.asarray(f(x))
+    ref = x @ w + b
+    assert np.abs(y - ref).max() / np.abs(ref).max() < 0.06
 
 
 @pytest.mark.parametrize("dtype", ["int8", "int4"])
