@@ -119,14 +119,75 @@ class Imdb(Dataset):
         return len(self.docs)
 
 
-class Imikolov(Dataset):
-    """ref: paddle.text.Imikolov — n-gram LM dataset."""
+def _read_text_member(data_file, member_basename):
+    """Lines of `member_basename` from a directory, a plain/gz file, or
+    a tarball containing it."""
+    if os.path.isdir(data_file):
+        data_file = os.path.join(data_file, member_basename)
+    if not os.path.exists(data_file):
+        raise ValueError(f"no '{member_basename}' at {data_file}")
+    if tarfile.is_tarfile(data_file):
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                if m.isfile() and \
+                        os.path.basename(m.name) == member_basename:
+                    return tf.extractfile(m).read().decode(
+                        "utf-8", errors="ignore").splitlines()
+        raise ValueError(
+            f"tarball {data_file} has no member '{member_basename}'")
+    with _open_maybe_gz(data_file) as f:
+        return [l.rstrip("\n") for l in f]
 
-    def __init__(self, mode="train", data_type="NGRAM", window_size=5,
-                 n_samples=5000, vocab=1000):
+
+class Imikolov(Dataset):
+    """ref: paddle.text.Imikolov — Penn Treebank n-gram / seq LM dataset.
+
+    data_file: the PTB release — a directory, the simple-examples
+    tarball, or the `ptb.{train,valid}.txt` file itself; mode selects
+    the member. Word dict built with min_word_freq (<unk> and <s>/<e>
+    reference sentinels), data_type NGRAM (sliding windows) or SEQ
+    (<s> sentence <e> pairs). Without data_file: deterministic
+    synthetic stream of the same shapes."""
+
+    def __init__(self, data_file=None, mode="train", data_type="NGRAM",
+                 window_size=5, min_word_freq=1, n_samples=5000,
+                 vocab=1000):
         super().__init__()
-        rng = _rng(2 if mode == "train" else 3)
         self.window_size = window_size
+        self.data_type = data_type
+        if data_file is not None:
+            member = f"ptb.{mode}.txt"
+            if os.path.isfile(data_file) and \
+                    not tarfile.is_tarfile(data_file):
+                lines = _read_text_member(data_file,
+                                          os.path.basename(data_file))
+            else:
+                lines = _read_text_member(data_file, member)
+            sents = [l.split() for l in lines if l.strip()]
+            freq = Counter(w for s in sents for w in s)
+            kept = sorted((w for w, c in freq.items()
+                           if c >= min_word_freq),
+                          key=lambda w: (-freq[w], w))
+            self.word_idx = {w: i for i, w in enumerate(kept)}
+            for tok in ("<unk>", "<s>", "<e>"):
+                self.word_idx.setdefault(tok, len(self.word_idx))
+            unk = self.word_idx["<unk>"]
+            wrapped = [[self.word_idx["<s>"]]
+                       + [self.word_idx.get(w, unk) for w in s]
+                       + [self.word_idx["<e>"]] for s in sents]
+            if data_type.upper() == "SEQ":
+                self.grams = [np.asarray(ids, np.int64)
+                              for ids in wrapped]
+            else:
+                # reference windows over <s> words <e>, so boundary
+                # n-grams exist and short sentences still contribute
+                self.grams = []
+                for ids in wrapped:
+                    for i in range(len(ids) - window_size + 1):
+                        self.grams.append(
+                            np.asarray(ids[i:i + window_size], np.int64))
+            return
+        rng = _rng(2 if mode == "train" else 3)
         # a Markov-ish synthetic stream so n-grams carry signal
         stream = [int(rng.integers(0, vocab))]
         for _ in range(n_samples + window_size):
@@ -138,6 +199,8 @@ class Imikolov(Dataset):
 
     def __getitem__(self, idx):
         g = self.grams[idx]
+        if self.data_type.upper() == "SEQ":
+            return g[:-1], g[1:]
         return g[:-1], g[-1]
 
     def __len__(self):
@@ -145,10 +208,35 @@ class Imikolov(Dataset):
 
 
 class UCIHousing(Dataset):
-    """ref: paddle.text.UCIHousing — 13-feature regression."""
+    """ref: paddle.text.UCIHousing — 13-feature regression.
 
-    def __init__(self, mode="train", n_samples=506):
+    data_file: the UCI housing.data file (14 whitespace columns);
+    features are min-max normalized like the reference and split 80/20
+    train/test by order. Without data_file: synthetic regression set."""
+
+    TRAIN_RATIO = 0.8
+
+    def __init__(self, data_file=None, mode="train", n_samples=506):
         super().__init__()
+        if data_file is not None:
+            rows = []
+            with _open_maybe_gz(str(data_file)) as f:
+                for line in f:
+                    parts = line.split()
+                    if len(parts) == 14:
+                        rows.append([float(p) for p in parts])
+            if not rows:
+                raise ValueError(
+                    f"no 14-column rows in {data_file} (expected the "
+                    "UCI housing.data format)")
+            data = np.asarray(rows, np.float32)
+            feats, target = data[:, :13], data[:, 13:]
+            lo, hi = feats.min(axis=0), feats.max(axis=0)
+            feats = (feats - lo) / np.maximum(hi - lo, 1e-8)
+            cut = int(len(data) * self.TRAIN_RATIO)
+            sl = slice(0, cut) if mode == "train" else slice(cut, None)
+            self.x, self.y = feats[sl], target[sl]
+            return
         rng = _rng(4 if mode == "train" else 5)
         self.x = rng.standard_normal((n_samples, 13)).astype(np.float32)
         w = rng.standard_normal((13,)).astype(np.float32)
@@ -164,11 +252,21 @@ class UCIHousing(Dataset):
 
 class WMT14(Dataset):
     """ref: paddle.text.WMT14 — (src_ids, trg_ids, trg_next) translation
-    triples."""
+    triples.
 
-    def __init__(self, mode="train", dict_size=1000, n_samples=2000,
-                 seq_len=16):
+    data_file: a tab-separated parallel corpus (file / directory with a
+    `{mode}` member / tarball) — same on-disk contract as WMT16, parsed
+    by the shared reader with <s>=0 <e>=1 <unk>=2. Without data_file:
+    deterministic synthetic pairs."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=1000,
+                 n_samples=2000, seq_len=16):
         super().__init__()
+        if data_file is not None:
+            self.src_dict, self.trg_dict, self.samples = \
+                WMT16._parse_parallel(data_file, mode, dict_size,
+                                      dict_size)
+            return
         rng = _rng(6 if mode == "train" else 7)
         self.samples = []
         for _ in range(n_samples):
@@ -305,12 +403,77 @@ class Conll05st(ViterbiDataset):
         return len(self.x)
 
 
+_ML_AGES = (1, 18, 25, 35, 45, 50, 56)        # ml-1m age buckets
+
+
 class Movielens(Dataset):
     """ref: paddle.text.Movielens — rating prediction. Samples:
     (user_id, gender, age, job, movie_id, category_vec, title_vec,
-    rating)."""
+    rating).
 
-    def __init__(self, mode="train", n_users=500, n_movies=800,
+    data_file: the MovieLens-1M release (directory or tarball holding
+    users.dat / movies.dat / ratings.dat in the `::`-separated format).
+    Ratings split train/test by a deterministic hash of
+    (user, movie, rand_seed) against test_ratio — membership depends
+    only on the pair, not on file order, matching the reference's
+    random-but-seeded split role. Without data_file: deterministic
+    synthetic samples with the same tuple shape."""
+
+    def __init__(self, data_file=None, mode="train", n_users=500,
+                 n_movies=800, test_ratio=0.1, rand_seed=0,
+                 **_synth_kw):
+        if data_file is not None:
+            super().__init__()
+            users = {}
+            for line in _read_text_member(data_file, "users.dat"):
+                if not line.strip():
+                    continue
+                uid, gender, age, job, _zip = line.split("::")
+                users[int(uid)] = (int(gender == "M"),
+                                   _ML_AGES.index(int(age)), int(job))
+            genres, titles_vocab = {}, {}
+            movies = {}
+            for line in _read_text_member(data_file, "movies.dat"):
+                if not line.strip():
+                    continue
+                mid, title, gen = line.split("::")
+                gvec = np.zeros((18,), np.int64)
+                for g in gen.split("|"):
+                    gvec[genres.setdefault(g, len(genres)) % 18] = 1
+                tids = [titles_vocab.setdefault(w.lower(),
+                                                len(titles_vocab) + 1)
+                        for w in title.split()][:8]
+                tvec = np.zeros((8,), np.int64)
+                tvec[:len(tids)] = tids
+                movies[int(mid)] = (gvec, tvec)
+            self.samples = []
+            import hashlib
+            for line in _read_text_member(data_file, "ratings.dat"):
+                if not line.strip():
+                    continue
+                uid, mid, rating, _ts = line.split("::")
+                uid, mid = int(uid), int(mid)
+                if uid not in users or mid not in movies:
+                    continue
+                # order-independent split: hash the (pair, seed), not a
+                # sequential RNG draw
+                h = hashlib.md5(
+                    f"{uid}:{mid}:{rand_seed}".encode()).digest()
+                is_test = (int.from_bytes(h[:4], "big") / 2 ** 32) \
+                    < test_ratio
+                if is_test != (mode == "test"):
+                    continue
+                g, a, j = users[uid]
+                cats, title = movies[mid]
+                self.samples.append(
+                    (np.int64(uid), np.int64(g), np.int64(a),
+                     np.int64(j), np.int64(mid), cats, title,
+                     np.float32(rating)))
+            return
+        self._init_synthetic(mode=mode, n_users=n_users,
+                             n_movies=n_movies, **_synth_kw)
+
+    def _init_synthetic(self, mode="train", n_users=500, n_movies=800,
                  n_samples=4000, n_cats=18, title_len=8):
         super().__init__()
         rng = _rng(12 if mode == "train" else 13)
@@ -355,27 +518,33 @@ class WMT16(WMT14):
                  trg_dict_size=2000, n_samples=2000, seq_len=24):
         if data_file is not None:
             Dataset.__init__(self)
-            pairs = self._read_pairs(data_file, mode)
-            if not pairs:
-                raise ValueError(f"no parallel '{mode}' lines found in "
-                                 f"{data_file}")
-            self.src_dict = self._build_dict(
-                (p[0] for p in pairs), src_dict_size)
-            self.trg_dict = self._build_dict(
-                (p[1] for p in pairs), trg_dict_size)
-            self.samples = []
-            for src_toks, trg_toks in pairs:
-                src = np.asarray([self.src_dict.get(t, self.UNK)
-                                  for t in src_toks], np.int64)
-                trg = np.asarray(
-                    [self.BOS] + [self.trg_dict.get(t, self.UNK)
-                                  for t in trg_toks] + [self.EOS],
-                    np.int64)
-                self.samples.append((src, trg[:-1], trg[1:]))
+            self.src_dict, self.trg_dict, self.samples = \
+                self._parse_parallel(data_file, mode, src_dict_size,
+                                     trg_dict_size)
             return
         super().__init__(mode=mode, dict_size=min(src_dict_size,
                                                   trg_dict_size),
                          n_samples=n_samples, seq_len=seq_len)
+
+    @classmethod
+    def _parse_parallel(cls, data_file, mode, src_dict_size,
+                        trg_dict_size):
+        """Shared WMT14/WMT16 corpus -> (src_dict, trg_dict, samples)."""
+        pairs = cls._read_pairs(data_file, mode)
+        if not pairs:
+            raise ValueError(f"no parallel '{mode}' lines found in "
+                             f"{data_file}")
+        src_dict = cls._build_dict((p[0] for p in pairs), src_dict_size)
+        trg_dict = cls._build_dict((p[1] for p in pairs), trg_dict_size)
+        samples = []
+        for src_toks, trg_toks in pairs:
+            src = np.asarray([src_dict.get(t, cls.UNK)
+                              for t in src_toks], np.int64)
+            trg = np.asarray(
+                [cls.BOS] + [trg_dict.get(t, cls.UNK)
+                             for t in trg_toks] + [cls.EOS], np.int64)
+            samples.append((src, trg[:-1], trg[1:]))
+        return src_dict, trg_dict, samples
 
     @staticmethod
     def _read_pairs(data_file, mode):

@@ -268,3 +268,137 @@ class ImageFolder(Dataset):
 
 __all__ += ["DatasetFolder", "ImageFolder", "image_load",
             "IMAGE_EXTENSIONS"]
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (ref: python/paddle/vision/datasets/flowers.py).
+
+    data_file=(images_dir_or_tgz, labels_mat, setid_mat) parses the real
+    release: jpg images, imagelabels.mat (1-based labels), setid.mat
+    (trnid/valid/tstid index splits — mode train/valid/test). Without
+    data_file: deterministic synthetic set with the same shapes."""
+
+    NUM_CLASSES = 102
+    _SPLIT_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 n=128, image_size=64, backend=None):
+        self.transform = transform
+        self.backend = backend
+        if data_file is not None:
+            import scipy.io
+            images, labels_mat, setid_mat = data_file
+            labels = scipy.io.loadmat(labels_mat)["labels"].ravel()
+            setid = scipy.io.loadmat(setid_mat)
+            ids = setid[self._SPLIT_KEY[mode]].ravel()
+            self._images_root = images
+            self._tar = None
+            self._tar_index = None
+            if os.path.isfile(images) and tarfile.is_tarfile(images):
+                # the release tarball itself: index members by basename,
+                # read lazily (lock: TarFile handles are not thread-safe
+                # under DataLoader workers)
+                import threading
+                self._tar_lock = threading.Lock()
+                self._tar = tarfile.open(images, "r:*")
+                self._tar_index = {
+                    os.path.basename(m.name): m
+                    for m in self._tar.getmembers() if m.isfile()}
+            # image_%05d.jpg, 1-based ids; labels 1-based -> 0-based
+            self.samples = [(f"image_{i:05d}.jpg", int(labels[i - 1]) - 1)
+                            for i in ids]
+            self._synthetic = None
+            return
+        imgs, labels = _synthetic_images(
+            n, (image_size, image_size, 3), self.NUM_CLASSES,
+            7 if mode == "train" else 8)
+        self._synthetic = (imgs, labels)
+        self._tar = None
+        self.samples = list(range(n))
+
+    def __getitem__(self, idx):
+        if self._synthetic is not None:
+            img, label = (self._synthetic[0][idx],
+                          self._synthetic[1][idx])
+        else:
+            fname, label = self.samples[idx]
+            if self._tar is not None:
+                import io as _io
+                from PIL import Image
+                with self._tar_lock:
+                    data = self._tar.extractfile(
+                        self._tar_index[fname]).read()
+                with Image.open(_io.BytesIO(data)) as im:
+                    im = im.convert("RGB")
+                    if self.backend == "pil":
+                        im.load()
+                        img = im
+                    else:
+                        img = np.asarray(im, dtype=np.uint8)
+            else:
+                img = image_load(os.path.join(self._images_root, fname),
+                                 backend=self.backend)
+            label = np.int64(label)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC 2012 segmentation (ref:
+    python/paddle/vision/datasets/voc2012.py — (image, segmentation
+    mask) pairs).
+
+    data_file = the VOCdevkit/VOC2012 root (extracted): reads
+    ImageSets/Segmentation/{train,val,trainval}.txt, JPEGImages/*.jpg
+    and SegmentationClass/*.png. Without data_file: synthetic pairs."""
+
+    _MODE_FILE = {"train": "train.txt", "valid": "val.txt",
+                  "test": "val.txt", "trainval": "trainval.txt"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 n=64, image_size=64, backend=None):
+        self.transform = transform
+        self.backend = backend
+        if data_file is not None:
+            root = data_file
+            lst = os.path.join(root, "ImageSets", "Segmentation",
+                               self._MODE_FILE[mode])
+            with open(lst) as f:
+                names = [l.strip() for l in f if l.strip()]
+            if not names:
+                raise ValueError(f"empty split list {lst}")
+            self._root = root
+            self.samples = names
+            self._synthetic = None
+            return
+        rng = np.random.RandomState(9 if mode == "train" else 10)
+        self._synthetic = (
+            (rng.rand(n, image_size, image_size, 3) * 255).astype(np.uint8),
+            rng.randint(0, 21, (n, image_size, image_size)).astype(np.uint8))
+        self.samples = list(range(n))
+
+    def __getitem__(self, idx):
+        if self._synthetic is not None:
+            img, mask = self._synthetic[0][idx], self._synthetic[1][idx]
+        else:
+            name = self.samples[idx]
+            img = image_load(os.path.join(self._root, "JPEGImages",
+                                          name + ".jpg"),
+                             backend=self.backend)
+            from PIL import Image
+            with Image.open(os.path.join(self._root, "SegmentationClass",
+                                         name + ".png")) as m:
+                mask = np.asarray(m, dtype=np.uint8)   # palette indices
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.samples)
+
+
+__all__ += ["Flowers", "VOC2012"]
