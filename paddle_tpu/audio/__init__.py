@@ -1,6 +1,7 @@
 """paddle.audio parity (ref: python/paddle/audio/__init__.py): feature
 layers + functional helpers. Dataset/backends (soundfile IO) are gated —
 this framework ships the on-device compute path."""
+from . import datasets  # noqa: F401
 from . import functional  # noqa: F401
 from . import layers  # noqa: F401
 from .layers import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa: F401
@@ -8,5 +9,5 @@ from .layers import MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram  # noqa
 # the reference exposes the layers under paddle.audio.features as well
 features = layers
 
-__all__ = ["functional", "layers", "features", "Spectrogram",
+__all__ = ["datasets", "functional", "layers", "features", "Spectrogram",
            "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

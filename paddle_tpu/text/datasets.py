@@ -119,24 +119,39 @@ class Imdb(Dataset):
         return len(self.docs)
 
 
+def _read_text_members(data_file, member_basenames):
+    """{basename: lines} for several members in ONE pass — a tarball is
+    opened and walked once, not once per member."""
+    out = {}
+    if os.path.isfile(data_file) and tarfile.is_tarfile(data_file):
+        want = set(member_basenames)
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if m.isfile() and base in want and base not in out:
+                    out[base] = tf.extractfile(m).read().decode(
+                        "utf-8", errors="ignore").splitlines()
+        missing = want - set(out)
+        if missing:
+            raise ValueError(
+                f"tarball {data_file} has no member(s) {sorted(missing)}")
+        return out
+    for base in member_basenames:
+        path = data_file
+        if os.path.isdir(data_file):
+            path = os.path.join(data_file, base)
+        if not os.path.exists(path):
+            raise ValueError(f"no '{base}' at {path}")
+        with _open_maybe_gz(path) as f:
+            out[base] = [l.rstrip("\n") for l in f]
+    return out
+
+
 def _read_text_member(data_file, member_basename):
     """Lines of `member_basename` from a directory, a plain/gz file, or
     a tarball containing it."""
-    if os.path.isdir(data_file):
-        data_file = os.path.join(data_file, member_basename)
-    if not os.path.exists(data_file):
-        raise ValueError(f"no '{member_basename}' at {data_file}")
-    if tarfile.is_tarfile(data_file):
-        with tarfile.open(data_file, "r:*") as tf:
-            for m in tf.getmembers():
-                if m.isfile() and \
-                        os.path.basename(m.name) == member_basename:
-                    return tf.extractfile(m).read().decode(
-                        "utf-8", errors="ignore").splitlines()
-        raise ValueError(
-            f"tarball {data_file} has no member '{member_basename}'")
-    with _open_maybe_gz(data_file) as f:
-        return [l.rstrip("\n") for l in f]
+    return _read_text_members(data_file, [member_basename])[
+        member_basename]
 
 
 class Imikolov(Dataset):
@@ -259,13 +274,15 @@ class WMT14(Dataset):
     by the shared reader with <s>=0 <e>=1 <unk>=2. Without data_file:
     deterministic synthetic pairs."""
 
+    BOS, EOS, UNK = 0, 1, 2
+
     def __init__(self, data_file=None, mode="train", dict_size=1000,
                  n_samples=2000, seq_len=16):
         super().__init__()
         if data_file is not None:
             self.src_dict, self.trg_dict, self.samples = \
-                WMT16._parse_parallel(data_file, mode, dict_size,
-                                      dict_size)
+                self._parse_parallel(data_file, mode, dict_size,
+                                     dict_size)
             return
         rng = _rng(6 if mode == "train" else 7)
         self.samples = []
@@ -279,6 +296,68 @@ class WMT14(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+    @classmethod
+    def _parse_parallel(cls, data_file, mode, src_dict_size,
+                        trg_dict_size):
+        """Shared WMT14/WMT16 corpus (lives on the parent; WMT16 inherits) -> (src_dict, trg_dict, samples)."""
+        pairs = cls._read_pairs(data_file, mode)
+        if not pairs:
+            raise ValueError(f"no parallel '{mode}' lines found in "
+                             f"{data_file}")
+        src_dict = cls._build_dict((p[0] for p in pairs), src_dict_size)
+        trg_dict = cls._build_dict((p[1] for p in pairs), trg_dict_size)
+        samples = []
+        for src_toks, trg_toks in pairs:
+            src = np.asarray([src_dict.get(t, cls.UNK)
+                              for t in src_toks], np.int64)
+            trg = np.asarray(
+                [cls.BOS] + [trg_dict.get(t, cls.UNK)
+                             for t in trg_toks] + [cls.EOS], np.int64)
+            samples.append((src, trg[:-1], trg[1:]))
+        return src_dict, trg_dict, samples
+
+    @staticmethod
+    def _read_pairs(data_file, mode):
+        def parse_lines(lines):
+            out = []
+            for line in lines:
+                if "\t" not in line:
+                    continue
+                src, trg = line.rstrip("\n").split("\t", 1)
+                if src and trg:
+                    out.append((src.split(), trg.split()))
+            return out
+
+        if os.path.isdir(data_file):
+            data_file = os.path.join(data_file, mode)
+        if not os.path.exists(data_file):
+            raise ValueError(
+                f"no '{mode}' corpus at {data_file} (expected a "
+                "tab-separated parallel file, a directory containing "
+                f"one named '{mode}', or the reference tarball)")
+        if tarfile.is_tarfile(data_file):
+            with tarfile.open(data_file, "r:*") as tf:
+                for m in tf.getmembers():
+                    if m.isfile() and os.path.basename(m.name) == mode:
+                        data = tf.extractfile(m).read().decode("utf-8")
+                        return parse_lines(data.splitlines())
+            return []
+        with _open_maybe_gz(data_file) as f:
+            return parse_lines(f)
+
+    @classmethod
+    def _build_dict(cls, tok_seqs, dict_size):
+        freq = Counter(t for toks in tok_seqs for t in toks)
+        specials = {"<s>": cls.BOS, "<e>": cls.EOS, "<unk>": cls.UNK}
+        d = dict(specials)
+        for w in sorted(freq, key=lambda w: (-freq[w], w)):
+            if len(d) >= dict_size:
+                break
+            if w not in d:
+                d[w] = len(d)
+        return d
 
 
 class ViterbiDataset(Dataset):
@@ -424,8 +503,10 @@ class Movielens(Dataset):
                  **_synth_kw):
         if data_file is not None:
             super().__init__()
+            members = _read_text_members(
+                data_file, ["users.dat", "movies.dat", "ratings.dat"])
             users = {}
-            for line in _read_text_member(data_file, "users.dat"):
+            for line in members["users.dat"]:
                 if not line.strip():
                     continue
                 uid, gender, age, job, _zip = line.split("::")
@@ -433,13 +514,19 @@ class Movielens(Dataset):
                                    _ML_AGES.index(int(age)), int(job))
             genres, titles_vocab = {}, {}
             movies = {}
-            for line in _read_text_member(data_file, "movies.dat"):
+            for line in members["movies.dat"]:
                 if not line.strip():
                     continue
                 mid, title, gen = line.split("::")
                 gvec = np.zeros((18,), np.int64)
                 for g in gen.split("|"):
-                    gvec[genres.setdefault(g, len(genres)) % 18] = 1
+                    gi = genres.setdefault(g, len(genres))
+                    if gi >= 18:
+                        raise ValueError(
+                            f"more than 18 distinct genres in movies.dat "
+                            f"(got {g!r} as #{gi + 1}) — not the ml-1m "
+                            "genre set this loader models")
+                    gvec[gi] = 1
                 tids = [titles_vocab.setdefault(w.lower(),
                                                 len(titles_vocab) + 1)
                         for w in title.split()][:8]
@@ -448,7 +535,7 @@ class Movielens(Dataset):
                 movies[int(mid)] = (gvec, tvec)
             self.samples = []
             import hashlib
-            for line in _read_text_member(data_file, "ratings.dat"):
+            for line in members["ratings.dat"]:
                 if not line.strip():
                     continue
                 uid, mid, rating, _ts = line.split("::")
@@ -512,8 +599,6 @@ class WMT16(WMT14):
     (src_ids, trg_ids[:-1], trg_ids[1:]) with the target wrapped in
     <s>...<e>."""
 
-    BOS, EOS, UNK = 0, 1, 2
-
     def __init__(self, data_file=None, mode="train", src_dict_size=2000,
                  trg_dict_size=2000, n_samples=2000, seq_len=24):
         if data_file is not None:
@@ -526,63 +611,3 @@ class WMT16(WMT14):
                                                   trg_dict_size),
                          n_samples=n_samples, seq_len=seq_len)
 
-    @classmethod
-    def _parse_parallel(cls, data_file, mode, src_dict_size,
-                        trg_dict_size):
-        """Shared WMT14/WMT16 corpus -> (src_dict, trg_dict, samples)."""
-        pairs = cls._read_pairs(data_file, mode)
-        if not pairs:
-            raise ValueError(f"no parallel '{mode}' lines found in "
-                             f"{data_file}")
-        src_dict = cls._build_dict((p[0] for p in pairs), src_dict_size)
-        trg_dict = cls._build_dict((p[1] for p in pairs), trg_dict_size)
-        samples = []
-        for src_toks, trg_toks in pairs:
-            src = np.asarray([src_dict.get(t, cls.UNK)
-                              for t in src_toks], np.int64)
-            trg = np.asarray(
-                [cls.BOS] + [trg_dict.get(t, cls.UNK)
-                             for t in trg_toks] + [cls.EOS], np.int64)
-            samples.append((src, trg[:-1], trg[1:]))
-        return src_dict, trg_dict, samples
-
-    @staticmethod
-    def _read_pairs(data_file, mode):
-        def parse_lines(lines):
-            out = []
-            for line in lines:
-                if "\t" not in line:
-                    continue
-                src, trg = line.rstrip("\n").split("\t", 1)
-                if src and trg:
-                    out.append((src.split(), trg.split()))
-            return out
-
-        if os.path.isdir(data_file):
-            data_file = os.path.join(data_file, mode)
-        if not os.path.exists(data_file):
-            raise ValueError(
-                f"WMT16: no '{mode}' corpus at {data_file} (expected a "
-                "tab-separated parallel file, a directory containing "
-                f"one named '{mode}', or the reference tarball)")
-        if tarfile.is_tarfile(data_file):
-            with tarfile.open(data_file, "r:*") as tf:
-                for m in tf.getmembers():
-                    if m.isfile() and os.path.basename(m.name) == mode:
-                        data = tf.extractfile(m).read().decode("utf-8")
-                        return parse_lines(data.splitlines())
-            return []
-        with _open_maybe_gz(data_file) as f:
-            return parse_lines(f)
-
-    @classmethod
-    def _build_dict(cls, tok_seqs, dict_size):
-        freq = Counter(t for toks in tok_seqs for t in toks)
-        specials = {"<s>": cls.BOS, "<e>": cls.EOS, "<unk>": cls.UNK}
-        d = dict(specials)
-        for w in sorted(freq, key=lambda w: (-freq[w], w)):
-            if len(d) >= dict_size:
-                break
-            if w not in d:
-                d[w] = len(d)
-        return d
