@@ -5,8 +5,10 @@ XLA's batched-COO format whose matmuls lower to gather/segment-sum (and,
 for structured patterns, MXU-friendly dots). COO and CSR constructors are
 supported; CSR converts to BCOO internally and keeps its compressed attrs
 for API parity. Elementwise ops act on `values` only (zero-preserving ops,
-like the reference). 3-D point-cloud convs (SubmConv3D) are out of scope
-and gated with a clear error.
+like the reference). 3-D point-cloud convs (Conv3D/SubmConv3D)
+build a host-side rulebook over the concrete COO coordinates (the
+spconv/torchsparse recipe) and run gather-matmul-scatter on device —
+see conv.py.
 """
 from __future__ import annotations
 
@@ -36,8 +38,13 @@ def _arr(x):
 class SparseCooTensor:
     """COO sparse tensor over BCOO (ref: paddle's SparseCooTensor)."""
 
-    def __init__(self, bcoo):
+    def __init__(self, bcoo, values_t=None):
         self._bcoo = bcoo
+        # optional tape-linked values Tensor: ops that produce this
+        # sparse tensor from a differentiable computation store their
+        # output Tensor here so eager backward chains THROUGH stacked
+        # sparse ops (the raw BCOO data array carries no tape link)
+        self._values_t = values_t
 
     # -- paddle surface -------------------------------------------------
     @property
@@ -52,26 +59,47 @@ class SparseCooTensor:
         return Tensor(self._bcoo.indices.T)  # paddle: [ndim, nnz]
 
     def values(self):
+        if self._values_t is not None:
+            return self._values_t
         return Tensor(self._bcoo.data)
 
     def nnz(self):
         return int(self._bcoo.nse)
 
     def to_dense(self):
+        # self.values() (not a fresh Tensor) so the tape link survives:
+        # conv(x).to_dense().sum().backward() must reach the weights
         return apply_op(lambda d: jsparse.BCOO(
             (d, self._bcoo.indices), shape=self._bcoo.shape).todense(),
-            Tensor(self._bcoo.data))
+            self.values())
 
     def to_sparse_csr(self):
         dense = np.asarray(self.to_dense()._value)
         return _dense_to_csr(dense)
 
     def coalesce(self):
-        return SparseCooTensor(self._bcoo.sum_duplicates())
+        out = SparseCooTensor(self._bcoo.sum_duplicates())
+        if self._values_t is not None:
+            # re-derive the summed values differentiably off the tape
+            uniq = out._bcoo.indices
+            inv = {tuple(c): i for i, c in
+                   enumerate(np.asarray(uniq).tolist())}
+            seg = np.asarray([inv[tuple(c)] for c in
+                              np.asarray(self._bcoo.indices).tolist()],
+                             np.int32)
+            n_out = int(uniq.shape[0])
+            out._values_t = apply_op(
+                lambda v: jnp.zeros((n_out,) + v.shape[1:],
+                                    v.dtype).at[seg].add(v),
+                self.values())
+        return out
 
     def with_values(self, values):
-        return SparseCooTensor(jsparse.BCOO(
+        out = SparseCooTensor(jsparse.BCOO(
             (_arr(values), self._bcoo.indices), shape=self._bcoo.shape))
+        if isinstance(values, Tensor):
+            out._values_t = values   # every producer keeps the tape link
+        return out
 
     def __repr__(self):
         return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
@@ -165,7 +193,7 @@ def is_sparse_csr(x):
 def _unary(fn, x):
     if not isinstance(x, SparseCooTensor):
         raise TypeError("expected a sparse tensor")
-    return x.with_values(_arr(apply_op(fn, x.values())))
+    return x.with_values(apply_op(fn, x.values()))
 
 
 def relu(x, name=None):
@@ -269,8 +297,8 @@ def masked_matmul(x, y, mask, name=None):
         cols = b[:, idx[:, 1]].T     # [nnz, K]
         vals = jnp.sum(rows * cols, -1)
         return vals
-    vals = apply_op(f, Tensor(xa), Tensor(ya))
-    return mask.with_values(_arr(vals))
+    vals = apply_op(f, _t_dense(x), _t_dense(y))
+    return mask.with_values(vals)
 
 
 def _t_dense(x):
@@ -296,19 +324,13 @@ class _SparseReLU:
         return relu(x)
 
 
-class _GatedConv:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "paddle.sparse.nn 3-D point-cloud convolutions (Conv3D/"
-            "SubmConv3D) are gated: XLA has no submanifold gather-scatter "
-            "primitive; use dense conv3d or an external point-cloud "
-            "pipeline")
+from .conv import Conv3D, SubmConv3D  # noqa: E402
 
 
 class _nn:
     ReLU = _SparseReLU
-    Conv3D = _GatedConv
-    SubmConv3D = _GatedConv
+    Conv3D = Conv3D
+    SubmConv3D = SubmConv3D
 
 
 nn = _nn()

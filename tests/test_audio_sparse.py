@@ -168,10 +168,13 @@ class TestSparse:
         assert np.allclose(_np(out.values()),
                            full[tuple(mask_idx)], atol=1e-5)
 
-    def test_nn_relu_and_gated_conv(self):
+    def test_nn_relu_and_conv_constructible(self):
         idx = np.array([[0], [0]])
         sp = S.sparse_coo_tensor(idx, np.array([-3.0], np.float32), (1, 1))
         out = S.nn.ReLU()(sp)
         assert np.allclose(_np(out.values()), [0.0])
+        # r4: the convs are real now (tests/test_sparse_conv.py); only
+        # grouped convs remain gated
+        assert S.nn.SubmConv3D(1, 1, 3).kernel_size == (3, 3, 3)
         with pytest.raises(NotImplementedError):
-            S.nn.SubmConv3D(1, 1, 3)
+            S.nn.Conv3D(2, 2, 3, groups=2)

@@ -1,0 +1,203 @@
+"""Sparse 3-D convolutions (ref: paddle.sparse.nn Conv3D/SubmConv3D).
+
+Ground truth: a dense conv computed by direct numpy loops over the
+zero-filled voxel grid — sparse results must match at every active
+output site, and (for SubmConv3D) the active set must not dilate.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _random_points(rng, n, shape_dhw, c, batch=1):
+    seen = set()
+    coords = []
+    while len(coords) < n:
+        p = (int(rng.integers(0, batch)),) + tuple(
+            int(rng.integers(0, s)) for s in shape_dhw)
+        if p not in seen:
+            seen.add(p)
+            coords.append(p)
+    coords = np.asarray(coords, np.int64)
+    vals = rng.standard_normal((n, c)).astype(np.float32)
+    return coords, vals
+
+
+def _dense_conv3d(grid, w, stride, padding):
+    """Direct-loop NDHWC conv: out[o] = sum_k grid[o*s - p + k] @ w[k]."""
+    N, D, H, W, Cin = grid.shape
+    kd, kh, kw, _, Cout = w.shape
+    sd, sh, sw = stride
+    pd, ph, pw = padding
+    OD = (D + 2 * pd - kd) // sd + 1
+    OH = (H + 2 * ph - kh) // sh + 1
+    OW = (W + 2 * pw - kw) // sw + 1
+    out = np.zeros((N, OD, OH, OW, Cout), np.float32)
+    for n in range(N):
+        for od in range(OD):
+            for oh in range(OH):
+                for ow in range(OW):
+                    for dd in range(kd):
+                        for hh in range(kh):
+                            for ww in range(kw):
+                                id_, ih, iw = (od * sd - pd + dd,
+                                               oh * sh - ph + hh,
+                                               ow * sw - pw + ww)
+                                if 0 <= id_ < D and 0 <= ih < H \
+                                        and 0 <= iw < W:
+                                    out[n, od, oh, ow] += (
+                                        grid[n, id_, ih, iw]
+                                        @ w[dd, hh, ww])
+    return out
+
+
+def _to_sparse(coords, vals, shape):
+    return sparse.sparse_coo_tensor(coords.T, vals, shape)
+
+
+def test_subm_conv3d_matches_dense_at_active_sites():
+    rng = np.random.default_rng(0)
+    D = H = W = 5
+    coords, vals = _random_points(rng, 12, (D, H, W), c=3)
+    x = _to_sparse(coords, vals, (1, D, H, W, 3))
+    paddle.seed(1)
+    conv = sparse.nn.SubmConv3D(3, 4, 3, padding=1, bias_attr=False)
+    out = conv(x)
+    # active set identical (submanifold property)
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(out.indices().numpy()), axis=1),
+        np.sort(coords.T, axis=1))
+    grid = np.zeros((1, D, H, W, 3), np.float32)
+    grid[tuple(coords.T)] = vals
+    ref = _dense_conv3d(grid, np.asarray(conv.weight.numpy()),
+                        (1, 1, 1), (1, 1, 1))
+    out_idx = np.asarray(out.indices().numpy()).T
+    out_vals = np.asarray(out.values().numpy())
+    for row, v in zip(out_idx, out_vals):
+        np.testing.assert_allclose(v, ref[tuple(row)], rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_conv3d_stride2_matches_dense():
+    rng = np.random.default_rng(2)
+    D = H = W = 6
+    coords, vals = _random_points(rng, 10, (D, H, W), c=2, batch=2)
+    x = _to_sparse(coords, vals, (2, D, H, W, 2))
+    paddle.seed(3)
+    conv = sparse.nn.Conv3D(2, 3, kernel_size=2, stride=2,
+                            bias_attr=False)
+    out = conv(x)
+    grid = np.zeros((2, D, H, W, 2), np.float32)
+    grid[tuple(coords.T)] = vals
+    ref = _dense_conv3d(grid, np.asarray(conv.weight.numpy()),
+                        (2, 2, 2), (0, 0, 0))
+    out_idx = np.asarray(out.indices().numpy()).T
+    out_vals = np.asarray(out.values().numpy())
+    assert len(out_idx)                       # non-empty active set
+    for row, v in zip(out_idx, out_vals):
+        np.testing.assert_allclose(v, ref[tuple(row)], rtol=1e-4,
+                                   atol=1e-5)
+    # everywhere off the active set the dense reference is zero
+    mask = np.zeros(ref.shape[:4], bool)
+    mask[tuple(out_idx.T)] = True
+    assert np.abs(ref[~mask]).max() < 1e-6
+
+
+def test_conv3d_output_shape_and_bias():
+    rng = np.random.default_rng(4)
+    coords, vals = _random_points(rng, 6, (4, 4, 4), c=2)
+    x = _to_sparse(coords, vals, (1, 4, 4, 4, 2))
+    paddle.seed(5)
+    conv = sparse.nn.Conv3D(2, 5, kernel_size=3, padding=1)
+    out = conv(x)
+    assert tuple(out.shape) == (1, 4, 4, 4, 5)
+    nb = sparse.nn.Conv3D(2, 5, kernel_size=3, padding=1,
+                          bias_attr=False)
+    nb.weight.set_value(conv.weight)
+    diff = (np.asarray(out.values().numpy())
+            - np.asarray(nb(x).values().numpy()))
+    np.testing.assert_allclose(diff, np.broadcast_to(
+        np.asarray(conv.bias.numpy()), diff.shape), rtol=1e-5,
+        atol=1e-6)
+
+
+def test_subm_conv_gradients_chain():
+    """Eager backward flows through TWO stacked sparse convs into both
+    weights (the tape-linked values chain)."""
+    rng = np.random.default_rng(6)
+    coords, vals = _random_points(rng, 8, (4, 4, 4), c=2)
+    x = _to_sparse(coords, vals, (1, 4, 4, 4, 2))
+    paddle.seed(7)
+    c1 = sparse.nn.SubmConv3D(2, 3, 3, padding=1, bias_attr=False)
+    c2 = sparse.nn.SubmConv3D(3, 2, 3, padding=1, bias_attr=False)
+    out = c2(c1(x))
+    loss = (out.values() ** 2).sum()
+    loss.backward()
+    assert c2.weight.grad is not None
+    assert np.abs(np.asarray(c2.weight.grad.numpy())).max() > 0
+    assert c1.weight.grad is not None
+    assert np.abs(np.asarray(c1.weight.grad.numpy())).max() > 0
+
+
+def test_duplicate_coordinates_rejected():
+    vals = np.ones((2, 1), np.float32)
+    coords = np.array([[0, 0, 0, 0], [0, 0, 0, 0]])
+    x = _to_sparse(coords, vals, (1, 2, 2, 2, 1))
+    paddle.seed(12)
+    conv = sparse.nn.SubmConv3D(1, 1, 1, bias_attr=False)
+    with pytest.raises(ValueError, match="coalesce"):
+        conv(x)
+    # coalesced input works and sums duplicates
+    out = conv(x.coalesce())
+    assert out.nnz() == 1
+    w = float(np.asarray(conv.weight.numpy()).ravel()[0])
+    np.testing.assert_allclose(np.asarray(out.values().numpy()),
+                               [[2.0 * w]], rtol=1e-6)
+
+
+def test_grads_through_to_dense():
+    """conv(x).to_dense() backward reaches the weight (the common
+    sparse-to-dense head pattern)."""
+    rng = np.random.default_rng(13)
+    coords, vals = _random_points(rng, 5, (3, 3, 3), c=2)
+    x = _to_sparse(coords, vals, (1, 3, 3, 3, 2))
+    paddle.seed(14)
+    conv = sparse.nn.SubmConv3D(2, 3, 3, padding=1, bias_attr=False)
+    dense = conv(x).to_dense()
+    (dense ** 2).sum().backward()
+    assert conv.weight.grad is not None
+    assert np.abs(np.asarray(conv.weight.grad.numpy())).max() > 0
+
+
+def test_subm_requires_stride_1_and_groups_gate():
+    with pytest.raises(ValueError, match="stride 1"):
+        sparse.nn.SubmConv3D(2, 2, 3, stride=2)
+    with pytest.raises(NotImplementedError, match="groups"):
+        sparse.nn.Conv3D(4, 4, 3, groups=2)
+
+
+def test_gradients_chain_through_sparse_relu():
+    """conv -> ReLU -> conv backward must reach the FIRST conv's weight
+    (the unary ops carry the tape-linked values chain too)."""
+    rng = np.random.default_rng(10)
+    coords, vals = _random_points(rng, 6, (4, 4, 4), c=2)
+    x = _to_sparse(coords, vals, (1, 4, 4, 4, 2))
+    paddle.seed(11)
+    c1 = sparse.nn.SubmConv3D(2, 4, 3, padding=1, bias_attr=False)
+    c2 = sparse.nn.Conv3D(4, 3, 2, stride=2, bias_attr=False)
+    out = c2(sparse.nn.ReLU()(c1(x)))
+    (out.values() ** 2).sum().backward()
+    assert c1.weight.grad is not None
+    assert np.abs(np.asarray(c1.weight.grad.numpy())).max() > 0
+
+
+def test_sparse_relu_composes_with_conv():
+    rng = np.random.default_rng(8)
+    coords, vals = _random_points(rng, 6, (3, 3, 3), c=2)
+    x = _to_sparse(coords, vals, (1, 3, 3, 3, 2))
+    paddle.seed(9)
+    conv = sparse.nn.SubmConv3D(2, 2, 3, padding=1)
+    y = sparse.nn.ReLU()(conv(x))
+    assert np.asarray(y.values().numpy()).min() >= 0
