@@ -326,13 +326,16 @@ def worker_decode(args, on_tpu):
     prompt = jnp.asarray(rng.integers(0, vocab, (batch, 64)), jnp.int32)
     log(f"bench decode: {cfg} batch={batch} new_tokens={new_tok} "
         f"flash={use_flash}")
-    out = generate(model, prompt, max_new_tokens=new_tok)  # compile
+    cache_dt = args.cache_dtype or "float32"
+    out = generate(model, prompt, max_new_tokens=new_tok,
+                   cache_dtype=cache_dt)  # compile
     float(jnp.sum(out._value if hasattr(out, "_value") else out))
     log("decode compiled; timing ...")
     t0 = time.perf_counter()
     reps = 3
     for _ in range(reps):
-        out = generate(model, prompt, max_new_tokens=new_tok)
+        out = generate(model, prompt, max_new_tokens=new_tok,
+                       cache_dtype=cache_dt)
         _Watchdog.pet()
     float(jnp.sum(out._value if hasattr(out, "_value") else out))
     dt = (time.perf_counter() - t0) / reps
@@ -345,6 +348,7 @@ def worker_decode(args, on_tpu):
         "ms_per_step": round(dt / new_tok * 1e3, 2),
         "flash": use_flash, "flash_kernel": flash_kernel,
         "weight_only": args.weight_only,
+        "cache_dtype": cache_dt,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -636,6 +640,9 @@ def main():
     ap.add_argument("--weight-only", choices=("int8", "int4"), default=None,
                     help="decode: serve with weight-only-quantized linears "
                          "(HBM-bandwidth lever)")
+    ap.add_argument("--cache-dtype", default=None,
+                    help="decode KV cache dtype (bfloat16 halves decode "
+                         "HBM traffic)")
     ap.add_argument("--scan-steps", type=int, default=0,
                     help="run K optimizer steps per compiled call "
                          "(lax.scan) to amortize dispatch latency")
@@ -689,7 +696,8 @@ def main():
     overrides = {"--steps": args.steps, "--batch": args.batch,
                  "--seq": args.seq, "--config": args.config,
                  "--moment-dtype": args.moment_dtype,
-                 "--weight-only": args.weight_only}
+                 "--weight-only": args.weight_only,
+                 "--cache-dtype": args.cache_dtype}
     if len(workloads) == 1:
         for flag, val in overrides.items():
             if val is not None:

@@ -98,7 +98,8 @@ def _seen_from_prompt(ids, vocab_size, pad_token_id=None):
 
 def build_decode_fn(model, max_new_tokens, temperature=1.0, top_k=0,
                     top_p=1.0, repetition_penalty=1.0, eos_token_id=None,
-                    pad_token_id=0, do_sample=None):
+                    pad_token_id=0, do_sample=None,
+                    cache_dtype="float32"):
     """Compile (params, buffers, ids, rng) -> [B, S0+max_new_tokens] ids.
     model must be a GPTForCausalLM (or any model supporting the
     cache/cache_index contract).
@@ -113,6 +114,7 @@ def build_decode_fn(model, max_new_tokens, temperature=1.0, top_k=0,
     if do_sample is None:
         do_sample = bool(temperature > 0 and (top_k or top_p < 1.0))
     sampling = do_sample and temperature > 0
+    cache_dt = jnp.dtype(str(cache_dtype))
 
     def decode(params, buffers, ids, rng):
         from ..autograd import no_grad
@@ -122,7 +124,7 @@ def build_decode_fn(model, max_new_tokens, temperature=1.0, top_k=0,
     def _decode_impl(params, buffers, ids, rng):
         b, s0 = ids.shape
         s_max = s0 + max_new_tokens
-        cache = _alloc_cache(cfg, b, s_max, jnp.float32)
+        cache = _alloc_cache(cfg, b, s_max, cache_dt)
 
         def fwd(tok, cache, idx):
             return _cache_fwd(model, params, buffers, tok, cache, idx)
@@ -184,7 +186,7 @@ def build_decode_fn(model, max_new_tokens, temperature=1.0, top_k=0,
 def build_beam_decode_fn(model, max_new_tokens, num_beams,
                          length_penalty=1.0, eos_token_id=None,
                          pad_token_id=0, temperature=1.0,
-                         repetition_penalty=1.0):
+                         repetition_penalty=1.0, cache_dtype="float32"):
     """Beam search, one XLA program (ref: paddlenlp GenerationMixin
     decode_strategy='beam_search').
 
@@ -219,7 +221,7 @@ def build_beam_decode_fn(model, max_new_tokens, num_beams,
 
         # prefill the [B] prompts ONCE, then tile the cache/logits per
         # beam — k identical prompt forwards would be pure waste
-        cache = _alloc_cache(cfg, b, s_max, jnp.float32)
+        cache = _alloc_cache(cfg, b, s_max, jnp.dtype(str(cache_dtype)))
         logits, cache = fwd(ids, cache, 0)
         cache = jax.tree_util.tree_map(
             lambda a: jnp.repeat(a, k, axis=0), cache)
@@ -302,7 +304,7 @@ def build_beam_decode_fn(model, max_new_tokens, num_beams,
 def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
              top_k=0, top_p=1.0, repetition_penalty=1.0, num_beams=1,
              length_penalty=1.0, eos_token_id=None, pad_token_id=0,
-             decode_strategy=None, seed=0):
+             decode_strategy=None, seed=0, cache_dtype="float32"):
     """One-call jitted decode (compiles once per (B, S0, max_new_tokens)
     shape; reuse via build_decode_fn / build_beam_decode_fn for repeated
     calls). decode_strategy: None (infer from args) | 'greedy_search' |
@@ -326,7 +328,8 @@ def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
             fn = build_beam_decode_fn(model, max_new_tokens, max(num_beams, 1),
                                       length_penalty, eos_token_id,
                                       pad_token_id, temperature,
-                                      repetition_penalty)
+                                      repetition_penalty,
+                                      cache_dtype=cache_dtype)
             out = fn(params, buffers, ids)
         else:
             do_sample = None
@@ -336,7 +339,8 @@ def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
                 do_sample = True
             fn = build_decode_fn(model, max_new_tokens, temperature, top_k,
                                  top_p, repetition_penalty, eos_token_id,
-                                 pad_token_id, do_sample=do_sample)
+                                 pad_token_id, do_sample=do_sample,
+                                 cache_dtype=cache_dtype)
             out = fn(params, buffers, ids, jax.random.PRNGKey(seed))
     finally:
         if was_training:

@@ -182,3 +182,43 @@ def test_beam_one_equals_greedy():
                              decode_strategy="beam_search",
                              num_beams=1)._value)
     np.testing.assert_array_equal(g, b1)
+
+
+def test_bf16_kv_cache_matches_fp32_greedy():
+    """cache_dtype='bfloat16' halves decode HBM traffic (the decode
+    bottleneck); greedy token ids must match the fp32 cache on a small
+    model (logit gaps >> bf16 cache rounding)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import GPTForCausalLM, GPTConfig
+    from paddle_tpu.nlp.generation import generate
+    paddle.seed(21)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0,
+                    use_flash_attention=False)
+    m = GPTForCausalLM(cfg)
+    ids = jnp.asarray(np.array([[3, 5, 7, 9]], dtype=np.int64))
+    a = np.asarray(generate(m, ids, max_new_tokens=8, temperature=0.0))
+    b = np.asarray(generate(m, ids, max_new_tokens=8, temperature=0.0,
+                            cache_dtype="bfloat16"))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_bf16_kv_cache_beam_path_runs():
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp import GPTForCausalLM, GPTConfig
+    from paddle_tpu.nlp.generation import generate
+    paddle.seed(22)
+    cfg = GPTConfig(vocab_size=48, hidden_size=16, num_hidden_layers=1,
+                    num_attention_heads=2, max_position_embeddings=48,
+                    intermediate_size=32)
+    m = GPTForCausalLM(cfg)
+    ids = jnp.asarray(np.array([[1, 2, 3]], dtype=np.int64))
+    out = generate(m, ids, max_new_tokens=5, num_beams=3,
+                   decode_strategy="beam_search", cache_dtype="bfloat16")
+    assert np.asarray(out).shape == (1, 8)

@@ -77,6 +77,11 @@ STAGES = [
                          "--moment-dtype", "bfloat16"], 2400, {}),
     ("decode_probe", [PY, "tools/decode_probe.py"], 2400, {}),
     ("bench_decode", [PY, "bench.py", "--decode"], 2400, {}),
+    ("bench_decode_bf16kv", [PY, "bench.py", "--decode",
+                             "--cache-dtype", "bfloat16"], 2400, {}),
+    ("bench_decode_int8", [PY, "bench.py", "--decode", "--weight-only",
+                           "int8", "--cache-dtype", "bfloat16"], 2400,
+     {}),
     ("fusion_audit", [PY, "tools/fusion_audit.py", "--out",
                       "campaign_out/fusion_audit.md"], 3600, {}),
 ]
