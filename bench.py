@@ -685,6 +685,9 @@ def main():
     if args.weight_only and workloads != ["decode"]:
         ap.error("--weight-only applies to decode serving only "
                  "(use --decode)")
+    if args.cache_dtype and workloads != ["decode"]:
+        ap.error("--cache-dtype applies to decode serving only "
+                 "(use --decode)")
     if args.moment_dtype and not set(workloads) <= {"gpt", "gpt-1.3b"}:
         ap.error("--moment-dtype applies to the gpt training "
                  "workloads only")

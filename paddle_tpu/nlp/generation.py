@@ -203,6 +203,7 @@ def build_beam_decode_fn(model, max_new_tokens, num_beams,
     beams).
     """
     cfg = model.config
+    cache_dt = jnp.dtype(str(cache_dtype))
     k = int(num_beams)
     track_seen = repetition_penalty != 1.0
 
@@ -221,7 +222,7 @@ def build_beam_decode_fn(model, max_new_tokens, num_beams,
 
         # prefill the [B] prompts ONCE, then tile the cache/logits per
         # beam — k identical prompt forwards would be pure waste
-        cache = _alloc_cache(cfg, b, s_max, jnp.dtype(str(cache_dtype)))
+        cache = _alloc_cache(cfg, b, s_max, cache_dt)
         logits, cache = fwd(ids, cache, 0)
         cache = jax.tree_util.tree_map(
             lambda a: jnp.repeat(a, k, axis=0), cache)

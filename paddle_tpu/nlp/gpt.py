@@ -160,8 +160,12 @@ class GPTAttention(Layer):
                 # (causal == "first idx+1 keys are valid" when sq == 1)
                 from ..ops.attention import flash_decode
                 lens = jnp.full((qv.shape[0],), idx + 1, jnp.int32)
-                out = flash_decode(qv, kbuf, vbuf, lens)
-                return out, kbuf, vbuf
+                # a reduced-precision cache (cache_dtype='bfloat16')
+                # must not break the kernel: dot_general needs matching
+                # dtypes, so run the attention in the cache dtype
+                out = flash_decode(qv.astype(kbuf.dtype), kbuf, vbuf,
+                                   lens)
+                return out.astype(qv.dtype), kbuf, vbuf
             # causal validity against absolute positions: query row r sits
             # at position idx+r and may attend keys at positions <= idx+r
             kpos = jnp.arange(s_max)[None, :]

@@ -188,11 +188,6 @@ def test_bf16_kv_cache_matches_fp32_greedy():
     """cache_dtype='bfloat16' halves decode HBM traffic (the decode
     bottleneck); greedy token ids must match the fp32 cache on a small
     model (logit gaps >> bf16 cache rounding)."""
-    import numpy as np
-    import jax.numpy as jnp
-    import paddle_tpu as paddle
-    from paddle_tpu.nlp import GPTForCausalLM, GPTConfig
-    from paddle_tpu.nlp.generation import generate
     paddle.seed(21)
     cfg = GPTConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
                     num_attention_heads=4, max_position_embeddings=64,
@@ -208,11 +203,6 @@ def test_bf16_kv_cache_matches_fp32_greedy():
 
 
 def test_bf16_kv_cache_beam_path_runs():
-    import numpy as np
-    import jax.numpy as jnp
-    import paddle_tpu as paddle
-    from paddle_tpu.nlp import GPTForCausalLM, GPTConfig
-    from paddle_tpu.nlp.generation import generate
     paddle.seed(22)
     cfg = GPTConfig(vocab_size=48, hidden_size=16, num_hidden_layers=1,
                     num_attention_heads=2, max_position_embeddings=48,
