@@ -1,0 +1,78 @@
+"""Dy2static: tensor-dependent Python control flow under @to_static.
+
+Three tiers, mirroring python/paddle/jit/dy2static's story:
+1. simple tensor `if`/`while` — AST-lowered automatically to
+   lax.cond/lax.while_loop on the first trace failure;
+2. the convert_* operators used directly;
+3. un-lowerable patterns — a ControlFlowError that names your function
+   and spells out the cond/while_loop/where migration recipe.
+
+Run: python examples/dynamic_control_flow.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+class AdaptiveScale(paddle.nn.Layer):
+    """Scales by 2 when activations run hot, 0.5 when cold — a
+    data-dependent branch that cannot trace naively."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = paddle.nn.Linear(8, 8)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if (h * h).mean() > 1.0:     # tensor-dependent: auto-lowered
+            y = h * 0.5
+        else:
+            y = h * 2.0
+        return y
+
+
+@paddle.jit.to_static
+def collatz_steps(n):
+    """while over a traced value -> lax.while_loop."""
+    steps = 0
+    while n > 1:
+        n = paddle.where((n % 2) == 0, n // 2, 3 * n + 1)
+        steps = steps + 1
+    return steps
+
+
+def main():
+    paddle.seed(0)
+    net = paddle.jit.to_static(AdaptiveScale())
+    hot = paddle.to_tensor(np.full((2, 8), 3.0, np.float32))
+    cold = paddle.to_tensor(np.full((2, 8), 0.01, np.float32))
+    print("hot branch mean:", float(net(hot).numpy().mean()))
+    print("cold branch mean:", float(net(cold).numpy().mean()))
+
+    n = paddle.to_tensor(np.asarray(27, np.int64))
+    print("collatz(27) steps:", int(np.asarray(collatz_steps(n).numpy())))
+
+    # tier 2: the public convert operators
+    from paddle_tpu.jit.dy2static import convert_ifelse
+    out = convert_ifelse(hot.sum() > 0,
+                         lambda c: (c[0] + 1.0,),
+                         lambda c: (c[0] - 1.0,),
+                         (paddle.to_tensor(np.float32(41.0)),))
+    print("convert_ifelse:", float(np.asarray(out[0].numpy()
+          if hasattr(out[0], 'numpy') else out[0])))
+
+    # tier 3: what un-lowerable control flow looks like
+    @paddle.jit.to_static
+    def early_return(x):
+        if x.sum() > 0:
+            return x * 2          # return inside a tensor branch
+        return x
+
+    try:
+        early_return(hot)
+    except Exception as e:
+        print("\nun-lowerable pattern raises:\n", str(e)[:400], "...")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Point-cloud classification with sparse 3-D convolutions.
+
+A miniature voxel-grid backbone (ref: the SECOND/spconv pattern that
+paddle.sparse.nn serves): SubmConv3D blocks keep the active set fixed,
+a strided Conv3D downsamples, and the dense head classifies. Runs
+end-to-end on CPU in seconds; the gather-matmul-scatter per kernel
+offset rides the MXU on TPU.
+
+Run: python examples/pointcloud_sparse_conv.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def make_cloud(rng, n_points, grid, label):
+    """Synthetic shapes: class 0 = diagonal line, class 1 = plane."""
+    pts = set()
+    while len(pts) < n_points:
+        if label == 0:
+            t = rng.integers(0, grid)
+            p = (t, t, int(np.clip(t + rng.integers(-1, 2), 0, grid - 1)))
+        else:
+            p = (int(rng.integers(0, grid)), int(rng.integers(0, grid)),
+                 grid // 2)
+        pts.add(p)
+    coords = np.asarray([(0, *p) for p in pts], np.int64)
+    feats = rng.standard_normal((len(coords), 4)).astype(np.float32)
+    return coords, feats
+
+
+class SparseNet(paddle.nn.Layer):
+    def __init__(self, grid):
+        super().__init__()
+        self.c1 = sparse.nn.SubmConv3D(4, 16, 3, padding=1)
+        self.c2 = sparse.nn.SubmConv3D(16, 16, 3, padding=1)
+        self.down = sparse.nn.Conv3D(16, 32, 2, stride=2)
+        self.head = paddle.nn.Linear(32, 2)
+        self.grid = grid
+
+    def forward(self, x):
+        x = sparse.nn.ReLU()(self.c1(x))
+        x = sparse.nn.ReLU()(self.c2(x))
+        x = self.down(x)
+        # global mean-pool over the active sites -> dense head
+        feats = x.values()
+        pooled = feats.mean(axis=0, keepdim=True)
+        return self.head(pooled)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    grid = 8
+    paddle.seed(0)
+    net = SparseNet(grid)
+    opt = paddle.optimizer.Adam(5e-3, parameters=net.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+
+    for step in range(60):
+        label = step % 2
+        coords, feats = make_cloud(rng, 20, grid, label)
+        x = sparse.sparse_coo_tensor(coords.T, feats,
+                                     (1, grid, grid, grid, 4))
+        logits = net(x)
+        loss = loss_fn(logits, paddle.to_tensor(
+            np.asarray([label], np.int64)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if step % 20 == 19:
+            print(f"step {step + 1}: loss {float(loss.numpy()):.4f}")
+
+    correct = 0
+    for i in range(20):
+        label = i % 2
+        coords, feats = make_cloud(rng, 20, grid, label)
+        x = sparse.sparse_coo_tensor(coords.T, feats,
+                                     (1, grid, grid, grid, 4))
+        pred = int(np.argmax(np.asarray(net(x).numpy())))
+        correct += int(pred == label)
+    print(f"accuracy on held-out clouds: {correct}/20")
+    assert correct >= 15, "sparse backbone failed to learn"
+
+
+if __name__ == "__main__":
+    main()

@@ -380,14 +380,15 @@ class GPTForCausalLM(FromPretrainedMixin, Layer):
     def generate(self, input_ids, max_new_tokens=20, temperature=1.0,
                  top_k=0, top_p=1.0, repetition_penalty=1.0, num_beams=1,
                  length_penalty=1.0, eos_token_id=None, pad_token_id=0,
-                 decode_strategy=None, seed=None):
+                 decode_strategy=None, seed=None, cache_dtype="float32"):
         """ref: paddlenlp.generation.GenerationMixin. Greedy
         (temperature=0/top_k=0) or top-k sampled decode runs the eager KV-
         cache loop below (parity surface); top_p / repetition_penalty /
         eos early-stop / beam search delegate to the jit-compiled decode
         in paddle_tpu.nlp.generation (one XLA program, the fast path)."""
         if (num_beams > 1 or top_p < 1.0 or repetition_penalty != 1.0
-                or eos_token_id is not None or decode_strategy is not None):
+                or eos_token_id is not None or decode_strategy is not None
+                or str(cache_dtype) != "float32"):
             from .generation import generate as _jit_generate
             return _jit_generate(
                 self, input_ids, max_new_tokens=max_new_tokens,
@@ -395,7 +396,7 @@ class GPTForCausalLM(FromPretrainedMixin, Layer):
                 repetition_penalty=repetition_penalty, num_beams=num_beams,
                 length_penalty=length_penalty, eos_token_id=eos_token_id,
                 pad_token_id=pad_token_id, decode_strategy=decode_strategy,
-                seed=0 if seed is None else seed)
+                seed=0 if seed is None else seed, cache_dtype=cache_dtype)
         was_training = self.training
         self.eval()
         ids = input_ids if isinstance(input_ids, Tensor) else Tensor(input_ids)

@@ -61,6 +61,17 @@ class TestExamples:
         _run("finetune_bert.py")
         assert "epoch 2" in capsys.readouterr().out
 
+    def test_dynamic_control_flow_runs(self, capsys):
+        _run("dynamic_control_flow.py")
+        out = capsys.readouterr().out
+        assert "collatz(27) steps: 111" in out
+        assert "un-lowerable pattern raises" in out
+
+    @pytest.mark.slow
+    def test_pointcloud_sparse_conv_runs(self, capsys):
+        _run("pointcloud_sparse_conv.py")
+        assert "accuracy on held-out clouds" in capsys.readouterr().out
+
 
 class TestIoHelpers:
     def test_get_worker_info_none_in_main(self):

@@ -47,6 +47,7 @@ def test_resnet18_pretrained_true_gives_recipe():
         resnet18(pretrained=True)
 
 
+@pytest.mark.slow
 def test_vgg_pretrained_path(tmp_path):
     from paddle_tpu.vision.models import vgg11
     paddle.seed(1)
