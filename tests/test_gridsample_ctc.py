@@ -1,0 +1,132 @@
+"""Torch-golden parity for grid_sample / affine_grid / ctc_loss —
+previously implemented but never numerically verified (SURVEY marked
+them gated).
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+
+def _np(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+@pytest.mark.parametrize("align", [True, False])
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+def test_grid_sample_matches_torch(align, mode):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 5, 7)).astype(np.float32)
+    grid = (rng.random((2, 4, 6, 2)).astype(np.float32) * 2.4 - 1.2)
+    ours = _np(F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                             mode=mode, padding_mode="zeros",
+                             align_corners=align))
+    ref = tF.grid_sample(torch.from_numpy(x), torch.from_numpy(grid),
+                         mode=mode, padding_mode="zeros",
+                         align_corners=align).numpy()
+    if mode == "nearest":
+        # ties at exactly .5 may round differently; compare off-tie only
+        close = np.isclose(ours, ref, atol=1e-5)
+        assert close.mean() > 0.97, close.mean()
+    else:
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("align", [True, False])
+def test_affine_grid_matches_torch(align):
+    rng = np.random.default_rng(1)
+    theta = rng.standard_normal((2, 2, 3)).astype(np.float32)
+    ours = _np(F.affine_grid(paddle.to_tensor(theta), (2, 3, 4, 5),
+                             align_corners=align))
+    ref = tF.affine_grid(torch.from_numpy(theta), (2, 3, 4, 5),
+                         align_corners=align).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_affine_grid_then_sample_identity():
+    """Identity theta reproduces the input (the STN smoke check)."""
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((1, 2, 6, 6)).astype(np.float32)
+    theta = np.asarray([[[1, 0, 0], [0, 1, 0]]], np.float32)
+    grid = F.affine_grid(paddle.to_tensor(theta), (1, 2, 6, 6),
+                         align_corners=True)
+    out = _np(F.grid_sample(paddle.to_tensor(x), grid,
+                            align_corners=True))
+    np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+
+
+def test_grid_sample_gradients_flow():
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((1, 2, 4, 4)), jnp.float32)
+    grid = jnp.asarray(rng.random((1, 3, 3, 2)) * 1.6 - 0.8, jnp.float32)
+
+    def loss(a, g):
+        out = F.grid_sample(paddle.to_tensor(a), paddle.to_tensor(g))
+        return jnp.sum(out._value ** 2)
+
+    ga, gg = jax.grad(loss, argnums=(0, 1))(x, grid)
+    assert float(jnp.abs(ga).max()) > 0
+    assert float(jnp.abs(gg).max()) > 0
+
+
+def _ctc_fixture(rng, b=3, t=12, c=6, lmax=4):
+    logits = rng.standard_normal((t, b, c)).astype(np.float32)
+    log_probs = torch.log_softmax(torch.from_numpy(logits), dim=-1)
+    labels = rng.integers(1, c, (b, lmax)).astype(np.int64)
+    in_len = np.asarray([t, t - 2, t - 1], np.int64)[:b]
+    lab_len = np.asarray([lmax, lmax - 1, 2], np.int64)[:b]
+    return log_probs, labels, in_len, lab_len
+
+
+def test_ctc_loss_matches_torch_mean():
+    rng = np.random.default_rng(4)
+    log_probs, labels, in_len, lab_len = _ctc_fixture(rng)
+    ref = tF.ctc_loss(log_probs, torch.from_numpy(labels),
+                      torch.from_numpy(in_len), torch.from_numpy(lab_len),
+                      blank=0, reduction="mean").numpy()
+    ours = _np(F.ctc_loss(paddle.to_tensor(log_probs.numpy()),
+                          paddle.to_tensor(labels),
+                          paddle.to_tensor(in_len),
+                          paddle.to_tensor(lab_len), blank=0,
+                          reduction="mean"))
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_matches_torch_none_and_sum():
+    rng = np.random.default_rng(5)
+    log_probs, labels, in_len, lab_len = _ctc_fixture(rng)
+    ref = tF.ctc_loss(log_probs, torch.from_numpy(labels),
+                      torch.from_numpy(in_len), torch.from_numpy(lab_len),
+                      blank=0, reduction="none").numpy()
+    ours = _np(F.ctc_loss(paddle.to_tensor(log_probs.numpy()),
+                          paddle.to_tensor(labels),
+                          paddle.to_tensor(in_len),
+                          paddle.to_tensor(lab_len), blank=0,
+                          reduction="none"))
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+    ref_s = tF.ctc_loss(log_probs, torch.from_numpy(labels),
+                        torch.from_numpy(in_len),
+                        torch.from_numpy(lab_len), blank=0,
+                        reduction="sum").numpy()
+    ours_s = _np(F.ctc_loss(paddle.to_tensor(log_probs.numpy()),
+                            paddle.to_tensor(labels),
+                            paddle.to_tensor(in_len),
+                            paddle.to_tensor(lab_len), blank=0,
+                            reduction="sum"))
+    np.testing.assert_allclose(ours_s, ref_s, rtol=1e-4, atol=1e-3)
+
+
+def test_ctc_layer_form():
+    from paddle_tpu.nn import CTCLoss
+    rng = np.random.default_rng(6)
+    log_probs, labels, in_len, lab_len = _ctc_fixture(rng)
+    loss = CTCLoss(blank=0)(paddle.to_tensor(log_probs.numpy()),
+                            paddle.to_tensor(labels),
+                            paddle.to_tensor(in_len),
+                            paddle.to_tensor(lab_len))
+    assert np.isfinite(float(_np(loss)))
