@@ -313,3 +313,74 @@ def test_undefined_sentinel_is_singleton_static_node():
     assert leaves == [1.0]           # UNDEFINED is structure, not a leaf
     back = jax.tree_util.tree_unflatten(treedef, leaves)
     assert back[0] is UNDEFINED
+
+
+def test_elif_chain_lowered():
+    """elif nests an If inside orelse; the transformer must lower the
+    whole chain."""
+    @paddle.jit.to_static
+    def f(x):
+        s = x.sum()
+        if s > 10.0:
+            y = x * 1.0
+        elif s > 0.0:
+            y = x * 2.0
+        else:
+            y = x * 3.0
+        return y
+
+    np.testing.assert_allclose(f(_x([20.0])).numpy(), [20.0])
+    np.testing.assert_allclose(f(_x([1.0])).numpy(), [2.0])
+    np.testing.assert_allclose(f(_x([-1.0])).numpy(), [-3.0])
+
+
+def test_static_python_branch_untouched():
+    """A Python-valued condition must keep eager short-circuit semantics
+    even after the function was AST-transformed for a tensor branch."""
+    calls = []
+
+    def probe():
+        calls.append(1)
+        return True
+
+    @paddle.jit.to_static
+    def f(x, flag):
+        if flag and probe():         # python condition: lazy evaluation
+            y = x * 2.0
+        else:
+            y = x * 3.0
+        if x.sum() > 0:              # tensor condition: forces transform
+            z = y + 1.0
+        else:
+            z = y - 1.0
+        return z
+
+    np.testing.assert_allclose(f(_x([1.0]), True).numpy(), [3.0])
+    np.testing.assert_allclose(f(_x([1.0]), False).numpy(), [4.0])
+    assert len(calls) >= 1           # probe ran for flag=True traces
+
+
+def test_static_for_range_unrolls():
+    """Static-bound for loops trace by unrolling — no transform, no
+    error."""
+    @paddle.jit.to_static
+    def f(x):
+        for _ in range(3):
+            x = x * 2.0
+        return x
+
+    np.testing.assert_allclose(f(_x([1.0])).numpy(), [8.0])
+
+
+def test_while_with_augassign():
+    @paddle.jit.to_static
+    def f(x):
+        total = x.sum() * 0.0
+        i = 0
+        while i < 4:
+            total += x.sum()
+            i += 1
+        return total
+
+    np.testing.assert_allclose(
+        np.asarray(f(_x([1.5, 0.5])).numpy()), 8.0, rtol=1e-6)

@@ -84,6 +84,7 @@ STAGES = [
      {}),
     ("fusion_audit", [PY, "tools/fusion_audit.py", "--out",
                       "campaign_out/fusion_audit.md"], 3600, {}),
+    ("resnet_roofline", [PY, "tools/resnet_roofline.py"], 2400, {}),
 ]
 
 
