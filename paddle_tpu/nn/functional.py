@@ -954,6 +954,9 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
 
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 align_corners=True, name=None):
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unknown padding_mode {padding_mode!r}")
+
     def f(a, g):
         n, c, h, w = a.shape
         gx, gy = g[..., 0], g[..., 1]
@@ -963,6 +966,25 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
         else:
             fx = ((gx + 1) * w - 1) / 2
             fy = ((gy + 1) * h - 1) / 2
+
+        if padding_mode == "reflection":
+            # reflect the CONTINUOUS coordinate about the sample-area
+            # edges (reference semantics differ by align_corners), then
+            # proceed as border within bounds
+            def reflect(v, size):
+                if align_corners:
+                    if size == 1:
+                        return jnp.zeros_like(v)
+                    span = 2.0 * (size - 1)
+                    v = jnp.abs(jnp.mod(v, span))
+                    return jnp.where(v > size - 1, span - v, v)
+                span = 2.0 * size
+                v = jnp.mod(v + 0.5, span)
+                v = jnp.abs(v)
+                v = jnp.where(v > size, span - v, v)
+                return jnp.clip(v - 0.5, 0, size - 1)
+            fx = reflect(fx, w)
+            fy = reflect(fy, h)
 
         def sample(ix, iy):
             ixc = jnp.clip(ix, 0, w - 1)

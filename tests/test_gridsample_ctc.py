@@ -130,3 +130,25 @@ def test_ctc_layer_form():
                             paddle.to_tensor(in_len),
                             paddle.to_tensor(lab_len))
     assert np.isfinite(float(_np(loss)))
+
+
+@pytest.mark.parametrize("pad", ["border", "reflection"])
+@pytest.mark.parametrize("align", [True, False])
+def test_grid_sample_padding_modes_match_torch(pad, align):
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((2, 2, 5, 6)).astype(np.float32)
+    grid = (rng.random((2, 4, 4, 2)).astype(np.float32) * 3.0 - 1.5)
+    ours = _np(F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                             mode="bilinear", padding_mode=pad,
+                             align_corners=align))
+    ref = tF.grid_sample(torch.from_numpy(x), torch.from_numpy(grid),
+                         mode="bilinear", padding_mode=pad,
+                         align_corners=align).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_grid_sample_unknown_padding_rejected():
+    with pytest.raises(ValueError, match="padding_mode"):
+        F.grid_sample(paddle.to_tensor(np.zeros((1, 1, 2, 2), np.float32)),
+                      paddle.to_tensor(np.zeros((1, 1, 1, 2), np.float32)),
+                      padding_mode="wrap")
