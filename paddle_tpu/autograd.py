@@ -82,14 +82,21 @@ def enable_grad():
 
 class GradNode:
     """One recorded op: pullback + its tensor inputs (strong) and outputs
-    (weak)."""
-    __slots__ = ("inputs", "out_refs", "vjp_fn", "n_outs", "__weakref__")
+    (weak). fwd_fn (the op over its diff inputs, non-diff args closed
+    over) enables functional REPLAY of the subgraph — what
+    grad(create_graph=True) differentiates, since re-deriving the
+    gradients from the inputs is the only way the residual term of the
+    second derivative survives (a vjp-of-the-stored-vjp would treat the
+    residuals as constants and silently drop it)."""
+    __slots__ = ("inputs", "out_refs", "vjp_fn", "fwd_fn", "n_outs",
+                 "__weakref__")
 
-    def __init__(self, inputs, outputs, vjp_fn):
+    def __init__(self, inputs, outputs, vjp_fn, fwd_fn=None):
         self.inputs = inputs                       # list[Tensor]
         self.out_refs = [weakref.ref(o) for o in outputs]
         self.n_outs = len(outputs)
         self.vjp_fn = vjp_fn
+        self.fwd_fn = fwd_fn
 
 
 def _is_tensor(x) -> bool:
@@ -157,12 +164,26 @@ def apply_op(fn: Callable, *args, differentiable: bool = True, **kwargs):
         return run(buf)
 
     out_arrs, vjp_fn = jax.vjp(run_diff, *(arrs[i] for i in diff_pos))
+
+    # replay closure for create_graph: closes over raw ARRAYS and the
+    # treedef only — not the Tensor wrappers `run` pins via `flat` — so
+    # taping an op does not extend wrapper lifetimes on the default path
+    base_flat = [None if j in t_idx else x for j, x in enumerate(flat)]
+
+    def fwd_replay(*darrs):
+        buf = list(base_flat)
+        for j, a in zip(t_idx, arrs):
+            buf[j] = a
+        for i, a in zip(diff_pos, darrs):
+            buf[t_idx[i]] = a
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, buf)
+        return fn(*a2, **k2)
     out_tensors = jax.tree_util.tree_map(
         lambda a: Tensor(a, stop_gradient=False), out_arrs)
     flat_outs = [t for t in jax.tree_util.tree_leaves(
         out_tensors, is_leaf=_is_tensor) if _is_tensor(t)]
     node = GradNode(inputs=[tensors[i] for i in diff_pos],
-                    outputs=flat_outs, vjp_fn=vjp_fn)
+                    outputs=flat_outs, vjp_fn=vjp_fn, fwd_fn=fwd_replay)
     for t in flat_outs:
         t._grad_node = node
     return out_tensors
@@ -299,25 +320,115 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
                 if o is not None:
                     o._grad_node = None
             node.vjp_fn = None
+            node.fwd_fn = None
             node.inputs = []
+
+
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
+    """grad(create_graph=True): functionally REPLAY the recorded
+    subgraph from the inputs (and every requires-grad leaf, so a later
+    backward through the returned grads reaches the parameters — the
+    WGAN-GP pattern), take jax.vjp of the replay, and record the whole
+    thing as ONE tape op. Differentiating the result re-runs jax's
+    second-order machinery over the true function of the inputs, so the
+    residual term of d2y/dx2 is exact (unlike differentiating the stored
+    pullback, which would treat residuals as constants).
+
+    Gradient hooks do not fire on this path (it never walks the tape
+    node-by-node); use backward()/grad(create_graph=False) for hooks."""
+    from .tensor import Tensor
+
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    seeds = tuple(
+        jnp.ones_like(o._value) if g is None
+        else (g._value if _is_tensor(g) else jnp.asarray(g))
+        for o, g in zip(outputs, grad_outputs))
+
+    order = _toposort(outputs)
+    if any(n.fwd_fn is None for n in order):
+        raise RuntimeError(
+            "create_graph=True needs the recorded forward fns; part of "
+            "this graph was built by an op that did not store one")
+    used_ids = {id(t) for node in order for t in node.inputs}
+    for o in outputs:              # an output passed as input is "used"
+        used_ids.add(id(o))
+    unused = [t for t in inputs if id(t) not in used_ids]
+    if unused and not allow_unused:
+        raise ValueError(
+            "some inputs are not reachable from outputs; pass "
+            "allow_unused=True to get None gradients for them")
+    # duplicates in `inputs` would fight over the id-keyed replay env;
+    # differentiate once per unique tensor and fan the result back out
+    uniq, uniq_ids = [], set()
+    for t in inputs:
+        if id(t) not in uniq_ids:
+            uniq_ids.add(id(t))
+            uniq.append(t)
+    in_ids = {id(t) for t in uniq}
+    leaves = []                    # requires-grad leaves beyond `inputs`
+    seen = set(in_ids)
+    for node in order:
+        for t in node.inputs:
+            if (getattr(t, "_grad_node", None) is None
+                    and not t.stop_gradient and id(t) not in seen):
+                seen.add(id(t))
+                leaves.append(t)
+    n_in = len(uniq)
+
+    def gradfn(*all_arrs):
+        in_arrs, leaf_arrs = all_arrs[:n_in], all_arrs[n_in:]
+
+        def replay(*xs):
+            env = {id(t): a for t, a in zip(uniq, xs)}
+            env.update({id(t): a for t, a in zip(leaves, leaf_arrs)})
+            for node in reversed(order):    # producers first
+                vals = [env.get(id(t), t._value) for t in node.inputs]
+                outs = jax.tree_util.tree_leaves(node.fwd_fn(*vals))
+                for ref, o in zip(node.out_refs, outs):
+                    ot = ref()
+                    # never overwrite a SEEDED value: for a non-leaf
+                    # input the producer also replays, and clobbering
+                    # the tracer would sever the vjp dependence
+                    if ot is not None and id(ot) not in in_ids:
+                        env[id(ot)] = o
+            return tuple(env.get(id(o), o._value) for o in outputs)
+
+        _, vjp = jax.vjp(replay, *in_arrs)
+        res = vjp(seeds)
+        # a bare array for the single-input case: the tape seeds a
+        # 1-output node with the raw cotangent, not a 1-tuple
+        return res[0] if len(res) == 1 else res
+
+    # create_graph means BUILD the graph — even under no_grad (the
+    # reference semantics); without taping, the later backward through
+    # the returned grads would be a silent no-op
+    with enable_grad():
+        grads = apply_op(gradfn, *uniq, *leaves)
+    grads = list(grads) if isinstance(grads, (tuple, list)) else [grads]
+    by_id = {id(t): g for t, g in zip(uniq, grads)}
+    return [None if id(t) not in used_ids else by_id[id(t)]
+            for t in inputs]
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, allow_unused=False):
     """ref: paddle.grad — gradients of outputs w.r.t. inputs via the eager
-    graph. create_graph (double grad) is not supported here; use
-    paddle_tpu.functional_grad (jax.grad composition) instead.
+    graph. create_graph=True returns gradients that are themselves on
+    the tape (functional replay — see _grad_create_graph), enabling
+    double/triple grad and gradient penalties.
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: compose paddle_tpu.value_and_grad / jax.grad "
-            "for higher-order gradients (functional path).")
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
+    if create_graph:
+        return _grad_create_graph(outputs, inputs, grad_outputs,
+                                  allow_unused)
     keep = {id(t): t._grad_value for t in inputs}
     retain = [t._retain_grads for t in inputs]
     for t in inputs:

@@ -956,6 +956,8 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 align_corners=True, name=None):
     if padding_mode not in ("zeros", "border", "reflection"):
         raise ValueError(f"unknown padding_mode {padding_mode!r}")
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unknown mode {mode!r} (bilinear | nearest)")
 
     def f(a, g):
         n, c, h, w = a.shape

@@ -1,0 +1,150 @@
+"""paddle.grad(create_graph=True) — double backward through the eager
+tape via functional replay (ref: paddle.grad double-grad, the
+gradient-penalty workhorse).
+
+The replay re-derives gradients as a function of the inputs, so the
+residual term of the second derivative is exact; recording the stored
+pullback instead would give d2(x^2)/dx2 == 0.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(v, sg=False):
+    return paddle.to_tensor(np.asarray(v, np.float32), stop_gradient=sg)
+
+
+def test_second_derivative_exact():
+    x = _t([2.0, -1.0, 0.5])
+    y = x * x * x
+    (g,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g.numpy(), 3 * np.array([4.0, 1.0, 0.25]),
+                               rtol=1e-6)
+    g.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               6 * np.array([2.0, -1.0, 0.5]), rtol=1e-6)
+
+
+def test_residual_term_not_dropped():
+    """The canonical failure of naive vjp-of-vjp: y = x*x has
+    d2y/dx2 = 2, which lives entirely in the residual term."""
+    x = _t([3.0])
+    (g,) = paddle.grad(x * x, x, create_graph=True)
+    g.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0], rtol=1e-6)
+
+
+def test_triple_nesting():
+    """grad of grad of grad: d3(x^4)/dx3 = 24x."""
+    x = _t([1.5])
+    (g1,) = paddle.grad(x * x * x * x, x, create_graph=True)
+    (g2,) = paddle.grad(g1, x, create_graph=True)
+    g2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [24 * 1.5], rtol=1e-5)
+
+
+def test_wgan_gp_param_grads_match_functional():
+    """Gradient-penalty loss: second-order grads into the layer params
+    must equal the pure jax.grad reference."""
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.Tanh(),
+                               paddle.nn.Linear(8, 1))
+    rng = np.random.default_rng(0)
+    xin_np = rng.standard_normal((3, 4)).astype(np.float32)
+
+    xin = _t(xin_np)
+    out = net(xin)
+    (gx,) = paddle.grad(out.sum(), xin, create_graph=True)
+    gp = ((gx * gx).sum(axis=1).sqrt() - 1.0)
+    ((gp * gp).mean()).backward()
+
+    # functional reference over the same params
+    from paddle_tpu.nn.layer import functional_call
+    from paddle_tpu.tensor import Tensor
+    params, buffers = net.raw_state()
+
+    def penalty(p, x):
+        def f(xx):
+            o = functional_call(net, p, buffers, Tensor(xx))
+            return jnp.sum(o._value)
+        g = jax.grad(f)(x)
+        gp = jnp.sqrt(jnp.sum(g * g, axis=1)) - 1.0
+        return jnp.mean(gp * gp)
+
+    ref = jax.grad(penalty)(params, jnp.asarray(xin_np))
+    for name, p in net.named_parameters():
+        np.testing.assert_allclose(np.asarray(p.grad.numpy()),
+                                   np.asarray(ref[name]), rtol=1e-4,
+                                   atol=1e-5, err_msg=name)
+
+
+def test_unused_input_allow_unused():
+    x = _t([1.0])
+    z = _t([5.0])
+    y = x * 2.0
+    with pytest.raises(ValueError, match="allow_unused"):
+        paddle.grad(y, [x, z], create_graph=True)
+    gx, gz = paddle.grad(y, [x, z], create_graph=True, allow_unused=True)
+    assert gz is None
+    np.testing.assert_allclose(gx.numpy(), [2.0])
+
+
+def test_grad_outputs_seed():
+    x = _t([1.0, 2.0])
+    y = x * x
+    (g,) = paddle.grad(y, x, grad_outputs=_t([3.0, 5.0], sg=True),
+                       create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [6.0, 20.0], rtol=1e-6)
+    g.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 10.0], rtol=1e-6)
+
+
+def test_first_order_path_unchanged():
+    x = _t([4.0])
+    (g,) = paddle.grad(x * x, x)            # create_graph=False default
+    np.testing.assert_allclose(g.numpy(), [8.0])
+    assert x.grad is None                   # grad() doesn't write .grad
+
+
+def test_non_leaf_input():
+    """grad w.r.t. an INTERMEDIATE tensor: the replay must not clobber
+    the seeded value with the recomputed producer output."""
+    x = _t([3.0])
+    h = x * x
+    y = (h * h).sum()
+    (gh,) = paddle.grad(y, h, create_graph=True)
+    np.testing.assert_allclose(gh.numpy(), [2 * 9.0], rtol=1e-6)  # 2h
+    gh.backward()
+    # d(2h)/dh == 2, deposited on... h is non-leaf; grads flow to x:
+    # d(2h)/dx = 2 * dh/dx = 4x
+    np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-6)
+
+
+def test_duplicate_inputs_consistent():
+    x = _t([2.0])
+    y = (x * x).sum()
+    g1, g2 = paddle.grad(y, [x, x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), [4.0], rtol=1e-6)
+    np.testing.assert_allclose(g2.numpy(), [4.0], rtol=1e-6)
+
+
+def test_create_graph_inside_no_grad():
+    """create_graph means BUILD the graph even under no_grad (the
+    reference semantics) — the later backward must not be a no-op."""
+    x = _t([2.0])
+    y = x * x * x
+    with paddle.no_grad():
+        (g,) = paddle.grad(y, x, create_graph=True)
+    g.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-6)
+
+
+def test_grid_sample_unknown_mode_rejected():
+    from paddle_tpu.nn import functional as F
+    with pytest.raises(ValueError, match="mode"):
+        F.grid_sample(_t(np.zeros((1, 1, 2, 2)), sg=True),
+                      _t(np.zeros((1, 1, 1, 2)), sg=True), mode="bicubic")
