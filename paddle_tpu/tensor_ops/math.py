@@ -419,6 +419,26 @@ def isreal(x, name=None):
     return apply_op(jnp.isreal, _c(x))
 
 
+def is_floating_point(x, name=None):
+    """ref: paddle.is_floating_point."""
+    from ..tensor import Tensor
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return bool(jnp.issubdtype(v.dtype, jnp.floating))
+
+
+def is_complex(x, name=None):
+    """ref: paddle.is_complex."""
+    from ..tensor import Tensor
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return bool(jnp.issubdtype(v.dtype, jnp.complexfloating))
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    """ref: paddle.isin — elementwise membership of x in test_x."""
+    return apply_op(
+        lambda a, t: jnp.isin(a, t, invert=invert), _c(x), _c(test_x))
+
+
 def vdot(x, y, name=None):
     return apply_op(jnp.vdot, _c(x), _c(y))
 
@@ -464,7 +484,8 @@ def cartesian_prod(*tensors, name=None):
 
 __all__ += [
     "nextafter", "xlogy", "i0e", "igamma", "igammac", "gammainc",
-    "gammaincc", "signbit", "isreal", "vdot", "renorm", "combinations",
+    "gammaincc", "signbit", "isreal", "is_floating_point", "is_complex",
+    "isin", "vdot", "renorm", "combinations",
     "cartesian_prod",
 ]
 

@@ -150,3 +150,19 @@ class TestManipStatLongtail:
         assert np.allclose(hb2, [1, 2, 3])
         assert np.allclose(
             _np(paddle.trapz(t(np.array([0.0, 1.0, 2.0])))), 2.0)
+
+
+def test_is_floating_point_is_complex_isin():
+    import numpy as np
+    import paddle_tpu as paddle
+    assert paddle.is_floating_point(paddle.to_tensor(np.float32(1.0)))
+    assert not paddle.is_floating_point(paddle.to_tensor(np.int64(1)))
+    assert paddle.is_complex(paddle.to_tensor(np.complex64(1j)))
+    assert not paddle.is_complex(paddle.to_tensor(np.float32(0.0)))
+    got = paddle.isin(paddle.to_tensor(np.array([1, 2, 3, 4])),
+                      paddle.to_tensor(np.array([2, 4])))
+    np.testing.assert_array_equal(np.asarray(got.numpy()),
+                                  [False, True, False, True])
+    inv = paddle.isin(paddle.to_tensor(np.array([1, 2])),
+                      paddle.to_tensor(np.array([2])), invert=True)
+    np.testing.assert_array_equal(np.asarray(inv.numpy()), [True, False])
