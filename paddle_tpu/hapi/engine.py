@@ -48,6 +48,7 @@ class Engine:
         self._opt_state = None
         self._step = 0
         self._train_fn = None
+        self._multi_fns = {}
         self._eval_fn = None
         self._pred_fn = None
         self._rng_key = jax.random.PRNGKey(0)
@@ -397,6 +398,107 @@ class Engine:
             self.network.load_raw_state(self._params, self._buffers)
         return loss_v, outs
 
+    def train_batch_multi(self, inputs, labels, lr_values=None):
+        """Run K optimizer steps in ONE device dispatch: inputs/labels
+        are lists of STACKED arrays [K, batch, ...] and the K steps run
+        inside a compiled lax.scan.
+
+        TPU-native perf lever: each dispatch to a (remote) backend costs
+        ~ms of latency; a K-step scan amortizes it K-fold (bench.py
+        --scan-steps uses the same construction — this is its public
+        form). Semantics match K train_batch calls exactly (per-step rng
+        folding, update counters), with the learning rate CONSTANT
+        across the window unless lr_values [K] supplies a schedule; the
+        LR scheduler object is advanced by the caller per update as
+        usual. A pending gradient-accumulation window is flushed first.
+        Returns (losses [K], None) — per-step model outputs are not
+        materialized (that would double-compute the last forward); use
+        train_batch when outputs/metrics are needed."""
+        if self.network.training is False:
+            self.network.train()
+        self._ensure_opt_state()
+        if self._micro_count:
+            self.flush_accum()
+        if self._train_fn is None:
+            self._train_fn = self._build_train_fn()
+        in_arrs = self._shard_batch_stacked(_unwrap(list(inputs)))
+        lab_arrs = self._shard_batch_stacked(_unwrap(list(labels)))
+        lead = {a.shape[0] for a in jax.tree_util.tree_leaves(
+            (in_arrs, lab_arrs)) if hasattr(a, "shape") and a.ndim >= 1}
+        if len(lead) != 1:
+            # validate BEFORE touching counters: a failed call must not
+            # skew _step/_opt_step (rng folds + Adam bias correction)
+            raise ValueError(
+                f"stacked inputs/labels disagree on K: {sorted(lead)}")
+        k = int(next(iter(lead)))
+        if lr_values is None:
+            lrs = np.full((k,), self._lr_now(), np.float32)
+        else:
+            lrs = np.asarray(lr_values, np.float32)
+            if lrs.shape != (k,):
+                raise ValueError(f"lr_values must have shape ({k},)")
+        # cache key includes the train_fn identity: any site that
+        # rebuilds _train_fn (resume/re-placement) invalidates these
+        # closures implicitly, with no second attribute to remember
+        cache_key = (k, id(self._train_fn))
+        multi = self._multi_fns.get(cache_key)
+        if multi is None:
+            fn = self._train_fn
+
+            def multi_step(params, buffers, opt_state, lrs, step0,
+                           opt_step0, rng, ins, labs):
+                def body(carry, xs):
+                    p, b, s = carry
+                    i, lr_i, xi, yi = xs
+                    p, b, s, loss_i, _ = fn(
+                        p, b, s, lr_i, step0 + i, opt_step0 + i, rng,
+                        list(xi), list(yi))
+                    return (p, b, s), loss_i
+                (p, b, s), losses = jax.lax.scan(
+                    body, (params, buffers, opt_state),
+                    (jnp.arange(k, dtype=jnp.int32), lrs,
+                     tuple(ins), tuple(labs)))
+                # one extra forward for the last step's outputs would
+                # double-compute; callers needing per-step outputs
+                # should use train_batch
+                return p, b, s, losses
+
+            multi = jax.jit(multi_step,
+                            donate_argnums=(0, 1, 2) if self.donate
+                            else ())
+            if len(self._multi_fns) > 8:
+                self._multi_fns.clear()
+            self._multi_fns[cache_key] = multi
+        step0, opt_step0 = self._step + 1, self._opt_step + 1
+        self._step += k
+        self._opt_step += k
+        (self._params, self._buffers, self._opt_state, losses) = multi(
+            self._params, self._buffers, self._opt_state, lrs,
+            np.int32(step0), np.int32(opt_step0), self._rng_key,
+            in_arrs, lab_arrs)
+        if self.donate:
+            self.network.load_raw_state(self._params, self._buffers)
+        return losses, None
+
+    def _shard_batch_stacked(self, arrs):
+        """dp placement for [K, batch, ...] stacks: batch is dim 1
+        (tree-mapped like _shard_batch, so nested containers work)."""
+        if self.mesh is None or "dp" not in self.mesh.axis_names:
+            return arrs
+        from jax.sharding import NamedSharding, PartitionSpec
+        sh = NamedSharding(self.mesh, PartitionSpec(None, "dp"))
+        ndp = self.mesh.shape["dp"]
+
+        def place(a):
+            if not (hasattr(a, "ndim") and a.ndim >= 2):
+                return a
+            if a.shape[1] % ndp:
+                raise ValueError(
+                    f"stacked batch dim {a.shape[1]} not divisible by "
+                    f"the dp mesh axis ({ndp})")
+            return jax.device_put(a, sh)
+        return jax.tree_util.tree_map(place, arrs)
+
     def eval_batch(self, inputs, labels=()):
         if self.network.training:
             self.network.eval()
@@ -446,5 +548,6 @@ class Engine:
         if getattr(self.optimizer, "_group_sharded", None) is not None:
             self._apply_zero_placement()
             self._train_fn = None
+            self._multi_fns = {}
             self._grad_fn = None
             self._apply_fn = None
