@@ -18,7 +18,8 @@ __all__ = ["Compose", "ToTensor", "Resize", "RandomHorizontalFlip",
            "RandomCrop", "RandomResizedCrop", "Pad", "BrightnessTransform",
            "ContrastTransform", "SaturationTransform", "HueTransform",
            "ColorJitter", "to_tensor", "normalize", "resize",
-           "hflip", "vflip", "center_crop", "crop", "pad"]
+           "hflip", "vflip", "center_crop", "crop", "pad",
+           "erase", "affine", "perspective"]
 
 
 def _size2(size):
@@ -347,6 +348,75 @@ def to_grayscale(img, num_output_channels=1):
     return np.clip(out, 0, 255).astype(adt) if adt == np.uint8 else out
 
 
+def erase(img, i, j, h, w, v, inplace=False):
+    """ref: paddle.vision.transforms.erase — set the [i:i+h, j:j+w]
+    rectangle to value `v` (scalar or broadcastable array)."""
+    a = np.asarray(img)
+    if not inplace:
+        a = a.copy()
+    vv = np.asarray(v)
+    a[i:i + h, j:j + w] = vv.astype(a.dtype) if vv.dtype != a.dtype \
+        else vv
+    return a
+
+
+def affine(img, angle, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    """ref: paddle.vision.transforms.affine — deterministic affine
+    resample: rotation (degrees) + translation (px) + scale + shear
+    (degrees, x then optional y), about `center` (default image
+    center). The inverse-map core shared with RandomAffine."""
+    a = np.asarray(img)
+    h, w = a.shape[:2]
+    if isinstance(shear, (int, float)):
+        shear = (shear, 0.0)
+    shx, shy = (tuple(shear) + (0.0,))[:2]
+    tx, ty = translate
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    ang, shx, shy = (math.radians(angle), math.radians(shx),
+                     math.radians(shy))
+    cos, sin = math.cos(ang), math.sin(ang)
+    S = np.array([[1.0, math.tan(shx)], [math.tan(shy), 1.0]])
+    R = np.array([[cos, -sin], [sin, cos]])
+    M = (R @ S) * scale
+    Minv = np.linalg.inv(M)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    dx = xx - cx - tx
+    dy = yy - cy - ty
+    xs = Minv[0, 0] * dx + Minv[0, 1] * dy + cx
+    ys = Minv[1, 0] * dx + Minv[1, 1] * dy + cy
+    return _inverse_map_sample(a, xs, ys, interpolation, fill)
+
+
+def _homography(src_pts, dst_pts):
+    A = []
+    for (x, y), (u, v) in zip(src_pts, dst_pts):
+        A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+        A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+    A = np.asarray(A, np.float64)
+    b = np.asarray(dst_pts, np.float64).reshape(-1)
+    h8 = np.linalg.solve(A, b)
+    return np.append(h8, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """ref: paddle.vision.transforms.perspective — projective warp
+    taking the 4 startpoints to the 4 endpoints (inverse-map
+    resample)."""
+    a = np.asarray(img)
+    h, w = a.shape[:2]
+    M = _homography(endpoints, startpoints)   # output pixel -> source
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ones = np.ones_like(xx)
+    pts = np.stack([xx, yy, ones], 0).reshape(3, -1)
+    mapped = M @ pts
+    xs = (mapped[0] / mapped[2]).reshape(h, w)
+    ys = (mapped[1] / mapped[2]).reshape(h, w)
+    return _inverse_map_sample(a, xs, ys, interpolation, fill)
+
+
 def _inverse_map_sample(a, xs, ys, interpolation="nearest", fill=0):
     """Sample source image `a` at float positions (ys, xs) (one per output
     pixel); out-of-bounds positions take `fill`. Shared by rotate /
@@ -517,10 +587,10 @@ class RandomErasing(BaseTransform):
                     noise = np.random.standard_normal(patch_shape)
                     if a.dtype == np.uint8:
                         noise = np.clip(noise * 255, 0, 255)
-                    a[top:top + eh, left:left + ew] = noise.astype(a.dtype)
-                else:
-                    a[top:top + eh, left:left + ew] = self.value
-                return a
+                    return erase(a, top, left, eh, ew,
+                                 noise.astype(a.dtype), inplace=True)
+                return erase(a, top, left, eh, ew, self.value,
+                             inplace=True)
         return a
 
 
@@ -546,34 +616,21 @@ class RandomAffine(BaseTransform):
     def _apply_image(self, img):
         a = np.asarray(img)
         h, w = a.shape[:2]
-        angle = math.radians(random.uniform(*self.degrees))
+        angle = random.uniform(*self.degrees)
         s = (random.uniform(*self.scale_range)
              if self.scale_range is not None else 1.0)
         shx = shy = 0.0
         if self.shear is not None:
-            shx = math.radians(random.uniform(self.shear[0], self.shear[1]))
+            shx = random.uniform(self.shear[0], self.shear[1])
             if len(self.shear) == 4:
-                shy = math.radians(random.uniform(self.shear[2],
-                                                  self.shear[3]))
+                shy = random.uniform(self.shear[2], self.shear[3])
         tx = (random.uniform(-self.translate[0], self.translate[0]) * w
               if self.translate is not None else 0.0)
         ty = (random.uniform(-self.translate[1], self.translate[1]) * h
               if self.translate is not None else 0.0)
-        cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if self.center is None \
-            else (self.center[1], self.center[0])
-        # forward M = R(angle) @ Shear(shx, shy) scaled by s, about the
-        # center, plus translation; resample with the inverse map
-        cos, sin = math.cos(angle), math.sin(angle)
-        S = np.array([[1.0, math.tan(shx)], [math.tan(shy), 1.0]])
-        R = np.array([[cos, -sin], [sin, cos]])
-        M = (R @ S) * s
-        Minv = np.linalg.inv(M)
-        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
-        dx = xx - cx - tx
-        dy = yy - cy - ty
-        xs = Minv[0, 0] * dx + Minv[0, 1] * dy + cx
-        ys = Minv[1, 0] * dx + Minv[1, 1] * dy + cy
-        return _inverse_map_sample(a, xs, ys, self.interpolation, self.fill)
+        return affine(a, angle, (tx, ty), s, (shx, shy),
+                      interpolation=self.interpolation, fill=self.fill,
+                      center=self.center)
 
 
 class RandomPerspective(BaseTransform):
@@ -587,17 +644,6 @@ class RandomPerspective(BaseTransform):
         self.interpolation = interpolation
         self.fill = fill
 
-    @staticmethod
-    def _homography(src, dst):
-        A = []
-        for (x, y), (u, v) in zip(src, dst):
-            A.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
-            A.append([0, 0, 0, x, y, 1, -v * x, -v * y])
-        A = np.asarray(A, np.float64)
-        b = np.asarray(dst, np.float64).reshape(-1)
-        h8 = np.linalg.solve(A, b)
-        return np.append(h8, 1.0).reshape(3, 3)
-
     def _apply_image(self, img):
         if random.random() > self.prob:
             return img
@@ -606,18 +652,11 @@ class RandomPerspective(BaseTransform):
         d = self.distortion_scale
         dx = lambda: random.uniform(0, d * w / 2)  # noqa: E731
         dy = lambda: random.uniform(0, d * h / 2)  # noqa: E731
-        dst = [(dx(), dy()), (w - 1 - dx(), dy()),
-               (w - 1 - dx(), h - 1 - dy()), (dx(), h - 1 - dy())]
-        src = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
-        # inverse map: output pixel -> source position
-        M = self._homography(dst, src)
-        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
-        ones = np.ones_like(xx)
-        pts = np.stack([xx, yy, ones], 0).reshape(3, -1)
-        mapped = M @ pts
-        xs = (mapped[0] / mapped[2]).reshape(h, w)
-        ys = (mapped[1] / mapped[2]).reshape(h, w)
-        return _inverse_map_sample(a, xs, ys, self.interpolation, self.fill)
+        endpoints = [(dx(), dy()), (w - 1 - dx(), dy()),
+                     (w - 1 - dx(), h - 1 - dy()), (dx(), h - 1 - dy())]
+        startpoints = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return perspective(a, startpoints, endpoints,
+                           self.interpolation, self.fill)
 
 
 class ToPILImage(BaseTransform):

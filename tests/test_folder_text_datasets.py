@@ -513,3 +513,20 @@ def test_movielens_split_is_order_independent(tmp_path):
     tr_b = {key(s) for s in Movielens(data_file=str(tmp_path / "b"),
                                       mode="train", test_ratio=0.5).samples}
     assert tr_a == tr_b                 # membership keyed on the pair
+
+
+def test_functional_erase_affine_perspective():
+    """r4: the deterministic functional forms behind the Random*
+    transforms (ref: paddle.vision.transforms.erase/affine/perspective)."""
+    from paddle_tpu.vision import transforms as T
+    img = np.arange(5 * 6 * 3, dtype=np.uint8).reshape(5, 6, 3)
+    e = T.erase(img, 1, 2, 2, 3, 7)
+    assert (e[1:3, 2:5] == 7).all()
+    assert (e[0] == img[0]).all()           # copy by default
+    np.testing.assert_array_equal(T.affine(img, angle=0.0), img)
+    corners = [(0, 0), (5, 0), (5, 4), (0, 4)]
+    np.testing.assert_array_equal(
+        T.perspective(img, corners, corners), img)
+    # 180-degree rotation is an exact double flip about the center
+    r = T.affine(img.astype(np.float32), angle=180.0)
+    np.testing.assert_allclose(r, img[::-1, ::-1].astype(np.float32))
