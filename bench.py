@@ -131,7 +131,6 @@ def run(eng, batch, seq, steps, warmup, scan_steps=0):
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from functools import partial
     rng = np.random.default_rng(0)
     vocab = eng.network.config.vocab_size
     ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), dtype=jnp.int32)
@@ -147,45 +146,21 @@ def run(eng, batch, seq, steps, warmup, scan_steps=0):
         log(f"  warmup step {i}: {time.perf_counter() - t:.2f}s")
     log(f"warmup done, loss={float(loss):.4f}")
     if scan_steps:
-        # amortize the per-dispatch tunnel latency (~6 ms on axon): run K
-        # real optimizer steps inside ONE compiled lax.scan per call
-        fn = eng._train_fn.__wrapped__ if hasattr(eng._train_fn, "__wrapped__") \
-            else eng._train_fn
-        key = eng._rng_key
+        # amortize the per-dispatch tunnel latency (~6 ms on axon): K
+        # real optimizer steps per compiled call — the public
+        # Engine.train_batch_multi (this bench construction, promoted)
         k = int(scan_steps)
-
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def multi(params, buffers, opt_state, step0):
-            def body(carry, i):
-                p, b, s = carry
-                # rng-step and opt-step advance together here (no
-                # accumulation inside the bench window)
-                p, b, s, l, _ = fn(p, b, s, np.float32(eng._lr_now()),
-                                   step0 + i, step0 + i, key,
-                                   [ids], [labels])
-                return (p, b, s), l
-            (p, b, s), ls = jax.lax.scan(
-                body, (params, buffers, opt_state),
-                jnp.arange(k, dtype=jnp.int32))
-            return p, b, s, ls[-1]
-
-        params, buffers, opt_state = eng._params, eng._buffers, eng._opt_state
-        params, buffers, opt_state, l = multi(params, buffers, opt_state,
-                                              np.int32(eng._step))
-        float(l)  # compile + warm
+        ids_k = jnp.broadcast_to(ids, (k,) + ids.shape)
+        labels_k = jnp.broadcast_to(labels, (k,) + labels.shape)
+        losses, _ = eng.train_batch_multi([ids_k], [labels_k])  # compile
+        float(losses[-1])
         t0 = time.perf_counter()
         calls = max(1, steps // k)
-        for c in range(calls):
-            params, buffers, opt_state, l = multi(
-                params, buffers, opt_state, np.int32(eng._step + (c + 1) * k))
+        for _ in range(calls):
+            losses, _ = eng.train_batch_multi([ids_k], [labels_k])
             _Watchdog.pet()
-        float(l)
+        float(losses[-1])
         dt = time.perf_counter() - t0
-        # donation deleted the engine's old arrays: rebind so any later
-        # train_batch/save on this engine sees live state
-        eng._params, eng._buffers, eng._opt_state = params, buffers, opt_state
-        eng._step += k * (calls + 1)
-        eng.network.load_raw_state(params, buffers)
         return batch * seq * k * calls / dt
     t0 = time.perf_counter()
     for i in range(steps):
