@@ -7,6 +7,7 @@ TPU MXU / vector unit.
 """
 from __future__ import annotations
 
+import functools
 import math
 
 import jax
@@ -776,6 +777,73 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 # ---------------------------------------------------------------------------
 # vision ops
 # ---------------------------------------------------------------------------
+@functools.lru_cache(maxsize=256)
+def _resize_weight_matrix(in_len, out_len, kind, align_corners,
+                          align_mode=0):
+    """[out_len, in_len] numpy weights reproducing the reference
+    resampling exactly: nearest (legacy floor(i*scale)), linear/cubic
+    (half-pixel when align_corners=False and align_mode=0, asymmetric
+    i*scale when align_mode=1, corner-aligned when align_corners=True;
+    cubic is Keys a=-0.75 with border replicate), and area (adaptive
+    mean over [floor(i*s), ceil((i+1)*s)) windows)."""
+    W = np.zeros((out_len, in_len), np.float32)
+    scale = in_len / out_len
+    if kind == "nearest":
+        if align_corners and out_len > 1:
+            # reference rounds ties UP (static_cast<int>(ratio*i + .5)),
+            # not numpy's ties-to-even
+            src = np.floor(np.arange(out_len) * ((in_len - 1)
+                           / (out_len - 1)) + 0.5).astype(np.int64)
+        else:
+            src = np.floor(np.arange(out_len) * scale).astype(np.int64)
+        W[np.arange(out_len), np.clip(src, 0, in_len - 1)] = 1.0
+        return W
+    if kind == "area":
+        # INTEGER window bounds (the reference's adaptive-pool formula);
+        # float floor/ceil drifts for e.g. in=21,out=19 and silently
+        # breaks the weights' sum-to-1
+        for i in range(out_len):
+            lo = (i * in_len) // out_len
+            hi = -((-(i + 1) * in_len) // out_len)     # ceil-div
+            W[i, lo:hi] = 1.0 / (hi - lo)
+        return W
+    # continuous source positions for linear/cubic
+    i = np.arange(out_len, dtype=np.float64)
+    if align_corners:
+        src = i * ((in_len - 1) / (out_len - 1)) if out_len > 1 \
+            else np.zeros((1,))
+    elif align_mode == 1 and kind == "linear":
+        # align_mode only affects the linear family in the reference;
+        # bicubic always samples half-pixel
+        src = i * scale
+    else:
+        src = (i + 0.5) * scale - 0.5
+    if kind == "linear":
+        src = np.clip(src, 0, in_len - 1)
+        lo = np.floor(src).astype(np.int64)
+        hi = np.minimum(lo + 1, in_len - 1)
+        w = src - lo
+        np.add.at(W, (np.arange(out_len), lo), (1.0 - w))
+        np.add.at(W, (np.arange(out_len), hi), w)
+        return W
+    # cubic: Keys kernel a=-0.75, 4 taps, border replicate (weights from
+    # UNCLAMPED distances accumulated into clamped indices — torch/paddle)
+    a = -0.75
+
+    def k(t):
+        t = np.abs(t)
+        return np.where(
+            t <= 1, ((a + 2) * t - (a + 3)) * t * t + 1,
+            np.where(t < 2, (((t - 5) * t + 8) * t - 4) * a, 0.0))
+    lo = np.floor(src).astype(np.int64)
+    for tap in (-1, 0, 1, 2):
+        idx = lo + tap
+        wt = k(src - idx)
+        np.add.at(W, (np.arange(out_len), np.clip(idx, 0, in_len - 1)),
+                  wt)
+    return W
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
@@ -791,35 +859,30 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nsp
         out_sp = tuple(int(math.floor(s * f)) for s, f in zip(sp_shape, sf))
 
-    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
-             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    base = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+            "trilinear": "linear", "bicubic": "cubic", "area": "area"}
+    if mode not in base:
+        raise ValueError(f"unknown interpolate mode {mode!r}")
+    kind = base[mode]
+    sp_axes = (list(range(1, 1 + nsp)) if channel_last
+               else list(range(2, 2 + nsp)))
+    # exact reference sampling as ONE static [out, in] weight matrix per
+    # spatial axis (separable for every supported mode) — a matmul per
+    # axis, which is both bit-exact vs the reference formulas and what
+    # the MXU wants; jax.image.resize is NOT used (its antialiased
+    # downscale and half-pixel nearest diverge from paddle/torch)
+    mats = [_resize_weight_matrix(int(sp_shape[d]), int(out_sp[d]), kind,
+                                  align_corners, align_mode)
+            for d in range(nsp)]
 
     def f(a):
-        if channel_last:
-            out_shape = (a.shape[0],) + out_sp + (a.shape[-1],)
-        else:
-            out_shape = a.shape[:2] + out_sp
-        if mode == "nearest":
-            # jax.image nearest matches align_corners=False reference behavior
-            return jax.image.resize(a, out_shape, method="nearest")
-        if align_corners:
-            # build index grid with corner alignment
-            sp_axes = list(range(1, 1 + nsp)) if channel_last else list(range(2, 2 + nsp))
-            out = a
-            for d, ax in enumerate(sp_axes):
-                i_len, o_len = a.shape[ax], out_sp[d]
-                if o_len == 1:
-                    idx = jnp.zeros((1,))
-                else:
-                    idx = jnp.linspace(0.0, i_len - 1, o_len)
-                lo = jnp.floor(idx).astype(jnp.int32)
-                hi = jnp.clip(lo + 1, 0, i_len - 1)
-                w = (idx - lo)[(None,) * ax + (...,) + (None,) * (out.ndim - ax - 1)]
-                lo_v = jnp.take(out, lo, axis=ax)
-                hi_v = jnp.take(out, hi, axis=ax)
-                out = lo_v * (1 - w) + hi_v * w
-            return out.astype(a.dtype)
-        return jax.image.resize(a, out_shape, method=jmode).astype(a.dtype)
+        out = a
+        for d, ax in enumerate(sp_axes):
+            W = jnp.asarray(mats[d], jnp.float32)      # [out, in]
+            moved = jnp.tensordot(out.astype(jnp.float32), W,
+                                  axes=[[ax], [1]])    # axis -> last
+            out = jnp.moveaxis(moved, -1, ax)
+        return out.astype(a.dtype)
 
     return apply_op(f, x_t)
 

@@ -1,0 +1,141 @@
+"""F.interpolate torch-golden parity (ref: paddle.nn.functional
+.interpolate) — r4 rewrite: jax.image.resize diverged from the
+reference on half-pixel bilinear/bicubic (antialiased downscale),
+legacy nearest, and area; now every mode is an exact static weight
+matrix per spatial axis.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+CASES_2D = [((2, 3, 8, 10), (5, 7)),      # downscale
+            ((2, 3, 5, 6), (9, 11)),      # upscale
+            ((1, 1, 4, 4), (4, 4))]       # identity
+
+
+@pytest.mark.parametrize("shape,size", CASES_2D)
+@pytest.mark.parametrize("mode,align", [
+    ("nearest", None), ("bilinear", False), ("bilinear", True),
+    ("bicubic", False), ("bicubic", True), ("area", None)])
+def test_interpolate_2d_matches_torch(shape, size, mode, align):
+    x = np.random.default_rng(0).standard_normal(shape).astype(np.float32)
+    kw = {} if align is None else {"align_corners": align}
+    ours = _np(F.interpolate(paddle.to_tensor(x), size=list(size),
+                             mode=mode, **kw))
+    ref = tF.interpolate(torch.from_numpy(x), size=size, mode=mode,
+                         **kw).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_interpolate_1d_and_3d_match_torch():
+    rng = np.random.default_rng(1)
+    x1 = rng.standard_normal((1, 2, 7)).astype(np.float32)
+    for mode, align in [("nearest", None), ("linear", False),
+                        ("linear", True), ("area", None)]:
+        kw = {} if align is None else {"align_corners": align}
+        ours = _np(F.interpolate(paddle.to_tensor(x1), size=[4],
+                                 mode=mode, **kw))
+        ref = tF.interpolate(torch.from_numpy(x1), size=(4,), mode=mode,
+                             **kw).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+    x3 = rng.standard_normal((1, 2, 4, 5, 6)).astype(np.float32)
+    for mode, align in [("nearest", None), ("trilinear", False),
+                        ("trilinear", True), ("area", None)]:
+        kw = {} if align is None else {"align_corners": align}
+        ours = _np(F.interpolate(paddle.to_tensor(x3), size=[3, 7, 9],
+                                 mode=mode, **kw))
+        ref = tF.interpolate(torch.from_numpy(x3), size=(3, 7, 9),
+                             mode=mode, **kw).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_interpolate_scale_factor_and_nhwc():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, 3, 6, 8)).astype(np.float32)
+    a = _np(F.interpolate(paddle.to_tensor(x), scale_factor=2,
+                          mode="bilinear"))
+    ref = tF.interpolate(torch.from_numpy(x), scale_factor=2,
+                         mode="bilinear", align_corners=False).numpy()
+    np.testing.assert_allclose(a, ref, rtol=1e-5, atol=1e-5)
+    # NHWC layout produces the transposed result
+    xl = np.transpose(x, (0, 2, 3, 1)).copy()
+    b = _np(F.interpolate(paddle.to_tensor(xl), scale_factor=2,
+                          mode="bilinear", data_format="NHWC"))
+    np.testing.assert_allclose(np.transpose(b, (0, 3, 1, 2)), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_interpolate_align_mode_1():
+    """paddle's align_mode=1 (src = i*scale, no half-pixel shift) —
+    checked against the direct formula."""
+    x = np.arange(8, dtype=np.float32).reshape(1, 1, 8)
+    out = _np(F.interpolate(paddle.to_tensor(x), size=[5], mode="linear",
+                            align_corners=False, align_mode=1))
+    src = np.arange(5) * (8 / 5)
+    lo = np.floor(src).astype(int)
+    hi = np.minimum(lo + 1, 7)
+    w = src - lo
+    ref = (x[0, 0, lo] * (1 - w) + x[0, 0, hi] * w).astype(np.float32)
+    np.testing.assert_allclose(out[0, 0], ref, rtol=1e-6)
+
+
+def test_interpolate_gradients_flow():
+    import jax
+    import jax.numpy as jnp
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((1, 2, 4, 4)),
+                    jnp.float32)
+
+    def loss(a):
+        o = F.interpolate(paddle.to_tensor(a), size=[8, 8],
+                          mode="bilinear")
+        return jnp.sum(o._value ** 2)
+
+    g = jax.grad(loss)(x)
+    assert float(jnp.abs(g).max()) > 0
+
+
+def test_interpolate_unknown_mode_rejected():
+    with pytest.raises(ValueError, match="mode"):
+        F.interpolate(paddle.to_tensor(np.zeros((1, 1, 4, 4), np.float32)),
+                      size=[2, 2], mode="lanczos")
+
+
+@pytest.mark.parametrize("in_len,out_len", [(21, 19), (25, 11), (130, 7)])
+def test_area_large_sizes_match_torch(in_len, out_len):
+    """Integer window bounds: float floor/ceil drifts at these sizes
+    (e.g. 21->19 truncated the last window) — review-confirmed bug."""
+    x = np.random.default_rng(4).standard_normal(
+        (1, 2, in_len)).astype(np.float32)
+    ours = _np(F.interpolate(paddle.to_tensor(x), size=[out_len],
+                             mode="area"))
+    ref = tF.interpolate(torch.from_numpy(x), size=(out_len,),
+                         mode="area").numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_nearest_align_corners_ties_round_up():
+    """in=3,out=5 puts source positions on exact .5: the reference
+    rounds UP (floor(x+0.5)), numpy's round would tie-to-even."""
+    x = np.asarray([[[10.0, 20.0, 30.0]]], np.float32)
+    out = _np(F.interpolate(paddle.to_tensor(x), size=[5],
+                            mode="nearest", align_corners=True))
+    np.testing.assert_array_equal(out[0, 0], [10, 20, 20, 30, 30])
+
+
+def test_bicubic_ignores_align_mode():
+    x = np.random.default_rng(5).standard_normal(
+        (1, 1, 6, 6)).astype(np.float32)
+    a = _np(F.interpolate(paddle.to_tensor(x), size=[9, 9],
+                          mode="bicubic", align_mode=0))
+    b = _np(F.interpolate(paddle.to_tensor(x), size=[9, 9],
+                          mode="bicubic", align_mode=1))
+    np.testing.assert_array_equal(a, b)
