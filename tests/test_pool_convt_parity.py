@@ -1,0 +1,77 @@
+"""Torch-golden spot checks for the pooling / conv-transpose / unfold
+family (r4 audit after the interpolate divergence — these all passed,
+pinned here so they stay that way). Note paddle's avg_pool default is
+exclusive=True == torch count_include_pad=False.
+"""
+import numpy as np
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+
+def _np(t):
+    return np.asarray(t.numpy())
+
+
+def test_pool_family_matches_torch():
+    x = np.random.default_rng(1).standard_normal(
+        (2, 3, 9, 11)).astype(np.float32)
+    xt, xr = paddle.to_tensor(x), torch.from_numpy(x)
+    np.testing.assert_allclose(
+        _np(F.max_pool2d(xt, 3, 2, 1)),
+        tF.max_pool2d(xr, 3, 2, 1).numpy(), atol=1e-6)
+    np.testing.assert_allclose(
+        _np(F.max_pool2d(xt, 3, 2, 0, ceil_mode=True)),
+        tF.max_pool2d(xr, 3, 2, 0, ceil_mode=True).numpy(), atol=1e-6)
+    np.testing.assert_allclose(          # paddle default == exclude-pad
+        _np(F.avg_pool2d(xt, 3, 2, 1)),
+        tF.avg_pool2d(xr, 3, 2, 1, count_include_pad=False).numpy(),
+        atol=1e-6)
+    np.testing.assert_allclose(
+        _np(F.avg_pool2d(xt, 3, 2, 1, exclusive=False)),
+        tF.avg_pool2d(xr, 3, 2, 1, count_include_pad=True).numpy(),
+        atol=1e-6)
+    np.testing.assert_allclose(
+        _np(F.lp_pool2d(xt, 2, 3, 2)),
+        tF.lp_pool2d(xr, 2, 3, 2).numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_adaptive_pools_match_torch_awkward_sizes():
+    """The interpolate area bug lived in float window bounds; the
+    adaptive pools use the same windows — pin the awkward sizes."""
+    rng = np.random.default_rng(0)
+    for in_sp, out_sp in [((21, 19), (19, 7)), ((25, 30), (11, 13))]:
+        x = rng.standard_normal((2, 3) + in_sp).astype(np.float32)
+        np.testing.assert_allclose(
+            _np(F.adaptive_avg_pool2d(paddle.to_tensor(x), out_sp)),
+            tF.adaptive_avg_pool2d(torch.from_numpy(x), out_sp).numpy(),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            _np(F.adaptive_max_pool2d(paddle.to_tensor(x), out_sp)),
+            tF.adaptive_max_pool2d(torch.from_numpy(x), out_sp).numpy(),
+            atol=1e-6)
+
+
+def test_conv_transpose_matches_torch():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, 4, 7, 8)).astype(np.float32)
+    w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+    xt, xr = paddle.to_tensor(x), torch.from_numpy(x)
+    wt, wr = paddle.to_tensor(w), torch.from_numpy(w)
+    for kw in [dict(stride=2), dict(stride=2, padding=1),
+               dict(stride=2, padding=1, output_padding=1),
+               dict(dilation=2)]:
+        np.testing.assert_allclose(
+            _np(F.conv2d_transpose(xt, wt, **kw)),
+            tF.conv_transpose2d(xr, wr, **kw).numpy(),
+            rtol=1e-4, atol=1e-5, err_msg=str(kw))
+
+
+def test_unfold_matches_torch():
+    x = np.random.default_rng(3).standard_normal(
+        (2, 4, 7, 8)).astype(np.float32)
+    np.testing.assert_allclose(
+        _np(F.unfold(paddle.to_tensor(x), 3, strides=2)),
+        tF.unfold(torch.from_numpy(x), 3, stride=2).numpy(), atol=1e-6)
