@@ -16,6 +16,8 @@ TPU-native design compiles the ENTIRE decode into one XLA program:
 from __future__ import annotations
 
 import functools
+import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -23,7 +25,66 @@ import jax.numpy as jnp
 from ..nn.layer import functional_call
 from ..tensor import Tensor
 
-__all__ = ["generate", "build_decode_fn", "build_beam_decode_fn"]
+__all__ = ["generate", "build_decode_fn", "build_beam_decode_fn",
+           "clear_decode_cache"]
+
+# generate() convenience-path memo: build_decode_fn returns a fresh
+# jax.jit object, and jit's executable cache is keyed on function
+# identity — without this memo every generate() call re-traces AND
+# re-compiles (measured on the axon TPU tunnel: ~30 s/call of remote
+# compile for gpt2-124M, masking the actual ~ms-scale decode).  Stored
+# ON the model instance: the decode closures reference the model, so a
+# module-level WeakKeyDictionary entry would never die (weakref's
+# value-refs-key caveat); an instance attribute makes model<->fn a pure
+# cycle the gc collects when the model is dropped.
+_MEMO_ATTR = "_paddle_tpu_decode_fn_memo"
+_MEMO_MAX = 8  # compiled decode programs kept per model (LRU)
+
+
+def clear_decode_cache(model):
+    """Drop generate()'s memoized compiled decode programs for `model`.
+
+    Needed only after an in-place structural mutation that keeps the
+    params pytree identical (e.g. toggling config.use_flash_attention is
+    already part of the key, but swapping a sublayer for one with the
+    same param shapes is not) — jit cannot see such a change, so the
+    memo would otherwise serve the old forward."""
+    with _memo_lock:
+        if getattr(model, _MEMO_ATTR, None):
+            getattr(model, _MEMO_ATTR).clear()
+
+
+# RLock: generate() holds it across build+call (functional_call swaps
+# tracers into the shared model while tracing, so concurrent tracing on
+# one model is unsafe by construction — same property as torch.func's
+# functional_call); _memoized_decode_fn re-acquires it under generate().
+_memo_lock = threading.RLock()
+
+
+def _memoized_decode_fn(model, key, build):
+    # lock covers the whole lookup/evict/build: concurrent generate()
+    # threads on one model must neither double-pay a ~30s remote compile
+    # for the same key nor race the LRU pop (build for a *different* key
+    # is serialized too — compiles are rare, simplicity wins)
+    with _memo_lock:
+        per_model = getattr(model, _MEMO_ATTR, None)
+        if per_model is None:
+            per_model = {}
+            object.__setattr__(model, _MEMO_ATTR, per_model)
+        # trace-time inputs invisible to the params pytree: the model's
+        # flash flag and the flash_decode env gate (ops/attention.py
+        # reads it while tracing) — both must key the compiled program
+        key = key + (bool(getattr(model.config, "use_flash_attention",
+                                  False)),
+                     os.environ.get("PADDLE_TPU_FLASH_DECODE"))
+        fn = per_model.get(key)
+        if fn is None:
+            if len(per_model) >= _MEMO_MAX:  # bounded: drop least-recent
+                per_model.pop(next(iter(per_model)))
+            fn = per_model[key] = build()
+        else:  # refresh LRU order
+            per_model[key] = per_model.pop(key)
+        return fn
 
 
 def _apply_repetition_penalty(logits, seen, penalty):
@@ -306,10 +367,41 @@ def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
              top_k=0, top_p=1.0, repetition_penalty=1.0, num_beams=1,
              length_penalty=1.0, eos_token_id=None, pad_token_id=0,
              decode_strategy=None, seed=0, cache_dtype="float32"):
-    """One-call jitted decode (compiles once per (B, S0, max_new_tokens)
-    shape; reuse via build_decode_fn / build_beam_decode_fn for repeated
-    calls). decode_strategy: None (infer from args) | 'greedy_search' |
-    'sampling' | 'beam_search' — ref: paddlenlp GenerationMixin."""
+    """One-call jitted decode. Compiled decode programs are memoized on
+    the model (LRU of 8 keyed by the generation args + flash flag), so
+    repeated generate() calls reuse the compiled program; only new
+    (B, S0) shapes retrace. Caveat: after an in-place model mutation
+    that keeps the params pytree identical (e.g. swapping a sublayer
+    with same-shape params), call clear_decode_cache(model).
+    decode_strategy: None (infer from args) | 'greedy_search' |
+    'sampling' | 'beam_search' — ref: paddlenlp GenerationMixin.
+
+    Thread-safe: the whole call is serialized under a module lock
+    (tracing swaps state into the shared model, and on one chip device
+    execution is serial anyway). For lock-free repeated calls, build a
+    fn once with build_decode_fn and manage params yourself."""
+    with _memo_lock:
+        return _generate_locked(
+            model, input_ids, max_new_tokens, temperature, top_k, top_p,
+            repetition_penalty, num_beams, length_penalty, eos_token_id,
+            pad_token_id, decode_strategy, seed, cache_dtype)
+
+
+def _generate_locked(model, input_ids, max_new_tokens, temperature,
+                     top_k, top_p, repetition_penalty, num_beams,
+                     length_penalty, eos_token_id, pad_token_id,
+                     decode_strategy, seed, cache_dtype):
+    # plain-python coercion: these land in the (hashable) memo key, and
+    # numpy/jax 0-d scalars were accepted here before memoization
+    max_new_tokens = int(max_new_tokens)
+    temperature = float(temperature)
+    top_k = int(top_k)
+    top_p = float(top_p)
+    repetition_penalty = float(repetition_penalty)
+    num_beams = int(num_beams)
+    length_penalty = float(length_penalty)
+    eos_token_id = None if eos_token_id is None else int(eos_token_id)
+    pad_token_id = None if pad_token_id is None else int(pad_token_id)
     was_training = model.training
     model.eval()
     try:
@@ -326,11 +418,15 @@ def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
                     "beam_search scores exhaustively — top_k/top_p do not "
                     "apply (use decode_strategy='sampling' for filtered "
                     "sampling)")
-            fn = build_beam_decode_fn(model, max_new_tokens, max(num_beams, 1),
-                                      length_penalty, eos_token_id,
-                                      pad_token_id, temperature,
-                                      repetition_penalty,
-                                      cache_dtype=cache_dtype)
+            fn = _memoized_decode_fn(
+                model,
+                ("beam", max_new_tokens, max(num_beams, 1), length_penalty,
+                 eos_token_id, pad_token_id, temperature,
+                 repetition_penalty, str(cache_dtype)),
+                lambda: build_beam_decode_fn(
+                    model, max_new_tokens, max(num_beams, 1),
+                    length_penalty, eos_token_id, pad_token_id, temperature,
+                    repetition_penalty, cache_dtype=cache_dtype))
             out = fn(params, buffers, ids)
         else:
             do_sample = None
@@ -338,10 +434,15 @@ def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
                 temperature, do_sample = 0.0, False
             elif decode_strategy == "sampling":
                 do_sample = True
-            fn = build_decode_fn(model, max_new_tokens, temperature, top_k,
-                                 top_p, repetition_penalty, eos_token_id,
-                                 pad_token_id, do_sample=do_sample,
-                                 cache_dtype=cache_dtype)
+            fn = _memoized_decode_fn(
+                model,
+                ("sample", max_new_tokens, temperature, top_k, top_p,
+                 repetition_penalty, eos_token_id, pad_token_id, do_sample,
+                 str(cache_dtype)),
+                lambda: build_decode_fn(
+                    model, max_new_tokens, temperature, top_k, top_p,
+                    repetition_penalty, eos_token_id, pad_token_id,
+                    do_sample=do_sample, cache_dtype=cache_dtype))
             out = fn(params, buffers, ids, jax.random.PRNGKey(seed))
     finally:
         if was_training:

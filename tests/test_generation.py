@@ -212,3 +212,69 @@ def test_bf16_kv_cache_beam_path_runs():
     out = generate(m, ids, max_new_tokens=5, num_beams=3,
                    decode_strategy="beam_search", cache_dtype="bfloat16")
     assert np.asarray(out).shape == (1, 8)
+
+
+def test_generate_memoizes_compiled_decode_fn():
+    """Repeat generate() must reuse the compiled program (the axon tunnel
+    measured ~30s/call of pure re-compile without this), stay bounded,
+    and keep the model collectable."""
+    import gc
+    import time
+    import weakref
+
+    from paddle_tpu.nlp.generation import (_MEMO_ATTR, _MEMO_MAX,
+                                           clear_decode_cache)
+    m = _model()
+    ids = Tensor(jnp.asarray([[5, 17, 3, 42], [9, 9, 1, 0]], jnp.int32))
+    t0 = time.perf_counter()
+    first = generate(m, ids, max_new_tokens=4, temperature=0.0)
+    t1 = time.perf_counter()
+    second = generate(m, ids, max_new_tokens=4, temperature=0.0)
+    t2 = time.perf_counter()
+    np.testing.assert_array_equal(np.asarray(first._value),
+                                  np.asarray(second._value))
+    assert (t2 - t1) < (t1 - t0) / 5, "warm call re-traced"
+    # numpy/jax scalar args are coerced into hashable key entries
+    generate(m, ids, max_new_tokens=np.int64(4), temperature=jnp.float32(0.5),
+             top_k=jnp.int32(2), seed=1)
+    # distinct arg combos stay bounded by the LRU cap
+    for i in range(_MEMO_MAX + 3):
+        generate(m, ids, max_new_tokens=2, temperature=0.5 + 0.01 * i,
+                 top_k=2, seed=i)
+    memo = getattr(m, _MEMO_ATTR)
+    assert 0 < len(memo) <= _MEMO_MAX
+    clear_decode_cache(m)
+    assert len(memo) == 0
+    # memo must not leak into checkpoints, nor pin the model in memory
+    assert not any("decode_fn_memo" in k for k in m.state_dict())
+    ref = weakref.ref(m)
+    del m, memo
+    gc.collect()
+    assert ref() is None, "decode memo kept the model alive"
+
+
+def test_generate_threadsafe_on_shared_model():
+    """Concurrent generate() on one model must not leak tracers or race
+    the LRU (functional_call swaps state into the shared model, so the
+    whole call is serialized under the module lock)."""
+    import threading
+
+    m = _model()
+    ids = Tensor(jnp.asarray([[5, 17, 3, 42]], jnp.int32))
+    errs = []
+
+    def worker(i):
+        try:
+            for j in range(3):
+                generate(m, ids, max_new_tokens=2,
+                         temperature=0.5 + 0.05 * ((i * 3 + j) % 10),
+                         top_k=2, seed=j)
+        except Exception as e:  # pragma: no cover - failure diagnostics
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs[:1]

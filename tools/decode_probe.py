@@ -24,6 +24,11 @@ import sys
 import threading
 import time
 
+# run as `python tools/decode_probe.py`: sys.path[0] is tools/, so the
+# child stages (the only processes importing paddle_tpu) need the repo
+# root on the path explicitly
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 STAGES = {}
 
 
