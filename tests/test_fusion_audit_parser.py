@@ -1,0 +1,58 @@
+"""The fusion-audit HLO parser must handle TPU-optimized HLO text.
+
+Regression for the r4 campaign run where the audit reported 0 entry
+instructions / empty fusion bodies on the real chip: TPU HLO annotates
+layouts inside types (``bf16[8,128]{1,0:T(8,128)(2,1)}``) and inside
+the ENTRY/fusion signatures, which the old regexes (that enumerated the
+characters a type may contain, and scanned for the first ``{`` after
+the computation name) could not survive. CPU HLO carries no layout
+annotations, so CPU-only testing never caught it.
+"""
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.fusion_audit import parse_entry_computation  # noqa: E402
+
+TPU_STYLE = """HloModule jit_step, is_scheduled=true
+%fused_computation.571.clone (param_0.1: bf16[8,1024]{1,0:T(8,128)(2,1)}, param_1.2: bf16[1024]{0:T(1024)}) -> bf16[8,1024]{1,0:T(8,128)(2,1)} {
+  %param_0.1 = bf16[8,1024]{1,0:T(8,128)(2,1)} parameter(0)
+  %param_1.2 = bf16[1024]{0:T(1024)} parameter(1)
+  %broadcast.9 = bf16[8,1024]{1,0:T(8,128)(2,1)} broadcast(bf16[1024]{0:T(1024)} %param_1.2), dimensions={1}
+  ROOT %add.5 = bf16[8,1024]{1,0:T(8,128)(2,1)} add(bf16[8,1024]{1,0:T(8,128)(2,1)} %param_0.1, bf16[8,1024]{1,0:T(8,128)(2,1)} %broadcast.9)
+}
+ENTRY %main.110 (p0: bf16[8,1024]{1,0:T(8,128)(2,1)}, p1: bf16[1024]{0:T(1024)}) -> (bf16[8,1024]{1,0:T(8,128)(2,1)}, f32[]) {
+  %p0 = bf16[8,1024]{1,0:T(8,128)(2,1)} parameter(0)
+  %p1 = bf16[1024]{0:T(1024)} parameter(1)
+  %fusion.2 = bf16[8,1024]{1,0:T(8,128)(2,1)} fusion(bf16[8,1024]{1,0:T(8,128)(2,1)} %p0, bf16[1024]{0:T(1024)} %p1), kind=kLoop, calls=%fused_computation.571.clone
+  %dot.3 = bf16[8,1024]{1,0:T(8,128)(2,1)} dot(%fusion.2, %p0), lhs_contracting_dims={1}, rhs_contracting_dims={1}
+  %constant.1 = f32[] constant(0)
+  ROOT %tuple.9 = (bf16[8,1024]{1,0:T(8,128)(2,1)}, f32[]) tuple(%dot.3, %constant.1)
+}
+"""
+
+CPU_STYLE = """HloModule jit_f
+%fused_computation (param_0.2: f32[1,4]) -> f32[] {
+  %param_0.2 = f32[1,4]{1,0} parameter(0)
+  ROOT %reduce.1 = f32[] reduce(f32[1,4]{1,0} %param_0.2), dimensions={0,1}, to_apply=%add
+}
+ENTRY %main.8 (Arg_0.1: f32[1,4]) -> f32[] {
+  %Arg_0.1 = f32[1,4]{1,0} parameter(0)
+  ROOT %fusion = f32[] fusion(f32[1,4]{1,0} %Arg_0.1), kind=kLoop, calls=%fused_computation
+}
+"""
+
+
+def test_tpu_layout_annotated_hlo():
+    ops, bodies = parse_entry_computation(TPU_STYLE)
+    assert ops == ["parameter", "parameter", "fusion", "dot",
+                   "constant", "tuple"]
+    body = bodies["fused_computation.571.clone"]
+    assert body["add"] == 1 and body["broadcast"] == 1
+
+
+def test_cpu_plain_hlo():
+    ops, bodies = parse_entry_computation(CPU_STYLE)
+    assert ops == ["parameter", "fusion"]
+    assert bodies["fused_computation"]["reduce"] == 1

@@ -35,28 +35,42 @@ HEAVY = {"dot", "convolution", "custom-call", "fusion", "all-reduce",
          "rng", "while", "conditional", "call"}
 
 
+# opcode after "= <type> ": the type is either a tuple "(...)" or a
+# single token; TPU-optimized HLO annotates layouts inside the type
+# (e.g. bf16[8,128]{1,0:T(8,128)(2,1)S(1)}), so the type is matched as
+# "anything without spaces" / a parenthesized tuple, never enumerated
+_INSTR_RE = re.compile(
+    r"(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?:\([^=\n]*?\)|\S+)\s+"
+    r"([a-z][\w\-]*)\(")
+
+
+def _block_after(header_re, hlo_text):
+    """Yield (name, body) for each computation whose header matches.
+    Headers end with '{' at end of line; the body runs to the first
+    line that is exactly '}' — signatures may contain braces (TPU
+    layout annotations), so never scan for 'first { after name'."""
+    for fm in re.finditer(header_re + r"[^\n]*\{[ ]*$\n(.*?)^\}",
+                          hlo_text, re.MULTILINE | re.DOTALL):
+        yield fm.group(1), fm.group(2)
+
+
 def parse_entry_computation(hlo_text):
     """Return the instruction opcodes of the ENTRY computation plus the
     full per-fusion bodies keyed by fusion name."""
-    # ENTRY block: from 'ENTRY ' to the matching closing brace at col 0
-    m = re.search(r"^ENTRY [^{]+\{(.*?)^\}", hlo_text,
-                  re.MULTILINE | re.DOTALL)
-    entry = m.group(1) if m else ""
     ops = []
-    for line in entry.splitlines():
-        line = line.strip()
-        mm = re.match(r"(?:ROOT\s+)?%?[\w.\-]+\s*=\s*[\w\[\]{},/ ]+?\s*"
-                      r"([a-z][\w\-]*)\(", line)
-        if mm:
-            ops.append(mm.group(1))
-    # fusion bodies: computations named %fused_computation*
+    for _, entry in _block_after(r"^(ENTRY)\s", hlo_text):
+        for line in entry.splitlines():
+            mm = _INSTR_RE.match(line.strip())
+            if mm:
+                ops.append(mm.group(1))
+        break
     bodies = {}
-    for fm in re.finditer(r"^%?(fused_[\w.\-]*|wrapped_[\w.\-]*) "
-                          r"[^{]*\{(.*?)^\}", hlo_text,
-                          re.MULTILINE | re.DOTALL):
-        body_ops = re.findall(
-            r"=\s*[\w\[\]{},/ ]+?\s*([a-z][\w\-]*)\(", fm.group(2))
-        bodies[fm.group(1)] = Counter(body_ops)
+    for name, body in _block_after(
+            r"^%?((?:fused_|wrapped_)[\w.\-]*)", hlo_text):
+        bodies[name] = Counter(
+            m.group(1) for m in (
+                _INSTR_RE.match(l.strip()) for l in body.splitlines())
+            if m)
     return ops, bodies
 
 

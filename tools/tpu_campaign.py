@@ -85,6 +85,9 @@ STAGES = [
     ("fusion_audit", [PY, "tools/fusion_audit.py", "--out",
                       "campaign_out/fusion_audit.md"], 3600, {}),
     ("resnet_roofline", [PY, "tools/resnet_roofline.py"], 2400, {}),
+    # retry queue (r4: the tunnel died mid-campaign after 45 min; these
+    # are what remained — tools/tunnel_watch.py fires them on revival)
+    ("bench_gpt13b", [PY, "bench.py", "--model", "gpt-1.3b"], 2400, {}),
 ]
 
 
@@ -95,13 +98,21 @@ def main():
     ap.add_argument("--skip", default="",
                     help="comma-separated stage names to skip")
     args = ap.parse_args()
-    only = set(args.only.split(",")) if args.only else None
+    only = args.only.split(",") if args.only else None
     skip = set(args.skip.split(",")) if args.skip else set()
     scale = float(os.environ.get("CAMPAIGN_TIMEOUT_SCALE", "1"))
     summary = {}
-    for name, cmd, timeout, env in STAGES:
+    stages = STAGES
+    if only:  # run in the order the caller listed, not STAGES order
+        by_name = {s[0]: s for s in STAGES}
+        unknown = [n for n in only if n not in by_name]
+        if unknown:
+            sys.exit(f"unknown stage(s): {unknown}; "
+                     f"known: {sorted(by_name)}")
+        stages = [by_name[n] for n in only]
+    for name, cmd, timeout, env in stages:
         timeout = max(10, int(timeout * scale))
-        if (only and name not in only) or name in skip:
+        if name in skip:
             continue
         print(f"=== {name} (timeout {timeout}s) ===", flush=True)
         rc, dt, tail = run(cmd, timeout, f"{name}.log", env)
@@ -115,8 +126,8 @@ def main():
         with open(os.path.join(OUT, "summary.json"), "w") as f:
             json.dump(summary, f, indent=1)
         if not ok and name != "probe":
-            rc2, _, _ = run([PY, "bench.py", "--worker", "probe"], 600,
-                            "reprobe.log")
+            rc2, _, _ = run([PY, "bench.py", "--worker", "probe"],
+                            max(10, int(600 * scale)), "reprobe.log")
             if rc2 != 0:
                 print("backend wedged after failure — stopping campaign "
                       "(earlier artifacts kept)", flush=True)
