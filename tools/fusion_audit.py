@@ -80,6 +80,17 @@ def audit(fn_or_layer, args, label):
 
     txt = pjit.get_hlo(fn_or_layer, *args, optimized=True)
     ops, bodies = parse_entry_computation(txt)
+    if not ops and "ENTRY" in txt:
+        # loud failure beats a vacuous all-zeros report that burns a
+        # scarce TPU window looking like a measurement (the r4 campaign
+        # shipped exactly that when TPU layout annotations broke the
+        # old regexes)
+        raise RuntimeError(
+            f"HLO parser matched 0 entry instructions for '{label}' but "
+            f"the dump contains an ENTRY computation ({len(txt)} chars) "
+            "— the HLO text dialect has drifted; fix "
+            "parse_entry_computation (see tests/test_fusion_audit_parser"
+            ".py)")
     counts = Counter(ops)
     n_fusion = counts.get("fusion", 0)
     unfused_ew = {o: c for o, c in counts.items()

@@ -7,22 +7,28 @@ so this automates "the moment the tunnel returns, measure" (VERDICT r3
 next #1). Every probe attempt is logged with a timestamp so an all-dead
 stretch is externally verifiable evidence, not an excuse.
 
+If the tunnel dies again mid-campaign, the watcher re-arms with only
+the stages that have not yet succeeded (read from campaign_out/
+summary.json) instead of declaring victory on a half-done run.
+
 Usage: python tools/tunnel_watch.py [--interval 300] [--stages a,b,c]
-Exits after the staged campaign finishes (one-shot: rerun to re-arm).
+Exits once every requested stage has succeeded.
 """
 from __future__ import annotations
 
 import argparse
 import datetime
+import json
 import os
-import signal
 import subprocess
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "campaign_out")
 PY = sys.executable
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from tpu_campaign import run  # noqa: E402  (shared killable-subprocess runner)
 
 
 def log_line(path, msg):
@@ -32,25 +38,16 @@ def log_line(path, msg):
     print(f"{stamp} {msg}", flush=True)
 
 
-def probe(timeout):
-    t0 = time.monotonic()
-    proc = subprocess.Popen([PY, "bench.py", "--worker", "probe"],
-                            cwd=REPO, stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL,
-                            start_new_session=True)
+def succeeded_stages():
     try:
-        rc = proc.wait(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            proc.kill()
-        proc.wait()
-        return "timeout", time.monotonic() - t0
-    return rc, time.monotonic() - t0
+        with open(os.path.join(OUT, "summary.json")) as f:
+            return {k for k, v in json.load(f).items() if v.get("ok")}
+    except (OSError, json.JSONDecodeError):
+        return set()
 
 
 def main():
+    import time
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=int, default=300)
     ap.add_argument("--probe-timeout", type=int, default=150)
@@ -62,19 +59,25 @@ def main():
     ap.add_argument("--log", default=os.path.join(OUT, "probe_r4b.log"))
     args = ap.parse_args()
     os.makedirs(OUT, exist_ok=True)
-    while True:
-        rc, dt = probe(args.probe_timeout)
-        if rc == 0:
-            log_line(args.log, f"probe OK in {dt:.1f}s — launching stages "
-                               f"{args.stages}")
-            camp = subprocess.run(
-                [PY, "tools/tpu_campaign.py", "--only", args.stages],
-                cwd=REPO)
-            log_line(args.log, f"stages done rc={camp.returncode}")
-            return
-        log_line(args.log, f"probe DEAD rc={rc} after {dt:.1f}s "
-                           f"(next try in {args.interval}s)")
-        time.sleep(args.interval)
+    pending = args.stages.split(",")
+    while pending:
+        rc, dt, _ = run([PY, "bench.py", "--worker", "probe"],
+                        args.probe_timeout, "watch_probe.log")
+        if rc != 0:
+            log_line(args.log, f"probe DEAD rc={rc} after {dt:.1f}s "
+                               f"(next try in {args.interval}s)")
+            time.sleep(args.interval)
+            continue
+        log_line(args.log, f"probe OK in {dt:.1f}s — launching stages "
+                           f"{','.join(pending)}")
+        camp = subprocess.run(
+            [PY, "tools/tpu_campaign.py", "--only", ",".join(pending)],
+            cwd=REPO)
+        done = succeeded_stages()
+        pending = [s for s in pending if s not in done]
+        log_line(args.log, f"campaign rc={camp.returncode}; "
+                           f"pending after run: {pending or 'NONE'}")
+    log_line(args.log, "all stages succeeded — watcher done")
 
 
 if __name__ == "__main__":
