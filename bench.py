@@ -107,7 +107,7 @@ def gpt_flops_per_token(model, seq):
 
 
 def build_engine(cfg_name, batch, seq, amp, use_flash=True, recompute=False,
-                 moment_dtype=None):
+                 moment_dtype=None, scan_layers=False):
     import jax.numpy as jnp
     from paddle_tpu.nlp.gpt import (GPTForCausalLM, GPT_CONFIGS,
                                     GPTPretrainingCriterion, _resolve_config)
@@ -118,7 +118,8 @@ def build_engine(cfg_name, batch, seq, amp, use_flash=True, recompute=False,
     model = GPTForCausalLM(_resolve_config(
         cfg_name, max_position_embeddings=max_pos,
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
-        use_flash_attention=use_flash, recompute=recompute))
+        use_flash_attention=use_flash, recompute=recompute,
+        scan_layers=scan_layers))
     model.train()
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
                 parameters=model.parameters(), moment_dtype=moment_dtype)
@@ -408,9 +409,11 @@ def worker_gpt(args, on_tpu, big=False):
         moment_dtype = args.moment_dtype
     log(f"bench: {cfg} batch={batch} seq={seq} steps={steps} "
         f"backend={jax.default_backend()} amp={amp} flash={use_flash} "
-        f"recompute={recompute} moment_dtype={moment_dtype}")
+        f"recompute={recompute} moment_dtype={moment_dtype} "
+        f"scan_layers={args.scan_layers}")
     eng = build_engine(cfg, batch, seq, amp, use_flash=use_flash,
-                       recompute=recompute, moment_dtype=moment_dtype)
+                       recompute=recompute, moment_dtype=moment_dtype,
+                       scan_layers=args.scan_layers)
     tput = run(eng, batch, seq, steps, warmup, scan_steps=args.scan_steps)
     fpt = gpt_flops_per_token(eng.network, seq)
     print(json.dumps({
@@ -427,6 +430,7 @@ def worker_gpt(args, on_tpu, big=False):
         if on_tpu else None,
         "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
         "config": cfg, "batch": batch, "seq": seq, "flash": use_flash,
+        "scan_layers": args.scan_layers,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -618,6 +622,10 @@ def main():
     ap.add_argument("--cache-dtype", default=None,
                     help="decode KV cache dtype (bfloat16 halves decode "
                          "HBM traffic)")
+    ap.add_argument("--scan-layers", action="store_true",
+                    help="gpt: stacked-params lax.scan over decoder "
+                         "layers (O(1-block) compiled program; the "
+                         "1.3B remote-compile mitigation)")
     ap.add_argument("--scan-steps", type=int, default=0,
                     help="run K optimizer steps per compiled call "
                          "(lax.scan) to amortize dispatch latency")
@@ -666,6 +674,9 @@ def main():
     if args.moment_dtype and not set(workloads) <= {"gpt", "gpt-1.3b"}:
         ap.error("--moment-dtype applies to the gpt training "
                  "workloads only")
+    if args.scan_layers and not set(workloads) <= {"gpt", "gpt-1.3b"}:
+        ap.error("--scan-layers applies to the gpt training "
+                 "workloads only")
 
     # per-workload tuning flags only make sense for a single explicit
     # workload — forwarding them to the whole suite would silently bench
@@ -688,8 +699,11 @@ def main():
             passthrough.append("--s2d")
         if args.scan_steps:
             passthrough += ["--scan-steps", str(args.scan_steps)]
+        if args.scan_layers:
+            passthrough.append("--scan-layers")
     elif any(v is not None for v in overrides.values()) or args.no_flash \
-            or args.recompute or args.scan_steps or args.s2d:
+            or args.recompute or args.scan_steps or args.s2d \
+            or args.scan_layers:
         print("[bench] ignoring per-workload flags in full-suite mode "
               "(use --model to tune one workload)", file=sys.stderr,
               flush=True)

@@ -1,0 +1,181 @@
+"""scan_layers=True (stacked [L,...] params + one lax.scan) must match
+the unrolled decoder exactly, shrink the traced program, and fail loudly
+on the paths it does not cover (KV-cache decode, eager-tape training).
+
+ref parity: the reference trains GPT-3 1.3B through fleet recompute over
+unrolled CUDA blocks; scan-over-layers is the XLA-idiom equivalent
+(gpt.py ScannedGPTLayers)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi.engine import Engine
+from paddle_tpu.nlp.gpt import (GPTConfig, GPTForCausalLM,
+                                GPTPretrainingCriterion, stack_layer_state,
+                                unstack_layer_state)
+from paddle_tpu.optimizer import AdamW
+from paddle_tpu.tensor import Tensor
+
+CFG = dict(vocab_size=89, hidden_size=32, num_hidden_layers=4,
+           num_attention_heads=4, max_position_embeddings=32,
+           hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+           use_flash_attention=False)
+
+
+def _models():
+    paddle.seed(7)
+    unrolled = GPTForCausalLM(GPTConfig(**CFG))
+    scanned = GPTForCausalLM(GPTConfig(**CFG, scan_layers=True))
+    sd = stack_layer_state(unrolled.state_dict(), CFG["num_hidden_layers"],
+                           prefix="gpt.h.")
+    # COPY the leaves: set_state_dict shares arrays, and the Engine
+    # donates its params — a shared buffer would be deleted under the
+    # other model after its first step
+    sd = {k: jnp.array(np.asarray(v._value if isinstance(v, Tensor) else v))
+          for k, v in sd.items()}
+    scanned.set_state_dict(sd)
+    return unrolled, scanned
+
+
+def _engine(model, sgd=False):
+    model.train()
+    # SGD for lockstep param comparisons: it is linear in the gradient,
+    # so scan-vs-unrolled fp32 reassociation noise stays O(1e-7); Adam
+    # divides near-zero moments and amplifies that noise arbitrarily
+    if sgd:
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=model.parameters())
+    else:
+        opt = AdamW(learning_rate=1e-3, weight_decay=0.01,
+                    parameters=model.parameters())
+    return Engine(model, loss=GPTPretrainingCriterion(), optimizer=opt)
+
+
+def _data(b=2, s=16, steps=3):
+    rng = np.random.default_rng(0)
+    return [(jnp.asarray(rng.integers(0, CFG["vocab_size"], (b, s)),
+                         jnp.int32),
+             jnp.asarray(rng.integers(0, CFG["vocab_size"], (b, s)),
+                         jnp.int32)) for _ in range(steps)]
+
+
+def test_scanned_training_matches_unrolled_exactly():
+    unrolled, scanned = _models()
+    eu, es = _engine(unrolled, sgd=True), _engine(scanned, sgd=True)
+    for ids, labels in _data():
+        lu, _ = eu.train_batch([ids], [labels])
+        ls, _ = es.train_batch([ids], [labels])
+        np.testing.assert_allclose(float(lu), float(ls), rtol=1e-6)
+    # parameters stay in lockstep after 3 optimizer steps
+    su = stack_layer_state(unrolled.state_dict(),
+                           CFG["num_hidden_layers"], prefix="gpt.h.")
+    ss = scanned.state_dict()
+    for k, v in ss.items():
+        np.testing.assert_allclose(
+            np.asarray(su[k]._value if isinstance(su[k], Tensor)
+                       else su[k]),
+            np.asarray(v._value), rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_scanned_recompute_matches_no_recompute():
+    _, scanned = _models()
+    paddle.seed(7)
+    remat = GPTForCausalLM(GPTConfig(**CFG, scan_layers=True,
+                                     recompute=True))
+    remat.set_state_dict({  # copy: donation would delete shared buffers
+        k: jnp.array(np.asarray(v._value))
+        for k, v in scanned.state_dict().items()})
+    e1, e2 = _engine(scanned), _engine(remat)
+    for ids, labels in _data(steps=2):
+        l1, _ = e1.train_batch([ids], [labels])
+        l2, _ = e2.train_batch([ids], [labels])
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+
+
+def test_stack_unstack_roundtrip():
+    unrolled, _ = _models()
+    sd = {k: np.asarray(v._value) for k, v in unrolled.state_dict().items()}
+    stacked = stack_layer_state(sd, CFG["num_hidden_layers"],
+                                prefix="gpt.h.")
+    back = unstack_layer_state(stacked, CFG["num_hidden_layers"],
+                               prefix="gpt.h.")
+    assert set(back) == set(sd)
+    for k in sd:
+        np.testing.assert_array_equal(back[k], sd[k])
+
+
+def test_dropout_path_runs_and_perturbs():
+    """Dropout inside the scan must actually drop (train != eval) and
+    per-layer keys must ride the scan xs: with all layers' weights
+    IDENTICAL and a 2-layer residual-free probe this is hard to observe
+    directly, so assert the observable contract — train-mode losses
+    vary across steps under fixed inputs (fresh masks each step) and
+    differ from the deterministic eval loss."""
+    paddle.seed(3)
+    m = GPTForCausalLM(GPTConfig(**{**CFG, "hidden_dropout_prob": 0.5},
+                                 scan_layers=True))
+    crit = GPTPretrainingCriterion()
+    ids, labels = _data(steps=1)[0]
+
+    from paddle_tpu.nn.layer import functional_call
+    params, buffers = m.raw_state()
+
+    def loss_with(seed_key, training):
+        from paddle_tpu.autograd import no_grad
+        m.train() if training else m.eval()
+        with no_grad():  # forward-only probe; eager train fwd is allowed
+            out = functional_call(m, params, buffers, Tensor(ids),
+                                  rng=jax.random.PRNGKey(seed_key))
+        logits = out[0] if isinstance(out, tuple) else out
+        return float(crit(logits, Tensor(labels))._value)
+
+    l_train_a = loss_with(0, True)
+    l_train_b = loss_with(1, True)
+    l_eval = loss_with(0, False)
+    assert np.isfinite([l_train_a, l_train_b, l_eval]).all()
+    assert l_train_a != l_train_b, "different rng keys gave same masks"
+    assert l_train_a != l_eval, "train-mode dropout was a no-op"
+
+
+def test_cache_decode_raises():
+    _, scanned = _models()
+    scanned.eval()
+    ids = Tensor(jnp.asarray([[1, 2, 3]], jnp.int32))
+    with pytest.raises(NotImplementedError, match="scan_layers"):
+        scanned(ids, use_cache=True)
+
+
+def test_eager_training_raises():
+    _, scanned = _models()
+    scanned.train()
+    ids = Tensor(jnp.asarray([[1, 2, 3]], jnp.int32))
+    with pytest.raises(RuntimeError, match="eager"):
+        scanned(ids)
+
+
+def test_program_size_shrinks():
+    from paddle_tpu import jit as pjit
+    unrolled, scanned = _models()
+    unrolled.eval(), scanned.eval()
+    ids = jnp.zeros((1, 8), jnp.int32)
+
+    def loss_of(model):
+        params, buffers = model.raw_state()
+
+        def f(p, i):
+            from paddle_tpu.nn.layer import functional_call
+            out = functional_call(model, p, buffers, Tensor(i))
+            logits = out[0] if isinstance(out, tuple) else out
+            v = logits._value if isinstance(logits, Tensor) else logits
+            return jnp.sum(v)
+        return f, params
+
+    fu, pu = loss_of(unrolled)
+    fs, ps = loss_of(scanned)
+    hlo_u = pjit.get_hlo(fu, pu, ids)
+    hlo_s = pjit.get_hlo(fs, ps, ids)
+    # 4 unrolled layers vs one scanned body: the traced program must
+    # shrink markedly (the point of the lever at 24 layers/1.3B)
+    assert len(hlo_s) < 0.6 * len(hlo_u), (len(hlo_s), len(hlo_u))
