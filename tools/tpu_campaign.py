@@ -87,17 +87,23 @@ STAGES = [
     ("resnet_roofline", [PY, "tools/resnet_roofline.py"], 2400, {}),
     # retry queue (r4: the tunnel died mid-campaign after 45 min; these
     # are what remained — tools/tunnel_watch.py fires them on revival)
-    ("bench_gpt13b", [PY, "bench.py", "--model", "gpt-1.3b"], 2400, {}),
+    ("bench_gpt13b", [PY, "bench.py", "--model", "gpt-1.3b",
+                      "--no-scan-fallback"], 2400, {}),
     # scan-over-layers variant: O(1-block) program — the mitigation for
     # the remote_compile RPC cutoff that killed the unrolled 1.3B
     ("bench_gpt13b_scan", [PY, "bench.py", "--model", "gpt-1.3b",
                            "--scan-layers"], 2400, {}),
+    # headline batch-scaling probe: MFU 0.40 at b8 — check whether b16
+    # lifts backward-pass efficiency (fits: 345M + Adam fp32 ~4.2 GB,
+    # acts at b16 s1024 with flash ~4 GB)
+    ("bench_gpt_b16", [PY, "bench.py", "--model", "gpt", "--batch", "16"],
+     2400, {}),
 ]
 
 # stages addressable via --only but excluded from the default sweep
 # (bench_full's workload list already includes gpt-1.3b — running the
 # standalone stage too would duplicate up to 2400s on a fragile tunnel)
-RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan"}
+RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16"}
 
 
 def main():
