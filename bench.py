@@ -705,6 +705,9 @@ def main():
     if args.scan_layers and not set(workloads) <= {"gpt", "gpt-1.3b"}:
         ap.error("--scan-layers applies to the gpt training "
                  "workloads only")
+    if args.no_scan_fallback and workloads != ["gpt-1.3b"]:
+        ap.error("--no-scan-fallback applies to the gpt-1.3b workload "
+                 "only (use --model gpt-1.3b)")
 
     # per-workload tuning flags only make sense for a single explicit
     # workload — forwarding them to the whole suite would silently bench

@@ -47,6 +47,10 @@ class BertConfig:
     pool_act: str = "tanh"
     use_flash_attention: bool = True
     num_labels: int = 2
+    # stacked [L,...] params + one lax.scan over the encoder blocks
+    # (nn/scan_stack.py): O(1-block) compiled program. Training/inference
+    # without per-layer outputs only; eager-tape training is gated.
+    scan_layers: bool = False
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -148,6 +152,21 @@ class BertLayer(Layer):
         return x
 
 
+def _build_encoder(config):
+    """LayerList of BertLayer, or the scan-over-layers stack when
+    config.scan_layers (checkpoints convert with
+    nn.scan_stack.stack_layer_state / unstack_layer_state)."""
+    blocks = [BertLayer(config) for _ in range(config.num_hidden_layers)]
+    if not config.scan_layers:
+        return LayerList(blocks)
+    from ..nn.scan_stack import ScannedLayerStack
+    return ScannedLayerStack(
+        blocks,
+        has_dropout=(config.hidden_dropout_prob > 0
+                     or config.attention_probs_dropout_prob > 0),
+        recompute=getattr(config, "recompute", False))
+
+
 class BertEmbeddings(Layer):
     """word (vocab-parallel) + position + token-type embeddings with
     post-sum LayerNorm (ref bert/modeling.py BertEmbeddings)."""
@@ -204,8 +223,7 @@ class BertModel(FromPretrainedMixin, Layer):
             config = BertConfig(**config)
         self.config = config
         self.embeddings = BertEmbeddings(config)
-        self.encoder = LayerList([BertLayer(config)
-                                  for _ in range(config.num_hidden_layers)])
+        self.encoder = _build_encoder(config)
         self.pooler = BertPooler(config)
 
     @classmethod
@@ -217,8 +235,11 @@ class BertModel(FromPretrainedMixin, Layer):
                 attention_mask=None):
         mask = _normalize_mask(attention_mask)
         x = self.embeddings(input_ids, token_type_ids, position_ids)
-        for blk in self.encoder:
-            x = blk(x, mask)
+        if self.config.scan_layers:
+            x = self.encoder(x, mask)
+        else:
+            for blk in self.encoder:
+                x = blk(x, mask)
         return x, self.pooler(x)
 
 

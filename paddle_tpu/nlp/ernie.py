@@ -18,9 +18,9 @@ import jax.numpy as jnp
 
 from ..nn import functional as F
 from ..nn.layer import Layer
-from ..nn.layers_common import Dropout, Embedding, LayerList, Linear
+from ..nn.layers_common import Dropout, Embedding, Linear
 from ..tensor import Tensor
-from .bert import (BertConfig, BertEmbeddings, BertLayer,
+from .bert import (BertConfig, BertEmbeddings,
                    BertLMPredictionHead, BertPooler,
                    BertForMaskedLM, BertForSequenceClassification,
                    BertForTokenClassification, BertForQuestionAnswering,
@@ -106,8 +106,8 @@ class ErnieModel(FromPretrainedMixin, Layer):
             config = ErnieConfig(**config)
         self.config = config
         self.embeddings = ErnieEmbeddings(config)
-        self.encoder = LayerList([BertLayer(config)
-                                  for _ in range(config.num_hidden_layers)])
+        from .bert import _build_encoder
+        self.encoder = _build_encoder(config)
         self.pooler = BertPooler(config)
 
     @classmethod
@@ -120,8 +120,11 @@ class ErnieModel(FromPretrainedMixin, Layer):
         mask = _normalize_mask(attention_mask)
         x = self.embeddings(input_ids, token_type_ids, position_ids,
                             task_type_ids)
-        for blk in self.encoder:
-            x = blk(x, mask)
+        if self.config.scan_layers:
+            x = self.encoder(x, mask)
+        else:
+            for blk in self.encoder:
+                x = blk(x, mask)
         return x, self.pooler(x)
 
 

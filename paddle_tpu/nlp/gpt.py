@@ -23,7 +23,9 @@ import jax.numpy as jnp
 
 from ..nn import functional as F
 from ..nn.initializer import Normal, ParamAttr
-from ..nn.layer import Layer, Parameter, functional_call
+from ..nn.layer import Layer
+from ..nn.scan_stack import (ScannedLayerStack, stack_layer_state,
+                             unstack_layer_state)
 from ..nn.layers_common import Dropout, Embedding, LayerList
 from ..nn.layers_norm import LayerNorm
 from ..tensor import Tensor
@@ -256,135 +258,18 @@ def _recompute_block(blk, x, attention_mask):
     return Tensor(jax.checkpoint(f)(xa), stop_gradient=False)
 
 
-def _flat_name(dotted):
-    """'attn.q_proj.weight' -> 'attn__q_proj__weight' (parameter-store
-    keys may not contain dots: named_parameters joins scopes with '.')."""
-    return dotted.replace(".", "__")
-
-
-class ScannedGPTLayers(Layer):
-    """The L decoder blocks as stacked [L, ...] parameters applied by one
-    lax.scan over a functional template block.
-
-    TPU-native rationale: XLA traces/compiles the scan BODY once, so the
-    program is O(1 block) instead of O(L) — at gpt3-1.3B (24 layers,
-    remat) the unrolled HLO was large enough to kill the axon tunnel's
-    remote-compile RPC. Storage is stacked from construction (no
-    in-trace jnp.stack copy: at 1.3B that transient would be ~5 GB).
-    ref parity: the reference trains this size with fleet recompute +
-    1F1B over unrolled CUDA blocks; scan-over-layers is the XLA-idiom
-    equivalent (cf. flax nn.scan / public MaxText-style decoders).
-    """
+class ScannedGPTLayers(ScannedLayerStack):
+    """GPT's L decoder blocks through the generic scan-over-layers stack
+    (nn/scan_stack.py — O(1-block) compiled program; the gpt3-1.3B
+    remote-compile mitigation, BENCHLOG r4)."""
 
     def __init__(self, config: GPTConfig):
-        super().__init__()
-        self.cfg = config
-        L = config.num_hidden_layers
-        blocks = [GPTDecoderLayer(config) for _ in range(L)]
-        template = blocks[0]
-        self._pnames = [n for n, _ in template.named_parameters()]
-        for n in self._pnames:
-            refs = [dict(b.named_parameters())[n] for b in blocks]
-            p = Parameter(jnp.stack([r._value for r in refs]),
-                          trainable=refs[0].trainable)
-            spec = getattr(refs[0], "sharding_spec", None)
-            if spec is not None:
-                from jax.sharding import PartitionSpec
-                p.sharding_spec = PartitionSpec(None, *spec)
-            self.add_parameter(_flat_name(n), p)
-        # the template is NOT a sublayer (object.__setattr__ skips
-        # registration): its params must not appear in state_dict /
-        # parameters(). Values are freed to scalar placeholders — the
-        # scan body swaps real slices in before any forward runs.
-        for _, p in template.named_parameters():
-            p._value = jnp.zeros((), p.dtype)
-        object.__setattr__(self, "_template", template)
-
-    def forward(self, x, attn_mask=None):
-        from ..autograd import in_jax_trace, is_grad_enabled
-        cfg = self.cfg
-        xa = x._value if isinstance(x, Tensor) else x
-        traced = in_jax_trace((xa,))
-        if not traced and self.training and is_grad_enabled():
-            raise RuntimeError(
-                "scan_layers=True trains through the jitted Engine/"
-                "Model path only (the eager tape cannot see through "
-                "lax.scan). Use Engine.train_batch / Model.fit, wrap "
-                "the step in paddle_tpu.jit.to_static, or build the "
-                "model with scan_layers=False for eager training.")
-        need_rng = self.training and (
-            cfg.hidden_dropout_prob > 0
-            or cfg.attention_probs_dropout_prob > 0)
-        if need_rng:
-            # ONE key drawn at trace level, split per layer and fed as
-            # scan xs: the body traces once, so per-layer distinctness
-            # must ride the scanned inputs (a trace-time counter would
-            # give every layer the same dropout mask)
-            from .. import framework
-            keys = jax.random.split(framework.next_rng_key(),
-                                    cfg.num_hidden_layers)
-        else:
-            keys = None
-        stacked = {n: self._parameters[_flat_name(n)]._value
-                   for n in self._pnames}
-        template = self._template
-        mask = attn_mask  # loop-invariant; closed over
-
-        def body(carry, per_layer):
-            sliced, key = per_layer
-            out = functional_call(template, sliced, {}, Tensor(carry),
-                                  mask, rng=key)
-            return (out._value if isinstance(out, Tensor) else out), None
-
-        if cfg.recompute and self.training and traced:
-            # remat-scan: O(1 block) activation memory AND program size
-            body = jax.checkpoint(body)
-        y, _ = jax.lax.scan(body, xa, (stacked, keys))
-        return Tensor(y, stop_gradient=not is_grad_enabled())
-
-
-def stack_layer_state(state_dict, num_layers, prefix="h."):
-    """Convert per-layer checkpoint keys ('h.3.attn.q_proj.weight') to
-    the stacked layout ('h.attn__q_proj__weight' with a [L, ...] leading
-    dim). Non-layer keys pass through. For loading unrolled .pdparams
-    into a scan_layers=True model; inverse: unstack_layer_state."""
-    import numpy as np
-    per_layer, rest = {}, {}
-    for k, v in state_dict.items():
-        if k.startswith(prefix) and "." in k[len(prefix):]:
-            idx, dotted = k[len(prefix):].split(".", 1)
-            if idx.isdigit():
-                per_layer.setdefault(dotted, {})[int(idx)] = v
-                continue
-        rest[k] = v  # non-layer (or already-stacked) keys pass through
-    for dotted, by_idx in per_layer.items():
-        missing = set(range(num_layers)) - set(by_idx)
-        if missing:
-            raise ValueError(f"layer state for '{dotted}' missing "
-                             f"indices {sorted(missing)}")
-        arrs = [by_idx[i]._value if isinstance(by_idx[i], Tensor)
-                else np.asarray(by_idx[i]) for i in range(num_layers)]
-        rest[prefix + _flat_name(dotted)] = np.stack(arrs)
-    return rest
-
-
-def unstack_layer_state(state_dict, num_layers, prefix="h."):
-    """Inverse of stack_layer_state: stacked keys back to per-layer."""
-    import numpy as np
-    out = {}
-    for k, v in state_dict.items():
-        if k.startswith(prefix) and "__" in k[len(prefix):]:
-            dotted = k[len(prefix):].replace("__", ".")
-            arr = v._value if isinstance(v, Tensor) else np.asarray(v)
-            if arr.shape[0] != num_layers:
-                raise ValueError(
-                    f"stacked leaf '{k}' has leading dim {arr.shape[0]}"
-                    f" != num_layers {num_layers}")
-            for i in range(num_layers):
-                out[f"{prefix}{i}.{dotted}"] = arr[i]
-        else:
-            out[k] = v
-    return out
+        super().__init__(
+            [GPTDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)],
+            has_dropout=(config.hidden_dropout_prob > 0
+                         or config.attention_probs_dropout_prob > 0),
+            recompute=config.recompute)
 
 
 class GPTEmbeddings(Layer):

@@ -179,3 +179,39 @@ def test_program_size_shrinks():
     # 4 unrolled layers vs one scanned body: the traced program must
     # shrink markedly (the point of the lever at 24 layers/1.3B)
     assert len(hlo_s) < 0.6 * len(hlo_u), (len(hlo_s), len(hlo_u))
+
+
+def test_bert_ernie_scanned_forward_matches_unrolled():
+    """The generic ScannedLayerStack behind BertConfig.scan_layers must
+    reproduce the unrolled encoder bit-for-bit at eval (BERT + ERNIE)."""
+    from paddle_tpu.autograd import no_grad
+    from paddle_tpu.nlp.bert import BertConfig, BertModel
+    from paddle_tpu.nlp.ernie import ErnieConfig, ErnieModel
+    from paddle_tpu.nn.scan_stack import stack_layer_state
+
+    for Model, Config in ((BertModel, BertConfig), (ErnieModel, ErnieConfig)):
+        cfg = dict(vocab_size=67, hidden_size=32, num_hidden_layers=3,
+                   num_attention_heads=4, max_position_embeddings=32,
+                   hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+                   use_flash_attention=False)
+        paddle.seed(5)
+        unrolled = Model(Config(**cfg))
+        scanned = Model(Config(**cfg, scan_layers=True))
+        sd = stack_layer_state(
+            {k: np.asarray(v._value)
+             for k, v in unrolled.state_dict().items()},
+            cfg["num_hidden_layers"], prefix="encoder.")
+        scanned.set_state_dict(sd)
+        unrolled.eval(), scanned.eval()
+        ids = jnp.asarray(np.random.default_rng(1).integers(
+            0, 67, (2, 12)), jnp.int32)
+        with no_grad():
+            seq_u, pool_u = unrolled(Tensor(ids))
+            seq_s, pool_s = scanned(Tensor(ids))
+        np.testing.assert_allclose(np.asarray(seq_u._value),
+                                   np.asarray(seq_s._value),
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=Model.__name__)
+        np.testing.assert_allclose(np.asarray(pool_u._value),
+                                   np.asarray(pool_s._value),
+                                   rtol=1e-5, atol=1e-6)
