@@ -293,6 +293,11 @@ def worker_decode(args, on_tpu):
         attention_probs_dropout_prob=0.0,
         use_flash_attention=use_flash))
     model.eval()
+    if args.serve_dtype:
+        # the simplest rung of the serving ladder: cast every weight to
+        # bf16 — halves the per-token HBM weight stream vs fp32
+        model = model.to(dtype=args.serve_dtype)
+        log(f"serving weights cast to {args.serve_dtype}")
     if args.weight_only:
         from paddle_tpu.nn.quant import quantize_for_serving
         n = quantize_for_serving(model, weight_dtype=args.weight_only)
@@ -324,6 +329,7 @@ def worker_decode(args, on_tpu):
         "ms_per_step": round(dt / new_tok * 1e3, 2),
         "flash": use_flash, "flash_kernel": flash_kernel,
         "weight_only": args.weight_only,
+        "serve_dtype": args.serve_dtype,
         "cache_dtype": cache_dt,
         "backend": jax.default_backend(),
     }), flush=True)
@@ -643,6 +649,10 @@ def main():
     ap.add_argument("--weight-only", choices=("int8", "int4"), default=None,
                     help="decode: serve with weight-only-quantized linears "
                          "(HBM-bandwidth lever)")
+    ap.add_argument("--serve-dtype", default=None,
+                    choices=("bfloat16", "float16"),
+                    help="decode: cast model weights for serving "
+                         "(bf16 halves the HBM weight stream)")
     ap.add_argument("--cache-dtype", default=None,
                     help="decode KV cache dtype (bfloat16 halves decode "
                          "HBM traffic)")
@@ -699,6 +709,14 @@ def main():
     if args.cache_dtype and workloads != ["decode"]:
         ap.error("--cache-dtype applies to decode serving only "
                  "(use --decode)")
+    if args.serve_dtype and workloads != ["decode"]:
+        ap.error("--serve-dtype applies to decode serving only "
+                 "(use --decode)")
+    if args.serve_dtype and args.weight_only:
+        ap.error("--serve-dtype and --weight-only are separate rungs of "
+                 "the serving ladder: quantization derives its scales "
+                 "from fp32 weights, so casting first would quantize "
+                 "rounded values and mislabel the result")
     if args.moment_dtype and not set(workloads) <= {"gpt", "gpt-1.3b"}:
         ap.error("--moment-dtype applies to the gpt training "
                  "workloads only")
@@ -717,6 +735,7 @@ def main():
                  "--seq": args.seq, "--config": args.config,
                  "--moment-dtype": args.moment_dtype,
                  "--weight-only": args.weight_only,
+                 "--serve-dtype": args.serve_dtype,
                  "--cache-dtype": args.cache_dtype}
     if len(workloads) == 1:
         for flag, val in overrides.items():

@@ -82,6 +82,9 @@ STAGES = [
     ("bench_decode_int8", [PY, "bench.py", "--decode", "--weight-only",
                            "int8", "--cache-dtype", "bfloat16"], 2400,
      {}),
+    ("bench_decode_bf16w", [PY, "bench.py", "--decode", "--serve-dtype",
+                            "bfloat16", "--cache-dtype", "bfloat16"],
+     2400, {}),
     ("fusion_audit", [PY, "tools/fusion_audit.py", "--out",
                       "campaign_out/fusion_audit.md"], 3600, {}),
     ("resnet_roofline", [PY, "tools/resnet_roofline.py"], 2400, {}),
