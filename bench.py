@@ -545,7 +545,62 @@ def _flush_partial(results, probe):
         pass
 
 
+CAMPAIGN_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "campaign_out")
+
+
+DRIVER_MARKER = os.path.join(CAMPAIGN_OUT, "driver_bench_active")
+
+
+def _preempt_campaign():
+    """A driver-style bench run owns the chip: kill any in-flight
+    campaign stage (tools/tpu_campaign.py records its pid) and leave a
+    marker that makes tools/tunnel_watch.py and tpu_campaign.py hold
+    off, so two processes never time the TPU simultaneously. The marker
+    is removed when orchestrate() returns; its mtime bounds the hold-off
+    if this process dies uncleanly."""
+    pid_path = os.path.join(CAMPAIGN_OUT, "current_stage.pid")
+    try:
+        pid = int(open(pid_path).read().strip())
+        # identity check: never killpg a recycled pid from a stale file
+        cmdline = open(f"/proc/{pid}/cmdline", "rb").read().decode(
+            "utf-8", "replace")
+        if "bench.py" in cmdline or "tpu_campaign" in cmdline \
+                or "decode_probe" in cmdline or "roofline" in cmdline \
+                or "fusion_audit" in cmdline:
+            os.killpg(pid, signal.SIGKILL)
+            print(f"[bench] killed in-flight campaign stage (pgid {pid})"
+                  " — driver bench takes the chip", file=sys.stderr,
+                  flush=True)
+    except (OSError, ValueError, ProcessLookupError, PermissionError):
+        pass
+    try:
+        os.makedirs(CAMPAIGN_OUT, exist_ok=True)
+        with open(DRIVER_MARKER, "w") as f:
+            f.write(str(os.getpid()))
+    except OSError:
+        pass
+
+
+def _release_chip():
+    try:
+        os.remove(DRIVER_MARKER)
+    except OSError:
+        pass
+
+
 def orchestrate(workloads, args, passthrough):
+    smoke = args.smoke
+    if not smoke and not os.environ.get("CAMPAIGN_CHILD"):
+        _preempt_campaign()
+        try:
+            return _orchestrate_impl(workloads, args, passthrough)
+        finally:
+            _release_chip()
+    return _orchestrate_impl(workloads, args, passthrough)
+
+
+def _orchestrate_impl(workloads, args, passthrough):
     smoke = args.smoke
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT",
                                        240 if smoke else 600))

@@ -28,7 +28,8 @@ OUT = os.path.join(REPO, "campaign_out")
 PY = sys.executable
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from tpu_campaign import run  # noqa: E402  (shared killable-subprocess runner)
+from tpu_campaign import (run,  # noqa: E402  (shared runner)
+                          _driver_bench_active)
 
 
 def log_line(path, msg):
@@ -66,6 +67,13 @@ def main():
     pending = args.stages.split(",")
     attempts = {s: 0 for s in pending}
     while pending:
+        # the round-end driver bench owns the chip: hold off while its
+        # marker is fresh (it also SIGKILLs any in-flight stage)
+        if _driver_bench_active():
+            log_line(args.log, "driver bench owns the chip — holding "
+                               f"off {args.interval}s")
+            time.sleep(args.interval)
+            continue
         rc, dt, _ = run([PY, "bench.py", "--worker", "probe"],
                         args.probe_timeout, "watch_probe.log")
         if rc != 0:
@@ -87,7 +95,15 @@ def main():
             [PY, "tools/tpu_campaign.py", "--only", ",".join(pending)],
             cwd=REPO)
         done = succeeded_stages()
+        preempted = _driver_bench_active()
         pending = [s for s in pending if s not in done]
+        if preempted:
+            # stages cut short by the driver bench did not genuinely
+            # fail — give their attempt back
+            for s in pending:
+                attempts[s] -= 1
+            log_line(args.log, "campaign preempted by driver bench — "
+                               "attempts refunded")
         # a stage that keeps failing while the probe stays green is a
         # code/config problem, not the tunnel — stop burning the scarce
         # window on it (3 strikes), keep going with the rest
