@@ -84,9 +84,12 @@ def main():
         log_line(args.log, f"probe OK in {dt:.1f}s — launching stages "
                            f"{','.join(pending)}")
         # a stale summary.json from an earlier campaign must not mark
-        # stages succeeded that never ran this attempt
+        # stages succeeded that never ran this attempt — archive it (the
+        # record of earlier windows feeds bench.py's null-run diagnostic)
         try:
-            os.remove(os.path.join(OUT, "summary.json"))
+            import time as _time
+            os.rename(os.path.join(OUT, "summary.json"),
+                      os.path.join(OUT, f"summary_{int(_time.time())}.json"))
         except OSError:
             pass
         for s in pending:
