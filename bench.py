@@ -622,28 +622,41 @@ def _orchestrate_impl(workloads, args, passthrough):
         # reader at those artifacts instead of looking like three prior
         # null rounds (r4: campaign_out/summary.json holds a full suite
         # captured 2026-07-31 before the tunnel dropped again).
-        try:
-            import glob
-            ok_stages = {}
-            paths = sorted(glob.glob(os.path.join(CAMPAIGN_OUT,
-                                                  "summary*.json")),
-                           key=os.path.getmtime)
-            for p in paths:  # later windows override per stage
+        import glob
+        import re as _re
+
+        def _window_key(p):
+            # archives are summary_<epoch>.json — the name is the
+            # reliable order (mtimes collapse after a git checkout)
+            m = _re.search(r"summary_\D*(\d{9,})", os.path.basename(p))
+            try:
+                return int(m.group(1)) if m else int(os.path.getmtime(p))
+            except OSError:
+                return 0
+
+        ok_stages, used_paths = {}, []
+        for p in sorted(glob.glob(os.path.join(CAMPAIGN_OUT,
+                                               "summary*.json")),
+                        key=_window_key):  # later windows override
+            try:
                 with open(p) as f:
                     summ = json.load(f)
-                ok_stages.update({k: v.get("result")
-                                  for k, v in summ.items()
-                                  if v.get("ok") and v.get("result")})
-            if ok_stages:
-                diag["earlier_session_measurements"] = {
-                    "note": "measured by tools/tpu_campaign.py during a "
-                            "live tunnel window THIS round (see "
-                            "BENCHLOG.md); NOT this run's measurement",
-                    "artifacts": "campaign_out/summary.json",
-                    "stages": ok_stages,
-                }
-        except (OSError, json.JSONDecodeError, AttributeError):
-            pass
+                stage_res = {k: v.get("result") for k, v in summ.items()
+                             if v.get("ok") and v.get("result")}
+            except (OSError, json.JSONDecodeError, AttributeError):
+                continue  # one torn file must not discard the rest
+            if stage_res:
+                ok_stages.update(stage_res)
+                used_paths.append(os.path.relpath(p))
+        if ok_stages:
+            diag["earlier_session_measurements"] = {
+                "note": "measured by tools/tpu_campaign.py during "
+                        "earlier live tunnel windows on this machine "
+                        "(dates in BENCHLOG.md); NOT this run's "
+                        "measurement",
+                "artifacts": used_paths,
+                "stages": ok_stages,
+            }
         print(json.dumps(diag), flush=True)
         return 2
     print(f"[bench] probe ok: backend={probe.get('backend')} "
