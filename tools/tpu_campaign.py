@@ -110,6 +110,12 @@ STAGES = [
     ("bench_decode_bf16w", [PY, "bench.py", "--decode", "--serve-dtype",
                             "bfloat16", "--cache-dtype", "bfloat16"],
      2400, {}),
+    # Pallas flash-decode kernel (env-gated; run AFTER decode_probe's
+    # bisection says the kernel compiles — r2's decode wedge came from
+    # exactly this path, which is why it is last in the ladder)
+    ("bench_decode_flashk", [PY, "bench.py", "--decode", "--cache-dtype",
+                             "bfloat16"], 2400,
+     {"PADDLE_TPU_FLASH_DECODE": "1"}),
     ("fusion_audit", [PY, "tools/fusion_audit.py", "--out",
                       "campaign_out/fusion_audit.md"], 3600, {}),
     ("resnet_roofline", [PY, "tools/resnet_roofline.py"], 2400, {}),
@@ -131,7 +137,8 @@ STAGES = [
 # stages addressable via --only but excluded from the default sweep
 # (bench_full's workload list already includes gpt-1.3b — running the
 # standalone stage too would duplicate up to 2400s on a fragile tunnel)
-RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16"}
+RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
+              "bench_decode_flashk"}
 
 
 def main():
