@@ -107,7 +107,7 @@ def gpt_flops_per_token(model, seq):
 
 
 def build_engine(cfg_name, batch, seq, amp, use_flash=True, recompute=False,
-                 moment_dtype=None, scan_layers=False):
+                 moment_dtype=None, scan_layers=False, fused_qkv=False):
     import jax.numpy as jnp
     from paddle_tpu.nlp.gpt import (GPTForCausalLM, GPT_CONFIGS,
                                     GPTPretrainingCriterion, _resolve_config)
@@ -119,7 +119,7 @@ def build_engine(cfg_name, batch, seq, amp, use_flash=True, recompute=False,
         cfg_name, max_position_embeddings=max_pos,
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
         use_flash_attention=use_flash, recompute=recompute,
-        scan_layers=scan_layers))
+        scan_layers=scan_layers, fused_qkv=fused_qkv))
     model.train()
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
                 parameters=model.parameters(), moment_dtype=moment_dtype)
@@ -420,7 +420,7 @@ def worker_gpt(args, on_tpu, big=False):
     scan_layers = args.scan_layers
     eng = build_engine(cfg, batch, seq, amp, use_flash=use_flash,
                        recompute=recompute, moment_dtype=moment_dtype,
-                       scan_layers=scan_layers)
+                       scan_layers=scan_layers, fused_qkv=args.fused_qkv)
     try:
         tput = run(eng, batch, seq, steps, warmup,
                    scan_steps=args.scan_steps)
@@ -442,7 +442,7 @@ def worker_gpt(args, on_tpu, big=False):
         scan_layers = True
         eng = build_engine(cfg, batch, seq, amp, use_flash=use_flash,
                            recompute=recompute, moment_dtype=moment_dtype,
-                           scan_layers=True)
+                           scan_layers=True, fused_qkv=args.fused_qkv)
         tput = run(eng, batch, seq, steps, warmup,
                    scan_steps=args.scan_steps)
     fpt = gpt_flops_per_token(eng.network, seq)
@@ -460,7 +460,7 @@ def worker_gpt(args, on_tpu, big=False):
         if on_tpu else None,
         "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
         "config": cfg, "batch": batch, "seq": seq, "flash": use_flash,
-        "scan_layers": scan_layers,
+        "scan_layers": scan_layers, "fused_qkv": args.fused_qkv,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -751,6 +751,9 @@ def main():
     ap.add_argument("--cache-dtype", default=None,
                     help="decode KV cache dtype (bfloat16 halves decode "
                          "HBM traffic)")
+    ap.add_argument("--fused-qkv", action="store_true",
+                    help="gpt: one [h,3h] qkv matmul (Megatron "
+                         "head-interleaved) instead of three [h,h]")
     ap.add_argument("--no-scan-fallback", action="store_true",
                     help="gpt-1.3b: fail instead of retrying a tunnel-cut "
                          "unrolled compile with scan_layers (the dedicated "
@@ -818,6 +821,9 @@ def main():
     if args.scan_layers and not set(workloads) <= {"gpt", "gpt-1.3b"}:
         ap.error("--scan-layers applies to the gpt training "
                  "workloads only")
+    if args.fused_qkv and not set(workloads) <= {"gpt", "gpt-1.3b"}:
+        ap.error("--fused-qkv applies to the gpt training "
+                 "workloads only")
     if args.no_scan_fallback and workloads != ["gpt-1.3b"]:
         ap.error("--no-scan-fallback applies to the gpt-1.3b workload "
                  "only (use --model gpt-1.3b)")
@@ -846,11 +852,13 @@ def main():
             passthrough += ["--scan-steps", str(args.scan_steps)]
         if args.scan_layers:
             passthrough.append("--scan-layers")
+        if args.fused_qkv:
+            passthrough.append("--fused-qkv")
         if args.no_scan_fallback:
             passthrough.append("--no-scan-fallback")
     elif any(v is not None for v in overrides.values()) or args.no_flash \
             or args.recompute or args.scan_steps or args.s2d \
-            or args.scan_layers:
+            or args.scan_layers or args.fused_qkv:
         print("[bench] ignoring per-workload flags in full-suite mode "
               "(use --model to tune one workload)", file=sys.stderr,
               flush=True)

@@ -132,13 +132,16 @@ STAGES = [
     # acts at b16 s1024 with flash ~4 GB)
     ("bench_gpt_b16", [PY, "bench.py", "--model", "gpt", "--batch", "16"],
      2400, {}),
+    # fused [h,3h] qkv matmul A/B on the headline config
+    ("bench_gpt_fusedqkv", [PY, "bench.py", "--model", "gpt",
+                            "--fused-qkv"], 2400, {}),
 ]
 
 # stages addressable via --only but excluded from the default sweep
 # (bench_full's workload list already includes gpt-1.3b — running the
 # standalone stage too would duplicate up to 2400s on a fragile tunnel)
 RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
-              "bench_decode_flashk"}
+              "bench_decode_flashk", "bench_gpt_fusedqkv"}
 
 
 def main():
