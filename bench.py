@@ -174,7 +174,7 @@ def run(eng, batch, seq, steps, warmup, scan_steps=0):
     return batch * seq * steps / dt
 
 
-def build_ernie_engine(batch, seq, amp):
+def build_ernie_engine(batch, seq, amp, fused_qkv=False):
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.nlp import (ErnieForPretraining,
@@ -189,7 +189,8 @@ def build_ernie_engine(batch, seq, amp):
                   ["max_position_embeddings"], seq)
     model = ErnieForPretraining(_ernie_cfg(
         "ernie-3.0-base-zh", max_position_embeddings=max_pos,
-        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        fused_qkv=fused_qkv))
     model.train()
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
                 parameters=model.parameters())
@@ -376,8 +377,9 @@ def worker_ernie(args, on_tpu):
     seq = args.seq or seq
     steps = args.steps or steps
     log(f"bench: ernie-3.0-base batch={batch} seq={seq} steps={steps} "
-        f"backend={jax.default_backend()} amp={amp}")
-    eng = build_ernie_engine(batch, seq, amp)
+        f"backend={jax.default_backend()} amp={amp} "
+        f"fused_qkv={args.fused_qkv}")
+    eng = build_ernie_engine(batch, seq, amp, fused_qkv=args.fused_qkv)
     tput = run_ernie(eng, batch, seq, steps, warmup)
     fpt = gpt_flops_per_token(eng.network, seq)  # same 6N+12Lhs conv.
     print(json.dumps({
@@ -388,7 +390,7 @@ def worker_ernie(args, on_tpu):
             tput / BASELINE_ERNIE_TOKENS_PER_SEC_PER_CHIP, 4)
         if on_tpu else None,
         "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
-        "batch": batch, "seq": seq,
+        "batch": batch, "seq": seq, "fused_qkv": args.fused_qkv,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -821,8 +823,9 @@ def main():
     if args.scan_layers and not set(workloads) <= {"gpt", "gpt-1.3b"}:
         ap.error("--scan-layers applies to the gpt training "
                  "workloads only")
-    if args.fused_qkv and not set(workloads) <= {"gpt", "gpt-1.3b"}:
-        ap.error("--fused-qkv applies to the gpt training "
+    if args.fused_qkv and not set(workloads) <= {"gpt", "gpt-1.3b",
+                                                 "ernie"}:
+        ap.error("--fused-qkv applies to the gpt/ernie training "
                  "workloads only")
     if args.no_scan_fallback and workloads != ["gpt-1.3b"]:
         ap.error("--no-scan-fallback applies to the gpt-1.3b workload "

@@ -51,6 +51,9 @@ class BertConfig:
     # (nn/scan_stack.py): O(1-block) compiled program. Training/inference
     # without per-layer outputs only; eager-tape training is gated.
     scan_layers: bool = False
+    # one [h, 3h] qkv matmul (Megatron head-interleave; convert
+    # checkpoints with gpt.fuse_qkv_state / split_qkv_state)
+    fused_qkv: bool = False
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -99,12 +102,18 @@ class BertSelfAttention(Layer):
         self.cfg = config
         h = config.hidden_size
         wa = _init_attr(config)
-        self.q_proj = ColumnParallelLinear(h, h, weight_attr=wa,
-                                           gather_output=False)
-        self.k_proj = ColumnParallelLinear(h, h, weight_attr=wa,
-                                           gather_output=False)
-        self.v_proj = ColumnParallelLinear(h, h, weight_attr=wa,
-                                           gather_output=False)
+        if getattr(config, "fused_qkv", False):
+            # one [h, 3h] matmul, Megatron head-interleave [H, 3, d]
+            # (same layout/conversion as GPT — gpt.fuse_qkv_state)
+            self.qkv_proj = ColumnParallelLinear(h, 3 * h, weight_attr=wa,
+                                                 gather_output=False)
+        else:
+            self.q_proj = ColumnParallelLinear(h, h, weight_attr=wa,
+                                               gather_output=False)
+            self.k_proj = ColumnParallelLinear(h, h, weight_attr=wa,
+                                               gather_output=False)
+            self.v_proj = ColumnParallelLinear(h, h, weight_attr=wa,
+                                               gather_output=False)
         self.out_proj = RowParallelLinear(h, h, weight_attr=wa,
                                           input_is_parallel=True)
 
@@ -112,10 +121,17 @@ class BertSelfAttention(Layer):
         b, s = x.shape[0], x.shape[1]
         return x.reshape([b, s, -1, self.cfg.head_dim])
 
+    def _qkv(self, x):
+        if getattr(self.cfg, "fused_qkv", False):
+            qkv = self.qkv_proj(x)
+            b, s = qkv.shape[0], qkv.shape[1]
+            qkv = qkv.reshape([b, s, -1, 3, self.cfg.head_dim])
+            return qkv[:, :, :, 0], qkv[:, :, :, 1], qkv[:, :, :, 2]
+        return (self._heads(self.q_proj(x)), self._heads(self.k_proj(x)),
+                self._heads(self.v_proj(x)))
+
     def forward(self, x, attn_mask=None):
-        q = self._heads(self.q_proj(x))
-        k = self._heads(self.k_proj(x))
-        v = self._heads(self.v_proj(x))
+        q, k, v = self._qkv(x)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask,
             dropout_p=self.cfg.attention_probs_dropout_prob

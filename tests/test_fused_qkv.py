@@ -113,3 +113,34 @@ def test_conversion_refuses_wrong_format():
                        4)
     with pytest.raises(ValueError, match="0 fused leaves"):
         split_qkv_state({"ln_f.weight": np.ones(4)}, 4)
+
+
+def test_bert_ernie_fused_matches_separate():
+    from paddle_tpu.nlp.bert import BertConfig, BertModel
+    from paddle_tpu.nlp.ernie import ErnieConfig, ErnieModel
+
+    for Model, Config in ((BertModel, BertConfig), (ErnieModel, ErnieConfig)):
+        cfg = dict(vocab_size=67, hidden_size=32, num_hidden_layers=2,
+                   num_attention_heads=4, max_position_embeddings=32,
+                   hidden_dropout_prob=0.0,
+                   attention_probs_dropout_prob=0.0,
+                   use_flash_attention=False)
+        paddle.seed(4)
+        sep = Model(Config(**cfg))
+        fused = Model(Config(**cfg, fused_qkv=True))
+        fused.set_state_dict(fuse_qkv_state(
+            {k: np.asarray(v._value) for k, v in sep.state_dict().items()},
+            cfg["num_attention_heads"]))
+        sep.eval(), fused.eval()
+        ids = jnp.asarray(np.random.default_rng(2).integers(
+            0, 67, (2, 10)), jnp.int32)
+        with no_grad():
+            s1, p1 = sep(Tensor(ids))
+            s2, p2 = fused(Tensor(ids))
+        np.testing.assert_allclose(np.asarray(s1._value),
+                                   np.asarray(s2._value),
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg=Model.__name__)
+        np.testing.assert_allclose(np.asarray(p1._value),
+                                   np.asarray(p2._value),
+                                   rtol=2e-5, atol=2e-6)

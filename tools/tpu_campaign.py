@@ -135,13 +135,16 @@ STAGES = [
     # fused [h,3h] qkv matmul A/B on the headline config
     ("bench_gpt_fusedqkv", [PY, "bench.py", "--model", "gpt",
                             "--fused-qkv"], 2400, {}),
+    ("bench_ernie_fusedqkv", [PY, "bench.py", "--model", "ernie",
+                              "--fused-qkv"], 2400, {}),
 ]
 
 # stages addressable via --only but excluded from the default sweep
 # (bench_full's workload list already includes gpt-1.3b — running the
 # standalone stage too would duplicate up to 2400s on a fragile tunnel)
 RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
-              "bench_decode_flashk", "bench_gpt_fusedqkv"}
+              "bench_decode_flashk", "bench_gpt_fusedqkv",
+              "bench_ernie_fusedqkv"}
 
 
 def main():
