@@ -144,3 +144,31 @@ def test_bert_ernie_fused_matches_separate():
         np.testing.assert_allclose(np.asarray(p1._value),
                                    np.asarray(p2._value),
                                    rtol=2e-5, atol=2e-6)
+
+
+def test_fused_qkv_composes_with_scan_layers():
+    """scan_layers + fused_qkv together (the 1.3B compile-size + launch
+    -count combo) must match the unrolled fused model in training."""
+    from paddle_tpu.hapi.engine import Engine
+    from paddle_tpu.nn.scan_stack import stack_layer_state
+
+    paddle.seed(11)
+    base = GPTForCausalLM(GPTConfig(**CFG, fused_qkv=True))
+    both = GPTForCausalLM(GPTConfig(**CFG, fused_qkv=True,
+                                    scan_layers=True, recompute=True))
+    sd = stack_layer_state({k: np.asarray(v._value)
+                            for k, v in base.state_dict().items()},
+                           CFG["num_hidden_layers"], prefix="gpt.h.")
+    both.set_state_dict(sd)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 89, (2, 16)), jnp.int32)
+    lbl = jnp.asarray(rng.integers(0, 89, (2, 16)), jnp.int32)
+    losses = []
+    for m in (base, both):
+        m.train()
+        eng = Engine(m, loss=GPTPretrainingCriterion(),
+                     optimizer=paddle.optimizer.SGD(
+                         0.05, parameters=m.parameters()))
+        losses.append([float(eng.train_batch([ids], [lbl])[0])
+                       for _ in range(2)])
+    np.testing.assert_allclose(losses[0], losses[1], rtol=1e-6)
