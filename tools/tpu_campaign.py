@@ -137,6 +137,9 @@ STAGES = [
                             "--fused-qkv"], 2400, {}),
     ("bench_ernie_fusedqkv", [PY, "bench.py", "--model", "ernie",
                               "--fused-qkv"], 2400, {}),
+    ("step_anatomy", [PY, "tools/step_anatomy.py"], 2400, {}),
+    ("step_anatomy_fused", [PY, "tools/step_anatomy.py", "--fused-qkv"],
+     2400, {}),
 ]
 
 # stages addressable via --only but excluded from the default sweep
@@ -144,7 +147,7 @@ STAGES = [
 # standalone stage too would duplicate up to 2400s on a fragile tunnel)
 RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
               "bench_decode_flashk", "bench_gpt_fusedqkv",
-              "bench_ernie_fusedqkv"}
+              "bench_ernie_fusedqkv", "step_anatomy", "step_anatomy_fused"}
 
 
 def main():
