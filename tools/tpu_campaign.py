@@ -110,6 +110,9 @@ STAGES = [
     ("bench_decode_bf16w", [PY, "bench.py", "--decode", "--serve-dtype",
                             "bfloat16", "--cache-dtype", "bfloat16"],
      2400, {}),
+    ("bench_decode_int4", [PY, "bench.py", "--decode", "--weight-only",
+                           "int4", "--cache-dtype", "bfloat16"], 2400,
+     {}),
     # Pallas flash-decode kernel (env-gated; run AFTER decode_probe's
     # bisection says the kernel compiles — r2's decode wedge came from
     # exactly this path, which is why it is last in the ladder)

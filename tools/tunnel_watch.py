@@ -55,7 +55,7 @@ def main():
     ap.add_argument(
         "--stages",
         default="bench_gpt13b_scan,bench_decode,bench_decode_bf16kv,"
-                "bench_decode_int8,bench_decode_bf16w,bench_gpt13b,decode_probe,"
+                "bench_decode_int8,bench_decode_bf16w,bench_decode_int4,bench_gpt13b,decode_probe,"
                 "bench_gpt_b16,bench_gpt_fusedqkv,bench_ernie_fusedqkv,step_anatomy,step_anatomy_fused,resnet_roofline,fusion_audit,bench_decode_flashk")
     ap.add_argument("--log", default=os.path.join(OUT, "probe_r4b.log"))
     ap.add_argument("--max-attempts", type=int, default=3,
