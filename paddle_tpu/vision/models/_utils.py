@@ -18,14 +18,24 @@ def load_pretrained(model, pretrained, arch=None):
         pretrained weights).
     pretrained=True         -> loud gate: this offline environment has
         no download path; the error documents the convert-and-load
-        recipe instead."""
+        recipe instead.
+
+    `model` may be a zero-arg factory (the zoo passes `lambda: VGG(...)`)
+    so the pretrained=True gate fires BEFORE paying model construction —
+    vgg16's random init alone is ~18 s on a 1-core host."""
+    def build():
+        return model() if callable(model) and not isinstance(model, nn.Layer) \
+            else model
+
     if not pretrained:
-        return model
+        return build()
     if isinstance(pretrained, (str, os.PathLike)):
         from ...serialization import load_into
-        load_into(model, pretrained)
-        return model
-    name = arch or type(model).__name__
+        built = build()
+        load_into(built, pretrained)
+        return built
+    name = arch or (type(model).__name__ if isinstance(model, nn.Layer)
+                    else "Model")
     raise NotImplementedError(
         f"pretrained=True needs a weights download, which this offline "
         f"environment cannot do. Recipe: in the reference framework run "

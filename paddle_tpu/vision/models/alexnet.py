@@ -35,4 +35,4 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    return load_pretrained(AlexNet(**kwargs), pretrained)
+    return load_pretrained(lambda: AlexNet(**kwargs), pretrained, arch="alexnet")

@@ -85,20 +85,20 @@ class DenseNet(nn.Layer):
 
 
 def densenet121(pretrained=False, **kw):
-    return load_pretrained(DenseNet(121, **kw), pretrained)
+    return load_pretrained(lambda: DenseNet(121, **kw), pretrained, arch="densenet121")
 
 
 def densenet161(pretrained=False, **kw):
-    return load_pretrained(DenseNet(161, **kw), pretrained)
+    return load_pretrained(lambda: DenseNet(161, **kw), pretrained, arch="densenet161")
 
 
 def densenet169(pretrained=False, **kw):
-    return load_pretrained(DenseNet(169, **kw), pretrained)
+    return load_pretrained(lambda: DenseNet(169, **kw), pretrained, arch="densenet169")
 
 
 def densenet201(pretrained=False, **kw):
-    return load_pretrained(DenseNet(201, **kw), pretrained)
+    return load_pretrained(lambda: DenseNet(201, **kw), pretrained, arch="densenet201")
 
 
 def densenet264(pretrained=False, **kw):
-    return load_pretrained(DenseNet(264, **kw), pretrained)
+    return load_pretrained(lambda: DenseNet(264, **kw), pretrained, arch="densenet264")

@@ -95,4 +95,4 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
-    return load_pretrained(GoogLeNet(**kwargs), pretrained)
+    return load_pretrained(lambda: GoogLeNet(**kwargs), pretrained, arch="googlenet")

@@ -139,4 +139,4 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
-    return load_pretrained(InceptionV3(**kwargs), pretrained)
+    return load_pretrained(lambda: InceptionV3(**kwargs), pretrained, arch="inception_v3")

@@ -227,16 +227,16 @@ class MobileNetV3Large(_MobileNetV3):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    return load_pretrained(MobileNetV1(scale=scale, **kwargs), pretrained)
+    return load_pretrained(lambda: MobileNetV1(scale=scale, **kwargs), pretrained, arch="mobilenet_v1")
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    return load_pretrained(MobileNetV2(scale=scale, **kwargs), pretrained)
+    return load_pretrained(lambda: MobileNetV2(scale=scale, **kwargs), pretrained, arch="mobilenet_v2")
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    return load_pretrained(MobileNetV3Small(scale=scale, **kwargs), pretrained)
+    return load_pretrained(lambda: MobileNetV3Small(scale=scale, **kwargs), pretrained, arch="mobilenet_v3_small")
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    return load_pretrained(MobileNetV3Large(scale=scale, **kwargs), pretrained)
+    return load_pretrained(lambda: MobileNetV3Large(scale=scale, **kwargs), pretrained, arch="mobilenet_v3_large")

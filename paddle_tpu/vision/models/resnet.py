@@ -197,7 +197,7 @@ class ResNet(nn.Layer):
 
 def _resnet(block, depth, pretrained=False, **kwargs):
     from ._utils import load_pretrained
-    return load_pretrained(ResNet(block, depth, **kwargs), pretrained,
+    return load_pretrained(lambda: ResNet(block, depth, **kwargs), pretrained,
                            arch=f"resnet{depth}")
 
 

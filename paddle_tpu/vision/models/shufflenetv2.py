@@ -105,28 +105,28 @@ class ShuffleNetV2(nn.Layer):
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kw):
-    return load_pretrained(ShuffleNetV2(0.25, **kw), pretrained)
+    return load_pretrained(lambda: ShuffleNetV2(0.25, **kw), pretrained, arch="shufflenet_v2_x0_25")
 
 
 def shufflenet_v2_x0_33(pretrained=False, **kw):
-    return load_pretrained(ShuffleNetV2(0.33, **kw), pretrained)
+    return load_pretrained(lambda: ShuffleNetV2(0.33, **kw), pretrained, arch="shufflenet_v2_x0_33")
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kw):
-    return load_pretrained(ShuffleNetV2(0.5, **kw), pretrained)
+    return load_pretrained(lambda: ShuffleNetV2(0.5, **kw), pretrained, arch="shufflenet_v2_x0_5")
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kw):
-    return load_pretrained(ShuffleNetV2(1.0, **kw), pretrained)
+    return load_pretrained(lambda: ShuffleNetV2(1.0, **kw), pretrained, arch="shufflenet_v2_x1_0")
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kw):
-    return load_pretrained(ShuffleNetV2(1.5, **kw), pretrained)
+    return load_pretrained(lambda: ShuffleNetV2(1.5, **kw), pretrained, arch="shufflenet_v2_x1_5")
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kw):
-    return load_pretrained(ShuffleNetV2(2.0, **kw), pretrained)
+    return load_pretrained(lambda: ShuffleNetV2(2.0, **kw), pretrained, arch="shufflenet_v2_x2_0")
 
 
 def shufflenet_v2_swish(pretrained=False, **kw):
-    return load_pretrained(ShuffleNetV2(1.0, act="swish", **kw), pretrained)
+    return load_pretrained(lambda: ShuffleNetV2(1.0, act="swish", **kw), pretrained, arch="shufflenet_v2_swish")

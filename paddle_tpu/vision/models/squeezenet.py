@@ -66,8 +66,8 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    return load_pretrained(SqueezeNet("1.0", **kwargs), pretrained)
+    return load_pretrained(lambda: SqueezeNet("1.0", **kwargs), pretrained, arch="squeezenet1_0")
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    return load_pretrained(SqueezeNet("1.1", **kwargs), pretrained)
+    return load_pretrained(lambda: SqueezeNet("1.1", **kwargs), pretrained, arch="squeezenet1_1")

@@ -61,8 +61,14 @@ class VGG(nn.Layer):
         return x
 
 
+_PUBLIC_NAME = {"A": "vgg11", "B": "vgg13", "D": "vgg16", "E": "vgg19"}
+
+
 def _vgg(cfg, batch_norm, pretrained=False, **kwargs):
-    return load_pretrained(VGG(make_layers(_CFGS[cfg], batch_norm), **kwargs), pretrained)
+    arch = _PUBLIC_NAME[cfg] + ("_bn" if batch_norm else "")
+    return load_pretrained(
+        lambda: VGG(make_layers(_CFGS[cfg], batch_norm), **kwargs),
+        pretrained, arch=arch)
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
