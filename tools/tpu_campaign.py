@@ -140,6 +140,10 @@ STAGES = [
                             "--fused-qkv"], 2400, {}),
     ("bench_ernie_fusedqkv", [PY, "bench.py", "--model", "ernie",
                               "--fused-qkv"], 2400, {}),
+    # long-context: flash 512-blocks beat XLA fused attention 1.77x at
+    # s=4096 (r2 microbench) — measure the end-to-end train step there
+    ("bench_gpt_s4k", [PY, "bench.py", "--model", "gpt", "--batch", "2",
+                       "--seq", "4096"], 2400, {}),
     ("step_anatomy", [PY, "tools/step_anatomy.py"], 2400, {}),
     ("step_anatomy_fused", [PY, "tools/step_anatomy.py", "--fused-qkv"],
      2400, {}),
@@ -150,7 +154,8 @@ STAGES = [
 # standalone stage too would duplicate up to 2400s on a fragile tunnel)
 RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
               "bench_decode_flashk", "bench_gpt_fusedqkv",
-              "bench_ernie_fusedqkv", "step_anatomy", "step_anatomy_fused"}
+              "bench_ernie_fusedqkv", "step_anatomy", "step_anatomy_fused",
+              "bench_gpt_s4k"}
 
 
 def main():
