@@ -613,10 +613,13 @@ def _orchestrate_impl(workloads, args, passthrough):
                                 + (["--smoke"] if smoke else []),
                                 probe_timeout, "probe")
     if probe is None or probe.get("probe") != "ok":
+        # error text can embed a multi-KB backend traceback — bound it,
+        # the final line must never outgrow the driver's capture
+        err_text = f"backend probe failed: {err or probe}"
         diag = {
             "metric": "gpt_pretrain_tokens_per_sec_per_chip",
             "value": None, "unit": "tokens/s/chip", "vs_baseline": None,
-            "error": f"backend probe failed: {err or probe}",
+            "error": err_text[:800],
             "probe_seconds": round(dt, 1),
         }
         # value stays null — this run measured nothing. But if an earlier
@@ -651,14 +654,46 @@ def _orchestrate_impl(workloads, args, passthrough):
                 ok_stages.update(stage_res)
                 used_paths.append(os.path.relpath(p))
         if ok_stages:
+            # The final line must stay COMPACT — r4's line embedded every
+            # stage payload, grew past the driver's capture, and was
+            # truncated mid-JSON (4th straight parsed:null). Full payload
+            # goes to a file; the line carries scalars + pointers only.
+            full_path = os.path.join(CAMPAIGN_OUT, "driver_diag.json")
+            try:
+                with open(full_path, "w") as f:
+                    json.dump({"artifacts": used_paths,
+                               "stages": ok_stages}, f, indent=1)
+            except OSError as e:
+                print(f"[bench] could not write {full_path}: {e}",
+                      file=sys.stderr, flush=True)
+                full_path = None
+            compact = {}
+            for name, res in ok_stages.items():
+                if not isinstance(res, dict):
+                    continue
+                row = {k: res[k] for k in ("metric", "value", "unit",
+                                           "vs_baseline", "mfu")
+                       if k in res and not isinstance(res[k],
+                                                      (dict, list))}
+                if row:
+                    compact[name] = row
             diag["earlier_session_measurements"] = {
                 "note": "measured by tools/tpu_campaign.py during "
                         "earlier live tunnel windows on this machine "
                         "(dates in BENCHLOG.md); NOT this run's "
                         "measurement",
                 "artifacts": used_paths,
-                "stages": ok_stages,
+                "full_diag": (os.path.relpath(full_path)
+                              if full_path else None),
+                "headline_scalars": compact,
             }
+            # belt-and-braces cap: shed weight until the line fits,
+            # heaviest-first, re-checking after each shed
+            em = diag["earlier_session_measurements"]
+            for shed in ("headline_scalars", "artifacts", "note"):
+                if len(json.dumps(diag)) <= 6000:
+                    break
+                em.pop(shed, None)
         print(json.dumps(diag), flush=True)
         return 2
     print(f"[bench] probe ok: backend={probe.get('backend')} "
