@@ -47,8 +47,11 @@ BASELINE_RESNET50_IMG_PER_SEC_PER_CHIP = 2900.0  # SURVEY §6: A100 fp16
 # ratio (same training-efficiency assumption): 3.5k * 1.3e9/118e6
 BASELINE_ERNIE_TOKENS_PER_SEC_PER_CHIP = 38500.0
 
+# partials live under campaign_out/ date-stamped like the summaries —
+# a probe-timeout diagnostic at the repo root read like a round result
 PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "bench_partial.json")
+                            "campaign_out",
+                            f"bench_partial_{int(time.time())}.json")
 
 
 def log(*a):
@@ -534,9 +537,23 @@ def _spawn(extra_args, timeout_s, tag):
     return (proc.returncode, parsed, None, dt)
 
 
+def _proc_starttime(pid):
+    """Kernel start time of `pid` (clock ticks since boot; field 22 of
+    /proc/<pid>/stat, parsed after the last ')' — comm may hold spaces).
+    Returns 0 if unreadable. Single owner of the 'pid starttime'
+    pidfile identity format; tools/tpu_campaign.py imports this."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            stat = f.read()
+        return int(stat.rsplit(")", 1)[1].split()[19])
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
 def _flush_partial(results, probe):
     """Persist everything measured so far — survives any later wedge."""
     try:
+        os.makedirs(os.path.dirname(PARTIAL_PATH), exist_ok=True)
         with open(PARTIAL_PATH, "w") as f:
             json.dump({
                 "probe": probe,
@@ -563,8 +580,16 @@ def _preempt_campaign():
     if this process dies uncleanly."""
     pid_path = os.path.join(CAMPAIGN_OUT, "current_stage.pid")
     try:
-        pid = int(open(pid_path).read().strip())
-        # identity check: never killpg a recycled pid from a stale file
+        parts = open(pid_path).read().split()
+        pid = int(parts[0])
+        recorded_start = int(parts[1]) if len(parts) > 1 else 0
+        # identity check: never killpg a recycled pid from a stale file.
+        # The kernel starttime recorded at spawn is the strong check
+        # (a recycled pid can't share it); 0 is the writer's
+        # "unreadable" sentinel and legacy pid-only files omit it —
+        # both fall through to the cmdline substring fallback alone.
+        if recorded_start and _proc_starttime(pid) != recorded_start:
+            raise ValueError("pid recycled (starttime mismatch)")
         cmdline = open(f"/proc/{pid}/cmdline", "rb").read().decode(
             "utf-8", "replace")
         if "bench.py" in cmdline or "tpu_campaign" in cmdline \
@@ -574,7 +599,8 @@ def _preempt_campaign():
             print(f"[bench] killed in-flight campaign stage (pgid {pid})"
                   " — driver bench takes the chip", file=sys.stderr,
                   flush=True)
-    except (OSError, ValueError, ProcessLookupError, PermissionError):
+    except (OSError, ValueError, IndexError, ProcessLookupError,
+            PermissionError):
         pass
     try:
         os.makedirs(CAMPAIGN_OUT, exist_ok=True)
@@ -630,26 +656,40 @@ def _orchestrate_impl(workloads, args, passthrough):
         import glob
         import re as _re
 
-        def _window_key(p):
-            # archives are summary_<epoch>.json — the name is the
-            # reliable order (mtimes collapse after a git checkout)
+        def _window_key(p, summ):
+            # prefer the capture epoch the campaign embeds in the JSON,
+            # then the summary_<epoch>.json filename; mtime is the last
+            # resort only (mtimes collapse after a git checkout)
+            emb = summ.get("_captured_at", {})
+            if isinstance(emb, dict) and emb.get("epoch"):
+                try:
+                    return int(emb["epoch"])
+                except (ValueError, TypeError):
+                    pass
             m = _re.search(r"summary_\D*(\d{9,})", os.path.basename(p))
             try:
                 return int(m.group(1)) if m else int(os.path.getmtime(p))
             except OSError:
                 return 0
 
-        ok_stages, used_paths = {}, []
-        for p in sorted(glob.glob(os.path.join(CAMPAIGN_OUT,
-                                               "summary*.json")),
-                        key=_window_key):  # later windows override
+        parsed_summaries = []
+        for p in glob.glob(os.path.join(CAMPAIGN_OUT, "summary*.json")):
             try:
                 with open(p) as f:
                     summ = json.load(f)
-                stage_res = {k: v.get("result") for k, v in summ.items()
-                             if v.get("ok") and v.get("result")}
-            except (OSError, json.JSONDecodeError, AttributeError):
+            except (OSError, json.JSONDecodeError):
                 continue  # one torn file must not discard the rest
+            if isinstance(summ, dict):
+                parsed_summaries.append((_window_key(p, summ), p, summ))
+        ok_stages, used_paths = {}, []
+        # later windows override
+        for _, p, summ in sorted(parsed_summaries, key=lambda t: t[0]):
+            try:
+                stage_res = {k: v.get("result") for k, v in summ.items()
+                             if isinstance(v, dict) and v.get("ok")
+                             and v.get("result")}
+            except AttributeError:
+                continue
             if stage_res:
                 ok_stages.update(stage_res)
                 used_paths.append(os.path.relpath(p))

@@ -49,16 +49,31 @@ def clear_decode_cache(model):
     already part of the key, but swapping a sublayer for one with the
     same param shapes is not) — jit cannot see such a change, so the
     memo would otherwise serve the old forward."""
-    with _memo_lock:
+    with _model_lock(model):
         if getattr(model, _MEMO_ATTR, None):
             getattr(model, _MEMO_ATTR).clear()
 
 
-# RLock: generate() holds it across build+call (functional_call swaps
-# tracers into the shared model while tracing, so concurrent tracing on
-# one model is unsafe by construction — same property as torch.func's
-# functional_call); _memoized_decode_fn re-acquires it under generate().
-_memo_lock = threading.RLock()
+# Per-model RLock: generate() holds it across build+call
+# (functional_call swaps tracers into the shared model while tracing,
+# so concurrent tracing on ONE model is unsafe by construction — same
+# property as torch.func's functional_call); _memoized_decode_fn
+# re-acquires it under generate(). Calls on *independent* models run
+# concurrently — a single module-global lock serialized them all. The
+# tiny global lock below guards only lock-attr creation.
+_LOCK_ATTR = "_paddle_tpu_decode_lock"
+_lock_creation_lock = threading.Lock()
+
+
+def _model_lock(model):
+    lock = getattr(model, _LOCK_ATTR, None)
+    if lock is None:
+        with _lock_creation_lock:
+            lock = getattr(model, _LOCK_ATTR, None)
+            if lock is None:
+                lock = threading.RLock()
+                object.__setattr__(model, _LOCK_ATTR, lock)
+    return lock
 
 
 def _memoized_decode_fn(model, key, build):
@@ -66,7 +81,7 @@ def _memoized_decode_fn(model, key, build):
     # threads on one model must neither double-pay a ~30s remote compile
     # for the same key nor race the LRU pop (build for a *different* key
     # is serialized too — compiles are rare, simplicity wins)
-    with _memo_lock:
+    with _model_lock(model):
         per_model = getattr(model, _MEMO_ATTR, None)
         if per_model is None:
             per_model = {}
@@ -376,11 +391,11 @@ def generate(model, input_ids, max_new_tokens=20, temperature=1.0,
     decode_strategy: None (infer from args) | 'greedy_search' |
     'sampling' | 'beam_search' — ref: paddlenlp GenerationMixin.
 
-    Thread-safe: the whole call is serialized under a module lock
-    (tracing swaps state into the shared model, and on one chip device
-    execution is serial anyway). For lock-free repeated calls, build a
+    Thread-safe: the whole call is serialized under a per-model lock
+    (tracing swaps state into the shared model; calls on independent
+    models proceed concurrently). For lock-free repeated calls, build a
     fn once with build_decode_fn and manage params yourself."""
-    with _memo_lock:
+    with _model_lock(model):
         return _generate_locked(
             model, input_ids, max_new_tokens, temperature, top_k, top_p,
             repetition_penalty, num_beams, length_penalty, eos_token_id,

@@ -50,6 +50,16 @@ class ScannedLayerStack(Layer):
         self.has_dropout = has_dropout
         self.recompute = recompute
         template = blocks[0]
+        buf_names = [n for n, _ in template.named_buffers()]
+        if buf_names:
+            # functional_call below feeds an empty buffers dict — a block
+            # with registered buffers (BatchNorm-style running stats)
+            # would silently run with default values instead of its own
+            raise ValueError(
+                "ScannedLayerStack blocks may not register buffers "
+                f"(found {buf_names}); stack such state as a Parameter "
+                "with trainable=False, or keep the model unrolled "
+                "(scan_layers=False)")
         self._pnames = [n for n, _ in template.named_parameters()]
         for n in self._pnames:
             refs = [dict(b.named_parameters())[n] for b in blocks]

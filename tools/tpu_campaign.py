@@ -30,6 +30,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "campaign_out")
 
+sys.path.insert(0, REPO)
+from bench import _proc_starttime  # noqa: E402  (single owner of the
+#                                     'pid starttime' pidfile format)
+
 
 def run(cmd, timeout, log_name, env_extra=None):
     os.makedirs(OUT, exist_ok=True)
@@ -46,8 +50,11 @@ def run(cmd, timeout, log_name, env_extra=None):
                                 stderr=subprocess.STDOUT,
                                 start_new_session=True, env=env)
         try:
+            # "pid starttime": the kernel starttime (field 22 of
+            # /proc/<pid>/stat) lets the driver-bench preemptor prove
+            # the pid was not recycled before it SIGKILLs the group
             with open(pid_path, "w") as f:
-                f.write(str(proc.pid))
+                f.write(f"{proc.pid} {_proc_starttime(proc.pid)}")
         except OSError:
             pass
         try:
@@ -168,7 +175,11 @@ def main():
     only = args.only.split(",") if args.only else None
     skip = set(args.skip.split(",")) if args.skip else set()
     scale = float(os.environ.get("CAMPAIGN_TIMEOUT_SCALE", "1"))
-    summary = {}
+    # _captured_at orders archived summaries reliably (file mtimes
+    # collapse after a fresh checkout; bench.py's null-run diagnostic
+    # sorts on this). Dict-shaped so readers iterating stage entries
+    # skip it via the missing "ok" key.
+    summary = {"_captured_at": {"epoch": int(time.time())}}
     stages = [s for s in STAGES if s[0] not in RETRY_ONLY]
     if only:  # run in the order the caller listed, not STAGES order
         by_name = {s[0]: s for s in STAGES}
@@ -190,7 +201,7 @@ def main():
         parsed = last_json(f"{name}.log")
         ok = rc == 0
         summary[name] = {"ok": ok, "rc": rc, "seconds": dt,
-                         "result": parsed}
+                         "ended_at": int(time.time()), "result": parsed}
         print(f"=== {name}: rc={rc} {dt}s "
               f"{json.dumps(parsed) if parsed else tail[-150:]!r} ===",
               flush=True)
@@ -210,8 +221,10 @@ def main():
     # nonzero exit when anything failed or was never reached, so a
     # wrapper (tools/tunnel_watch.py) can re-arm instead of reading a
     # half-done campaign as success
-    ran_all = all(s["ok"] for s in summary.values()) and \
-        len(summary) == len([s for s in stages if s[0] not in skip])
+    stage_rows = {k: v for k, v in summary.items()
+                  if not k.startswith("_")}
+    ran_all = all(s["ok"] for s in stage_rows.values()) and \
+        len(stage_rows) == len([s for s in stages if s[0] not in skip])
     sys.exit(0 if ran_all else 1)
 
 

@@ -40,11 +40,25 @@ def log_line(path, msg):
 
 
 def succeeded_stages():
+    return {k for k, v in ran_stages().items() if v.get("ok")}
+
+
+def ran_stages():
+    """Stage rows of this attempt's summary.json (meta keys dropped)."""
     try:
         with open(os.path.join(OUT, "summary.json")) as f:
-            return {k for k, v in json.load(f).items() if v.get("ok")}
+            return {k: v for k, v in json.load(f).items()
+                    if isinstance(v, dict) and not k.startswith("_")}
     except (OSError, json.JSONDecodeError):
-        return set()
+        return {}
+
+
+def driver_marker_mtime():
+    from tpu_campaign import DRIVER_MARKER
+    try:
+        return os.path.getmtime(DRIVER_MARKER)
+    except OSError:
+        return 0
 
 
 def main():
@@ -99,14 +113,25 @@ def main():
             cwd=REPO)
         done = succeeded_stages()
         preempted = _driver_bench_active()
+        ran = ran_stages()
         pending = [s for s in pending if s not in done]
         if preempted:
             # stages cut short by the driver bench did not genuinely
-            # fail — give their attempt back
+            # fail — give their attempt back. But ONLY stages the
+            # campaign never reached, or whose run ended at/after the
+            # preemption started (i.e. the driver's SIGKILL cut them):
+            # a stage that failed on its own merits before the driver
+            # arrived keeps its strike (3-strike cap stays meaningful).
+            preempt_t0 = driver_marker_mtime()
+            refunded = []
             for s in pending:
-                attempts[s] -= 1
+                row = ran.get(s)
+                if row is None or (preempt_t0 and
+                                   row.get("ended_at", 0) >= preempt_t0):
+                    attempts[s] -= 1
+                    refunded.append(s)
             log_line(args.log, "campaign preempted by driver bench — "
-                               "attempts refunded")
+                               f"attempts refunded for {refunded}")
         # a stage that keeps failing while the probe stays green is a
         # code/config problem, not the tunnel — stop burning the scarce
         # window on it (3 strikes), keep going with the rest
