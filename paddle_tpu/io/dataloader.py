@@ -48,11 +48,24 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_process_workers=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        # True: spawn worker PROCESSES + shared-memory transport (ref:
+        # paddle's dataloader/worker.py — the GIL cannot feed a
+        # TPU-rate consumer through Python decode/augment). Default
+        # (None/False) keeps the thread+C++-ring prefetcher: spawn
+        # re-imports the framework per worker (~seconds), which only
+        # pays for itself on decode/augment-heavy input pipelines —
+        # exactly where the reference's worker processes earn their
+        # keep (bench.py --input-pipeline measures the crossover).
+        self.use_process_workers = use_process_workers
+        self.use_shared_memory = use_shared_memory
+        self.persistent_workers = persistent_workers
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -85,12 +98,63 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
-        gen = self._gen_batches()
         if self.num_workers == 0:
-            for b in gen:
+            for b in self._gen_batches():
                 yield _to_tensors(b)
             return
-        yield from self._prefetch_iter(gen)
+        if self._use_processes():
+            pool = self._process_pool()
+            try:
+                for b in pool.run_epoch(iter(self.batch_sampler)):
+                    yield _to_tensors(b)
+            finally:
+                if not self.persistent_workers:
+                    pool.shutdown()
+                    self._pool = None
+            return
+        yield from self._prefetch_iter(self._gen_batches())
+
+    def _process_pool(self):
+        from .process_worker import ProcessPrefetcher
+        pool = getattr(self, "_pool", None)
+        if pool is not None and not pool._closed:
+            return pool  # persistent_workers: reuse across epochs
+        # base seed ties worker augmentation randomness to paddle.seed
+        # (reproducible runs) while varying across pools, so a fresh
+        # non-persistent pool does not replay epoch 1's augmentations
+        import jax
+
+        from .. import framework
+        seed = int(jax.random.randint(framework.next_rng_key(), (),
+                                      0, 2 ** 31 - 1))
+        pool = self._pool = ProcessPrefetcher(
+            self.dataset, self.collate_fn, self.num_workers,
+            prefetch_factor=self.prefetch_factor,
+            worker_init_fn=self.worker_init_fn, seed=seed,
+            timeout=self.timeout)
+        return pool
+
+    def _use_processes(self):
+        """Process workers: opted in, map-style dataset, shared memory
+        wanted, and everything the spawn must carry pickles."""
+        if not self.use_process_workers:
+            return False
+        if self._iterable or not self.use_shared_memory:
+            raise ValueError(
+                "use_process_workers=True needs a map-style dataset and "
+                "use_shared_memory=True (IterableDataset streams through "
+                "the thread prefetcher)")
+        from .process_worker import can_use_process_workers
+        ok = can_use_process_workers(self.dataset, self.collate_fn) and \
+            (self.worker_init_fn is None or
+             can_use_process_workers(self.worker_init_fn, None))
+        if not ok:
+            raise ValueError(
+                "use_process_workers=True but the dataset / collate_fn / "
+                "worker_init_fn does not pickle (spawn workers require "
+                "it); use module-level functions instead of lambdas or "
+                "pass use_process_workers=False")
+        return True
 
     def _prefetch_iter(self, gen):
         """Thread prefetch backed by the C++ ring buffer when available."""
@@ -208,14 +272,28 @@ def device_prefetch(iterable, sharding=None, size=2):
         buf.clear()
 
 
+class WorkerInfo:
+    """ref: paddle.io.dataloader.worker.WorkerInfo."""
+
+    def __init__(self, id, num_workers, seed, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, "
+                f"num_workers={self.num_workers}, seed={self.seed})")
+
+
+_worker_info = None  # set inside process workers (io/process_worker.py)
+
+
 def get_worker_info():
-    """ref: paddle.io.get_worker_info — returns None outside a worker
-    process. The TPU DataLoader prefetches on ONE producer thread (the
-    C++ ring buffer parallelizes at the buffer level, not via worker
-    processes), so dataset code always runs in the main process and the
-    reference's `if get_worker_info() is None: iterate everything`
-    guard degenerates correctly."""
-    return None
+    """ref: paddle.io.get_worker_info — WorkerInfo inside a DataLoader
+    worker process (spawn-based pool, io/process_worker.py), None in
+    the main process / thread-prefetch path."""
+    return _worker_info
 
 
 def default_convert_fn(batch):

@@ -1,0 +1,82 @@
+"""Spawn-based DataLoader worker processes + shared-memory transport
+(ref: python/paddle/io/dataloader/worker.py _worker_loop). Kept tiny:
+spawn costs seconds on this 1-core box, so ONE pool exercises order,
+values, worker_init_fn, get_worker_info, and error propagation."""
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, Dataset
+
+pytestmark = pytest.mark.filterwarnings("ignore::UserWarning")
+
+
+class SquaresDataset(Dataset):
+    """Module-level (picklable) dataset; item i -> [i, i*i] float32."""
+
+    def __init__(self, n=64):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        from paddle_tpu.io.dataloader import get_worker_info
+        info = get_worker_info()
+        assert info is not None and 0 <= info.id < info.num_workers
+        return np.asarray([i, i * i], dtype=np.float32)
+
+
+class BoomDataset(SquaresDataset):
+    def __getitem__(self, i):
+        if i == 13:
+            raise RuntimeError("boom at 13")
+        return super().__getitem__(i)
+
+
+def _init(worker_id):
+    import os
+    os.environ["PT_TEST_WORKER_INIT"] = str(worker_id)
+
+
+def test_process_pool_order_values_and_info():
+    dl = DataLoader(SquaresDataset(64), batch_size=8, shuffle=False,
+                    num_workers=2, use_process_workers=True,
+                    worker_init_fn=_init)
+    got = [np.asarray(b._value if hasattr(b, "_value") else b)
+           for b in dl]
+    assert len(got) == 8
+    flat = np.concatenate(got)[:, 0]
+    # in-order delivery despite 2 out-of-order workers
+    np.testing.assert_array_equal(flat, np.arange(64, dtype=np.float32))
+    np.testing.assert_array_equal(np.concatenate(got)[:, 1],
+                                  (np.arange(64) ** 2).astype(np.float32))
+
+
+def test_worker_error_propagates():
+    dl = DataLoader(BoomDataset(32), batch_size=8, shuffle=False,
+                    num_workers=2, use_process_workers=True)
+    with pytest.raises(RuntimeError, match="boom at 13"):
+        list(dl)
+
+
+def test_persistent_pool_reused_across_epochs():
+    dl = DataLoader(SquaresDataset(16), batch_size=8, shuffle=False,
+                    num_workers=2, use_process_workers=True,
+                    persistent_workers=True)
+    list(dl)
+    pool1 = dl._pool
+    assert pool1 is not None and not pool1._closed
+    got = [np.asarray(b._value if hasattr(b, "_value") else b)
+           for b in dl]
+    assert dl._pool is pool1  # same spawn pool, no per-epoch respawn
+    np.testing.assert_array_equal(np.concatenate(got)[:, 0],
+                                  np.arange(16, dtype=np.float32))
+    pool1.shutdown()
+
+
+def test_unpicklable_raises_actionable():
+    dl = DataLoader(SquaresDataset(8), batch_size=4, num_workers=2,
+                    use_process_workers=True,
+                    collate_fn=lambda b: np.stack(b))
+    with pytest.raises(ValueError, match="does not pickle"):
+        iter(dl).__next__()
