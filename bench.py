@@ -110,7 +110,8 @@ def gpt_flops_per_token(model, seq):
 
 
 def build_engine(cfg_name, batch, seq, amp, use_flash=True, recompute=False,
-                 moment_dtype=None, scan_layers=False, fused_qkv=False):
+                 moment_dtype=None, scan_layers=False, fused_qkv=False,
+                 fused_ln=False):
     import jax.numpy as jnp
     from paddle_tpu.nlp.gpt import (GPTForCausalLM, GPT_CONFIGS,
                                     GPTPretrainingCriterion, _resolve_config)
@@ -122,7 +123,8 @@ def build_engine(cfg_name, batch, seq, amp, use_flash=True, recompute=False,
         cfg_name, max_position_embeddings=max_pos,
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
         use_flash_attention=use_flash, recompute=recompute,
-        scan_layers=scan_layers, fused_qkv=fused_qkv))
+        scan_layers=scan_layers, fused_qkv=fused_qkv,
+        fused_ln=fused_ln))
     model.train()
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
                 parameters=model.parameters(), moment_dtype=moment_dtype)
@@ -177,7 +179,7 @@ def run(eng, batch, seq, steps, warmup, scan_steps=0):
     return batch * seq * steps / dt
 
 
-def build_ernie_engine(batch, seq, amp, fused_qkv=False):
+def build_ernie_engine(batch, seq, amp, fused_qkv=False, fused_ln=False):
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.nlp import (ErnieForPretraining,
@@ -193,7 +195,7 @@ def build_ernie_engine(batch, seq, amp, fused_qkv=False):
     model = ErnieForPretraining(_ernie_cfg(
         "ernie-3.0-base-zh", max_position_embeddings=max_pos,
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
-        fused_qkv=fused_qkv))
+        fused_qkv=fused_qkv, fused_ln=fused_ln))
     model.train()
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
                 parameters=model.parameters())
@@ -347,6 +349,8 @@ def worker_resnet(args, on_tpu):
         batch, steps, warmup, amp, hw = 256, 20, 3, True, 224
     batch = args.batch or batch
     steps = args.steps or steps
+    if args.serve:
+        return _resnet_serve(args, on_tpu, batch, steps, hw)
     log(f"bench: resnet50 batch={batch} hw={hw} steps={steps} "
         f"backend={jax.default_backend()} amp={amp} s2d={args.s2d}")
     eng = build_resnet_engine(amp, s2d=args.s2d)
@@ -370,6 +374,56 @@ def worker_resnet(args, on_tpu):
     }), flush=True)
 
 
+def _resnet_serve(args, on_tpu, batch, steps, hw):
+    """Inference img/s; --fold-bn applies the conv_bn_fuse_pass
+    equivalent (incubate.fuse_conv_bn) before jit — one fewer
+    elementwise HBM pass per conv at serving."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.layer import functional_call
+    from paddle_tpu.tensor import Tensor
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50()
+    model.eval()
+    folded = 0
+    if args.fold_bn:
+        from paddle_tpu.incubate import fuse_conv_bn
+        model, folded = fuse_conv_bn(model)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    if on_tpu:
+        model.to(dtype=dtype)
+    params, buffers = model.raw_state()
+    log(f"bench: resnet50 SERVE batch={batch} hw={hw} steps={steps} "
+        f"fold_bn={args.fold_bn} (folded {folded} pairs)")
+
+    @jax.jit
+    def fwd(params, buffers, x):
+        out = functional_call(model, params, buffers, Tensor(x))
+        return out._value if isinstance(out, Tensor) else out
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (batch, 3, hw, hw)), dtype)
+    fwd(params, buffers, x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fwd(params, buffers, x)
+        _Watchdog.pet()
+    float(out.sum())
+    dt = time.perf_counter() - t0
+    tput = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_serve_images_per_sec_per_chip",
+        "value": round(tput, 1), "unit": "images/s/chip",
+        "vs_baseline": None, "fold_bn": bool(args.fold_bn),
+        "folded_pairs": folded, "batch": batch, "image": hw,
+        "backend": jax.default_backend(),
+    }), flush=True)
+
+
 def worker_ernie(args, on_tpu):
     import jax
     if args.smoke or not on_tpu:
@@ -382,7 +436,8 @@ def worker_ernie(args, on_tpu):
     log(f"bench: ernie-3.0-base batch={batch} seq={seq} steps={steps} "
         f"backend={jax.default_backend()} amp={amp} "
         f"fused_qkv={args.fused_qkv}")
-    eng = build_ernie_engine(batch, seq, amp, fused_qkv=args.fused_qkv)
+    eng = build_ernie_engine(batch, seq, amp, fused_qkv=args.fused_qkv,
+                             fused_ln=args.fused_ln)
     tput = run_ernie(eng, batch, seq, steps, warmup)
     fpt = gpt_flops_per_token(eng.network, seq)  # same 6N+12Lhs conv.
     print(json.dumps({
@@ -394,6 +449,7 @@ def worker_ernie(args, on_tpu):
         if on_tpu else None,
         "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
         "batch": batch, "seq": seq, "fused_qkv": args.fused_qkv,
+        "fused_ln": args.fused_ln,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -425,7 +481,8 @@ def worker_gpt(args, on_tpu, big=False):
     scan_layers = args.scan_layers
     eng = build_engine(cfg, batch, seq, amp, use_flash=use_flash,
                        recompute=recompute, moment_dtype=moment_dtype,
-                       scan_layers=scan_layers, fused_qkv=args.fused_qkv)
+                       scan_layers=scan_layers, fused_qkv=args.fused_qkv,
+                       fused_ln=args.fused_ln)
     try:
         tput = run(eng, batch, seq, steps, warmup,
                    scan_steps=args.scan_steps)
@@ -447,7 +504,8 @@ def worker_gpt(args, on_tpu, big=False):
         scan_layers = True
         eng = build_engine(cfg, batch, seq, amp, use_flash=use_flash,
                            recompute=recompute, moment_dtype=moment_dtype,
-                           scan_layers=True, fused_qkv=args.fused_qkv)
+                           scan_layers=True, fused_qkv=args.fused_qkv,
+                           fused_ln=args.fused_ln)
         tput = run(eng, batch, seq, steps, warmup,
                    scan_steps=args.scan_steps)
     fpt = gpt_flops_per_token(eng.network, seq)
@@ -466,7 +524,55 @@ def worker_gpt(args, on_tpu, big=False):
         "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
         "config": cfg, "batch": batch, "seq": seq, "flash": use_flash,
         "scan_layers": scan_layers, "fused_qkv": args.fused_qkv,
+        "fused_ln": args.fused_ln,
         "backend": jax.default_backend(),
+    }), flush=True)
+
+
+def worker_input_pipeline(args, on_tpu):
+    """Input-pipeline load test: decode/augment img/s per worker mode
+    (inline / thread prefetch / N spawn processes) against a null
+    consumer. ref: paddle's worker-process DataLoader exists exactly to
+    beat the GIL on this workload; the 2,225 img/s ResNet consumer is
+    the rate to beat. Steady-state: timing starts at the FIRST batch,
+    so spawn+import cost (amortized over an epoch in real training)
+    is excluded."""
+    import multiprocessing
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.synthetic import SyntheticImageDataset
+
+    n = 192 if args.smoke else 1536
+    batch = args.batch or 32
+    ds = SyntheticImageDataset(n)
+    results = {}
+
+    def timed(tag, **kw):
+        dl = DataLoader(ds, batch_size=batch, shuffle=False,
+                        drop_last=True, **kw)
+        it = iter(dl)
+        first = next(it)
+        t0 = time.perf_counter()
+        count = 0
+        for b in it:
+            count += int(b.shape[0])
+        dt = time.perf_counter() - t0
+        del first
+        results[tag] = round(count / dt, 1)
+        log(f"  {tag}: {results[tag]} img/s")
+
+    timed("inline")
+    timed("threads_2", num_workers=2)
+    worker_counts = (1, 2) if args.smoke else (1, 2, 4)
+    for w in worker_counts:
+        timed(f"proc_{w}", num_workers=w, use_process_workers=True)
+    best = max(results.values())
+    print(json.dumps({
+        "metric": "input_pipeline_img_per_sec", "value": best,
+        "unit": "img/s", "vs_baseline": round(best / 2225.0, 4),
+        "host_cores": multiprocessing.cpu_count(),
+        "batch": batch, "images": n, "modes": results,
+        "note": "vs_baseline compares against the r4 ResNet-50 TPU "
+                "consumer rate (2225 img/s); scaling needs host cores",
     }), flush=True)
 
 
@@ -476,6 +582,7 @@ WORKERS = {
     "ernie": worker_ernie,
     "resnet50": worker_resnet,
     "decode": worker_decode,
+    "input-pipeline": worker_input_pipeline,
 }
 
 
@@ -619,25 +726,33 @@ def _release_chip():
 
 def orchestrate(workloads, args, passthrough):
     smoke = args.smoke
-    if not smoke and not os.environ.get("CAMPAIGN_CHILD"):
+    host_only = workloads == ["input-pipeline"]  # no chip involved:
+    # don't preempt the campaign, don't gate on the backend probe
+    if not smoke and not host_only \
+            and not os.environ.get("CAMPAIGN_CHILD"):
         _preempt_campaign()
         try:
             return _orchestrate_impl(workloads, args, passthrough)
         finally:
             _release_chip()
-    return _orchestrate_impl(workloads, args, passthrough)
+    return _orchestrate_impl(workloads, args, passthrough,
+                             skip_probe=host_only)
 
 
-def _orchestrate_impl(workloads, args, passthrough):
+def _orchestrate_impl(workloads, args, passthrough, skip_probe=False):
     smoke = args.smoke
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT",
                                        240 if smoke else 600))
     work_timeout = int(os.environ.get("BENCH_WORK_TIMEOUT",
                                       600 if smoke else 1800))
 
-    rc, probe, err, dt = _spawn(["--worker", "probe"]
-                                + (["--smoke"] if smoke else []),
-                                probe_timeout, "probe")
+    if skip_probe:
+        probe, err, dt = {"probe": "ok", "backend": "host-only",
+                          "seconds": 0.0}, None, 0.0
+    else:
+        rc, probe, err, dt = _spawn(["--worker", "probe"]
+                                    + (["--smoke"] if smoke else []),
+                                    probe_timeout, "probe")
     if probe is None or probe.get("probe") != "ok":
         # error text can embed a multi-KB backend traceback — bound it,
         # the final line must never outgrow the driver's capture
@@ -758,6 +873,8 @@ def _orchestrate_impl(workloads, args, passthrough):
             print(f"[bench] {name} FAILED: {err}", file=sys.stderr,
                   flush=True)
         _flush_partial(results, probe)
+        if not ok and skip_probe:
+            continue  # host-only workload: never touch the backend
         if not ok:
             # a failed workload may have wedged the terminal — reprobe
             # before burning timeout on the next one
@@ -815,6 +932,12 @@ def main():
                          "batches)")
     ap.add_argument("--moment-dtype", default=None,
                     help="Adam moment dtype override (e.g. bfloat16)")
+    ap.add_argument("--serve", action="store_true",
+                    help="resnet50: inference throughput instead of "
+                         "training")
+    ap.add_argument("--fold-bn", action="store_true",
+                    help="resnet50 --serve: fold BatchNorms into conv "
+                         "weights first (conv_bn_fuse_pass parity)")
     ap.add_argument("--s2d", action="store_true",
                     help="resnet50: MLPerf space-to-depth stem (exactly "
                          "equivalent 4x4/s1 conv over 12 channels)")
@@ -828,6 +951,9 @@ def main():
     ap.add_argument("--cache-dtype", default=None,
                     help="decode KV cache dtype (bfloat16 halves decode "
                          "HBM traffic)")
+    ap.add_argument("--fused-ln", action="store_true",
+                    help="gpt: fuse residual add + LayerNorm into one "
+                         "Pallas pass (elementwise-HBM lever)")
     ap.add_argument("--fused-qkv", action="store_true",
                     help="gpt: one [h,3h] qkv matmul (Megatron "
                          "head-interleaved) instead of three [h,h]")
@@ -842,6 +968,10 @@ def main():
     ap.add_argument("--scan-steps", type=int, default=0,
                     help="run K optimizer steps per compiled call "
                          "(lax.scan) to amortize dispatch latency")
+    ap.add_argument("--input-pipeline", action="store_true",
+                    help="measure decode/augment img/s per DataLoader "
+                         "worker mode (inline/threads/processes) "
+                         "against a null consumer")
     ap.add_argument("--decode", action="store_true",
                     help="measure KV-cache generation throughput instead "
                          "of training (opt-in; never on the default path)")
@@ -859,13 +989,21 @@ def main():
         if args.worker == "probe":
             worker_probe()
             return
+        if args.worker == "input-pipeline":
+            # host-side workload: never touch jax (a dead tunnel would
+            # hang backend init for a bench that doesn't need the chip)
+            import _cpu_env  # noqa: F401
+            worker_input_pipeline(args, False)
+            return
         import jax
         on_tpu = jax.default_backend() == "tpu"
         WORKERS[args.worker](args, on_tpu)
         return
 
     # ---- orchestrator mode: jax-free ----
-    if args.decode:
+    if args.input_pipeline:
+        workloads = ["input-pipeline"]
+    elif args.decode:
         workloads = ["decode"]
     elif args.model:
         workloads = [args.model]
@@ -902,6 +1040,13 @@ def main():
                                                  "ernie"}:
         ap.error("--fused-qkv applies to the gpt/ernie training "
                  "workloads only")
+    if args.fused_ln and not set(workloads) <= {"gpt", "gpt-1.3b",
+                                                "ernie"}:
+        ap.error("--fused-ln applies to the gpt/ernie training "
+                 "workloads only")
+    if (args.serve or args.fold_bn) and workloads != ["resnet50"]:
+        ap.error("--serve/--fold-bn apply to resnet50 serving only "
+                 "(use --model resnet50 --serve)")
     if args.no_scan_fallback and workloads != ["gpt-1.3b"]:
         ap.error("--no-scan-fallback applies to the gpt-1.3b workload "
                  "only (use --model gpt-1.3b)")
@@ -926,17 +1071,23 @@ def main():
             passthrough.append("--recompute")
         if args.s2d:
             passthrough.append("--s2d")
+        if args.serve:
+            passthrough.append("--serve")
+        if args.fold_bn:
+            passthrough.append("--fold-bn")
         if args.scan_steps:
             passthrough += ["--scan-steps", str(args.scan_steps)]
         if args.scan_layers:
             passthrough.append("--scan-layers")
         if args.fused_qkv:
             passthrough.append("--fused-qkv")
+        if args.fused_ln:
+            passthrough.append("--fused-ln")
         if args.no_scan_fallback:
             passthrough.append("--no-scan-fallback")
     elif any(v is not None for v in overrides.values()) or args.no_flash \
             or args.recompute or args.scan_steps or args.s2d \
-            or args.scan_layers or args.fused_qkv:
+            or args.scan_layers or args.fused_qkv or args.fused_ln:
         print("[bench] ignoring per-workload flags in full-suite mode "
               "(use --model to tune one workload)", file=sys.stderr,
               flush=True)

@@ -140,10 +140,18 @@ def gather_sequence(x, axis_name, seq_axis=1):
 
 
 def _spmd(local_fn, mesh, axis):
+    """shard_map over `axis` only; any OTHER mesh axes (dp/mp) stay
+    *auto* so GSPMD keeps partitioning batch/heads inside the manual
+    sequence-sharded body — this is what lets a dp x sp (or dp x mp x
+    sp) train step compose with no extra code."""
     spec = P(None, axis, None, None)
+    kwargs = {"check_vma": False}
+    if len(mesh.axis_names) > 1:
+        # manual over `axis` only; dp/mp stay auto for GSPMD
+        kwargs["axis_names"] = frozenset({axis})
     return jax.shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-        out_specs=spec, check_vma=False)
+        out_specs=spec, **kwargs)
 
 
 def ring_attention_spmd(q, k, v, mesh, *, axis="sp", causal=False,

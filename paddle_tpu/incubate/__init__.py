@@ -13,5 +13,7 @@ class autograd:  # noqa: N801  (namespace parity: paddle.incubate.autograd)
 
 EMA = ExponentialMovingAverage
 
+from .fuse import fuse_conv_bn  # noqa: E402
+
 __all__ = ["LookAhead", "ModelAverage", "ExponentialMovingAverage", "EMA",
-           "optimizer", "nn"]
+           "optimizer", "nn", "fuse_conv_bn"]

@@ -1,0 +1,103 @@
+"""Conv+BatchNorm folding for serving.
+
+ref parity: the reference's inference-time `conv_bn_fuse_pass`
+(paddle/fluid/framework/ir/conv_bn_fuse_pass.cc) — at serving time a
+frozen BatchNorm is an affine transform per output channel, so it
+folds into the preceding conv's weights and bias:
+
+    scale_c = gamma_c / sqrt(var_c + eps)
+    W'[c]   = W[c] * scale_c
+    b'_c    = (b_c - mean_c) * scale_c + beta_c
+
+TPU-native shape of the same idea: there is no Program pass pipeline —
+the fold is a module-tree transform (`fuse_conv_bn`) you apply to an
+eval-mode model before jit/`jit.save`; XLA then compiles the folded
+conv exactly like any other (one fewer elementwise HBM pass per conv,
+and the BN buffers disappear from the serving artifact).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["fuse_conv_bn"]
+
+
+def _fold_pair(conv, bn):
+    import jax.numpy as jnp
+
+    from ..nn.layer import Parameter
+    gamma = (np.asarray(bn.weight._value) if bn.weight is not None
+             else np.ones(bn._num_features, np.float32))
+    beta = (np.asarray(bn.bias._value) if bn.bias is not None
+            else np.zeros(bn._num_features, np.float32))
+    mean = np.asarray(bn._mean._value)
+    var = np.asarray(bn._variance._value)
+    scale = gamma / np.sqrt(var + bn._epsilon)
+
+    w = np.asarray(conv.weight._value)
+    # non-transpose convs store [out, in/groups, *k]; scale is per-out
+    w = w * scale.reshape((-1,) + (1,) * (w.ndim - 1))
+    b = (np.asarray(conv.bias._value) if conv.bias is not None
+         else np.zeros(w.shape[0], np.float32))
+    b = (b - mean) * scale + beta
+
+    conv.weight._value = jnp.asarray(w, conv.weight._value.dtype)
+    if conv.bias is None:
+        p = Parameter(jnp.asarray(b, w.dtype))
+        conv.bias = p
+        conv._parameters["bias"] = p
+    else:
+        conv.bias._value = jnp.asarray(b, conv.bias._value.dtype)
+
+
+def fuse_conv_bn(model):
+    """Fold every (Conv, BatchNorm) pair in `model` IN PLACE; the BN
+    layers become Identity. Eval-mode only (training BN uses batch
+    statistics — folding would change semantics). Recognised shapes:
+
+    - `nn.Sequential` with a BatchNorm directly following a conv
+    - sibling attributes named `conv*` / `bn*` where the names match
+      after the prefix (`conv1`/`bn1`, `conv`/`bn`, ...) — the layer
+      zoo convention (ResNet/VGG/MobileNet blocks)
+
+    Returns (model, n_folded)."""
+    from ..nn.layers_common import Identity, Sequential
+    from ..nn.layers_conv import Conv1D, Conv2D, Conv3D
+    from ..nn.layers_norm import _BatchNormBase
+
+    if model.training:
+        raise ValueError(
+            "fuse_conv_bn folds the running statistics of FROZEN "
+            "BatchNorms: call model.eval() first (training-mode BN "
+            "normalizes by batch stats, which cannot fold)")
+    conv_types = (Conv1D, Conv2D, Conv3D)
+    n = 0
+
+    def walk(layer):
+        nonlocal n
+        if isinstance(layer, Sequential):
+            kids = list(layer._sub_layers.items())
+            for (k1, a), (k2, b) in zip(kids, kids[1:]):
+                if isinstance(a, conv_types) and \
+                        isinstance(b, _BatchNormBase):
+                    _fold_pair(a, b)
+                    layer._sub_layers[k2] = Identity()
+                    setattr(layer, k2, layer._sub_layers[k2])
+                    n += 1
+        names = list(layer._sub_layers)
+        for cname in names:
+            child = layer._sub_layers[cname]
+            if isinstance(child, conv_types) and cname.startswith("conv"):
+                bname = "bn" + cname[len("conv"):]
+                sib = layer._sub_layers.get(bname)
+                if isinstance(sib, _BatchNormBase):
+                    _fold_pair(child, sib)
+                    ident = Identity()
+                    layer._sub_layers[bname] = ident
+                    setattr(layer, bname, ident)
+                    n += 1
+        for child in layer._sub_layers.values():
+            walk(child)
+
+    walk(model)
+    return model, n

@@ -54,6 +54,9 @@ class BertConfig:
     # one [h, 3h] qkv matmul (Megatron head-interleave; convert
     # checkpoints with gpt.fuse_qkv_state / split_qkv_state)
     fused_qkv: bool = False
+    # fuse each residual add into its following LayerNorm with one
+    # Pallas pass (both block sites in post-LN; ops/pallas/fused_ln.py)
+    fused_ln: bool = False
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -147,6 +150,7 @@ class BertLayer(Layer):
 
     def __init__(self, config: BertConfig):
         super().__init__()
+        self.cfg = config
         eps = config.layer_norm_eps
         wa = _init_attr(config)
         self.attn = BertSelfAttention(config)
@@ -163,7 +167,17 @@ class BertLayer(Layer):
         self.ln_2 = LayerNorm(config.hidden_size, epsilon=eps)
 
     def forward(self, x, attn_mask=None):
-        x = self.ln_1(x + self.dropout1(self.attn(x, attn_mask)))
+        h1 = self.dropout1(self.attn(x, attn_mask))
+        if getattr(self.cfg, "fused_ln", False):
+            # post-LN fuses at BOTH block sites: y = LN(x + h) is the
+            # whole pattern; want_sum=False skips even the sum's HBM
+            # write (it is not consumed downstream)
+            from .modeling_utils import fused_residual_ln
+            x = fused_residual_ln(x, h1, self.ln_1, want_sum=False)
+            h2 = self.dropout2(self.fc2(self.act(self.fc1(x))))
+            x = fused_residual_ln(x, h2, self.ln_2, want_sum=False)
+            return x
+        x = self.ln_1(x + h1)
         x = self.ln_2(x + self.dropout2(self.fc2(self.act(self.fc1(x)))))
         return x
 

@@ -67,10 +67,39 @@ class GPTConfig:
     # layout differs from the separate projections — convert checkpoints
     # with fuse_qkv_state / split_qkv_state.
     fused_qkv: bool = False
+    # interleaved ('virtual') pipeline stages for GPTForCausalLMPipe:
+    # each pp rank holds v chunks and activations ride a ring ppermute,
+    # shrinking the bubble to (S-1)/(m*v+S-1). ref: fleet
+    # num_virtual_pipeline_stages (Megatron interleaved schedule).
+    num_virtual_pipeline_stages: int = 1
+    # fuse the block's residual add into the following LayerNorm with
+    # one Pallas pass (y=LN(x+r) and s=x+r in a single read of the
+    # operands — the add->reduce boundary XLA keeps as a kernel break;
+    # step anatomy r4 put the MFU gap in exactly these elementwise HBM
+    # passes). A/B lever: bench.py --fused-ln. ref:
+    # paddle/phi/kernels/fusion/fused_layernorm_residual_dropout_bias.
+    fused_ln: bool = False
+    # sequence/context parallelism for long sequences: '' (off), 'ring'
+    # (KV blocks rotate by ppermute with an online-softmax accumulator;
+    # arXiv:2310.01889) or 'ulysses' (all_to_all seq<->heads swap;
+    # arXiv:2309.14509). Takes effect when the active mesh has an 'sp'
+    # axis of size > 1; attention then runs sequence-sharded via
+    # shard_map while everything pointwise in S stays GSPMD-partitioned.
+    # ref: fleet sep_parallel / RingFlashAttention (meta_parallel).
+    sequence_parallel: str = ""
 
     def __post_init__(self):
         if not self.intermediate_size:
             self.intermediate_size = 4 * self.hidden_size
+        if self.sequence_parallel not in ("", "ring", "ulysses"):
+            raise ValueError(
+                f"sequence_parallel={self.sequence_parallel!r}: expected "
+                "'', 'ring' or 'ulysses'")
+        if self.sequence_parallel and self.attention_probs_dropout_prob:
+            raise ValueError(
+                "sequence_parallel requires attention_probs_dropout_prob"
+                "=0 (the sp attention kernels carry no dropout stream; "
+                "hidden_dropout_prob is fine — it is pointwise in S)")
 
     @property
     def head_dim(self):
@@ -160,6 +189,10 @@ class GPTAttention(Layer):
                 k = concat([cache[0], k], axis=1)
                 v = concat([cache[1], v], axis=1)
             cache = (k, v)
+        sp_out = self._maybe_sequence_parallel(q, k, v, attn_mask,
+                                               cache)
+        if sp_out is not None:
+            return sp_out
         # causal ALWAYS applies (decoder-only LM): a user attention_mask is
         # a padding mask combined ON TOP of the causal structure (ref:
         # GPTModel builds causal&padding jointly in modeling.py's
@@ -174,6 +207,36 @@ class GPTAttention(Layer):
         b, s = out.shape[0], out.shape[1]
         out = self.out_proj(out.reshape([b, s, -1]))
         return (out, cache) if cache is not None else out
+
+    def _maybe_sequence_parallel(self, q, k, v, attn_mask, cache):
+        """Route attention through ring/Ulysses sequence parallelism when
+        config asks for it AND the active mesh has an 'sp' axis (>1).
+        Returns the projected output, or None to fall through to SDPA.
+        Training/no-cache path only: cached decode grows S dynamically,
+        which a static sequence shard cannot host."""
+        mode = getattr(self.cfg, "sequence_parallel", "")
+        if not mode or cache is not None:
+            return None
+        from ..distributed.mesh import get_mesh
+        mesh = get_mesh()
+        if mesh is None or "sp" not in mesh.axis_names or \
+                mesh.shape["sp"] <= 1:
+            return None
+        if attn_mask is not None:
+            raise ValueError(
+                "sequence_parallel attention does not take a padding "
+                "attention_mask (pad to full blocks or mask the loss "
+                "instead — ref: fleet sep_parallel has the same "
+                "contract)")
+        from ..autograd import apply_op
+        from ..distributed.fleet.sequence_parallel import (
+            ring_attention_spmd, ulysses_attention_spmd)
+        fn = (ring_attention_spmd if mode == "ring"
+              else ulysses_attention_spmd)
+        out = apply_op(
+            lambda qq, kk, vv: fn(qq, kk, vv, mesh, causal=True), q, k, v)
+        b, s = out.shape[0], out.shape[1]
+        return self.out_proj(out.reshape([b, s, -1]))
 
     def _forward_static_cache(self, q, k, v, cache, cache_index):
         from ..autograd import apply_op
@@ -314,6 +377,7 @@ class GPTDecoderLayer(Layer):
 
     def __init__(self, config: GPTConfig):
         super().__init__()
+        self.cfg = config
         eps = config.layer_norm_epsilon
         self.ln_1 = LayerNorm(config.hidden_size, epsilon=eps)
         self.attn = GPTAttention(config)
@@ -329,8 +393,16 @@ class GPTDecoderLayer(Layer):
                                  cache_index=cache_index)
         else:
             h = self.attn(h, attn_mask)
-        x = residual + self.dropout1(h)
-        x = x + self.mlp(self.ln_2(x))
+        h = self.dropout1(h)
+        if getattr(self.cfg, "fused_ln", False):
+            # one Pallas pass: s = residual + h AND ln_2(s) — saves a
+            # full re-read of s between the add and the norm
+            from .modeling_utils import fused_residual_ln
+            y, s = fused_residual_ln(residual, h, self.ln_2)
+            x = s + self.mlp(y)
+        else:
+            x = residual + h
+            x = x + self.mlp(self.ln_2(x))
         return (x, cache) if cache is not None else x
 
 
@@ -611,7 +683,9 @@ class GPTForCausalLMPipe(Layer):
         self.embeddings = GPTEmbeddings(config)
         self.pipe = PipelineLayer(
             [GPTDecoderLayer(config)
-             for _ in range(config.num_hidden_layers)])
+             for _ in range(config.num_hidden_layers)],
+            num_virtual_pipeline_stages=
+            config.num_virtual_pipeline_stages)
         self.ln_f = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_epsilon)
         self.mesh = mesh

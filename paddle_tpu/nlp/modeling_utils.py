@@ -29,6 +29,26 @@ def normalize_attention_mask(attention_mask):
     return Tensor(m)
 
 
+def fused_residual_ln(residual, h, ln, want_sum=True):
+    """LN(residual + h) scaled/shifted by `ln`'s params in ONE Pallas
+    pass (ops/pallas/fused_ln.py) — the add->reduce boundary XLA keeps
+    as separate HBM round trips. want_sum=True returns (y, s) with
+    s = residual + h materialized (GPT pre-LN: s feeds the next
+    residual); want_sum=False returns y alone and skips the sum's HBM
+    write entirely (BERT/ERNIE post-LN discard it). interpret off-TPU."""
+    import jax as _jax
+
+    from ..autograd import apply_op
+    from ..ops.pallas.fused_ln import (fused_add_layer_norm,
+                                       fused_add_layer_norm_y)
+    interp = _jax.default_backend() != "tpu"
+    eps = getattr(ln, "_epsilon", 1e-5)
+    fn = fused_add_layer_norm if want_sum else fused_add_layer_norm_y
+    return apply_op(
+        lambda a, b, g, bb: fn(a, b, g, bb, eps, 0, interp),
+        residual, h, ln.weight, ln.bias)
+
+
 def from_pretrained_impl(cls, resolve, name_or_path, pretrained_path=None,
                          config_name=None, **overrides):
     """PaddleNLP `Model.from_pretrained` parity for an offline
@@ -71,9 +91,69 @@ def from_pretrained_impl(cls, resolve, name_or_path, pretrained_path=None,
             f"from_pretrained('{name}', pretrained_path='"
             f"{name}.pdparams') — the .pdparams pickle loads directly "
             "(paddle_tpu.compat.load_pdparams)")
-    from ..serialization import load_into
-    load_into(model, pretrained_path)
+    from ..serialization import load
+    state = load(str(pretrained_path))
+    if isinstance(state, dict) and set(state) >= {"params"} and \
+            all(k in ("params", "buffers", "specs") for k in state):
+        state = {**state.get("params", {}), **state.get("buffers", {})}
+    state = adapt_state_for_model(model, state)
+    # strict, like serialization.load_into (which would re-read the
+    # file — at 1.3B scale that is gigabytes of redundant unpickling)
+    missing = [k for k in model.state_dict() if k not in state]
+    if missing:
+        raise ValueError(
+            f"checkpoint {pretrained_path} (after layout conversion) "
+            f"is missing parameters "
+            f"{missing[:8]}{'...' if len(missing) > 8 else ''} — "
+            "refusing a partial load")
+    model.set_state_dict(state)
     return model
+
+
+def adapt_state_for_model(model, state):
+    """Bridge checkpoint layouts to the built model's: unrolled
+    per-layer keys <-> scan-stacked [L, ...] leaves
+    (config.scan_layers), and separate q/k/v projections <-> the fused
+    Megatron-interleaved qkv_proj (config.fused_qkv) — both directions,
+    composing (a stacked-fused model loads a plain reference
+    checkpoint and vice versa). Returns `state` unchanged when the
+    layouts already agree. ref: paddlenlp PretrainedModel's
+    convert-from-other-layout hooks (from_pretrained does the
+    equivalent bridging for torch-layout weights)."""
+    cfg = getattr(model, "config", None)
+    if cfg is None or not isinstance(state, dict) or not state:
+        return state
+    from ..nn.scan_stack import stack_layer_state, unstack_layer_state
+    from .gpt import fuse_qkv_state, split_qkv_state
+    L = getattr(cfg, "num_hidden_layers", None)
+    heads = getattr(cfg, "num_attention_heads", None)
+
+    def stacked_prefix(keys):
+        for k in keys:
+            if "__" in k:
+                head = k.split("__", 1)[0]
+                return head.rsplit(".", 1)[0] + "." if "." in head else ""
+        return None
+
+    model_keys = list(model.state_dict())
+    m_stacked = stacked_prefix(model_keys)
+    orig = state
+    c_stacked = stacked_prefix(state)
+    if c_stacked is not None and m_stacked is None and L:
+        state = unstack_layer_state(state, L, prefix=c_stacked)
+    want_fused = any(".qkv_proj." in k or "qkv_proj__" in k
+                     for k in model_keys)
+    have_sep = any(".q_proj." in k for k in state)
+    have_fused = any(".qkv_proj." in k for k in state)
+    if heads and want_fused and have_sep and not have_fused:
+        state = fuse_qkv_state(state, heads)
+    elif heads and not want_fused and have_fused:
+        state = split_qkv_state(state, heads)
+    if m_stacked is not None and stacked_prefix(state) is None and L:
+        state = stack_layer_state(state, L, prefix=m_stacked)
+    # if nothing changed semantically, hand back the original object so
+    # the caller can fall through to the plain strict load
+    return state if state is not orig else orig
 
 
 class FromPretrainedMixin:

@@ -1,0 +1,55 @@
+"""Conv+BN folding (ref: conv_bn_fuse_pass) — numerical equivalence on
+the zoo blocks and the Sequential/attribute patterns, plus guards."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import fuse_conv_bn
+
+
+def test_sequential_pattern_folds_exactly():
+    paddle.seed(0)
+    m = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1, bias_attr=False),
+        nn.BatchNorm2D(8),
+        nn.ReLU(),
+        nn.Conv2D(8, 4, 1),
+        nn.BatchNorm2D(4),
+    )
+    # give the BN non-trivial running stats
+    m.train()
+    x = paddle.to_tensor(np.random.default_rng(1)
+                         .standard_normal((4, 3, 16, 16)).astype("f"))
+    for _ in range(3):
+        m(x)
+    m.eval()
+    want = np.asarray(m(x)._value)
+    m, n = fuse_conv_bn(m)
+    assert n == 2
+    got = np.asarray(m(x)._value)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+    # the folded model carries no BatchNorm anymore
+    assert not any(type(s).__name__.startswith("BatchNorm")
+                   for _, s in m.named_sublayers())
+
+
+def test_resnet18_folds_exactly():
+    from paddle_tpu.vision.models import resnet18
+    paddle.seed(3)
+    m = resnet18()
+    m.eval()
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((2, 3, 32, 32)).astype("f"))
+    want = np.asarray(m(x)._value)
+    m, n = fuse_conv_bn(m)
+    assert n == 20  # 17 block convs + stem + 2 downsample convs
+    got = np.asarray(m(x)._value)
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+
+def test_training_mode_refuses():
+    m = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4))
+    m.train()
+    with pytest.raises(ValueError, match="eval"):
+        fuse_conv_bn(m)

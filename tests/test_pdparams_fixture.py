@@ -1,0 +1,174 @@
+"""Binary-faithful .pdparams round trip (VERDICT r4 next #5).
+
+No egress means no real published checkpoint; the honest substitute is
+a fixture written in upstream's exact on-disk layout (ref:
+python/paddle/framework/io.py paddle.save — a plain pickle of
+{name: ndarray} for state-dict saves, and the older tensor-REBUILD
+pickles whose values are GLOBAL calls like
+paddle.framework.io._rebuild_tensor(ndarray, ...)). These tests
+generate both byte layouts with the stdlib pickler alone — the
+"rebuild" layout by installing a throwaway module named
+paddle.framework.io so the pickler emits the same GLOBAL opcodes the
+reference does — then pull BERT through from_pretrained and one
+finetune step, including fused-qkv and scan-stacked layout conversion
+both ways. If our reader or writer drifts from the upstream layout,
+these fail.
+"""
+import pickle
+import pickletools
+import sys
+import types
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.compat import load_pdparams, save_pdparams
+from paddle_tpu.nlp.bert import BertForSequenceClassification, BertModel
+
+
+def upstream_save_pdparams(state, path, layout="plain", protocol=2):
+    """Emulate paddle.save's on-disk bytes for a state dict.
+
+    layout='plain': pickle.dump({name: ndarray}) — what current
+    paddle.save writes for state dicts (framework/io.py pickles the
+    ndarray-converted dict directly).
+    layout='rebuild': values serialize as calls to
+    paddle.framework.io._rebuild_tensor(ndarray, stop_gradient) — the
+    older tensor-wrapper save. The GLOBAL opcode stream is identical to
+    upstream's because the pickler records module+qualname.
+    """
+    arrs = {k: np.asarray(v) for k, v in state.items()}
+    if layout == "plain":
+        with open(path, "wb") as f:
+            pickle.dump(arrs, f, protocol=protocol)
+        return
+    assert layout == "rebuild"
+    created = []
+    try:
+        for mname in ("paddle", "paddle.framework", "paddle.framework.io"):
+            if mname not in sys.modules:
+                sys.modules[mname] = types.ModuleType(mname)
+                created.append(mname)
+
+        def _rebuild_tensor(arr, stop_gradient=True):
+            return arr
+        _rebuild_tensor.__module__ = "paddle.framework.io"
+        _rebuild_tensor.__qualname__ = "_rebuild_tensor"
+        sys.modules["paddle.framework.io"]._rebuild_tensor = \
+            _rebuild_tensor
+
+        class _AsRebuild:
+            def __init__(self, a):
+                self.a = a
+
+            def __reduce__(self):
+                return (_rebuild_tensor, (self.a, True))
+
+        with open(path, "wb") as f:
+            pickle.dump({k: _AsRebuild(a) for k, a in arrs.items()}, f,
+                        protocol=protocol)
+    finally:
+        for mname in created:
+            del sys.modules[mname]
+
+
+def _tiny_state():
+    rng = np.random.default_rng(0)
+    return {"linear.weight": rng.standard_normal((4, 3)).astype("float32"),
+            "linear.bias": rng.standard_normal((3,)).astype("float32")}
+
+
+def test_writer_matches_upstream_bytes(tmp_path):
+    """save_pdparams must emit byte-for-byte what upstream paddle.save
+    emits for the same state dict — the layout-drift tripwire."""
+    state = _tiny_state()
+    ours, ref = tmp_path / "ours.pdparams", tmp_path / "ref.pdparams"
+    save_pdparams({k: paddle.to_tensor(v) for k, v in state.items()}, ours)
+    upstream_save_pdparams(state, ref, layout="plain")
+    assert ours.read_bytes() == ref.read_bytes()
+
+
+def test_rebuild_layout_pickles_reference_globals(tmp_path):
+    """The rebuild fixture must reference the reference framework's
+    global by name — that's what makes it a faithful stand-in for an
+    old checkpoint (and what exercises the compat passthrough)."""
+    p = tmp_path / "old.pdparams"
+    upstream_save_pdparams(_tiny_state(), p, layout="rebuild")
+    ops = [(op.name, arg) for op, arg, _ in
+           pickletools.genops(p.read_bytes())]
+    globals_seen = [arg for name, arg in ops
+                    if name in ("GLOBAL", "STACK_GLOBAL") and arg]
+    assert any("paddle.framework.io" in str(g) for g in globals_seen), \
+        globals_seen
+    state = load_pdparams(p, return_numpy=True)
+    np.testing.assert_array_equal(state["linear.weight"],
+                                  _tiny_state()["linear.weight"])
+
+
+@pytest.mark.parametrize("layout", ["plain", "rebuild"])
+def test_bert_from_pretrained_roundtrip(tmp_path, layout):
+    from paddle_tpu.nlp.bert import _resolve_config
+    paddle.seed(11)
+    src = BertForSequenceClassification(_resolve_config("bert-tiny"))
+    state = {k: np.asarray(v._value) for k, v in src.state_dict().items()}
+    p = tmp_path / f"bert_{layout}.pdparams"
+    upstream_save_pdparams(state, p, layout=layout)
+
+    model = BertForSequenceClassification.from_pretrained(
+        "bert-tiny", pretrained_path=str(p))
+    for k, v in model.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v._value), state[k], k)
+
+    # one finetune step must run and move the loaded weights
+    from paddle_tpu.hapi.engine import Engine
+    from paddle_tpu.optimizer import AdamW
+    import paddle_tpu.nn as nn
+    model.train()
+    eng = Engine(model, loss=nn.CrossEntropyLoss(),
+                 optimizer=AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters()))
+    ids = jnp.ones((2, 16), dtype=jnp.int32)
+    labels = jnp.zeros((2,), dtype=jnp.int32)
+    loss, _ = eng.train_batch([ids], [labels])
+    assert np.isfinite(float(loss))
+
+
+@pytest.mark.parametrize("variant", ["fused_qkv", "scan_layers",
+                                     "fused_scan"])
+def test_layout_conversion_on_load(tmp_path, variant):
+    """A plain reference checkpoint loads into fused-qkv and/or
+    scan-stacked models (forward parity pinned), and the converted
+    model's state saves back into a file the PLAIN model can load —
+    both directions through the .pdparams format."""
+    paddle.seed(5)
+    plain = BertModel.from_config_name("bert-tiny")
+    plain.eval()
+    state = {k: np.asarray(v._value)
+             for k, v in plain.state_dict().items()}
+    p = tmp_path / "plain.pdparams"
+    upstream_save_pdparams(state, p, layout="plain")
+
+    overrides = {"fused_qkv": variant in ("fused_qkv", "fused_scan"),
+                 "scan_layers": variant in ("scan_layers", "fused_scan")}
+    model = BertModel.from_pretrained("bert-tiny", pretrained_path=str(p),
+                                      **overrides)
+    model.eval()
+    ids = jnp.asarray(np.arange(32).reshape(2, 16) % 512, dtype=jnp.int32)
+    want_seq, want_pooled = plain(ids)
+    got_seq, got_pooled = model(ids)
+    np.testing.assert_allclose(np.asarray(got_seq._value),
+                               np.asarray(want_seq._value), atol=2e-5,
+                               rtol=2e-5)
+
+    # reverse direction: converted state -> .pdparams -> plain model
+    back = tmp_path / "converted.pdparams"
+    save_pdparams(model.state_dict(), back)
+    plain2 = BertModel.from_pretrained("bert-tiny",
+                                       pretrained_path=str(back))
+    plain2.eval()
+    got2, _ = plain2(ids)
+    np.testing.assert_allclose(np.asarray(got2._value),
+                               np.asarray(want_seq._value), atol=2e-5,
+                               rtol=2e-5)

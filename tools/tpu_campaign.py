@@ -129,6 +129,14 @@ STAGES = [
     ("fusion_audit", [PY, "tools/fusion_audit.py", "--out",
                       "campaign_out/fusion_audit.md"], 3600, {}),
     ("resnet_roofline", [PY, "tools/resnet_roofline.py"], 2400, {}),
+    # serving throughput +/- conv-bn folding (conv_bn_fuse_pass parity)
+    ("bench_resnet_serve", [PY, "bench.py", "--model", "resnet50",
+                            "--serve"], 2400, {}),
+    ("bench_resnet_serve_fold", [PY, "bench.py", "--model", "resnet50",
+                                 "--serve", "--fold-bn"], 2400, {}),
+    # training-throughput attempts the r4 verdict asked for
+    ("bench_resnet_b512", [PY, "bench.py", "--model", "resnet50",
+                           "--batch", "512"], 2400, {}),
     # retry queue (r4: the tunnel died mid-campaign after 45 min; these
     # are what remained — tools/tunnel_watch.py fires them on revival)
     ("bench_gpt13b", [PY, "bench.py", "--model", "gpt-1.3b",
@@ -145,8 +153,16 @@ STAGES = [
     # fused [h,3h] qkv matmul A/B on the headline config
     ("bench_gpt_fusedqkv", [PY, "bench.py", "--model", "gpt",
                             "--fused-qkv"], 2400, {}),
+    # fused residual-add+LayerNorm Pallas pass A/B (elementwise-HBM
+    # lever from the r4 step anatomy)
+    ("bench_gpt_fusedln", [PY, "bench.py", "--model", "gpt",
+                           "--fused-ln"], 2400, {}),
+    ("bench_gpt_fusedboth", [PY, "bench.py", "--model", "gpt",
+                             "--fused-ln", "--fused-qkv"], 2400, {}),
     ("bench_ernie_fusedqkv", [PY, "bench.py", "--model", "ernie",
                               "--fused-qkv"], 2400, {}),
+    ("bench_ernie_fusedln", [PY, "bench.py", "--model", "ernie",
+                             "--fused-ln"], 2400, {}),
     # long-context: flash 512-blocks beat XLA fused attention 1.77x at
     # s=4096 (r2 microbench) — measure the end-to-end train step there
     ("bench_gpt_s4k", [PY, "bench.py", "--model", "gpt", "--batch", "2",
@@ -154,6 +170,9 @@ STAGES = [
     ("step_anatomy", [PY, "tools/step_anatomy.py"], 2400, {}),
     ("step_anatomy_fused", [PY, "tools/step_anatomy.py", "--fused-qkv"],
      2400, {}),
+    # single-chip schedule-overhead A/B: ms/tick of FThenB vs
+    # interleaved-v2 vs sequential (bounds what pipeline_cost ignores)
+    ("pipeline_overhead", [PY, "tools/pipeline_overhead.py"], 2400, {}),
 ]
 
 # stages addressable via --only but excluded from the default sweep
@@ -162,7 +181,9 @@ STAGES = [
 RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
               "bench_decode_flashk", "bench_gpt_fusedqkv",
               "bench_ernie_fusedqkv", "step_anatomy", "step_anatomy_fused",
-              "bench_gpt_s4k"}
+              "bench_gpt_s4k", "pipeline_overhead", "bench_gpt_fusedln",
+              "bench_gpt_fusedboth", "bench_ernie_fusedln", "bench_resnet_serve",
+              "bench_resnet_serve_fold", "bench_resnet_b512"}
 
 
 def main():
