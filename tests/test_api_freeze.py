@@ -1,0 +1,50 @@
+"""API freeze (VERDICT r4 next #9): every name in the generated
+surface snapshot (docs/api_surface.json, written by
+tools/api_parity_report.py) must keep resolving. Removing or renaming
+a public name is an API break and must be a deliberate act: regenerate
+the snapshot in the same commit and say so. Additions don't fail —
+the next regeneration picks them up."""
+import importlib
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SNAP = os.path.join(REPO, "docs", "api_surface.json")
+
+
+def _namespace(ns):
+    try:
+        return importlib.import_module(ns)
+    except ModuleNotFoundError:
+        parent, leaf = ns.rsplit(".", 1)
+        return getattr(importlib.import_module(parent), leaf)
+
+
+def test_frozen_surface_still_resolves():
+    with open(SNAP) as f:
+        snap = json.load(f)
+    missing = []
+    for ns, names in snap["surface"].items():
+        try:
+            mod = _namespace(ns)
+        except Exception as e:
+            missing.append(f"{ns} (namespace gone: {e!r})")
+            continue
+        for n in names:
+            if not hasattr(mod, n):
+                missing.append(f"{ns}.{n}")
+    assert not missing, (
+        f"{len(missing)} frozen public names no longer resolve "
+        f"(API break — regenerate docs/api_surface.json deliberately "
+        f"if intended): {missing[:20]}")
+
+
+def test_snapshot_version_matches_package():
+    import paddle_tpu
+    with open(SNAP) as f:
+        snap = json.load(f)
+    assert snap["version"] == paddle_tpu.__version__, (
+        "package version changed without regenerating the API "
+        "snapshot: run python tools/api_parity_report.py")
