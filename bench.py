@@ -111,7 +111,7 @@ def gpt_flops_per_token(model, seq):
 
 def build_engine(cfg_name, batch, seq, amp, use_flash=True, recompute=False,
                  moment_dtype=None, scan_layers=False, fused_qkv=False,
-                 fused_ln=False):
+                 fused_ln=False, chunked_ce=0):
     import jax.numpy as jnp
     from paddle_tpu.nlp.gpt import (GPTForCausalLM, GPT_CONFIGS,
                                     GPTPretrainingCriterion, _resolve_config)
@@ -124,7 +124,7 @@ def build_engine(cfg_name, batch, seq, amp, use_flash=True, recompute=False,
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
         use_flash_attention=use_flash, recompute=recompute,
         scan_layers=scan_layers, fused_qkv=fused_qkv,
-        fused_ln=fused_ln))
+        fused_ln=fused_ln, chunked_ce=chunked_ce))
     model.train()
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
                 parameters=model.parameters(), moment_dtype=moment_dtype)
@@ -449,7 +449,7 @@ def worker_ernie(args, on_tpu):
         if on_tpu else None,
         "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
         "batch": batch, "seq": seq, "fused_qkv": args.fused_qkv,
-        "fused_ln": args.fused_ln,
+        "fused_ln": args.fused_ln, "chunked_ce": args.chunked_ce,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -482,7 +482,7 @@ def worker_gpt(args, on_tpu, big=False):
     eng = build_engine(cfg, batch, seq, amp, use_flash=use_flash,
                        recompute=recompute, moment_dtype=moment_dtype,
                        scan_layers=scan_layers, fused_qkv=args.fused_qkv,
-                       fused_ln=args.fused_ln)
+                       fused_ln=args.fused_ln, chunked_ce=args.chunked_ce)
     try:
         tput = run(eng, batch, seq, steps, warmup,
                    scan_steps=args.scan_steps)
@@ -505,7 +505,8 @@ def worker_gpt(args, on_tpu, big=False):
         eng = build_engine(cfg, batch, seq, amp, use_flash=use_flash,
                            recompute=recompute, moment_dtype=moment_dtype,
                            scan_layers=True, fused_qkv=args.fused_qkv,
-                           fused_ln=args.fused_ln)
+                           fused_ln=args.fused_ln,
+                           chunked_ce=args.chunked_ce)
         tput = run(eng, batch, seq, steps, warmup,
                    scan_steps=args.scan_steps)
     fpt = gpt_flops_per_token(eng.network, seq)
@@ -524,7 +525,7 @@ def worker_gpt(args, on_tpu, big=False):
         "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
         "config": cfg, "batch": batch, "seq": seq, "flash": use_flash,
         "scan_layers": scan_layers, "fused_qkv": args.fused_qkv,
-        "fused_ln": args.fused_ln,
+        "fused_ln": args.fused_ln, "chunked_ce": args.chunked_ce,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -951,6 +952,10 @@ def main():
     ap.add_argument("--cache-dtype", default=None,
                     help="decode KV cache dtype (bfloat16 halves decode "
                          "HBM traffic)")
+    ap.add_argument("--chunked-ce", type=int, default=0,
+                    help="gpt: fuse the LM head into the loss over "
+                         "token chunks of this size (the [N,vocab] "
+                         "logits never materialize)")
     ap.add_argument("--fused-ln", action="store_true",
                     help="gpt: fuse residual add + LayerNorm into one "
                          "Pallas pass (elementwise-HBM lever)")
@@ -1044,6 +1049,9 @@ def main():
                                                 "ernie"}:
         ap.error("--fused-ln applies to the gpt/ernie training "
                  "workloads only")
+    if args.chunked_ce and not set(workloads) <= {"gpt", "gpt-1.3b"}:
+        ap.error("--chunked-ce applies to the gpt training "
+                 "workloads only")
     if (args.serve or args.fold_bn) and workloads != ["resnet50"]:
         ap.error("--serve/--fold-bn apply to resnet50 serving only "
                  "(use --model resnet50 --serve)")
@@ -1083,11 +1091,14 @@ def main():
             passthrough.append("--fused-qkv")
         if args.fused_ln:
             passthrough.append("--fused-ln")
+        if args.chunked_ce:
+            passthrough += ["--chunked-ce", str(args.chunked_ce)]
         if args.no_scan_fallback:
             passthrough.append("--no-scan-fallback")
     elif any(v is not None for v in overrides.values()) or args.no_flash \
             or args.recompute or args.scan_steps or args.s2d \
-            or args.scan_layers or args.fused_qkv or args.fused_ln:
+            or args.scan_layers or args.fused_qkv or args.fused_ln \
+            or args.chunked_ce:
         print("[bench] ignoring per-workload flags in full-suite mode "
               "(use --model to tune one workload)", file=sys.stderr,
               flush=True)

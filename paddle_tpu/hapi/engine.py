@@ -141,6 +141,12 @@ class Engine:
             else:
                 l = outs_t[0]
             l_arr = l._value if isinstance(l, Tensor) else l
+            if isinstance(outs, dict) and "chunked_ce" in outs:
+                # loss-only aux pack (fused head+CE): returning it from
+                # the compiled step would materialize the tied embedding
+                # weight as an extra program output every step — the
+                # very HBM the feature frees
+                outs = ()
             return l_arr.astype(jnp.float32), (_unwrap(outs), new_buf)
         return loss_fn
 

@@ -72,6 +72,14 @@ class GPTConfig:
     # shrinking the bubble to (S-1)/(m*v+S-1). ref: fleet
     # num_virtual_pipeline_stages (Megatron interleaved schedule).
     num_virtual_pipeline_stages: int = 1
+    # fuse the tied LM head matmul into the loss, computed over token
+    # CHUNKS of this size (lax.scan + jax.checkpoint): the [N, vocab]
+    # logits tensor — 824 MB fp32 at 1.3B b4 s1024 — never exists in
+    # HBM; each chunk's logits live only inside one scan step and are
+    # recomputed in backward. The Liger-kernel/Megatron fused-CE idea
+    # in XLA-native form. Training path only; 0 disables.
+    # ref: paddlenlp parallel_cross_entropy + fused head variants.
+    chunked_ce: int = 0
     # fuse the block's residual add into the following LayerNorm with
     # one Pallas pass (y=LN(x+r) and s=x+r in a single read of the
     # operands — the add->reduce boundary XLA keeps as a kernel break;
@@ -570,6 +578,16 @@ class GPTForCausalLM(FromPretrainedMixin, Layer):
             hidden, new_cache = out
         else:
             hidden, new_cache = out, None
+        if (getattr(self.config, "chunked_ce", 0) and self.training
+                and new_cache is None):
+            # fused head+loss: hand the criterion the HIDDEN states and
+            # the tied embedding weight — GPTPretrainingCriterion runs
+            # the head matmul chunk-by-chunk inside the loss so the
+            # full [N, vocab] logits never materialize (config docs)
+            return {"hidden": hidden,
+                    "lm_weight": self.gpt.embeddings.word_embeddings
+                    .weight,
+                    "chunked_ce": int(self.config.chunked_ce)}
         # vocab stays sharded under shard_map: GPTPretrainingCriterion's
         # ParallelCrossEntropy consumes vocab-LOCAL logits (Megatron-style)
         logits = parallel_matmul(
@@ -638,13 +656,77 @@ class GPTPretrainingCriterion(Layer):
         self.ce = ParallelCrossEntropy()
 
     def forward(self, prediction_scores, masked_lm_labels, loss_mask=None):
-        loss = self.ce(prediction_scores, masked_lm_labels)
+        if isinstance(prediction_scores, dict) and \
+                "chunked_ce" in prediction_scores:
+            loss = self._chunked_head_ce(
+                prediction_scores["hidden"],
+                prediction_scores["lm_weight"],
+                masked_lm_labels, prediction_scores["chunked_ce"])
+        else:
+            loss = self.ce(prediction_scores, masked_lm_labels)
         if loss_mask is not None:
             m = loss_mask if isinstance(loss_mask, Tensor) else Tensor(loss_mask)
             num = (loss * m.astype(loss.dtype)).sum()
             den = m.astype(loss.dtype).sum()
             return num / den
         return loss.mean()
+
+    @staticmethod
+    def _chunked_head_ce(hidden, weight, labels, chunk):
+        """Per-token CE with the tied-head matmul fused into the loss,
+        lax.scan over token chunks + jax.checkpoint: each chunk's
+        [chunk, vocab] logits live only inside one scan step (and are
+        recomputed in backward), so peak HBM holds chunk*vocab instead
+        of B*S*vocab. Grads to hidden and weight flow through the scan
+        transpose (weight cotangents accumulate across chunks)."""
+        from ..autograd import apply_op
+        from ..distributed.fleet.mpu import axis_bound
+        if axis_bound("mp"):
+            # inside shard_map the weight is the vocab-LOCAL shard: the
+            # chunked lse/gather would silently cover one shard's
+            # partition function. ParallelCrossEntropy owns that path.
+            raise NotImplementedError(
+                "chunked_ce does not run inside shard_map tensor "
+                "parallelism (vocab-sharded weight) — use the default "
+                "head + ParallelCrossEntropy there; under GSPMD "
+                "annotation-based mp, chunked_ce is fine (XLA "
+                "partitions the per-chunk matmul globally)")
+
+        def run(h, w, y):
+            b, s, hd = h.shape
+            n = b * s
+            h2 = h.reshape(n, hd)
+            y2 = y.reshape(n)
+            c = max(1, min(int(chunk), n))
+            pad = (-n) % c
+            if pad:
+                h2 = jnp.concatenate(
+                    [h2, jnp.zeros((pad, hd), h2.dtype)])
+                y2 = jnp.concatenate(  # pad rows count as ignored
+                    [y2, jnp.full((pad,), -100, y2.dtype)])
+            hc = h2.reshape(-1, c, hd)
+            yc = y2.reshape(-1, c)
+
+            @jax.checkpoint
+            def body(carry, xs):
+                h_c, y_c = xs
+                logits = jnp.einsum(
+                    "ch,vh->cv", h_c, w,
+                    preferred_element_type=jnp.float32)
+                lse = jax.scipy.special.logsumexp(logits, axis=-1)
+                # ignore_index=-100 parity with ParallelCrossEntropy:
+                # ignored positions contribute EXACTLY 0 loss
+                ok = y_c != -100
+                safe = jnp.clip(y_c.astype(jnp.int32), 0, None)
+                picked = jnp.take_along_axis(
+                    logits, safe[:, None], axis=-1)[:, 0]
+                return carry, jnp.where(ok, lse - picked, 0.0)
+            _, losses = jax.lax.scan(body, 0.0, (hc, yc))
+            return losses.reshape(-1)[:n].reshape(b, s)
+
+        return apply_op(run, hidden, weight,
+                        labels if isinstance(labels, Tensor)
+                        else Tensor(labels))
 
 
 class GPTForCausalLMPipe(Layer):
@@ -679,6 +761,12 @@ class GPTForCausalLMPipe(Layer):
                 "GPTForCausalLMPipe requires hidden_dropout_prob=0 and "
                 "attention_probs_dropout_prob=0 (dropout masks cannot vary "
                 "across pipeline microbatches)")
+        if getattr(config, "chunked_ce", 0):
+            raise NotImplementedError(
+                "chunked_ce is not wired through GPTForCausalLMPipe "
+                "(its head computes full logits after the pipelined "
+                "trunk) — set chunked_ce=0 for pipeline parallelism, or "
+                "use GPTForCausalLM")
         self.config = config
         self.embeddings = GPTEmbeddings(config)
         self.pipe = PipelineLayer(

@@ -1,0 +1,75 @@
+"""Fused chunked head+CE (GPTConfig.chunked_ce): the [N, vocab] logits
+never materialize; loss and every gradient must equal the plain
+head->ParallelCrossEntropy path."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi.engine import Engine
+from paddle_tpu.nlp.gpt import (GPTConfig, GPTForCausalLM,
+                                GPTPretrainingCriterion)
+from paddle_tpu.optimizer import AdamW
+
+CFG = dict(vocab_size=151, hidden_size=32, num_hidden_layers=2,
+           num_attention_heads=4, max_position_embeddings=32,
+           hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+           use_flash_attention=False)
+
+
+def _one_step(chunked):
+    paddle.seed(13)
+    m = GPTForCausalLM(GPTConfig(**CFG, chunked_ce=chunked))
+    m.train()
+    eng = Engine(m, loss=GPTPretrainingCriterion(),
+                 optimizer=AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters()))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 151, (2, 24)), jnp.int32)
+    loss, _ = eng.train_batch([ids], [ids])
+    p = jax.tree_util.tree_leaves(eng._params)[0]
+    return float(loss), np.asarray(p)
+
+
+def test_chunked_ce_train_step_matches_plain():
+    # chunk=16 does not divide N=48 — exercises the padded tail too
+    base_loss, base_p = _one_step(0)
+    for chunk in (16, 64):
+        ch_loss, ch_p = _one_step(chunk)
+        assert abs(base_loss - ch_loss) < 1e-4, (chunk, base_loss, ch_loss)
+        np.testing.assert_allclose(ch_p, base_p, atol=2e-4, rtol=2e-4)
+
+
+def test_chunked_ce_ignore_index_matches_plain():
+    # -100-padded labels (the standard MLM/CLM convention) must
+    # contribute exactly zero loss, like ParallelCrossEntropy
+    def one(chunked):
+        paddle.seed(17)
+        m = GPTForCausalLM(GPTConfig(**CFG, chunked_ce=chunked))
+        m.train()
+        eng = Engine(m, loss=GPTPretrainingCriterion(),
+                     optimizer=AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters()))
+        rng = np.random.default_rng(1)
+        ids = jnp.asarray(rng.integers(0, 151, (2, 24)), jnp.int32)
+        labels = np.array(ids)  # writable copy
+        labels[:, ::3] = -100
+        loss, _ = eng.train_batch([ids], [jnp.asarray(labels)])
+        return float(loss)
+
+    assert abs(one(0) - one(16)) < 1e-4, (one(0), one(16))
+
+
+def test_chunked_ce_pipe_refuses_loudly():
+    import pytest
+    from paddle_tpu.nlp.gpt import GPTForCausalLMPipe
+    with pytest.raises(NotImplementedError, match="chunked_ce"):
+        GPTForCausalLMPipe(GPTConfig(**CFG, chunked_ce=16))
+
+
+def test_chunked_ce_eval_path_still_returns_logits():
+    paddle.seed(5)
+    m = GPTForCausalLM(GPTConfig(**CFG, chunked_ce=16))
+    m.eval()
+    out = m(jnp.ones((1, 8), jnp.int32))
+    assert out.shape == [1, 8, 151]  # eval serves logits as usual

@@ -145,6 +145,13 @@ STAGES = [
     # the remote_compile RPC cutoff that killed the unrolled 1.3B
     ("bench_gpt13b_scan", [PY, "bench.py", "--model", "gpt-1.3b",
                            "--scan-layers"], 2400, {}),
+    # + fused head/loss: the [N,vocab] logits never materialize —
+    # the memory headroom lever for bigger 1.3B batches
+    ("bench_gpt13b_scan_cce", [PY, "bench.py", "--model", "gpt-1.3b",
+                               "--scan-layers", "--chunked-ce", "2048"],
+     2400, {}),
+    ("bench_gpt_chunkedce", [PY, "bench.py", "--model", "gpt",
+                             "--chunked-ce", "2048"], 2400, {}),
     # headline batch-scaling probe: MFU 0.40 at b8 — check whether b16
     # lifts backward-pass efficiency (fits: 345M + Adam fp32 ~4.2 GB,
     # acts at b16 s1024 with flash ~4 GB)
@@ -183,7 +190,8 @@ RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
               "bench_ernie_fusedqkv", "step_anatomy", "step_anatomy_fused",
               "bench_gpt_s4k", "pipeline_overhead", "bench_gpt_fusedln",
               "bench_gpt_fusedboth", "bench_ernie_fusedln", "bench_resnet_serve",
-              "bench_resnet_serve_fold", "bench_resnet_b512"}
+              "bench_resnet_serve_fold", "bench_resnet_b512",
+              "bench_gpt13b_scan_cce", "bench_gpt_chunkedce"}
 
 
 def main():
