@@ -141,11 +141,12 @@ class Engine:
             else:
                 l = outs_t[0]
             l_arr = l._value if isinstance(l, Tensor) else l
-            if isinstance(outs, dict) and "chunked_ce" in outs:
-                # loss-only aux pack (fused head+CE): returning it from
-                # the compiled step would materialize the tied embedding
-                # weight as an extra program output every step — the
-                # very HBM the feature frees
+            if isinstance(outs, dict) and outs.get("_loss_only_aux"):
+                # model-agnostic convention: a dict output marked
+                # _loss_only_aux feeds ONLY the criterion (e.g. GPT's
+                # fused head+CE passes the tied weight) — returning it
+                # from the compiled step would materialize those
+                # tensors as extra program outputs every step
                 outs = ()
             return l_arr.astype(jnp.float32), (_unwrap(outs), new_buf)
         return loss_fn

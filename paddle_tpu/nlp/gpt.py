@@ -583,10 +583,18 @@ class GPTForCausalLM(FromPretrainedMixin, Layer):
             # fused head+loss: hand the criterion the HIDDEN states and
             # the tied embedding weight — GPTPretrainingCriterion runs
             # the head matmul chunk-by-chunk inside the loss so the
-            # full [N, vocab] logits never materialize (config docs)
-            return {"hidden": hidden,
-                    "lm_weight": self.gpt.embeddings.word_embeddings
-                    .weight,
+            # full [N, vocab] logits never materialize (config docs).
+            # Snapshot the weight's CURRENT (traced, AMP-cast) value
+            # into a fresh Tensor: functional_call restores the
+            # Parameter object's _value after forward returns, so
+            # passing the Parameter itself would bake the stale
+            # concrete array into the jit as a constant (no grads to
+            # the tied weight through the head).
+            w = self.gpt.embeddings.word_embeddings.weight
+            return {"_loss_only_aux": True,
+                    "hidden": hidden,
+                    "lm_weight": Tensor(w._value,
+                                        stop_gradient=w.stop_gradient),
                     "chunked_ce": int(self.config.chunked_ce)}
         # vocab stays sharded under shard_map: GPTPretrainingCriterion's
         # ParallelCrossEntropy consumes vocab-LOCAL logits (Megatron-style)

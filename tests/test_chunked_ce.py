@@ -17,7 +17,10 @@ CFG = dict(vocab_size=151, hidden_size=32, num_hidden_layers=2,
            use_flash_attention=False)
 
 
-def _one_step(chunked):
+def _steps(chunked, n_steps=2):
+    """Run n_steps and return (losses, FULL param tree) — a single
+    step over one leaf cannot see a frozen tied head (the stale-weight
+    constant bug diverged only at step 2, in the embedding leaf)."""
     paddle.seed(13)
     m = GPTForCausalLM(GPTConfig(**CFG, chunked_ce=chunked))
     m.train()
@@ -25,19 +28,28 @@ def _one_step(chunked):
                  optimizer=AdamW(learning_rate=1e-3,
                                  parameters=m.parameters()))
     rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(0, 151, (2, 24)), jnp.int32)
-    loss, _ = eng.train_batch([ids], [ids])
-    p = jax.tree_util.tree_leaves(eng._params)[0]
-    return float(loss), np.asarray(p)
+    losses = []
+    for _ in range(n_steps):
+        ids = jnp.asarray(rng.integers(0, 151, (2, 24)), jnp.int32)
+        loss, _ = eng.train_batch([ids], [ids])
+        losses.append(float(loss))
+    return losses, {k: np.asarray(v) for k, v in
+                    zip(range(10**9),
+                        jax.tree_util.tree_leaves(eng._params))}
 
 
 def test_chunked_ce_train_step_matches_plain():
-    # chunk=16 does not divide N=48 — exercises the padded tail too
-    base_loss, base_p = _one_step(0)
+    # chunk=16 does not divide N=48 — exercises the padded tail too.
+    # TWO steps + EVERY param leaf: grads must reach the tied
+    # embedding through the fused head, not only the decoder
+    base_losses, base_p = _steps(0)
     for chunk in (16, 64):
-        ch_loss, ch_p = _one_step(chunk)
-        assert abs(base_loss - ch_loss) < 1e-4, (chunk, base_loss, ch_loss)
-        np.testing.assert_allclose(ch_p, base_p, atol=2e-4, rtol=2e-4)
+        ch_losses, ch_p = _steps(chunk)
+        for a, b in zip(base_losses, ch_losses):
+            assert abs(a - b) < 1e-4, (chunk, base_losses, ch_losses)
+        for k in base_p:
+            np.testing.assert_allclose(ch_p[k], base_p[k], atol=2e-4,
+                                       rtol=2e-4, err_msg=f"leaf {k}")
 
 
 def test_chunked_ce_ignore_index_matches_plain():

@@ -33,6 +33,8 @@ def main():
     ap.add_argument("--steps", type=int, default=10,
                     help="timed iterations per program (>= 1)")
     ap.add_argument("--fused-qkv", action="store_true")
+    ap.add_argument("--fused-ln", action="store_true")
+    ap.add_argument("--chunked-ce", type=int, default=0)
     ap.add_argument("--scan-layers", action="store_true")
     ap.add_argument("--smoke", action="store_true")
     args = ap.parse_args()
@@ -60,7 +62,9 @@ def main():
     big = args.model == "gpt-1.3b" and not args.smoke and on_tpu
     eng = build_engine(cfg, batch, seq, amp=on_tpu and not args.smoke,
                       recompute=big, moment_dtype="bfloat16" if big else None,
-                      scan_layers=args.scan_layers, fused_qkv=args.fused_qkv)
+                      scan_layers=args.scan_layers,
+                      fused_qkv=args.fused_qkv, fused_ln=args.fused_ln,
+                      chunked_ce=args.chunked_ce)
     model, crit = eng.network, eng.loss
     params, buffers = model.raw_state()
     rng = np.random.default_rng(0)
@@ -108,6 +112,7 @@ def main():
         "metric": "gpt_step_anatomy", "config": cfg,
         "batch": batch, "seq": seq,
         "fused_qkv": args.fused_qkv, "scan_layers": args.scan_layers,
+        "fused_ln": args.fused_ln, "chunked_ce": args.chunked_ce,
         "fwd_ms": round(t_fwd * 1e3, 2),
         "fwd_bwd_ms": round(t_grad * 1e3, 2),
         "full_step_ms": round(t_full * 1e3, 2),

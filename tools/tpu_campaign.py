@@ -177,6 +177,8 @@ STAGES = [
     ("step_anatomy", [PY, "tools/step_anatomy.py"], 2400, {}),
     ("step_anatomy_fused", [PY, "tools/step_anatomy.py", "--fused-qkv"],
      2400, {}),
+    ("step_anatomy_fusedln", [PY, "tools/step_anatomy.py",
+                              "--fused-ln"], 2400, {}),
     # single-chip schedule-overhead A/B: ms/tick of FThenB vs
     # interleaved-v2 vs sequential (bounds what pipeline_cost ignores)
     ("pipeline_overhead", [PY, "tools/pipeline_overhead.py"], 2400, {}),
@@ -191,7 +193,8 @@ RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
               "bench_gpt_s4k", "pipeline_overhead", "bench_gpt_fusedln",
               "bench_gpt_fusedboth", "bench_ernie_fusedln", "bench_resnet_serve",
               "bench_resnet_serve_fold", "bench_resnet_b512",
-              "bench_gpt13b_scan_cce", "bench_gpt_chunkedce"}
+              "bench_gpt13b_scan_cce", "bench_gpt_chunkedce",
+              "step_anatomy_fusedln"}
 
 
 def main():
