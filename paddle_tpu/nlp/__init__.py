@@ -19,6 +19,10 @@ from .ernie import (  # noqa: F401
     ErnieForMaskedLM, ErnieForSequenceClassification,
     ErnieForTokenClassification, ErnieForQuestionAnswering, ERNIE_CONFIGS,
 )
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaModel, LlamaForCausalLM,
+    LlamaPretrainingCriterion, LLAMA_CONFIGS,
+)
 from .tokenizer import (  # noqa: F401
     BasicTokenizer, WordpieceTokenizer, BertTokenizer, GPTTokenizer,
 )

@@ -127,8 +127,12 @@ def _mask_top_p(logits, top_p):
 
 
 def _alloc_cache(cfg, batch, s_max, dtype):
+    # GQA models (Llama-style num_key_value_heads < heads) cache only
+    # the kv heads — the whole point of grouped-query attention
+    kv_heads = getattr(cfg, "num_key_value_heads", 0) \
+        or cfg.num_attention_heads
     return [
-        (jnp.zeros((batch, s_max, cfg.num_attention_heads, cfg.head_dim),
+        (jnp.zeros((batch, s_max, kv_heads, cfg.head_dim),
                    dtype=dtype),) * 2
         for _ in range(cfg.num_hidden_layers)]
 
