@@ -111,7 +111,7 @@ def gpt_flops_per_token(model, seq):
 
 def build_engine(cfg_name, batch, seq, amp, use_flash=True, recompute=False,
                  moment_dtype=None, scan_layers=False, fused_qkv=False,
-                 fused_ln=False, chunked_ce=0):
+                 fused_ln=False, chunked_ce=0, fused_adamw=False):
     import jax.numpy as jnp
     from paddle_tpu.nlp.gpt import (GPTForCausalLM, GPT_CONFIGS,
                                     GPTPretrainingCriterion, _resolve_config)
@@ -127,7 +127,8 @@ def build_engine(cfg_name, batch, seq, amp, use_flash=True, recompute=False,
         fused_ln=fused_ln, chunked_ce=chunked_ce))
     model.train()
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
-                parameters=model.parameters(), moment_dtype=moment_dtype)
+                parameters=model.parameters(), moment_dtype=moment_dtype,
+                fused_kernel=fused_adamw)
     eng = Engine(model, loss=GPTPretrainingCriterion(), optimizer=opt,
                  amp_dtype=jnp.bfloat16 if amp else None)
     return eng
@@ -450,6 +451,7 @@ def worker_ernie(args, on_tpu):
         "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
         "batch": batch, "seq": seq, "fused_qkv": args.fused_qkv,
         "fused_ln": args.fused_ln, "chunked_ce": args.chunked_ce,
+        "fused_adamw": args.fused_adamw,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -482,7 +484,8 @@ def worker_gpt(args, on_tpu, big=False):
     eng = build_engine(cfg, batch, seq, amp, use_flash=use_flash,
                        recompute=recompute, moment_dtype=moment_dtype,
                        scan_layers=scan_layers, fused_qkv=args.fused_qkv,
-                       fused_ln=args.fused_ln, chunked_ce=args.chunked_ce)
+                       fused_ln=args.fused_ln, chunked_ce=args.chunked_ce,
+                       fused_adamw=args.fused_adamw)
     try:
         tput = run(eng, batch, seq, steps, warmup,
                    scan_steps=args.scan_steps)
@@ -506,7 +509,8 @@ def worker_gpt(args, on_tpu, big=False):
                            recompute=recompute, moment_dtype=moment_dtype,
                            scan_layers=True, fused_qkv=args.fused_qkv,
                            fused_ln=args.fused_ln,
-                           chunked_ce=args.chunked_ce)
+                           chunked_ce=args.chunked_ce,
+                           fused_adamw=args.fused_adamw)
         tput = run(eng, batch, seq, steps, warmup,
                    scan_steps=args.scan_steps)
     fpt = gpt_flops_per_token(eng.network, seq)
@@ -526,6 +530,7 @@ def worker_gpt(args, on_tpu, big=False):
         "config": cfg, "batch": batch, "seq": seq, "flash": use_flash,
         "scan_layers": scan_layers, "fused_qkv": args.fused_qkv,
         "fused_ln": args.fused_ln, "chunked_ce": args.chunked_ce,
+        "fused_adamw": args.fused_adamw,
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -952,6 +957,9 @@ def main():
     ap.add_argument("--cache-dtype", default=None,
                     help="decode KV cache dtype (bfloat16 halves decode "
                          "HBM traffic)")
+    ap.add_argument("--fused-adamw", action="store_true",
+                    help="gpt: one-HBM-pass Pallas optimizer update "
+                         "(the 22.8ms-vs-11.8ms-floor lever)")
     ap.add_argument("--chunked-ce", type=int, default=0,
                     help="gpt: fuse the LM head into the loss over "
                          "token chunks of this size (the [N,vocab] "
@@ -1052,6 +1060,9 @@ def main():
     if args.chunked_ce and not set(workloads) <= {"gpt", "gpt-1.3b"}:
         ap.error("--chunked-ce applies to the gpt training "
                  "workloads only")
+    if args.fused_adamw and not set(workloads) <= {"gpt", "gpt-1.3b"}:
+        ap.error("--fused-adamw applies to the gpt training "
+                 "workloads only")
     if (args.serve or args.fold_bn) and workloads != ["resnet50"]:
         ap.error("--serve/--fold-bn apply to resnet50 serving only "
                  "(use --model resnet50 --serve)")
@@ -1093,12 +1104,14 @@ def main():
             passthrough.append("--fused-ln")
         if args.chunked_ce:
             passthrough += ["--chunked-ce", str(args.chunked_ce)]
+        if args.fused_adamw:
+            passthrough.append("--fused-adamw")
         if args.no_scan_fallback:
             passthrough.append("--no-scan-fallback")
     elif any(v is not None for v in overrides.values()) or args.no_flash \
             or args.recompute or args.scan_steps or args.s2d \
             or args.scan_layers or args.fused_qkv or args.fused_ln \
-            or args.chunked_ce:
+            or args.chunked_ce or args.fused_adamw:
         print("[bench] ignoring per-workload flags in full-suite mode "
               "(use --model to tune one workload)", file=sys.stderr,
               flush=True)

@@ -323,7 +323,7 @@ class Adam(Optimizer):
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
                  name=None, apply_decay_param_fun=None, amsgrad=False,
-                 moment_dtype=None):
+                 moment_dtype=None, fused_kernel=False):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, name, apply_decay_param_fun)
         self._beta1 = beta1
@@ -331,6 +331,12 @@ class Adam(Optimizer):
         self._epsilon = epsilon
         self._amsgrad = amsgrad
         self._decoupled = False
+        # one-HBM-pass Pallas update for large fp32 leaves (ref: the
+        # CUDA fused adamw_kernel) — r4 step anatomy measured the jnp
+        # chain at ~2x its bandwidth floor. Opt-in A/B lever
+        # (bench --fused-adamw); ineligible leaves (small, amsgrad,
+        # master weights, bf16 moments) keep the jnp path.
+        self._fused_kernel = bool(fused_kernel)
         # reduced-precision moment storage (bf16 halves optimizer HBM
         # traffic; math stays fp32, stores use stochastic rounding)
         self._moment_dtype = jnp.dtype(moment_dtype) if moment_dtype else None
@@ -372,7 +378,24 @@ class Adam(Optimizer):
         new_m, new_v, new_p = {}, {}, {}
         new_vhat = {}
         new_master = {}
+        use_fused = self._fused_kernel and not self._amsgrad \
+            and not self._multi_precision
+        if use_fused:
+            import jax as _jax
+            from ..ops.pallas.fused_adamw import (fused_adamw_supported,
+                                                  fused_adamw_update)
+            interp = _jax.default_backend() != "tpu"
         for k in params:
+            if use_fused and fused_adamw_supported(
+                    params[k], state["m"][k], state["v"][k]):
+                apply_wd = wd and (decay_fn is None or decay_fn(k))
+                elr = self._effective_lr(lr, lr_mult, k)
+                new_p[k], new_m[k], new_v[k] = fused_adamw_update(
+                    params[k], state["m"][k], state["v"][k], grads[k],
+                    elr, bc1, bc2, beta1=b1, beta2=b2, eps=eps,
+                    weight_decay=(wd if apply_wd else 0.0),
+                    decoupled=self._decoupled, interpret=interp)
+                continue
             g = grads[k].astype(jnp.float32)
             p32 = state["master"][k] if self._multi_precision else \
                 params[k].astype(jnp.float32)
@@ -428,11 +451,12 @@ class AdamW(Adam):
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None,
-                 amsgrad=False, moment_dtype=None):
+                 amsgrad=False, moment_dtype=None, fused_kernel=False):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
                          name, apply_decay_param_fun, amsgrad,
-                         moment_dtype=moment_dtype)
+                         moment_dtype=moment_dtype,
+                         fused_kernel=fused_kernel)
         self._decoupled = True
 
 

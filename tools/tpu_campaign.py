@@ -152,6 +152,10 @@ STAGES = [
      2400, {}),
     ("bench_gpt_chunkedce", [PY, "bench.py", "--model", "gpt",
                              "--chunked-ce", "2048"], 2400, {}),
+    # one-HBM-pass Pallas optimizer update A/B (step anatomy: the
+    # jnp AdamW chain ran at ~2x its bandwidth floor)
+    ("bench_gpt_fusedadamw", [PY, "bench.py", "--model", "gpt",
+                              "--fused-adamw"], 2400, {}),
     # headline batch-scaling probe: MFU 0.40 at b8 — check whether b16
     # lifts backward-pass efficiency (fits: 345M + Adam fp32 ~4.2 GB,
     # acts at b16 s1024 with flash ~4 GB)
@@ -194,7 +198,7 @@ RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
               "bench_gpt_fusedboth", "bench_ernie_fusedln", "bench_resnet_serve",
               "bench_resnet_serve_fold", "bench_resnet_b512",
               "bench_gpt13b_scan_cce", "bench_gpt_chunkedce",
-              "step_anatomy_fusedln"}
+              "step_anatomy_fusedln", "bench_gpt_fusedadamw"}
 
 
 def main():
