@@ -180,7 +180,8 @@ def run(eng, batch, seq, steps, warmup, scan_steps=0):
     return batch * seq * steps / dt
 
 
-def build_ernie_engine(batch, seq, amp, fused_qkv=False, fused_ln=False):
+def build_ernie_engine(batch, seq, amp, fused_qkv=False, fused_ln=False,
+                       mlm_gather=0.0):
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.nlp import (ErnieForPretraining,
@@ -196,7 +197,8 @@ def build_ernie_engine(batch, seq, amp, fused_qkv=False, fused_ln=False):
     model = ErnieForPretraining(_ernie_cfg(
         "ernie-3.0-base-zh", max_position_embeddings=max_pos,
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
-        fused_qkv=fused_qkv, fused_ln=fused_ln))
+        fused_qkv=fused_qkv, fused_ln=fused_ln,
+        mlm_gather_capacity=mlm_gather))
     model.train()
     opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
                 parameters=model.parameters())
@@ -438,7 +440,8 @@ def worker_ernie(args, on_tpu):
         f"backend={jax.default_backend()} amp={amp} "
         f"fused_qkv={args.fused_qkv}")
     eng = build_ernie_engine(batch, seq, amp, fused_qkv=args.fused_qkv,
-                             fused_ln=args.fused_ln)
+                             fused_ln=args.fused_ln,
+                             mlm_gather=args.mlm_gather)
     tput = run_ernie(eng, batch, seq, steps, warmup)
     fpt = gpt_flops_per_token(eng.network, seq)  # same 6N+12Lhs conv.
     print(json.dumps({
@@ -450,7 +453,7 @@ def worker_ernie(args, on_tpu):
         if on_tpu else None,
         "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
         "batch": batch, "seq": seq, "fused_qkv": args.fused_qkv,
-        "fused_ln": args.fused_ln, "chunked_ce": args.chunked_ce,
+        "fused_ln": args.fused_ln, "mlm_gather": args.mlm_gather, "chunked_ce": args.chunked_ce,
         "fused_adamw": args.fused_adamw,
         "backend": jax.default_backend(),
     }), flush=True)
@@ -957,6 +960,11 @@ def main():
     ap.add_argument("--cache-dtype", default=None,
                     help="decode KV cache dtype (bfloat16 halves decode "
                          "HBM traffic)")
+    ap.add_argument("--mlm-gather", type=float, default=0.0,
+                    help="ernie: gather at most this fraction of "
+                         "positions (the masked ~15%%) before the "
+                         "MLM head — head FLOPs/logits shrink "
+                         "~1/c-fold (0 = full head)")
     ap.add_argument("--fused-adamw", action="store_true",
                     help="gpt: one-HBM-pass Pallas optimizer update "
                          "(the 22.8ms-vs-11.8ms-floor lever)")
@@ -1063,6 +1071,8 @@ def main():
     if args.fused_adamw and not set(workloads) <= {"gpt", "gpt-1.3b"}:
         ap.error("--fused-adamw applies to the gpt training "
                  "workloads only")
+    if args.mlm_gather and workloads != ["ernie"]:
+        ap.error("--mlm-gather applies to the ernie workload only")
     if (args.serve or args.fold_bn) and workloads != ["resnet50"]:
         ap.error("--serve/--fold-bn apply to resnet50 serving only "
                  "(use --model resnet50 --serve)")
@@ -1106,12 +1116,14 @@ def main():
             passthrough += ["--chunked-ce", str(args.chunked_ce)]
         if args.fused_adamw:
             passthrough.append("--fused-adamw")
+        if args.mlm_gather:
+            passthrough += ["--mlm-gather", str(args.mlm_gather)]
         if args.no_scan_fallback:
             passthrough.append("--no-scan-fallback")
     elif any(v is not None for v in overrides.values()) or args.no_flash \
             or args.recompute or args.scan_steps or args.s2d \
             or args.scan_layers or args.fused_qkv or args.fused_ln \
-            or args.chunked_ce or args.fused_adamw:
+            or args.chunked_ce or args.fused_adamw or args.mlm_gather:
         print("[bench] ignoring per-workload flags in full-suite mode "
               "(use --model to tune one workload)", file=sys.stderr,
               flush=True)

@@ -57,6 +57,17 @@ class BertConfig:
     # fuse each residual add into its following LayerNorm with one
     # Pallas pass (both block sites in post-LN; ops/pallas/fused_ln.py)
     fused_ln: bool = False
+    # MLM masked-position gather: only ~15% of pretraining positions
+    # carry labels, yet the LM head computes [B,S,vocab] logits for all
+    # of them (~20% of the step's FLOPs at base scale). With capacity
+    # c > 0, training gathers at most ceil(c*B*S) masked positions
+    # (STATIC shape — TPU/jit-safe) before the transform+decode, so
+    # head FLOPs and logits memory shrink ~1/c-fold. Loss is EXACTLY
+    # the baseline's while the masked count fits the capacity; overflow
+    # drops the excess positions (pick c with slack over the mask rate
+    # — 0.25 for the standard 15%). ref: Megatron/ERNIE pretraining
+    # gathers masked tokens the same way before the vocab projection.
+    mlm_gather_capacity: float = 0.0
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -332,7 +343,34 @@ class BertForPretraining(FromPretrainedMixin, Layer):
                 attention_mask=None):
         seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
                                 attention_mask)
+        cap = getattr(self.config, "mlm_gather_capacity", 0.0)
+        if cap and self.training:
+            return _mlm_gather_aux(self.config, self.cls.predictions,
+                                   seq,
+                                   self.cls.seq_relationship(pooled),
+                                   cap)
         return self.cls(seq, pooled)
+
+
+def _mlm_gather_aux(config, pred_head, seq, nsp_score, cap):
+    """Defer the MLM head to the criterion so it can gather the masked
+    positions (only the criterion sees the labels). Carries the head's
+    TRACED parameter values — functional_call restores the Parameter
+    objects' values after forward, so passing modules/Parameters would
+    bake stale constants into the jit (same contract as chunked_ce)."""
+    t = pred_head.transform
+    ln = pred_head.layer_norm
+    val = lambda p: Tensor(p._value, stop_gradient=p.stop_gradient)
+    return {
+        "_loss_only_aux": True, "mlm_gather": True,
+        "hidden": seq, "nsp_score": nsp_score,
+        "t_w": val(t.weight), "t_b": val(t.bias),
+        "ln_w": val(ln.weight), "ln_b": val(ln.bias),
+        "dec_w": val(pred_head._tied), "dec_b": val(pred_head.decoder_bias),
+        # static (consumed inside the trace, stripped before jit output)
+        "act": config.hidden_act, "capacity": float(cap),
+        "ln_eps": config.layer_norm_eps,
+    }
 
 
 class BertPretrainingCriterion(Layer):
@@ -343,9 +381,18 @@ class BertPretrainingCriterion(Layer):
         super().__init__()
         self.ce = ParallelCrossEntropy()
 
-    def forward(self, prediction_scores, seq_relationship_score,
-                masked_lm_labels, next_sentence_labels=None,
+    def forward(self, prediction_scores, seq_relationship_score=None,
+                masked_lm_labels=None, next_sentence_labels=None,
                 masked_lm_weights=None):
+        if isinstance(prediction_scores, dict) and \
+                prediction_scores.get("mlm_gather"):
+            # the model returned ONE aux dict instead of (scores, nsp),
+            # so every label argument arrives one position early
+            return self._gathered_mlm_loss(
+                prediction_scores,
+                masked_lm_labels=seq_relationship_score,
+                next_sentence_labels=masked_lm_labels,
+                masked_lm_weights=next_sentence_labels)
         mlm = self.ce(prediction_scores, masked_lm_labels)
         if masked_lm_weights is not None:
             w = masked_lm_weights if isinstance(masked_lm_weights, Tensor) \
@@ -364,6 +411,95 @@ class BertPretrainingCriterion(Layer):
         if next_sentence_labels is None:
             return mlm_loss
         nsp_loss = F.cross_entropy(seq_relationship_score,
+                                   next_sentence_labels)
+        return mlm_loss + nsp_loss
+
+    def _gathered_mlm_loss(self, aux, masked_lm_labels,
+                           next_sentence_labels=None,
+                           masked_lm_weights=None):
+        """MLM loss over at most ceil(capacity*B*S) GATHERED masked
+        positions: transform+LN+decode run on [K, h] instead of
+        [B*S, h] (see BertConfig.mlm_gather_capacity). Equals the full
+        loss exactly while the masked count fits K; overflow drops the
+        latest excess positions but keeps the full-count normalizer."""
+        import math as _math
+
+        import jax as _jax
+
+        from ..autograd import apply_op
+        from ..distributed.fleet.mpu import axis_bound
+        if axis_bound("mp"):
+            raise NotImplementedError(
+                "mlm_gather_capacity does not run inside shard_map "
+                "tensor parallelism (the decode weight is vocab-local) "
+                "— use the default head + ParallelCrossEntropy there")
+        import functools as _ft
+
+        # exactness parity with the baseline head: F.gelu defaults to
+        # the exact erf form (jax.nn.gelu alone defaults to the tanh
+        # approximation — up to ~1e-3 apart at |x|~2)
+        acts = {"gelu": _ft.partial(_jax.nn.gelu, approximate=False),
+                "relu": _jax.nn.relu, "silu": _jax.nn.silu,
+                "swish": _jax.nn.silu, "tanh": jnp.tanh}
+        if aux["act"] not in acts:
+            raise NotImplementedError(
+                f"mlm_gather_capacity with hidden_act="
+                f"{aux['act']!r} is not wired (supported: "
+                f"{sorted(acts)}); set mlm_gather_capacity=0")
+        act = acts[aux["act"]]
+        cap = float(aux["capacity"])
+        eps = float(aux["ln_eps"])
+        ii = self.ce.ignore_index
+
+        def run(hidden, t_w, t_b, ln_w, ln_b, dec_w, dec_b, y, w):
+            b, s, h = hidden.shape
+            n = b * s
+            k = max(8, int(_math.ceil(cap * n)))
+            yf = y.reshape(n)
+            valid = yf != ii
+            # stable argsort: valid positions first, original order kept
+            idx = jnp.argsort(jnp.where(valid, 0, 1), stable=True)[:k]
+            hg = hidden.reshape(n, h)[idx]
+            yg = yf[idx]          # overflow tail is ii -> zero loss
+            # AMP parity with the baseline head: operands stay in their
+            # (possibly bf16) dtype so the matmuls ride the MXU at full
+            # rate; accumulation is fp32 via preferred_element_type
+            hh = act(jnp.einsum("kh,ho->ko", hg, t_w,
+                                preferred_element_type=jnp.float32)
+                     + t_b.astype(jnp.float32))
+            mu = jnp.mean(hh, axis=-1, keepdims=True)
+            var = jnp.mean(jnp.square(hh - mu), axis=-1, keepdims=True)
+            hh = (hh - mu) * _jax.lax.rsqrt(var + eps) \
+                * ln_w.astype(jnp.float32) + ln_b.astype(jnp.float32)
+            logits = jnp.einsum("kh,vh->kv", hh.astype(hg.dtype), dec_w,
+                                preferred_element_type=jnp.float32) \
+                + dec_b.astype(jnp.float32)
+            lse = _jax.scipy.special.logsumexp(logits, axis=-1)
+            safe = jnp.clip(yg.astype(jnp.int32), 0, None)
+            picked = jnp.take_along_axis(logits, safe[:, None],
+                                         axis=-1)[:, 0]
+            ok = yg != ii
+            losses = jnp.where(ok, lse - picked, 0.0)
+            if w is not None:
+                wg = w.reshape(n)[idx].astype(jnp.float32)
+                return jnp.sum(losses * wg) / \
+                    jnp.clip(jnp.sum(w.astype(jnp.float32)), 1.0)
+            count = jnp.sum(valid.astype(jnp.float32))
+            return jnp.sum(losses) / jnp.clip(count, 1.0)
+
+        y = masked_lm_labels if isinstance(masked_lm_labels, Tensor) \
+            else Tensor(masked_lm_labels)
+        args = [aux["hidden"], aux["t_w"], aux["t_b"], aux["ln_w"],
+                aux["ln_b"], aux["dec_w"], aux["dec_b"], y]
+        if masked_lm_weights is not None:
+            w = masked_lm_weights if isinstance(masked_lm_weights, Tensor)\
+                else Tensor(masked_lm_weights)
+            mlm_loss = apply_op(lambda *a: run(*a), *args, w)
+        else:
+            mlm_loss = apply_op(lambda *a: run(*a, None), *args)
+        if next_sentence_labels is None:
+            return mlm_loss
+        nsp_loss = F.cross_entropy(aux["nsp_score"],
                                    next_sentence_labels)
         return mlm_loss + nsp_loss
 

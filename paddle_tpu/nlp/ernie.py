@@ -168,6 +168,11 @@ class ErnieForPretraining(Layer):
                 attention_mask=None):
         seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
                                  attention_mask)
+        cap = getattr(self.config, "mlm_gather_capacity", 0.0)
+        if cap and self.training:
+            from .bert import _mlm_gather_aux
+            return _mlm_gather_aux(self.config, self.cls, seq,
+                                   self.seq_relationship(pooled), cap)
         return self.cls(seq), self.seq_relationship(pooled)
 
 

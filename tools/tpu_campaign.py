@@ -174,6 +174,10 @@ STAGES = [
                               "--fused-qkv"], 2400, {}),
     ("bench_ernie_fusedln", [PY, "bench.py", "--model", "ernie",
                              "--fused-ln"], 2400, {}),
+    # masked-position gather before the MLM head: ~20%% of ERNIE's
+    # step FLOPs are vocab logits for unmasked positions
+    ("bench_ernie_mlmgather", [PY, "bench.py", "--model", "ernie",
+                               "--mlm-gather", "0.25"], 2400, {}),
     # long-context: flash 512-blocks beat XLA fused attention 1.77x at
     # s=4096 (r2 microbench) — measure the end-to-end train step there
     ("bench_gpt_s4k", [PY, "bench.py", "--model", "gpt", "--batch", "2",
@@ -198,7 +202,8 @@ RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
               "bench_gpt_fusedboth", "bench_ernie_fusedln", "bench_resnet_serve",
               "bench_resnet_serve_fold", "bench_resnet_b512",
               "bench_gpt13b_scan_cce", "bench_gpt_chunkedce",
-              "step_anatomy_fusedln", "bench_gpt_fusedadamw"}
+              "step_anatomy_fusedln", "bench_gpt_fusedadamw",
+              "bench_ernie_mlmgather"}
 
 
 def main():

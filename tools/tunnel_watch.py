@@ -70,7 +70,7 @@ def main():
         "--stages",
         default="bench_gpt13b_scan,bench_gpt13b_scan_cce,bench_decode,bench_decode_bf16kv,"
                 "bench_decode_int8,bench_decode_bf16w,bench_decode_int4,bench_gpt13b,decode_probe,"
-                "bench_gpt_b16,bench_gpt_fusedqkv,bench_gpt_fusedln,bench_gpt_chunkedce,bench_gpt_fusedadamw,bench_gpt_fusedboth,bench_ernie_fusedqkv,bench_ernie_fusedln,bench_gpt_s4k,step_anatomy,step_anatomy_fused,step_anatomy_fusedln,resnet_roofline,bench_resnet_serve,bench_resnet_serve_fold,bench_resnet_b512,fusion_audit,pipeline_overhead,bench_decode_flashk")
+                "bench_gpt_b16,bench_gpt_fusedqkv,bench_gpt_fusedln,bench_gpt_chunkedce,bench_gpt_fusedadamw,bench_gpt_fusedboth,bench_ernie_fusedqkv,bench_ernie_fusedln,bench_ernie_mlmgather,bench_gpt_s4k,step_anatomy,step_anatomy_fused,step_anatomy_fusedln,resnet_roofline,bench_resnet_serve,bench_resnet_serve_fold,bench_resnet_b512,fusion_audit,pipeline_overhead,bench_decode_flashk")
     ap.add_argument("--log", default=os.path.join(OUT, "probe_r4b.log"))
     ap.add_argument("--max-attempts", type=int, default=3,
                     help="drop a stage after this many failed campaign "
