@@ -156,7 +156,11 @@ def main():
                   file=sys.stderr, flush=True)
             break
     print(json.dumps(results), flush=True)
+    # nonzero when any stage failed/timed out: the campaign marks this
+    # stage by rc, and a silently-green half-failed bisection would
+    # read as "decode path proven" in summary.json
+    return 0 if results and all(r["ok"] for r in results.values()) else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""Preflight: every campaign stage's command line must parse.
+
+A stage with a bad flag (or a renamed script) would burn a scarce
+tunnel window on an instant failure. This runs each STAGES entry with
+a 5s probe budget: an argparse failure or instant crash is flagged; a
+healthy command reaches the probe (which then times out on a dead
+tunnel — the expected PASS signal here). Run after editing the
+ladder, while the tunnel is DOWN (on a live tunnel this would consume
+window time): python tools/validate_stages.py
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from tpu_campaign import REPO, STAGES  # noqa: E402
+
+_BUDGET_S = 120
+_INSTANT_S = 3.0  # a real stage spends longer than this just importing
+
+
+def _run_stage(cmd, env):
+    """Run with its own session and killpg on timeout — bench.py's
+    workers are start_new_session'd, so killing only the direct child
+    would leave them running on the shared 1-core box."""
+    t0 = time.monotonic()
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=_BUDGET_S)
+        return proc.returncode, err, time.monotonic() - t0, False
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        proc.wait()
+        return None, "", time.monotonic() - t0, True
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="stage_preflight_")
+    env = dict(os.environ)
+    env.update({"BENCH_PROBE_TIMEOUT": "5", "BENCH_WORK_TIMEOUT": "5",
+                "CAMPAIGN_CHILD": "1",
+                "DECODE_PROBE_TIMEOUT": "5"})
+    bad = []
+    for name, cmd, _timeout, env_extra in STAGES:
+        e = dict(env)
+        e.update(env_extra)
+        # a stage that COMPLETES must not clobber real campaign
+        # artifacts with preflight junk — point any --out at a temp dir
+        cmd = list(cmd)
+        for i, a in enumerate(cmd):
+            if a == "--out" and i + 1 < len(cmd):
+                cmd[i + 1] = os.path.join(tmp,
+                                          os.path.basename(cmd[i + 1]))
+        rc, err, dt, timed_out = _run_stage(cmd, e)
+        if timed_out:
+            print(f"  {name}: ran past preflight budget (OK — command "
+                  "parsed, killed group)", flush=True)
+            continue
+        argparse_fail = "usage:" in err and (
+            "unrecognized" in err or "invalid" in err or "error:" in err)
+        # slow nonzero exits are the EXPECTED dead-tunnel outcome
+        # (bench probe rc=2, decode_probe rc=1); a fast nonzero exit is
+        # a launch failure (typo'd script, SyntaxError, ImportError)
+        instant_crash = rc != 0 and dt < _INSTANT_S
+        if argparse_fail or instant_crash:
+            tail = err.strip().splitlines()[-1] if err.strip() else ""
+            bad.append((name, f"rc={rc} after {dt:.1f}s: {tail}"))
+            print(f"  {name}: SUSPECT ({bad[-1][1]})", flush=True)
+        else:
+            print(f"  {name}: ok (rc={rc} in {dt:.1f}s)", flush=True)
+    if bad:
+        print("\nBROKEN/SUSPECT STAGES:")
+        for name, line in bad:
+            print(f"  {name}: {line}")
+        return 1
+    print(f"\nall {len(STAGES)} stage command lines parse")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
