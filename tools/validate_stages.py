@@ -24,10 +24,31 @@ _BUDGET_S = 120
 _INSTANT_S = 3.0  # a real stage spends longer than this just importing
 
 
+def _child_pgids(pid):
+    """Process groups of `pid`'s direct children: bench.py/decode_probe
+    start their workers with start_new_session=True, so killpg on the
+    stage's own group does NOT reach them — collect their groups before
+    killing. (Workers also self-limit via the 5s probe budget; this
+    sweep just avoids leaving them to that.)"""
+    pgids = set()
+    try:
+        for entry in os.listdir("/proc"):
+            if not entry.isdigit():
+                continue
+            try:
+                with open(f"/proc/{entry}/stat") as f:
+                    fields = f.read().rsplit(")", 1)[1].split()
+                ppid, pgrp = int(fields[1]), int(fields[2])
+            except (OSError, IndexError, ValueError):
+                continue
+            if ppid == pid:
+                pgids.add(pgrp)
+    except OSError:
+        pass
+    return pgids
+
+
 def _run_stage(cmd, env):
-    """Run with its own session and killpg on timeout — bench.py's
-    workers are start_new_session'd, so killing only the direct child
-    would leave them running on the shared 1-core box."""
     t0 = time.monotonic()
     proc = subprocess.Popen(cmd, cwd=REPO, env=env,
                             stdout=subprocess.PIPE,
@@ -37,10 +58,13 @@ def _run_stage(cmd, env):
         out, err = proc.communicate(timeout=_BUDGET_S)
         return proc.returncode, err, time.monotonic() - t0, False
     except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            proc.kill()
+        groups = _child_pgids(proc.pid) | {proc.pid}
+        for pg in groups:
+            try:
+                os.killpg(pg, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
+        proc.kill()
         proc.wait()
         return None, "", time.monotonic() - t0, True
 
@@ -50,7 +74,10 @@ def main():
     env = dict(os.environ)
     env.update({"BENCH_PROBE_TIMEOUT": "5", "BENCH_WORK_TIMEOUT": "5",
                 "CAMPAIGN_CHILD": "1",
-                "DECODE_PROBE_TIMEOUT": "5"})
+                # >=30: decode_probe's in-child watchdog sleeps
+                # STAGE_TIMEOUT-5 — a 5s budget would make it fire at
+                # t=0 and read as an instant crash
+                "DECODE_PROBE_TIMEOUT": "30"})
     bad = []
     for name, cmd, _timeout, env_extra in STAGES:
         e = dict(env)
