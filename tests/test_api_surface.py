@@ -127,6 +127,12 @@ FFT = ("fft ifft rfft irfft hfft ihfft fft2 ifft2 fftn ifftn fftfreq "
 TOP = ("Model summary flops save load grad no_grad seed Tensor "
        "to_tensor einsum iinfo finfo").split()
 
+NLP = ("GPTConfig GPTModel GPTForCausalLM GPTPretrainingCriterion "
+       "BertConfig BertModel BertForPretraining "
+       "BertForSequenceClassification ErnieConfig ErnieModel "
+       "ErnieForPretraining LlamaConfig LlamaModel LlamaForCausalLM "
+       "LlamaPretrainingCriterion BertTokenizer GPTTokenizer").split()
+
 
 @pytest.mark.parametrize("name", TENSOR_OPS)
 def test_tensor_op_exists(name):
@@ -184,6 +190,12 @@ def test_vision_transform_exists(name):
 def test_io_exists(name):
     from paddle_tpu import io
     assert getattr(io, name) is not None
+
+
+@pytest.mark.parametrize("name", NLP)
+def test_nlp_exists(name):
+    from paddle_tpu import nlp
+    assert getattr(nlp, name) is not None
 
 
 @pytest.mark.parametrize("name", GEOMETRIC)
