@@ -229,14 +229,26 @@ def run_ernie(eng, batch, seq, steps, warmup):
     return batch * seq * steps / (time.perf_counter() - t0)
 
 
-def build_resnet_engine(amp, s2d=False):
+def _resnet_layout(layout, fused_bottleneck):
+    """CLI spelling -> model layout. --fused-bottleneck implies NHWC
+    when the layout is left on auto (the kernel is channels-last only,
+    and 'auto' resolves to NCHW off-TPU where the smoke runs live)."""
+    lay = {"auto": "auto", "nhwc": "NHWC", "nchw": "NCHW"}[layout or "auto"]
+    if fused_bottleneck and lay == "auto":
+        lay = "NHWC"
+    return lay
+
+
+def build_resnet_engine(amp, s2d=False, layout="auto",
+                        fused_bottleneck=False):
     import jax.numpy as jnp
     import paddle_tpu as paddle
     from paddle_tpu.hapi.engine import Engine
     from paddle_tpu.vision.models import resnet50
 
     paddle.seed(0)
-    model = resnet50(num_classes=1000, s2d_stem=s2d)
+    model = resnet50(num_classes=1000, s2d_stem=s2d, layout=layout,
+                     fused_bottleneck=fused_bottleneck)
     model.train()
     opt = paddle.optimizer.Momentum(0.1, momentum=0.9,
                                     parameters=model.parameters())
@@ -354,9 +366,12 @@ def worker_resnet(args, on_tpu):
     steps = args.steps or steps
     if args.serve:
         return _resnet_serve(args, on_tpu, batch, steps, hw)
+    layout = _resnet_layout(args.layout, args.fused_bottleneck)
     log(f"bench: resnet50 batch={batch} hw={hw} steps={steps} "
-        f"backend={jax.default_backend()} amp={amp} s2d={args.s2d}")
-    eng = build_resnet_engine(amp, s2d=args.s2d)
+        f"backend={jax.default_backend()} amp={amp} s2d={args.s2d} "
+        f"layout={layout} fused_bottleneck={args.fused_bottleneck}")
+    eng = build_resnet_engine(amp, s2d=args.s2d, layout=layout,
+                              fused_bottleneck=args.fused_bottleneck)
     tput = run_resnet(eng, batch, steps, warmup, hw)
     # 4.1 GFLOP fwd inference at 224px, x3 for fwd+bwd; scaled for
     # smaller images
@@ -373,6 +388,8 @@ def worker_resnet(args, on_tpu):
         "mfu": round(tput * flops_per_img / TPU_PEAK_FLOPS, 4)
         if on_tpu else None,
         "batch": batch, "image": hw, "s2d_stem": args.s2d,
+        "layout": eng.network._layout,
+        "fused_bottleneck": bool(args.fused_bottleneck),
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -390,7 +407,9 @@ def _resnet_serve(args, on_tpu, batch, steps, hw):
     from paddle_tpu.vision.models import resnet50
 
     paddle.seed(0)
-    model = resnet50()
+    layout = _resnet_layout(args.layout, args.fused_bottleneck)
+    model = resnet50(layout=layout,
+                     fused_bottleneck=args.fused_bottleneck)
     model.eval()
     folded = 0
     if args.fold_bn:
@@ -401,7 +420,8 @@ def _resnet_serve(args, on_tpu, batch, steps, hw):
         model.to(dtype=dtype)
     params, buffers = model.raw_state()
     log(f"bench: resnet50 SERVE batch={batch} hw={hw} steps={steps} "
-        f"fold_bn={args.fold_bn} (folded {folded} pairs)")
+        f"fold_bn={args.fold_bn} (folded {folded} pairs) "
+        f"layout={model._layout}")
 
     @jax.jit
     def fwd(params, buffers, x):
@@ -423,6 +443,8 @@ def _resnet_serve(args, on_tpu, batch, steps, hw):
         "value": round(tput, 1), "unit": "images/s/chip",
         "vs_baseline": None, "fold_bn": bool(args.fold_bn),
         "folded_pairs": folded, "batch": batch, "image": hw,
+        "layout": model._layout,
+        "fused_bottleneck": bool(args.fused_bottleneck),
         "backend": jax.default_backend(),
     }), flush=True)
 
@@ -805,9 +827,9 @@ def _orchestrate_impl(workloads, args, passthrough, skip_probe=False):
                 continue  # one torn file must not discard the rest
             if isinstance(summ, dict):
                 parsed_summaries.append((_window_key(p, summ), p, summ))
-        ok_stages, used_paths = {}, []
+        ok_stages, stage_window, used_paths = {}, {}, []
         # later windows override
-        for _, p, summ in sorted(parsed_summaries, key=lambda t: t[0]):
+        for wkey, p, summ in sorted(parsed_summaries, key=lambda t: t[0]):
             try:
                 stage_res = {k: v.get("result") for k, v in summ.items()
                              if isinstance(v, dict) and v.get("ok")
@@ -816,6 +838,8 @@ def _orchestrate_impl(workloads, args, passthrough, skip_probe=False):
                 continue
             if stage_res:
                 ok_stages.update(stage_res)
+                for k in stage_res:
+                    stage_window[k] = wkey
                 used_paths.append(os.path.relpath(p))
         if ok_stages:
             # The final line must stay COMPACT — r4's line embedded every
@@ -831,9 +855,21 @@ def _orchestrate_impl(workloads, args, passthrough, skip_probe=False):
                 print(f"[bench] could not write {full_path}: {e}",
                       file=sys.stderr, flush=True)
                 full_path = None
-            compact = {}
+            # generate() program memoization landed early in the r5
+            # session (2026-07-31 ~16:10 local): decode scalars captured
+            # BEFORE it timed recompiles, not decode — presenting them
+            # as headline numbers was VERDICT r5 weak #3's "misleading"
+            # finding. Post-fix decode windows pass through untouched.
+            decode_valid_since = 1785513600  # 2026-07-31 16:00 local
+            compact, excluded_decode = {}, []
             for name, res in ok_stages.items():
                 if not isinstance(res, dict):
+                    continue
+                if (res.get("metric") ==
+                        "gpt_decode_tokens_per_sec_per_chip"
+                        and stage_window.get(name, 0)
+                        < decode_valid_since):
+                    excluded_decode.append(name)
                     continue
                 row = {k: res[k] for k in ("metric", "value", "unit",
                                            "vs_baseline", "mfu")
@@ -851,10 +887,19 @@ def _orchestrate_impl(workloads, args, passthrough, skip_probe=False):
                               if full_path else None),
                 "headline_scalars": compact,
             }
+            if excluded_decode:
+                diag["earlier_session_measurements"][
+                    "excluded_decode_stages"] = {
+                    "stages": sorted(excluded_decode),
+                    "reason": "recompile-contaminated (pre-memoization "
+                              "decode loop, BENCHLOG r4) — rerun the "
+                              "bench_decode_* ladder for valid numbers",
+                }
             # belt-and-braces cap: shed weight until the line fits,
             # heaviest-first, re-checking after each shed
             em = diag["earlier_session_measurements"]
-            for shed in ("headline_scalars", "artifacts", "note"):
+            for shed in ("headline_scalars", "excluded_decode_stages",
+                         "artifacts", "note"):
                 if len(json.dumps(diag)) <= 6000:
                     break
                 em.pop(shed, None)
@@ -950,6 +995,19 @@ def main():
     ap.add_argument("--s2d", action="store_true",
                     help="resnet50: MLPerf space-to-depth stem (exactly "
                          "equivalent 4x4/s1 conv over 12 channels)")
+    ap.add_argument("--layout", choices=("auto", "nhwc", "nchw"),
+                    default=None,
+                    help="resnet50: conv-stack layout A/B — nhwc is the "
+                         "TPU-native channels-last pipeline (ONE boundary "
+                         "transpose, HWIO kernels); auto resolves to nhwc "
+                         "on TPU, nchw elsewhere")
+    ap.add_argument("--fused-bottleneck", action="store_true",
+                    help="resnet50: route the bottleneck 1x1-conv+BN+ReLU"
+                         "(+residual) chains through the Pallas fused "
+                         "kernel (the diagnosed HBM-bandwidth wall; "
+                         "implies nhwc while --layout is auto)")
+    ap.add_argument("--dryrun", action="store_true",
+                    help="alias for --smoke")
     ap.add_argument("--weight-only", choices=("int8", "int4"), default=None,
                     help="decode: serve with weight-only-quantized linears "
                          "(HBM-bandwidth lever)")
@@ -1001,6 +1059,8 @@ def main():
     ap.add_argument("--all", action="store_true",
                     help="run every workload incl. smoke mode")
     args = ap.parse_args()
+    if args.dryrun:
+        args.smoke = True
 
     if args.worker:
         # ---- child mode: the only place jax is imported ----
@@ -1076,6 +1136,10 @@ def main():
     if (args.serve or args.fold_bn) and workloads != ["resnet50"]:
         ap.error("--serve/--fold-bn apply to resnet50 serving only "
                  "(use --model resnet50 --serve)")
+    if (args.layout or args.fused_bottleneck) \
+            and workloads != ["resnet50"]:
+        ap.error("--layout/--fused-bottleneck apply to the resnet50 "
+                 "workload only (use --model resnet50)")
     if args.no_scan_fallback and workloads != ["gpt-1.3b"]:
         ap.error("--no-scan-fallback applies to the gpt-1.3b workload "
                  "only (use --model gpt-1.3b)")
@@ -1100,6 +1164,10 @@ def main():
             passthrough.append("--recompute")
         if args.s2d:
             passthrough.append("--s2d")
+        if args.layout:
+            passthrough += ["--layout", args.layout]
+        if args.fused_bottleneck:
+            passthrough.append("--fused-bottleneck")
         if args.serve:
             passthrough.append("--serve")
         if args.fold_bn:
@@ -1123,7 +1191,8 @@ def main():
     elif any(v is not None for v in overrides.values()) or args.no_flash \
             or args.recompute or args.scan_steps or args.s2d \
             or args.scan_layers or args.fused_qkv or args.fused_ln \
-            or args.chunked_ce or args.fused_adamw or args.mlm_gather:
+            or args.chunked_ce or args.fused_adamw or args.mlm_gather \
+            or args.layout or args.fused_bottleneck:
         print("[bench] ignoring per-workload flags in full-suite mode "
               "(use --model to tune one workload)", file=sys.stderr,
               flush=True)

@@ -145,13 +145,11 @@ def _spmd(local_fn, mesh, axis):
     sequence-sharded body — this is what lets a dp x sp (or dp x mp x
     sp) train step compose with no extra code."""
     spec = P(None, axis, None, None)
-    kwargs = {"check_vma": False}
-    if len(mesh.axis_names) > 1:
-        # manual over `axis` only; dp/mp stay auto for GSPMD
-        kwargs["axis_names"] = frozenset({axis})
-    return jax.shard_map(
-        local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-        out_specs=spec, **kwargs)
+    from ..mesh import shard_map_compat
+    # manual over `axis` only; dp/mp stay auto for GSPMD
+    return shard_map_compat(
+        local_fn, mesh, in_specs=(spec, spec, spec),
+        out_specs=spec, manual_axes={axis})
 
 
 def ring_attention_spmd(q, k, v, mesh, *, axis="sp", causal=False,

@@ -35,10 +35,15 @@ def _fold_pair(conv, bn):
     scale = gamma / np.sqrt(var + bn._epsilon)
 
     w = np.asarray(conv.weight._value)
-    # non-transpose convs store [out, in/groups, *k]; scale is per-out
-    w = w * scale.reshape((-1,) + (1,) * (w.ndim - 1))
+    # non-transpose convs store [out, in/groups, *k]; the channels-last
+    # stack (layers_conv.to_channels_last) stores HWIO [*k, in/g, out].
+    # scale is per-out either way
+    if getattr(conv, "_weight_format", "OIHW") == "HWIO":
+        w = w * scale
+    else:
+        w = w * scale.reshape((-1,) + (1,) * (w.ndim - 1))
     b = (np.asarray(conv.bias._value) if conv.bias is not None
-         else np.zeros(w.shape[0], np.float32))
+         else np.zeros(scale.shape[0], np.float32))
     b = (b - mean) * scale + beta
 
     conv.weight._value = jnp.asarray(w, conv.weight._value.dtype)

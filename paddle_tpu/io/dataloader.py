@@ -64,6 +64,15 @@ class DataLoader:
         # exactly where the reference's worker processes earn their
         # keep (bench.py --input-pipeline measures the crossover).
         self.use_process_workers = use_process_workers
+        if use_process_workers and num_workers == 0:
+            # __iter__ takes the num_workers==0 inline path before
+            # _use_processes() ever runs — without this check the
+            # opt-in would be silently ignored (every other invalid
+            # combination raises; ADVICE r5 #3)
+            raise ValueError(
+                "use_process_workers=True requires num_workers >= 1 "
+                "(num_workers=0 is the inline single-process path; the "
+                "spawn-worker opt-in would be silently ignored)")
         self.use_shared_memory = use_shared_memory
         self.persistent_workers = persistent_workers
         self._iterable = isinstance(dataset, IterableDataset)

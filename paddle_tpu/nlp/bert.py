@@ -354,13 +354,21 @@ class BertForPretraining(FromPretrainedMixin, Layer):
 
 def _mlm_gather_aux(config, pred_head, seq, nsp_score, cap):
     """Defer the MLM head to the criterion so it can gather the masked
-    positions (only the criterion sees the labels). Carries the head's
-    TRACED parameter values — functional_call restores the Parameter
-    objects' values after forward, so passing modules/Parameters would
-    bake stale constants into the jit (same contract as chunked_ce)."""
+    positions (only the criterion sees the labels). Under a trace this
+    carries the head's TRACED parameter values — functional_call
+    restores the Parameter objects' values after forward, so passing
+    Parameters would bake stale constants into the jit (same contract
+    as chunked_ce). EAGERLY it carries the Parameters themselves: a
+    fresh Tensor is a detached tape leaf and loss.backward() would
+    silently drop every head grad (ADVICE r5 #1)."""
+    from ..autograd import in_jax_trace
     t = pred_head.transform
     ln = pred_head.layer_norm
-    val = lambda p: Tensor(p._value, stop_gradient=p.stop_gradient)
+
+    def val(p):
+        if in_jax_trace((p._value,)):
+            return Tensor(p._value, stop_gradient=p.stop_gradient)
+        return p
     return {
         "_loss_only_aux": True, "mlm_gather": True,
         "hidden": seq, "nsp_score": nsp_score,
@@ -380,6 +388,13 @@ class BertPretrainingCriterion(Layer):
     def __init__(self, config=None):
         super().__init__()
         self.ce = ParallelCrossEntropy()
+        # eager-path observability for mlm_gather_capacity: number of
+        # masked positions the last _gathered_mlm_loss call CLIPPED
+        # (0-dim int Tensor; None before the first eager gathered call).
+        # Clipping biases the loss downward, so a nonzero value means
+        # the configured capacity is undersized for the data's mask
+        # rate (ADVICE r5 #4). Only set outside jit traces.
+        self.last_mlm_overflow = None
 
     def forward(self, prediction_scores, seq_relationship_score=None,
                 masked_lm_labels=None, next_sentence_labels=None,
@@ -489,6 +504,20 @@ class BertPretrainingCriterion(Layer):
 
         y = masked_lm_labels if isinstance(masked_lm_labels, Tensor) \
             else Tensor(masked_lm_labels)
+        # capacity-clip signal (ADVICE r5 #4): masked positions beyond K
+        # are dropped from the loss while the normalizer keeps the full
+        # count — count them so undersizing is detectable, not silent
+        from ..autograd import in_jax_trace
+        hid = aux["hidden"]
+        n_pos = int(hid.shape[0]) * int(hid.shape[1])
+        k_cap = max(8, int(_math.ceil(cap * n_pos)))
+        overflow = apply_op(
+            lambda yy: jnp.maximum(
+                jnp.sum((yy.reshape(-1) != ii).astype(jnp.int32))
+                - jnp.int32(k_cap), 0),
+            y, differentiable=False)
+        if not in_jax_trace((overflow._value,)):
+            self.last_mlm_overflow = overflow
         args = [aux["hidden"], aux["t_w"], aux["t_b"], aux["ln_w"],
                 aux["ln_b"], aux["dec_w"], aux["dec_b"], y]
         if masked_lm_weights is not None:

@@ -584,17 +584,23 @@ class GPTForCausalLM(FromPretrainedMixin, Layer):
             # the tied embedding weight — GPTPretrainingCriterion runs
             # the head matmul chunk-by-chunk inside the loss so the
             # full [N, vocab] logits never materialize (config docs).
-            # Snapshot the weight's CURRENT (traced, AMP-cast) value
-            # into a fresh Tensor: functional_call restores the
-            # Parameter object's _value after forward returns, so
-            # passing the Parameter itself would bake the stale
-            # concrete array into the jit as a constant (no grads to
-            # the tied weight through the head).
+            # Under a trace, snapshot the weight's CURRENT (traced,
+            # AMP-cast) value into a fresh Tensor: functional_call
+            # restores the Parameter object's _value after forward
+            # returns, so passing the Parameter itself would bake the
+            # stale concrete array into the jit as a constant (no grads
+            # to the tied weight through the head). EAGERLY the reverse
+            # holds: a fresh Tensor is a detached tape leaf that would
+            # silently swallow the tied-embedding grad under
+            # loss.backward() — pass the Parameter itself there
+            # (ADVICE r5 #1).
+            from ..autograd import in_jax_trace
             w = self.gpt.embeddings.word_embeddings.weight
+            lm_w = (Tensor(w._value, stop_gradient=w.stop_gradient)
+                    if in_jax_trace((w._value,)) else w)
             return {"_loss_only_aux": True,
                     "hidden": hidden,
-                    "lm_weight": Tensor(w._value,
-                                        stop_gradient=w.stop_gradient),
+                    "lm_weight": lm_w,
                     "chunked_ce": int(self.config.chunked_ce)}
         # vocab stays sharded under shard_map: GPTPretrainingCriterion's
         # ParallelCrossEntropy consumes vocab-LOCAL logits (Megatron-style)

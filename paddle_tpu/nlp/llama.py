@@ -174,6 +174,13 @@ class LlamaAttention(Layer):
         from ..autograd import apply_op
         cfg = self.cfg
         groups = cfg.num_attention_heads // cfg.num_key_value_heads
+        if cache_index is not None and cache is None:
+            raise ValueError(
+                "cache_index was given without cache: the static-cache "
+                "decode path updates preallocated [B, S_max, Hkv, D] "
+                "buffers in place — build them first (generation.py's "
+                "init_cache / forward(use_cache=True)) or drop "
+                "cache_index")
         q, k, v = self._shaped_qkv(x)
         if cache_index is not None:
             return self._forward_static_cache(q, k, v, cache,
@@ -367,6 +374,12 @@ class LlamaModel(FromPretrainedMixin, Layer):
     def forward(self, input_ids, attention_mask=None, use_cache=False,
                 cache=None, cache_index=None):
         from .gpt import _recompute_block
+        if cache_index is not None and cache is None:
+            raise ValueError(
+                "cache_index was given without cache: decode-by-index "
+                "needs the preallocated static KV buffers (run a "
+                "use_cache=True prefill / generation.init_cache first, "
+                "or drop cache_index)")
         mask = normalize_attention_mask(attention_mask)
         x = self.embed_tokens(input_ids)
         if self.config.scan_layers:
@@ -437,13 +450,21 @@ class LlamaForCausalLM(FromPretrainedMixin, Layer):
             w, tied = self._head_weight()
             # the criterion's chunked einsum wants [vocab, hidden]; the
             # untied lm_head stores the Linear [in, out] layout — hand
-            # it the traced TRANSPOSE (a layout op XLA folds into the
-            # per-chunk matmul, not a copy). Traced value, not the
-            # Parameter: functional_call restores _value post-forward.
-            wv = w._value if tied else w._value.T
+            # it the TRANSPOSE (a layout op XLA folds into the
+            # per-chunk matmul, not a copy). Under a trace use the
+            # traced value, not the Parameter (functional_call restores
+            # _value post-forward — the Parameter would bake a stale
+            # constant); EAGERLY pass the Parameter / a tape-linked
+            # transpose, else loss.backward() drops the head grad on a
+            # detached leaf (ADVICE r5 #1).
+            from ..autograd import in_jax_trace
+            if in_jax_trace((w._value,)):
+                wv = w._value if tied else w._value.T
+                lm_w = Tensor(wv, stop_gradient=w.stop_gradient)
+            else:
+                lm_w = w if tied else w.transpose([1, 0])
             return {"_loss_only_aux": True, "hidden": hidden,
-                    "lm_weight": Tensor(wv,
-                                        stop_gradient=w.stop_gradient),
+                    "lm_weight": lm_w,
                     "chunked_ce": int(self.config.chunked_ce)}
         w, tied = self._head_weight()
         if tied:

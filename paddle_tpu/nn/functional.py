@@ -262,7 +262,8 @@ def _conv_padding(padding, n, kernel, dilation):
 
 
 def _conv(x, weight, bias, stride, padding, dilation, groups, n,
-          data_format, transpose=False, output_padding=0):
+          data_format, transpose=False, output_padding=0,
+          weight_format="OIHW"):
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)
     channel_last = data_format.endswith("C")
@@ -271,11 +272,24 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
         lhs_spec = "N" + spatial + "C"
     else:
         lhs_spec = "NC" + spatial
-    rhs_spec = "OI" + spatial
+    if weight_format == "HWIO":
+        # TPU-native channels-last kernels: [*k, in/g, out]. No per-step
+        # transpose between the stored Parameter and what the conv
+        # consumes (see layers_conv.to_channels_last / docs/performance).
+        if transpose:
+            raise ValueError("weight_format='HWIO' is not supported for "
+                             "transpose convs (kept NCHW-path only)")
+        rhs_spec = spatial + "IO"
+        kernel = tuple(weight.shape[:n])
+    elif weight_format == "OIHW":
+        rhs_spec = "OI" + spatial
+        kernel = tuple(weight.shape[2:])
+    else:
+        raise ValueError(f"unknown weight_format {weight_format!r} "
+                         "(OIHW | HWIO)")
     out_spec = lhs_spec
     dn = jax.lax.conv_dimension_numbers(
         tuple(x.shape), tuple(weight.shape), (lhs_spec, rhs_spec, out_spec))
-    kernel = tuple(weight.shape[2:])
     pad = _conv_padding(padding, n, kernel, dilation)
 
     def f(a, w, *b):
@@ -319,18 +333,21 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCL", name=None):
-    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+           data_format="NCL", name=None, weight_format="OIHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format, weight_format=weight_format)
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCHW", name=None):
-    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+           data_format="NCHW", name=None, weight_format="OIHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, weight_format=weight_format)
 
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
-           data_format="NCDHW", name=None):
-    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+           data_format="NCDHW", name=None, weight_format="OIHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, weight_format=weight_format)
 
 
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,

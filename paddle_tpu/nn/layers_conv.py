@@ -3,6 +3,15 @@
 Weight layouts match the reference: Conv [out_c, in_c/groups, *k],
 ConvTranspose [in_c, out_c/groups, *k]. Default initializer matches the
 reference conv default (Xavier-uniform over fan computed from the kernel).
+
+TPU-native channels-last mode: ``conv.to_channels_last()`` (or the
+module-level :func:`to_channels_last` on a whole tree) re-stores the
+kernel HWIO ([*k, in_c/groups, out_c]) and switches the op to the
+channel-last data_format, so a network that transposes its input ONCE at
+entry runs every conv in the layout the TPU conv units want — no per-op
+relayout, no per-step weight transpose. Init parity: the weight is drawn
+in the reference OIHW layout first and transposed, so seeded runs match
+the NCHW build exactly (modulo layout).
 """
 from __future__ import annotations
 
@@ -11,6 +20,8 @@ import numpy as np
 from . import functional as F
 from .initializer import XavierUniform
 from .layer import Layer
+
+_CHANNELS_LAST_FMT = {1: "NLC", 2: "NHWC", 3: "NDHWC"}
 
 
 def _ntuple(v, n):
@@ -37,6 +48,7 @@ class _ConvNd(Layer):
         self._transpose = transpose
         self._output_padding = output_padding
         self._padding_mode = padding_mode
+        self._weight_format = "OIHW"
 
         if transpose:
             w_shape = (in_channels, out_channels // groups) + self._kernel_size
@@ -51,6 +63,24 @@ class _ConvNd(Layer):
         else:
             self.bias = self.create_parameter((out_channels,), attr=bias_attr,
                                               is_bias=True)
+
+    def to_channels_last(self):
+        """Switch to the TPU-native channels-last layout: data_format
+        becomes N*C and the weight Parameter is re-stored HWIO
+        ([*k, in_c/groups, out_c]) in place. Idempotent; transpose convs
+        are not supported (they keep the reference path)."""
+        if self._transpose:
+            raise ValueError(
+                "to_channels_last: transpose convs keep the reference "
+                "NCHW path (HWIO kernels are wired for forward convs "
+                "only)")
+        if self._weight_format != "HWIO":
+            import jax.numpy as jnp
+            perm = tuple(range(2, 2 + self._n)) + (1, 0)
+            self.weight._value = jnp.transpose(self.weight._value, perm)
+            self._weight_format = "HWIO"
+        self._data_format = _CHANNELS_LAST_FMT[self._n]
+        return self
 
     def extra_repr(self):
         return (f"{self._in_channels}, {self._out_channels}, "
@@ -67,7 +97,8 @@ class Conv1D(_ConvNd):
 
     def forward(self, x):
         return F.conv1d(x, self.weight, self.bias, self._stride, self._padding,
-                        self._dilation, self._groups, self._data_format)
+                        self._dilation, self._groups, self._data_format,
+                        weight_format=self._weight_format)
 
 
 class Conv2D(_ConvNd):
@@ -80,7 +111,8 @@ class Conv2D(_ConvNd):
 
     def forward(self, x):
         return F.conv2d(x, self.weight, self.bias, self._stride, self._padding,
-                        self._dilation, self._groups, self._data_format)
+                        self._dilation, self._groups, self._data_format,
+                        weight_format=self._weight_format)
 
 
 class Conv3D(_ConvNd):
@@ -93,7 +125,8 @@ class Conv3D(_ConvNd):
 
     def forward(self, x):
         return F.conv3d(x, self.weight, self.bias, self._stride, self._padding,
-                        self._dilation, self._groups, self._data_format)
+                        self._dilation, self._groups, self._data_format,
+                        weight_format=self._weight_format)
 
 
 class Conv1DTranspose(_ConvNd):
@@ -142,3 +175,35 @@ class Conv3DTranspose(_ConvNd):
                                   self._padding, self._output_padding,
                                   self._groups, self._dilation, output_size,
                                   self._data_format)
+
+
+def to_channels_last(layer):
+    """Convert a module tree IN PLACE to the TPU-native channels-last
+    layout: forward convs get HWIO kernels + N*C data_format, BatchNorms
+    normalize the trailing axis, pooling layers window the middle axes.
+    The caller owns the single entry/exit transpose (the point: ONE
+    boundary relayout instead of one per op). Returns (layer, n_converted).
+    """
+    from .layers_norm import _BatchNormBase
+    from .layers_pooling import (AdaptiveAvgPool2D, AdaptiveAvgPool3D,
+                                 _Pool)
+    n = 0
+    for _, sub in layer.named_sublayers(include_self=True):
+        if isinstance(sub, _ConvNd) and not sub._transpose:
+            sub.to_channels_last()
+            n += 1
+        elif isinstance(sub, _BatchNormBase):
+            sub.to_channels_last()
+            n += 1
+        elif isinstance(sub, _Pool):
+            fmt = sub._kw.get("data_format")
+            if fmt and not fmt.endswith("C"):
+                sub._kw["data_format"] = _CHANNELS_LAST_FMT[
+                    len(fmt) - 2]
+                n += 1
+        elif isinstance(sub, (AdaptiveAvgPool2D, AdaptiveAvgPool3D)):
+            if not sub._data_format.endswith("C"):
+                sub._data_format = _CHANNELS_LAST_FMT[
+                    len(sub._data_format) - 2]
+                n += 1
+    return layer, n

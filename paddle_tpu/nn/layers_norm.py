@@ -10,11 +10,19 @@ from .initializer import Constant
 from .layer import Layer
 
 
+_CHANNELS_LAST_BN = {"NCL": "NLC", "NCHW": "NHWC", "NCDHW": "NDHWC"}
+
+
 class _BatchNormBase(Layer):
     def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
                  weight_attr=None, bias_attr=None, data_format="NCHW",
                  use_global_stats=None, name=None):
         super().__init__()
+        if not (data_format.startswith("NC") or data_format.endswith("C")):
+            raise ValueError(
+                f"unsupported BatchNorm data_format {data_format!r}: "
+                "expected a channels-first NC* or channels-last N*C spec "
+                "(e.g. NCHW | NHWC | NCL | NLC)")
         self._num_features = num_features
         self._momentum = momentum
         self._epsilon = epsilon
@@ -50,6 +58,15 @@ class _BatchNormBase(Layer):
             training=self.training, momentum=self._momentum,
             epsilon=self._epsilon, data_format=self._data_format,
             use_global_stats=self._use_global_stats)
+
+    def to_channels_last(self):
+        """Normalize over the trailing axis (TPU-native channels-last
+        stack; see layers_conv.to_channels_last). Stats/affine params
+        are per-channel vectors either way — only the reduce axes move,
+        so checkpoints are layout-independent. Idempotent."""
+        self._data_format = _CHANNELS_LAST_BN.get(self._data_format,
+                                                  self._data_format)
+        return self
 
     def extra_repr(self):
         return f"num_features={self._num_features}, momentum={self._momentum}"

@@ -5,4 +5,6 @@ ref parity: the reference's hand-written CUDA kernels
 Here each kernel is written against the MXU/VPU with VMEM blocking and is
 validated in interpret mode on CPU (tests/test_pallas_*).
 """
+from .conv_bn_act import (conv1x1_batch_stats,  # noqa: F401
+                          fused_conv1x1_bn_act)
 from .flash_attention import flash_attention, flash_decode  # noqa: F401

@@ -162,14 +162,14 @@ def _fwd_kernel(lens_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         # hard-masked entries must contribute exactly 0 even in a fully
         # masked row (where m_new == _NEG_INF would otherwise make p = 1);
         # with l = 0 the final tick's safe_l guard then emits a 0 output row
-        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        p = jnp.where(s > _NEG_INF / 2, p, jnp.float32(0.0))
         alpha = jnp.exp(m_prev - m_new)
         # denominator from the UN-dropped p (flash-attn v2 dropout order)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         if dropout_p:
             keep = _dropout_keep(seed_ref[0], b, qi, ki, p.shape,
                                  block_q, block_k, sk, dropout_p)
-            p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+            p = jnp.where(keep, p / (1.0 - dropout_p), jnp.float32(0.0))
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0],
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -180,7 +180,7 @@ def _fwd_kernel(lens_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(ki == nk - 1)
     def _():
         l = l_scr[:, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
+        safe_l = jnp.where(l == 0.0, jnp.float32(1.0), l)
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
         # lse is stored [bh, sq, 8]: the trailing size-8 lane dim exists only
         # to satisfy Mosaic's block-shape rules (a (1, block_q) block is not
@@ -197,7 +197,7 @@ def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, *, sm_scale, causal,
     s = _mask_s(s, qi, ki, block_q, block_k, offset, causal, kv_len)
     p = jnp.exp(s - lse_ref[0][:, :1])
     # masked entries contribute no gradient (matches fwd's hard zero)
-    return jnp.where(s > _NEG_INF / 2, p, 0.0)
+    return jnp.where(s > _NEG_INF / 2, p, jnp.float32(0.0))
 
 
 def _dq_kernel(lens_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -229,7 +229,7 @@ def _dq_kernel(lens_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         if dropout_p:
             keep = _dropout_keep(seed_ref[0], b, qi, ki, p.shape,
                                  block_q, block_k, sk, dropout_p)
-            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), jnp.float32(0.0))
         ds = p * (dp - delta_ref[0][:, :1])
         acc_scr[:] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0],
@@ -268,7 +268,7 @@ def _dkv_kernel(lens_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             keep = _dropout_keep(seed_ref[0], b, qi, ki, p.shape,
                                  block_q, block_k, sk, dropout_p)
             scale = 1.0 / (1.0 - dropout_p)
-            p_d = jnp.where(keep, p * scale, 0.0)
+            p_d = jnp.where(keep, p * scale, jnp.float32(0.0))
         else:
             p_d = p
         # dV += P_dropped^T dO
@@ -281,7 +281,7 @@ def _dkv_kernel(lens_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if dropout_p:
-            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), jnp.float32(0.0))
         ds = p * (dp - delta_ref[0][:, :1])
         # dK += dS^T Q * scale
         dk_scr[:] += jax.lax.dot_general(

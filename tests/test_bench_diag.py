@@ -79,3 +79,22 @@ def test_every_stdout_json_line_parses(probe_fail_run):
         ln = ln.strip()
         if ln.startswith("{"):
             json.loads(ln)
+
+
+def test_recompile_contaminated_decode_scalars_excluded(probe_fail_run):
+    """VERDICT r5 weak #3: the r4 window's decode stages timed
+    recompiles, not decode — their scalars must NOT ride in
+    headline_scalars. They are named (with the reason) instead, so the
+    artifact stays honest without looking like the stages never ran."""
+    diag = json.loads(_last_json_line(probe_fail_run.stdout))
+    em = diag.get("earlier_session_measurements")
+    if em is None:
+        pytest.skip("no committed campaign summaries on this checkout")
+    for name, row in (em.get("headline_scalars") or {}).items():
+        assert row.get("metric") != "gpt_decode_tokens_per_sec_per_chip", (
+            f"{name} presents an invalidated decode scalar as a "
+            "headline number")
+    excl = em.get("excluded_decode_stages")
+    if excl is not None:  # present whenever decode stages were parsed
+        assert excl["stages"], "exclusion note without stage names"
+        assert "recompile" in excl["reason"]

@@ -77,3 +77,30 @@ def test_eval_path_unchanged():
     ids = jnp.ones((1, 8), jnp.int32)
     scores, nsp = m(ids)
     assert scores.shape == [1, 8, 211] and nsp.shape == [1, 2]
+
+
+def test_overflow_count_is_surfaced():
+    """Capacity clipping must be detectable (ADVICE r5 #4): the
+    criterion exposes last_mlm_overflow = masked positions beyond K on
+    the eager path, 0 when everything fits."""
+    paddle.seed(2)
+    m = BertForPretraining(BertConfig(**TINY, mlm_gather_capacity=0.25))
+    m.train()
+    crit = BertPretrainingCriterion()
+    assert crit.last_mlm_overflow is None  # no gathered call yet
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, 211, (2, 24)),
+                      jnp.int32)
+    # K = max(8, ceil(0.25 * 48)) = 12; mask 20 positions -> overflow 8
+    lab = np.full((2, 24), -100, np.int32)
+    lab[:, :10] = 5
+    from paddle_tpu.tensor import Tensor
+    loss = crit(m(Tensor(ids)), Tensor(jnp.asarray(lab)),
+                Tensor(jnp.asarray([0, 1], jnp.int32)))
+    assert np.isfinite(float(loss._value))
+    assert int(crit.last_mlm_overflow._value) == 20 - 12
+    # fits-in-capacity batch resets the signal to 0
+    lab2 = np.full((2, 24), -100, np.int32)
+    lab2[:, :3] = 5
+    crit(m(Tensor(ids)), Tensor(jnp.asarray(lab2)),
+         Tensor(jnp.asarray([0, 1], jnp.int32)))
+    assert int(crit.last_mlm_overflow._value) == 0

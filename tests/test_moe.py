@@ -81,12 +81,13 @@ class TestExpertParallel:
         want, want_aux = moe_apply_dense(x, **p, k=2)
 
         mesh = Mesh(np.array(jax.devices()), ("ep",))
-        fn = jax.shard_map(
+        from paddle_tpu.distributed.mesh import shard_map_compat
+        fn = shard_map_compat(
             lambda x, gw, w1, b1, w2, b2: moe_apply_ep(
                 x, gw, w1, b1, w2, b2, axis_name="ep", k=2),
-            mesh=mesh,
+            mesh,
             in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep"), P("ep")),
-            out_specs=(P("ep"), P()), check_vma=False)
+            out_specs=(P("ep"), P()))
         got, got_aux = fn(x, p["gate_w"], p["w1"], p["b1"], p["w2"],
                           p["b2"])
         # aux is computed per-rank (local gating, like the reference), so
@@ -107,12 +108,13 @@ class TestExpertParallel:
         x = jax.random.normal(jax.random.PRNGKey(3), (16, d))
         want, _ = moe_apply_dense(x, **p, k=1)
         mesh = Mesh(np.array(jax.devices()[:1]), ("ep",))
-        fn = jax.shard_map(
+        from paddle_tpu.distributed.mesh import shard_map_compat
+        fn = shard_map_compat(
             lambda x, gw, w1, b1, w2, b2: moe_apply_ep(
                 x, gw, w1, b1, w2, b2, axis_name="ep", k=1),
-            mesh=mesh,
+            mesh,
             in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep"), P("ep")),
-            out_specs=(P("ep"), P()), check_vma=False)
+            out_specs=(P("ep"), P()))
         got, _ = fn(x, p["gate_w"], p["w1"], p["b1"], p["w2"], p["b2"])
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-5, rtol=1e-5)

@@ -1,0 +1,236 @@
+"""NHWC-native conv stack: NCHW<->NHWC parity for conv/pool/BN, the
+HWIO weight conversion, space-to-depth, and the full ResNet-50 forward
+(+backward) in both layouts. The public API stays NCHW in/out — the
+layout flips exactly once at the network boundary."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn.layer import functional_call
+from paddle_tpu.tensor import Tensor
+from paddle_tpu.vision.models import resnet50
+from paddle_tpu.vision.models.resnet import space_to_depth
+
+
+def _img(shape=(2, 3, 32, 32), seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).standard_normal(shape), jnp.float32)
+
+
+def _fwd(m, x, train=False):
+    params, buffers = m.raw_state()
+
+    @jax.jit
+    def f(p, b, a):
+        if train:
+            out, nb = functional_call(m, p, b, Tensor(a), mutable=True)
+            return out._value, nb
+        return functional_call(m, p, b, Tensor(a))._value
+    return f(params, buffers, x)
+
+
+# ---------------------------------------------------------------------------
+# op-level parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("groups,stride,dilation,padding", [
+    (1, 1, 1, 1), (2, 2, 1, [2, 1, 2, 1]), (4, 1, 2, 2)])
+def test_conv2d_hwio_parity(groups, stride, dilation, padding):
+    paddle.seed(0)
+    c = nn.Conv2D(8, 16, 3, stride=stride, padding=padding,
+                  dilation=dilation, groups=groups)
+    x = Tensor(_img((2, 8, 12, 12)))
+    ref = c(x)
+    c.to_channels_last()
+    assert c.weight._value.shape == (3, 3, 8 // groups, 16)
+    out = c(x.transpose([0, 2, 3, 1]))
+    np.testing.assert_allclose(
+        np.asarray(ref._value),
+        np.asarray(out.transpose([0, 3, 1, 2])._value), atol=1e-5)
+
+
+def test_conv1d_hwio_parity():
+    paddle.seed(0)
+    c = nn.Conv1D(6, 10, 3, padding=1)
+    x = Tensor(_img((2, 6, 16)))
+    ref = c(x)
+    c.to_channels_last()
+    out = c(x.transpose([0, 2, 1]))
+    np.testing.assert_allclose(
+        np.asarray(ref._value),
+        np.asarray(out.transpose([0, 2, 1])._value), atol=1e-5)
+
+
+def test_transpose_conv_rejects_channels_last():
+    c = nn.Conv2DTranspose(4, 4, 2)
+    with pytest.raises(ValueError, match="transpose convs"):
+        c.to_channels_last()
+
+
+def test_pool_and_bn_parity():
+    x = _img((2, 8, 10, 10))
+    xt = jnp.transpose(x, (0, 2, 3, 1))
+    mp = nn.MaxPool2D(3, stride=2, padding=1)
+    mp_cl = nn.MaxPool2D(3, stride=2, padding=1, data_format="NHWC")
+    np.testing.assert_allclose(
+        np.asarray(mp(Tensor(x))._value),
+        np.asarray(mp_cl(Tensor(xt)).transpose([0, 3, 1, 2])._value))
+    ap = nn.AdaptiveAvgPool2D((1, 1))
+    ap_cl = nn.AdaptiveAvgPool2D((1, 1), data_format="NHWC")
+    np.testing.assert_allclose(
+        np.asarray(ap(Tensor(x))._value),
+        np.asarray(ap_cl(Tensor(xt)).transpose([0, 3, 1, 2])._value),
+        atol=1e-6)
+    paddle.seed(1)
+    bn = nn.BatchNorm2D(8)
+    paddle.seed(1)
+    bn_cl = nn.BatchNorm2D(8, data_format="NHWC")
+    for m in (bn, bn_cl):
+        m.train()
+    y1 = bn(Tensor(x))
+    y2 = bn_cl(Tensor(xt))
+    np.testing.assert_allclose(
+        np.asarray(y1._value),
+        np.asarray(y2.transpose([0, 3, 1, 2])._value), atol=1e-5)
+    # train-mode running-stat updates identical across layouts
+    np.testing.assert_allclose(np.asarray(bn._mean._value),
+                               np.asarray(bn_cl._mean._value), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(bn._variance._value),
+                               np.asarray(bn_cl._variance._value),
+                               atol=1e-6)
+
+
+def test_bn_rejects_bogus_data_format():
+    with pytest.raises(ValueError, match="data_format"):
+        nn.BatchNorm2D(4, data_format="HWCN")
+
+
+def test_space_to_depth_layouts_agree():
+    x = _img((2, 4, 8, 8))
+    a = space_to_depth(Tensor(x), 2)
+    b = space_to_depth(Tensor(jnp.transpose(x, (0, 2, 3, 1))), 2,
+                       data_format="NHWC")
+    np.testing.assert_allclose(
+        np.asarray(a._value),
+        np.asarray(b.transpose([0, 3, 1, 2])._value))
+
+
+# ---------------------------------------------------------------------------
+# ResNet-50 end to end
+# ---------------------------------------------------------------------------
+
+def test_resnet50_eval_forward_parity():
+    x = _img()
+    paddle.seed(0)
+    m1 = resnet50(num_classes=8, layout="NCHW")
+    paddle.seed(0)
+    m2 = resnet50(num_classes=8, layout="NHWC")
+    m1.eval()
+    m2.eval()
+    o1, o2 = _fwd(m1, x), _fwd(m2, x)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               atol=2e-4, rtol=1e-4)
+
+
+def test_resnet50_train_forward_parity_and_stats():
+    # 48px keeps layer4's spatial extent >1 so train-mode BN stats are
+    # well-conditioned (at 32px the 2-sample variance amplifies fp
+    # reduction-order noise chaotically through 16 blocks)
+    x = _img((2, 3, 48, 48))
+    paddle.seed(0)
+    m1 = resnet50(num_classes=8, layout="NCHW")
+    paddle.seed(0)
+    m2 = resnet50(num_classes=8, layout="NHWC", fused_bottleneck=True)
+    m1.train()
+    m2.train()
+    o1, nb1 = _fwd(m1, x, train=True)
+    o2, nb2 = _fwd(m2, x, train=True)
+    scale = float(np.abs(np.asarray(o1)).max())
+    np.testing.assert_allclose(np.asarray(o1) / scale,
+                               np.asarray(o2) / scale, atol=2e-3)
+    # running stats (incl. the Gram-trick conv3 path) match the NCHW
+    # reference update
+    for k in ("bn1._mean", "layer2.0.bn3._mean",
+              "layer2.0.bn3._variance", "layer4.2.bn3._variance"):
+        a, b = np.asarray(nb1[k]), np.asarray(nb2[k])
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-3,
+                                   err_msg=k)
+
+
+def test_resnet50_s2d_stem_nhwc_parity():
+    x = _img()
+    paddle.seed(0)
+    m1 = resnet50(num_classes=8, s2d_stem=True, layout="NCHW")
+    paddle.seed(0)
+    m2 = resnet50(num_classes=8, s2d_stem=True, layout="NHWC")
+    m1.eval()
+    m2.eval()
+    np.testing.assert_allclose(np.asarray(_fwd(m1, x)),
+                               np.asarray(_fwd(m2, x)),
+                               atol=2e-4, rtol=1e-4)
+
+
+def test_convert_after_build_matches_native_nhwc():
+    # the pretrained-checkpoint path: build NCHW, then convert in place
+    x = _img()
+    paddle.seed(0)
+    m1 = resnet50(num_classes=8, layout="NCHW")
+    m1.eval()
+    ref = _fwd(m1, x)
+    m1.convert_to_nhwc()
+    assert m1._layout == "NHWC"
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(_fwd(m1, x)),
+                               atol=2e-4, rtol=1e-4)
+    m1._arm_fused_bottleneck()
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(_fwd(m1, x)),
+                               atol=2e-4, rtol=1e-4)
+
+
+def test_layout_flag_validation():
+    with pytest.raises(ValueError, match="layout"):
+        resnet50(num_classes=4, layout="NDHW")
+    with pytest.raises(ValueError, match="NHWC"):
+        resnet50(num_classes=4, layout="NCHW", fused_bottleneck=True)
+
+
+@pytest.mark.slow
+def test_resnet50_grads_parity_both_layouts():
+    """Full fwd+bwd in train mode, NCHW vs NHWC+fused. Tolerance is
+    relative-to-scale: train BN batch-stat normalization amplifies fp
+    reduction-order differences through 16 blocks (~1e-2 relative is
+    layout-change noise, not a wiring bug — the block-level test in
+    test_fused_conv_bn_act pins 1e-6)."""
+    import paddle_tpu.nn.functional as F
+    x = _img((4, 3, 64, 64))
+    y = jnp.asarray(np.random.default_rng(1).integers(0, 8, (4,)))
+    paddle.seed(0)
+    m1 = resnet50(num_classes=8, layout="NCHW")
+    paddle.seed(0)
+    m2 = resnet50(num_classes=8, layout="NHWC", fused_bottleneck=True)
+    m1.train()
+    m2.train()
+
+    def grads(m):
+        params, buffers = m.raw_state()
+
+        @jax.jit
+        def g(p, b, a, lbl):
+            def loss_fn(pp):
+                out = functional_call(m, pp, b, Tensor(a))
+                return F.cross_entropy(out, Tensor(lbl))._value
+            return jax.grad(loss_fn)(p)
+        return g(params, buffers, x, y)
+
+    g1, g2 = grads(m1), grads(m2)
+    for k in ("conv1.weight", "layer1.0.conv1.weight",
+              "layer3.0.conv3.weight", "layer3.0.bn3.weight",
+              "fc.weight"):
+        a, b = np.asarray(g1[k]), np.asarray(g2[k])
+        if a.ndim == 4:
+            a = a.transpose(2, 3, 1, 0)
+        scale = max(1.0, np.abs(a).max())
+        np.testing.assert_allclose(a / scale, b / scale, atol=2e-2,
+                                   err_msg=k)

@@ -1,0 +1,146 @@
+"""Round-6 regression tests for the round-5 advisor findings:
+
+1. chunked_ce / mlm_gather_capacity aux dicts carry the Parameter
+   itself on the EAGER path (a fresh Tensor(w._value) is a detached
+   tape leaf: loss.backward() silently dropped the tied-embedding /
+   head grads), while the traced path keeps snapshotting values.
+2. LlamaModel/LlamaAttention raise a ValueError up front when
+   cache_index is given without cache (was a TypeError deep in
+   apply_op).
+3. DataLoader(use_process_workers=True, num_workers=0) raises in
+   __init__ instead of silently ignoring the opt-in.
+(4. the _gathered_mlm_loss overflow counter is asserted in
+   test_mlm_gather.py, next to the capacity tests.)
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+
+
+# ---------- 1. eager backward reaches the smuggled head weights ----------
+
+def test_gpt_chunked_ce_eager_backward_reaches_tied_embedding():
+    from paddle_tpu.nlp.gpt import (GPTForCausalLM,
+                                    GPTPretrainingCriterion,
+                                    _resolve_config)
+    paddle.seed(0)
+    m = GPTForCausalLM(_resolve_config(
+        "gpt-tiny", chunked_ce=16, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0))
+    m.train()
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, m.config.vocab_size, (2, 16)), jnp.int32)
+    loss = GPTPretrainingCriterion()(m(Tensor(ids)), Tensor(ids))
+    loss.backward()
+    g = m.gpt.embeddings.word_embeddings.weight.grad
+    assert g is not None
+    assert float(jnp.abs(g._value).max()) > 0
+
+
+@pytest.mark.parametrize("tie", [True, False])
+def test_llama_chunked_ce_eager_backward_reaches_head(tie):
+    from paddle_tpu.nlp.gpt import GPTPretrainingCriterion
+    from paddle_tpu.nlp.llama import LlamaForCausalLM, _resolve_config
+    paddle.seed(0)
+    m = LlamaForCausalLM(_resolve_config(
+        "llama-tiny", chunked_ce=16, tie_word_embeddings=tie,
+        vocab_size=256, max_position_embeddings=64))
+    m.train()
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 16)),
+                      jnp.int32)
+    loss = GPTPretrainingCriterion()(m(Tensor(ids)), Tensor(ids))
+    loss.backward()
+    w, tied = m._head_weight()
+    assert tied is tie
+    assert w.grad is not None
+    assert float(jnp.abs(w.grad._value).max()) > 0
+
+
+def test_bert_mlm_gather_eager_backward_reaches_head():
+    from paddle_tpu.nlp.bert import (BertConfig, BertForPretraining,
+                                     BertPretrainingCriterion)
+    paddle.seed(0)
+    m = BertForPretraining(BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, mlm_gather_capacity=0.3))
+    m.train()
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 16)),
+                      jnp.int32)
+    lbl = np.full((2, 16), -100, np.int32)
+    lbl[:, :4] = 7
+    loss = BertPretrainingCriterion()(
+        m(Tensor(ids)), Tensor(jnp.asarray(lbl)),
+        Tensor(jnp.asarray([0, 1], jnp.int32)))
+    loss.backward()
+    for name, p in (
+            ("transform.weight", m.cls.predictions.transform.weight),
+            ("layer_norm.weight", m.cls.predictions.layer_norm.weight),
+            ("tied embedding",
+             m.bert.embeddings.word_embeddings.weight)):
+        assert p.grad is not None, name
+        assert float(jnp.abs(p.grad._value).max()) > 0, name
+
+
+def test_traced_path_still_trains():
+    """The Engine/jit path must keep its exact-parity contract after
+    the eager fix (the tracer branch still snapshots values)."""
+    from paddle_tpu.hapi.engine import Engine
+    from paddle_tpu.nlp.gpt import (GPTForCausalLM,
+                                    GPTPretrainingCriterion,
+                                    _resolve_config)
+    from paddle_tpu.optimizer import AdamW
+    paddle.seed(0)
+    m = GPTForCausalLM(_resolve_config(
+        "gpt-tiny", chunked_ce=16, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0))
+    m.train()
+    eng = Engine(m, loss=GPTPretrainingCriterion(),
+                 optimizer=AdamW(1e-3, parameters=m.parameters()))
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, m.config.vocab_size, (2, 16)), jnp.int32)
+    w0 = np.asarray(m.gpt.embeddings.word_embeddings.weight._value).copy()
+    l0, _ = eng.train_batch([ids], [ids])
+    l1, _ = eng.train_batch([ids], [ids])
+    assert np.isfinite(float(l0)) and np.isfinite(float(l1))
+    eng.sync_to_layer()
+    w1 = np.asarray(m.gpt.embeddings.word_embeddings.weight._value)
+    assert np.abs(w1 - w0).max() > 0  # the tied weight DID update
+
+
+# ---------- 2. llama cache_index-without-cache guard ----------
+
+def test_llama_cache_index_without_cache_raises():
+    from paddle_tpu.nlp.llama import LlamaForCausalLM, _resolve_config
+    paddle.seed(0)
+    m = LlamaForCausalLM(_resolve_config(
+        "llama-tiny", vocab_size=256, max_position_embeddings=64))
+    m.eval()
+    ids = jnp.asarray([[1, 2, 3]], jnp.int32)
+    with pytest.raises(ValueError, match="cache_index"):
+        m(Tensor(ids), cache_index=0)
+    with pytest.raises(ValueError, match="cache_index"):
+        m.llama(Tensor(ids), cache_index=0)
+
+
+# ---------- 3. DataLoader process-worker opt-in validation ----------
+
+def test_dataloader_process_workers_without_workers_raises():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    class DS(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return np.zeros(3, np.float32)
+
+    with pytest.raises(ValueError, match="num_workers"):
+        DataLoader(DS(), use_process_workers=True, num_workers=0)
+    # the valid opt-in shape still constructs
+    DataLoader(DS(), use_process_workers=True, num_workers=1)

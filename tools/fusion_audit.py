@@ -153,12 +153,16 @@ def gpt_step(tiny=False):
              np.float32(1e-4), np.int32(2), eng._rng_key))
 
 
-def resnet_step(tiny=False, s2d=False):
+def resnet_step(tiny=False, s2d=False, layout=None,
+                fused_bottleneck=False):
     import jax.numpy as jnp
     import numpy as np
     sys.path.insert(0, ".")
-    from bench import build_resnet_engine
-    eng = build_resnet_engine(amp=not tiny, s2d=s2d)
+    from bench import _resnet_layout, build_resnet_engine
+    eng = build_resnet_engine(amp=not tiny, s2d=s2d,
+                              layout=_resnet_layout(layout,
+                                                    fused_bottleneck),
+                              fused_bottleneck=fused_bottleneck)
     hw = 64 if tiny else 224
     batch = 2 if tiny else 256
     rng = np.random.default_rng(0)
@@ -179,6 +183,13 @@ def main():
     ap.add_argument("--tiny", action="store_true",
                     help="CPU-sized configs (tooling smoke only)")
     ap.add_argument("--s2d", action="store_true")
+    ap.add_argument("--layout", choices=("auto", "nhwc", "nchw"),
+                    default=None,
+                    help="resnet: channels-last A/B (see bench.py "
+                         "--layout)")
+    ap.add_argument("--fused-bottleneck", action="store_true",
+                    help="resnet: Pallas fused bottleneck 1x1 chains "
+                         "(implies nhwc while --layout is auto)")
     ap.add_argument("--out", default=None)
     ap.add_argument("--dump-hlo", default=None,
                     help="also write the raw optimized HLO here (prefix)")
@@ -189,8 +200,12 @@ def main():
     if args.model in ("gpt", "both"):
         todo.append(("gpt train step", lambda: gpt_step(args.tiny)))
     if args.model in ("resnet", "both"):
-        todo.append((f"resnet50 train step (s2d={args.s2d})",
-                     lambda: resnet_step(args.tiny, args.s2d)))
+        todo.append((f"resnet50 train step (s2d={args.s2d}, "
+                     f"layout={args.layout or 'auto'}, "
+                     f"fused_bottleneck={args.fused_bottleneck})",
+                     lambda: resnet_step(args.tiny, args.s2d,
+                                         args.layout,
+                                         args.fused_bottleneck)))
     for label, build in todo:
         fn, a = build()
         rep, txt = audit(fn, a, label)

@@ -7,6 +7,8 @@ wedge costs one stage (results land incrementally in campaign_out/):
   2. bench full suite (gpt, ernie, resnet50,     -> bench_full.json
      gpt-1.3b) — the BENCH_r03 shape
   3. resnet50 --s2d A/B                          -> bench_resnet_s2d.json
+  3b. resnet50 NHWC layout / fused-bottleneck    -> bench_resnet_nhwc.json
+      A/B (the r6 "win ResNet" directive)           bench_resnet_nhwc_fused.json
   4. gpt moment_dtype=bfloat16 A/B               -> bench_gpt_bf16m.json
   5. decode bisection probes (kernel/scan/full)  -> decode_probe.json
   6. decode bench (safe jnp path)                -> bench_decode.json
@@ -105,6 +107,17 @@ STAGES = [
     ("bench_full", [PY, "bench.py"], 7200, {}),
     ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
      2400, {}),
+    # NHWC-native conv stack + Pallas fused bottleneck: the round-6
+    # "win ResNet" levers (VERDICT r5 directive #3). NCHW baseline is
+    # bench_full's resnet50; these are the two rungs on top.
+    ("bench_resnet_nhwc", [PY, "bench.py", "--model", "resnet50",
+                           "--layout", "nhwc"], 2400, {}),
+    ("bench_resnet_nhwc_fused", [PY, "bench.py", "--model", "resnet50",
+                                 "--layout", "nhwc",
+                                 "--fused-bottleneck"], 2400, {}),
+    # s2d stem stacked on the NHWC pipeline (the stems compose)
+    ("bench_resnet_nhwc_s2d", [PY, "bench.py", "--model", "resnet50",
+                               "--layout", "nhwc", "--s2d"], 2400, {}),
     ("bench_gpt_bf16m", [PY, "bench.py", "--model", "gpt",
                          "--moment-dtype", "bfloat16"], 2400, {}),
     ("decode_probe", [PY, "tools/decode_probe.py"], 2400, {}),
@@ -128,6 +141,11 @@ STAGES = [
      {"PADDLE_TPU_FLASH_DECODE": "1"}),
     ("fusion_audit", [PY, "tools/fusion_audit.py", "--out",
                       "campaign_out/fusion_audit.md"], 3600, {}),
+    ("fusion_audit_nhwc", [PY, "tools/fusion_audit.py", "--model",
+                           "resnet", "--layout", "nhwc",
+                           "--fused-bottleneck", "--out",
+                           "campaign_out/fusion_audit_nhwc.md"], 3600,
+     {}),
     ("resnet_roofline", [PY, "tools/resnet_roofline.py"], 2400, {}),
     # serving throughput +/- conv-bn folding (conv_bn_fuse_pass parity)
     ("bench_resnet_serve", [PY, "bench.py", "--model", "resnet50",
@@ -203,7 +221,8 @@ RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
               "bench_resnet_serve_fold", "bench_resnet_b512",
               "bench_gpt13b_scan_cce", "bench_gpt_chunkedce",
               "step_anatomy_fusedln", "bench_gpt_fusedadamw",
-              "bench_ernie_mlmgather"}
+              "bench_ernie_mlmgather", "bench_resnet_nhwc_s2d",
+              "fusion_audit_nhwc"}
 
 
 def main():
