@@ -27,3 +27,6 @@ from .tokenizer import (  # noqa: F401
     BasicTokenizer, WordpieceTokenizer, BertTokenizer, GPTTokenizer,
 )
 from . import generation  # noqa: F401
+# continuous-batching serving engine (paged KV cache); the Pallas
+# paged kernels load lazily inside it, so this import stays light
+from .serving import ServingEngine  # noqa: F401

@@ -182,6 +182,12 @@ class GPTAttention(Layer):
 
     def forward(self, x, attn_mask=None, cache=None, cache_index=None):
         q, k, v = self._qkv(x)
+        from .paged_cache import PagedLayerCache, paged_layer_forward
+        if isinstance(cache, PagedLayerCache):
+            # serving path (nlp/serving.py): paged block cache, one
+            # token per slot, per-slot positions — shared contract with
+            # Llama (nlp/paged_cache.py)
+            return paged_layer_forward(q, k, v, cache, self.out_proj)
         if cache_index is not None:
             # STATIC cache (jit decode fast path, nlp/generation.py):
             # fixed [B, S_max, H, D] buffers written in place at
@@ -507,9 +513,16 @@ class GPTModel(FromPretrainedMixin, Layer):
         if position_ids is None and cache_index is not None:
             idx = cache_index._value if isinstance(cache_index, Tensor) \
                 else cache_index
+            idx = jnp.asarray(idx)
             s = input_ids.shape[1]
-            position_ids = Tensor(
-                (idx + jnp.arange(s, dtype=jnp.int32))[None, :])
+            if idx.ndim:
+                # per-slot positions (paged serving decode): [B] index
+                # vector -> [B, s] position grid
+                position_ids = Tensor(
+                    idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :])
+            else:
+                position_ids = Tensor(
+                    (idx + jnp.arange(s, dtype=jnp.int32))[None, :])
         elif position_ids is None and cache is not None:
             # cached decode: positions continue after the cache length
             # (ref: GPTModel.forward's past_length offset)

@@ -96,6 +96,13 @@ LLAMA_CONFIGS = {
                        num_hidden_layers=2, num_attention_heads=4,
                        num_key_value_heads=2, intermediate_size=128,
                        max_position_embeddings=128),
+    # single-chip bench flagship for the GQA family: a TinyLlama-class
+    # 1.1B shape (GQA 4:16); like gpt3-1.3B it needs bf16 Adam moments
+    # + remat to fit one 16GB chip (bench.py worker_llama defaults)
+    "llama-1b": dict(vocab_size=32000, hidden_size=2048,
+                     num_hidden_layers=22, num_attention_heads=16,
+                     num_key_value_heads=4, intermediate_size=5632,
+                     max_position_embeddings=2048),
 }
 
 
@@ -112,19 +119,22 @@ def _init_attr(cfg):
 
 def apply_rope(x, positions, theta):
     """Rotary embedding, HF/paddlenlp half-split convention:
-    x [B, S, H, D]; positions [S] (absolute). rotate_half(x) =
-    concat(-x2, x1) over the last-dim halves; out = x*cos + rot*sin
-    with cos/sin of freqs = pos * theta^(-2i/D) repeated over halves.
-    Computed in-trace (no tables) so cached decode's dynamic offset
-    (positions = cache_index + arange) compiles into the one decode
-    program."""
+    x [B, S, H, D]; positions [S] (absolute, shared across the batch)
+    or [B, S] (per-row — the paged serving decode, where every slot
+    sits at its own offset). rotate_half(x) = concat(-x2, x1) over the
+    last-dim halves; out = x*cos + rot*sin with cos/sin of
+    freqs = pos * theta^(-2i/D) repeated over halves. Computed
+    in-trace (no tables) so cached decode's dynamic offset (positions
+    = cache_index + arange) compiles into the one decode program."""
     d = x.shape[-1]
     inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    freqs = positions.astype(jnp.float32)[:, None] * inv[None, :]
+    freqs = positions.astype(jnp.float32)[..., None] * inv  # [..., D/2]
     cos = jnp.concatenate([jnp.cos(freqs), jnp.cos(freqs)], axis=-1)
     sin = jnp.concatenate([jnp.sin(freqs), jnp.sin(freqs)], axis=-1)
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
+    if positions.ndim == 1:      # [S] -> broadcast over batch + heads
+        cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    else:                        # [B, S] -> broadcast over heads
+        cos, sin = cos[:, :, None, :], sin[:, :, None, :]
     x1, x2 = jnp.split(x, 2, axis=-1)
     rot = jnp.concatenate([-x2, x1], axis=-1)
     return (x.astype(jnp.float32) * cos
@@ -182,6 +192,13 @@ class LlamaAttention(Layer):
                 "init_cache / forward(use_cache=True)) or drop "
                 "cache_index")
         q, k, v = self._shaped_qkv(x)
+        from .paged_cache import PagedLayerCache, paged_layer_forward
+        if isinstance(cache, PagedLayerCache):
+            # serving path (nlp/serving.py): the shared paged contract
+            # handles per-slot RoPE + page write + GQA attention
+            return paged_layer_forward(q, k, v, cache, self.o_proj,
+                                       groups=groups,
+                                       rope_theta=cfg.rope_theta)
         if cache_index is not None:
             return self._forward_static_cache(q, k, v, cache,
                                               cache_index, groups)

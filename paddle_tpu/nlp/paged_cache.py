@@ -1,0 +1,283 @@
+"""Paged (block) KV cache — the serving-time cache contract shared by
+GPT and Llama (ref: vLLM PagedAttention, arXiv:2309.06180; upstream
+Paddle ships the CUDA equivalent under paddle/fluid/operators/fused/ +
+FastDeploy's block-wise attention).
+
+TPU-native shape of the idea: all shapes are STATIC so the whole decode
+loop stays one compiled XLA program —
+
+- the cache is a fixed pool of pages per layer, laid out HEAD-MAJOR
+  `[Hkv, P, page_size, D]` (the layout the Pallas paged flash-decode
+  kernel reads pages from HBM in, one (head, page) block per grid step);
+- a `[num_slots, max_pages]` int32 page table maps each serving slot's
+  token positions to pages; rows are rewritten host-side at step
+  boundaries only (admission/eviction — nlp/serving.py owns the free
+  list), so no recompile ever;
+- page 0 is RESERVED as the trash page: inactive slots point every
+  table entry at it and write position 0, so masked lanes of the
+  batched step have a legal destination without any dynamic shapes;
+- writes go through one `scatter` (`.at[].set`) per step; per-slot
+  validity is carried by `positions` ([num_slots] int32 = tokens
+  already cached) and attention masks keys at index >= positions+1.
+
+Cache dtypes: float32 / bfloat16 store K/V directly; int8 stores
+per-token-per-head symmetric-quantized rows with an f32 scale sidecar
+`[Hkv, P, page_size, 1]` (the trailing singleton keeps the Mosaic lane
+dim equal to the array dim, so the kernel can read scales as a legal
+block — see ops/pallas/flash_decode.py).
+
+The model integration point is `PagedLayerCache`: attention layers that
+receive one as their layer cache route through
+`paged_update_and_attend` instead of the dense static-cache path. It is
+NOT a pytree — nlp/serving.py constructs it inside its jitted programs
+from raw array arguments and unpacks the returned arrays, so it never
+crosses a jit boundary.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PagedLayerCache", "alloc_pages", "quantize_rows",
+           "write_token_kv", "write_prompt_kv", "paged_attention_ref",
+           "paged_update_and_attend", "paged_layer_forward",
+           "TRASH_PAGE"]
+
+# page index 0 is never allocated to a sequence: it is the write sink
+# for masked (inactive/finished) slots and for prefill bucket tail
+# pages beyond a request's allocation
+TRASH_PAGE = 0
+
+_INT8_MAX = 127.0
+
+
+class PagedLayerCache:
+    """One layer's view of the paged cache plus the shared routing
+    state. Plain object (deliberately not a pytree — see module doc);
+    `use_flash` is trace-time-static kernel routing, everything else is
+    a traced array."""
+
+    __slots__ = ("k_pages", "v_pages", "k_scale", "v_scale",
+                 "page_table", "positions", "use_flash")
+
+    def __init__(self, k_pages, v_pages, page_table, positions,
+                 k_scale=None, v_scale=None, use_flash=False):
+        self.k_pages = k_pages          # [Hkv, P, ps, D]
+        self.v_pages = v_pages          # [Hkv, P, ps, D]
+        self.k_scale = k_scale          # [Hkv, P, ps, 1] f32 | None
+        self.v_scale = v_scale          # [Hkv, P, ps, 1] f32 | None
+        self.page_table = page_table    # [B, MP] int32
+        self.positions = positions      # [B] int32 tokens already cached
+        self.use_flash = bool(use_flash)
+
+    def replaced(self, k_pages, v_pages, k_scale=None, v_scale=None):
+        """New view with updated page arrays (same table/positions/
+        routing) — what an attention layer returns as its new cache."""
+        return PagedLayerCache(k_pages, v_pages, self.page_table,
+                               self.positions, k_scale=k_scale,
+                               v_scale=v_scale, use_flash=self.use_flash)
+
+    @property
+    def page_size(self):
+        return self.k_pages.shape[2]
+
+    @property
+    def quantized(self):
+        return self.k_scale is not None
+
+
+def alloc_pages(num_pages, page_size, kv_heads, head_dim, cache_dtype):
+    """Fresh page pool for ONE layer. cache_dtype: 'float32' |
+    'bfloat16' | 'int8' (int8 adds the f32 scale sidecars)."""
+    dt = jnp.dtype(cache_dtype) if cache_dtype != "int8" else jnp.int8
+    shape = (kv_heads, num_pages, page_size, head_dim)
+    k = jnp.zeros(shape, dt)
+    v = jnp.zeros(shape, dt)
+    if cache_dtype == "int8":
+        # two distinct arrays: the engine donates the whole pool, and
+        # aliased buffers trip XLA's double-donation check
+        return (k, v, jnp.zeros(shape[:3] + (1,), jnp.float32),
+                jnp.zeros(shape[:3] + (1,), jnp.float32))
+    return k, v, None, None
+
+
+def quantize_rows(x):
+    """Symmetric per-row int8 quantization over the trailing (D) axis.
+    x [..., D] f32/bf16 -> (q int8 [..., D], scale f32 [..., 1])."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = amax / _INT8_MAX
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / safe),
+                 -_INT8_MAX, _INT8_MAX).astype(jnp.int8)
+    return q, scale
+
+
+def _dequant(pages, scale, dtype):
+    x = pages.astype(jnp.float32)
+    if scale is not None:
+        x = x * scale
+    return x.astype(dtype)
+
+
+def write_token_kv(cache: PagedLayerCache, k_new, v_new, live):
+    """Write one token per slot into the pages. k_new/v_new
+    [B, Hkv, D] (post-RoPE for Llama); live [B] bool — masked slots are
+    redirected to the trash page so the scatter stays full-width.
+    Returns the updated (k_pages, v_pages, k_scale, v_scale)."""
+    ps = cache.page_size
+    pos = cache.positions
+    page = jnp.take_along_axis(cache.page_table,
+                               (pos // ps)[:, None], axis=1)[:, 0]
+    page = jnp.where(live, page, TRASH_PAGE)
+    row = jnp.where(live, pos % ps, 0)
+    kt = jnp.swapaxes(k_new, 0, 1)      # [Hkv, B, D]
+    vt = jnp.swapaxes(v_new, 0, 1)
+    if cache.quantized:
+        kq, ks = quantize_rows(kt)
+        vq, vs = quantize_rows(vt)
+        return (cache.k_pages.at[:, page, row].set(kq),
+                cache.v_pages.at[:, page, row].set(vq),
+                cache.k_scale.at[:, page, row].set(ks),
+                cache.v_scale.at[:, page, row].set(vs))
+    return (cache.k_pages.at[:, page, row].set(kt.astype(
+                cache.k_pages.dtype)),
+            cache.v_pages.at[:, page, row].set(vt.astype(
+                cache.v_pages.dtype)),
+            None, None)
+
+
+def write_prompt_kv(k_pages, v_pages, k_scale, v_scale, k_full, v_full,
+                    pages_vec):
+    """Prefill write: one request's whole (bucket-padded) prompt K/V
+    into its pages. k_full/v_full [1, S_b, Hkv, D] with S_b a multiple
+    of page_size; pages_vec [S_b // ps] int32 page ids (tail entries
+    beyond the request's allocation point at TRASH_PAGE). Rows past the
+    true prompt length carry garbage — they are either overwritten by
+    the decode steps that reach those positions or masked by the
+    attention length, never read."""
+    ps = k_pages.shape[2]
+    nb = k_full.shape[1] // ps
+
+    def blocks(x):                      # [1, S_b, Hkv, D] -> [Hkv, nb, ps, D]
+        x = jnp.swapaxes(x[0], 0, 1)    # [Hkv, S_b, D]
+        return x.reshape(x.shape[0], nb, ps, x.shape[-1])
+
+    kb, vb = blocks(k_full), blocks(v_full)
+    if k_scale is not None:
+        kq, ks = quantize_rows(kb)
+        vq, vs = quantize_rows(vb)
+        return (k_pages.at[:, pages_vec].set(kq),
+                v_pages.at[:, pages_vec].set(vq),
+                k_scale.at[:, pages_vec].set(ks),
+                v_scale.at[:, pages_vec].set(vs))
+    return (k_pages.at[:, pages_vec].set(kb.astype(k_pages.dtype)),
+            v_pages.at[:, pages_vec].set(vb.astype(v_pages.dtype)),
+            None, None)
+
+
+def paged_attention_ref(q, k_pages, v_pages, page_table, lens,
+                        k_scale=None, v_scale=None, sm_scale=None):
+    """jnp reference paged attention (the XLA-fused fallback path and
+    the parity pin for the Pallas kernel).
+
+    q [B, Hkv, G, D] (G = query heads per kv head); pages
+    [Hkv, P, ps, D]; page_table [B, MP]; lens [B] int32 — keys at
+    flat index >= lens[b] are masked. Returns [B, Hkv, G, D].
+
+    Gathers the slot's pages into a dense [B, S_cap, ...] view — the
+    reference trades the kernel's in-place HBM reads for clarity; the
+    gather is why the Pallas kernel exists at serving batch sizes."""
+    b, hkv, g, d = q.shape
+    ps = k_pages.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+
+    def gather(pages, scale):
+        x = pages[:, page_table]        # [Hkv, B, MP, ps, D]
+        x = _dequant(x, None if scale is None else scale[:, page_table],
+                     jnp.float32)
+        x = jnp.moveaxis(x, 1, 0)       # [B, Hkv, MP, ps, D]
+        return x.reshape(b, hkv, -1, d)  # [B, Hkv, S_cap, D]
+
+    k = gather(k_pages, k_scale)
+    v = gather(v_pages, v_scale)
+    s = jnp.einsum("bhgd,bhkd->bhgk", q.astype(jnp.float32), k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    kpos = jnp.arange(k.shape[2])[None, None, None, :]
+    s = jnp.where(kpos < lens[:, None, None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)  # fully-masked rows -> 0
+    out = jnp.einsum("bhgk,bhkd->bhgd", p, v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _rope_rows(x, positions, theta):
+    """RoPE for single-token rows: x [B, H, D], positions [B] — the
+    per-slot-offset case of llama.apply_rope (ONE shared formula: a
+    convention drift between prefill and paged decode would silently
+    break K parity)."""
+    from .llama import apply_rope
+    return apply_rope(x[:, None], positions[:, None], theta)[:, 0]
+
+
+def paged_layer_forward(q, k, v, cache: PagedLayerCache, out_proj,
+                        groups=1, rope_theta=None):
+    """The whole per-layer serving branch both GPTAttention and
+    LlamaAttention delegate to: Tensor-level dispatch (apply_op) around
+    paged_update_and_attend plus the output projection. Returns
+    (projected out, new PagedLayerCache)."""
+    from ..autograd import apply_op
+
+    def run(qv, kv, vv):
+        out, new_pages = paged_update_and_attend(
+            qv, kv, vv, cache, groups=groups, rope_theta=rope_theta)
+        return (out,) + new_pages
+
+    out, kp, vp, ks, vs = apply_op(run, q, k, v, differentiable=False)
+    b, s = out.shape[0], out.shape[1]
+    return (out_proj(out.reshape([b, s, -1])),
+            cache.replaced(kp, vp, ks, vs))
+
+
+def paged_update_and_attend(q, k, v, cache: PagedLayerCache, groups=1,
+                            rope_theta=None):
+    """The per-layer serving step, shared by GPT and Llama attention:
+    (optionally RoPE at per-slot positions,) write the new token's K/V
+    into the pages, attend the single query row against the slot's
+    paged history (self included).
+
+    q [B, 1, H, D]; k/v [B, 1, Hkv, D] raw projections. Returns
+    (out [B, 1, H, D], (k_pages, v_pages, k_scale, v_scale)).
+    Masked slots (positions route their table row to the trash page —
+    the engine's contract) produce zero attention rows; the engine
+    discards their sampled tokens."""
+    b, sq, h, d = q.shape
+    assert sq == 1, "paged decode is the single-token path"
+    hkv = k.shape[2]
+    assert h == hkv * groups, (h, hkv, groups)
+    pos = cache.positions
+    q1 = q[:, 0]                        # [B, H, D]
+    k1 = k[:, 0]                        # [B, Hkv, D]
+    v1 = v[:, 0]
+    if rope_theta is not None:
+        q1 = _rope_rows(q1, pos, rope_theta)
+        k1 = _rope_rows(k1, pos, rope_theta)
+    # live-ness is encoded upstream: inactive slots carry an all-trash
+    # page table row, so the write is always safe full-width
+    live = jnp.ones((b,), jnp.bool_)
+    k_pages, v_pages, k_scale, v_scale = write_token_kv(cache, k1, v1,
+                                                        live)
+    lens = pos + 1                      # the written token attends itself
+    qg = q1.reshape(b, hkv, groups, d)
+    if cache.use_flash:
+        from ..ops.attention import paged_flash_decode
+        out = paged_flash_decode(qg, k_pages, v_pages, cache.page_table,
+                                 lens, k_scale=k_scale, v_scale=v_scale)
+    else:
+        out = paged_attention_ref(qg, k_pages, v_pages, cache.page_table,
+                                  lens, k_scale=k_scale, v_scale=v_scale)
+    out = out.reshape(b, 1, h, d)
+    return out, (k_pages, v_pages, k_scale, v_scale)

@@ -1,0 +1,509 @@
+"""Continuous-batching serving engine — paged KV cache + batched decode.
+
+ref parity: FastDeploy / vLLM-style continuous batching over the
+PaddleNLP generation surface (the reference serves GPT/Llama through
+fused block-attention CUDA ops; see PAPERS.md on memory-efficient
+attention serving). TPU-native design: EVERYTHING the chip executes is
+one of a small, fixed set of compiled XLA programs —
+
+- ONE batched decode program per sampling strategy: a `lax.scan` of
+  `steps_per_dispatch` single-token steps over the whole slot pool
+  (single dispatch per K tokens x B slots), paged-cache reads/writes
+  inside (nlp/paged_cache.py; Pallas GQA flash-decode when armed);
+- ONE prefill program per power-of-two length bucket: admission pads
+  the prompt to the bucket, masks the tail, and scatters the prompt's
+  K/V into the slot's pages — a new request NEVER triggers a fresh
+  trace once its bucket is warm;
+- page allocation, slot assignment, admission and eviction are
+  host-side bookkeeping BETWEEN dispatches (a free-list of page ids
+  and a [slots, max_pages] int32 table) — they change array CONTENTS,
+  never shapes, so the steady state compiles nothing.
+
+Zero-recompile is not aspirational: every jitted program runs under a
+trace counter and `compile_counts()` exposes them; `bench.py --serve`
+asserts the counts freeze after warmup on every ladder rung.
+
+The cache is shared GPT/Llama (both models' attention layers route a
+`PagedLayerCache` through `paged_update_and_attend`): GQA models cache
+only their kv heads; `cache_dtype` float32/bfloat16/int8 trades HBM
+decode bandwidth for precision (int8 carries per-token-per-head f32
+scale sidecars).
+
+Single-threaded by design (one engine owns one chip's decode loop);
+wrap submissions in your own queue for multi-producer serving.
+"""
+from __future__ import annotations
+
+import collections
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import functional_call
+from ..tensor import Tensor
+from .paged_cache import PagedLayerCache, alloc_pages, write_prompt_kv, \
+    TRASH_PAGE
+
+__all__ = ["ServingEngine", "ServeRequest"]
+
+
+class ServeRequest:
+    """One queued generation request."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id")
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_token_id):
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+
+
+class _Slot:
+    __slots__ = ("req", "pages", "out_tokens")
+
+    def __init__(self, req, pages):
+        self.req = req
+        self.pages = pages          # page ids owned by this sequence
+        self.out_tokens = []        # generated tokens (host ints)
+
+
+def _next_pow2(n):
+    return 1 << max(0, (int(n) - 1)).bit_length()
+
+
+class ServingEngine:
+    """Continuous-batching decode over a fixed slot pool.
+
+    model: GPTForCausalLM / LlamaForCausalLM (anything whose attention
+    layers understand the PagedLayerCache contract). All requests share
+    one sampling strategy (greedy when temperature==0, else
+    temperature/top-k sampling) — the strategy is baked into the one
+    compiled decode program.
+
+    max_slots: decode batch width (the slot pool).
+    page_size: tokens per KV page (multiple of 8).
+    max_seq_len: per-sequence capacity (prompt + generated), rounded up
+        to whole pages; fixes the page-table width.
+    num_pages: total pool pages (page 0 is the reserved trash page).
+        Default fully provisions every slot; smaller values exercise
+        admission back-pressure/recycling.
+    cache_dtype: 'float32' | 'bfloat16' | 'int8' KV storage.
+    use_flash: None auto (TPU + PADDLE_TPU_FLASH_DECODE=1), True force
+        the Pallas paged kernel (interpret mode off-TPU), False jnp ref.
+    steps_per_dispatch: decode tokens per compiled call (the scan
+        length) — admission/eviction happen at dispatch boundaries.
+    donate: donate the page pool to the decode/prefill programs
+        (in-place HBM updates). Turn OFF when running under a
+        persistent compilation cache on jax 0.4.x (reloading donated
+        executables aborts — R6_NOTES.md); bench.py does this
+        automatically for PADDLE_TPU_BENCH_CACHE.
+    """
+
+    def __init__(self, model, *, max_slots=8, page_size=16,
+                 max_seq_len=256, num_pages=None, cache_dtype="float32",
+                 use_flash=None, temperature=0.0, top_k=0, seed=0,
+                 pad_token_id=0, steps_per_dispatch=8, donate=True):
+        if page_size % 8:
+            raise ValueError(f"page_size must be a multiple of 8 "
+                             f"(Mosaic sublane tiling), got {page_size}")
+        model.eval()
+        self.model = model
+        cfg = model.config
+        self.cfg = cfg
+        self.kv_heads = (getattr(cfg, "num_key_value_heads", 0)
+                         or cfg.num_attention_heads)
+        self.groups = cfg.num_attention_heads // self.kv_heads
+        self.num_layers = cfg.num_hidden_layers
+        self.head_dim = cfg.head_dim
+        self.page_size = int(page_size)
+        self.max_slots = int(max_slots)
+        self.max_pages_per_seq = -(-int(max_seq_len) // self.page_size)
+        self.max_seq_len = self.max_pages_per_seq * self.page_size
+        max_pos = getattr(cfg, "max_position_embeddings", None)
+        if max_pos and self.max_seq_len > max_pos:
+            raise ValueError(
+                f"max_seq_len={max_seq_len} exceeds the model's "
+                f"max_position_embeddings={max_pos}")
+        if num_pages is None:
+            num_pages = 1 + self.max_slots * self.max_pages_per_seq
+        self.num_pages = int(num_pages)
+        self.cache_dtype = str(cache_dtype)
+        if self.cache_dtype not in ("float32", "bfloat16", "int8"):
+            raise ValueError(f"cache_dtype {cache_dtype!r}: expected "
+                             "float32 | bfloat16 | int8")
+        from ..ops.attention import paged_flash_available
+        self.use_flash = paged_flash_available(self.head_dim,
+                                               self.page_size, use_flash)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.pad_token_id = int(pad_token_id)
+        self.steps_per_dispatch = int(steps_per_dispatch)
+        self.donate = bool(donate)
+
+        self._params, self._buffers = model.raw_state()
+        self._pages = [alloc_pages(self.num_pages, self.page_size,
+                                   self.kv_heads, self.head_dim,
+                                   self.cache_dtype)
+                       for _ in range(self.num_layers)]
+        self._quantized = self.cache_dtype == "int8"
+
+        b = self.max_slots
+        self._page_table = np.zeros((b, self.max_pages_per_seq), np.int32)
+        self._seq_lens = np.zeros((b,), np.int32)
+        self._last_tokens = np.zeros((b,), np.int32)
+        self._emitted = np.zeros((b,), np.int32)
+        self._max_new = np.ones((b,), np.int32)
+        self._eos = np.full((b,), -1, np.int32)  # -1 = no eos for slot
+        self._done = np.ones((b,), bool)
+        self._active = np.zeros((b,), bool)
+        self._rng = jax.random.PRNGKey(seed)
+
+        # device-resident mirror of the scheduling arrays: refreshed
+        # from host only when admission/eviction mutates them, so a
+        # steady full-pool decode pays zero host->device uploads per
+        # dispatch (the compiled step's launch overhead is the serving
+        # metric's denominator)
+        self._dev_sched = None
+
+        self._free_pages = list(range(1, self.num_pages))  # 0 = trash
+        self._slots = [None] * b
+        self._queue = collections.deque()
+        self._finished = []
+        self._next_rid = 0
+
+        self._trace_counts = {}
+        self._decode_fn = self._build_decode_fn()
+        self._prefill_fns = {}
+        # decode-dispatch accounting: batched-decode throughput is THE
+        # serving metric (wall time also pays per-request prefill,
+        # which is batch-1 by construction); bench.py --serve reads
+        # these for the ladder's tok/s rows
+        self.decode_seconds = 0.0
+        self.decode_tokens = 0
+        self.decode_dispatches = 0
+
+    def reset_counters(self):
+        self.decode_seconds = 0.0
+        self.decode_tokens = 0
+        self.decode_dispatches = 0
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=16, eos_token_id=None):
+        """Queue one request; returns its id. Admitted at the next
+        step() boundary (slot + pages permitting)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if not len(prompt):
+            raise ValueError("empty prompt")
+        need = len(prompt) + int(max_new_tokens)
+        if need > self.max_seq_len:
+            raise ValueError(
+                f"prompt({len(prompt)}) + max_new_tokens"
+                f"({max_new_tokens}) = {need} exceeds max_seq_len="
+                f"{self.max_seq_len}")
+        need_pages = -(-need // self.page_size)
+        if need_pages > self.num_pages - 1:
+            # would never admit: back-pressure can free at most the
+            # whole pool (page 0 is reserved)
+            raise ValueError(
+                f"request needs {need_pages} pages but the pool only "
+                f"has {self.num_pages - 1} usable")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(ServeRequest(rid, prompt, max_new_tokens,
+                                        eos_token_id))
+        return rid
+
+    def step(self):
+        """One scheduling round: evict finished slots, admit queued
+        requests, run ONE batched decode dispatch
+        (steps_per_dispatch tokens x all live slots). Returns the list
+        of requests finished this round as dicts
+        {id, prompt, tokens} (tokens = generated only)."""
+        self._evict()
+        self._admit()
+        if self._active.any() and not (self._done | ~self._active).all():
+            self._dispatch_decode()
+        self._evict()
+        out, self._finished = self._finished, []
+        return out
+
+    def run_to_completion(self, max_rounds=10_000):
+        """Drive step() until queue and slots drain; returns all
+        finished requests in completion order."""
+        results = []
+        rounds = 0
+        while self._queue or any(s is not None for s in self._slots):
+            results.extend(self.step())
+            rounds += 1
+            if rounds > max_rounds:
+                raise RuntimeError("serving loop did not drain "
+                                   f"within {max_rounds} rounds")
+        return results
+
+    def generate(self, prompts, max_new_tokens=16, eos_token_id=None):
+        """Convenience batch API: submit all, drain, return generated
+        token lists in submission order."""
+        ids = [self.submit(p, max_new_tokens, eos_token_id)
+               for p in prompts]
+        res = {r["id"]: r for r in self.run_to_completion()}
+        return [res[i]["tokens"] for i in ids]
+
+    def compile_counts(self):
+        """Trace counts per compiled program (name -> count). Steady
+        state == this dict stops changing; bench.py --serve asserts
+        it per ladder rung."""
+        return dict(self._trace_counts)
+
+    @property
+    def free_page_count(self):
+        return len(self._free_pages)
+
+    # -- sampling (one strategy per engine == per compiled program) ---------
+
+    def _sample(self, logits, key):
+        logits = logits.astype(jnp.float32)
+        if self.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / self.temperature
+        if self.top_k:
+            vals, cand = jax.lax.top_k(logits, self.top_k)
+            pick = jax.random.categorical(key, vals)
+            return jnp.take_along_axis(
+                cand, pick[..., None], axis=-1)[..., 0].astype(jnp.int32)
+        return jax.random.categorical(key, logits).astype(jnp.int32)
+
+    # -- compiled programs --------------------------------------------------
+
+    def _counting(self, name, fn, donate_argnums=()):
+        """jit with a trace counter: the counter bumps exactly when jax
+        (re)traces, i.e. on every compile — the zero-recompile
+        assertion's ground truth."""
+        counts = self._trace_counts
+
+        def wrapped(*args):
+            counts[name] = counts.get(name, 0) + 1
+            from ..autograd import no_grad
+            with no_grad():
+                return fn(*args)
+
+        if self.donate and donate_argnums:
+            return jax.jit(wrapped, donate_argnums=donate_argnums)
+        return jax.jit(wrapped)
+
+    def _layer_caches(self, pages, page_table, positions):
+        return [PagedLayerCache(k, v, page_table, positions,
+                                k_scale=ks, v_scale=vs,
+                                use_flash=self.use_flash)
+                for (k, v, ks, vs) in pages]
+
+    @staticmethod
+    def _unwrap_pages(new_caches):
+        def arr(x):
+            return x._value if isinstance(x, Tensor) else x
+        return [(arr(c.k_pages), arr(c.v_pages),
+                 None if c.k_scale is None else arr(c.k_scale),
+                 None if c.v_scale is None else arr(c.v_scale))
+                for c in new_caches]
+
+    def _model_token_step(self, params, buffers, tokens, pages,
+                          page_table, positions):
+        """One batched single-token forward through the paged cache.
+        tokens [B] int32; returns (last_logits [B, V] f32, new pages)."""
+        caches = self._layer_caches(pages, page_table, positions)
+        out = functional_call(
+            self.model, params, buffers, Tensor(tokens[:, None]),
+            use_cache=False, cache=caches,
+            cache_index=Tensor(positions))
+        logits_t, new_caches = out
+        logits = logits_t._value if isinstance(logits_t, Tensor) \
+            else logits_t
+        return (logits[:, -1].astype(jnp.float32),
+                self._unwrap_pages(new_caches))
+
+    def _build_decode_fn(self):
+        steps = self.steps_per_dispatch
+        pad = self.pad_token_id
+
+        def decode(params, buffers, pages, page_table, seq_lens,
+                   last_tokens, active, done, emitted, max_new, eos,
+                   rng):
+            def step(carry, _):
+                (pages, seq_lens, last, done, emitted, rng) = carry
+                live = active & ~done
+                logits, pages = self._model_token_step(
+                    params, buffers, last, pages, page_table, seq_lens)
+                rng, sub = jax.random.split(rng)
+                nxt = self._sample(logits, sub)
+                nxt = jnp.where(live, nxt, jnp.int32(pad))
+                emitted = emitted + live.astype(jnp.int32)
+                stop = (emitted >= max_new) | ((eos >= 0) & (nxt == eos))
+                done = done | (live & stop)
+                seq_lens = seq_lens + live.astype(jnp.int32)
+                last = jnp.where(live, nxt, last)
+                return (pages, seq_lens, last, done, emitted, rng), nxt
+
+            carry = (pages, seq_lens, last_tokens, done, emitted, rng)
+            carry, toks = jax.lax.scan(step, carry, None, length=steps)
+            pages, seq_lens, last, done, emitted, rng = carry
+            return (toks, pages, seq_lens, last, done, emitted, rng)
+
+        # donate the page pool (arg 2): decode updates it in place
+        return self._counting("decode", decode, donate_argnums=(2,))
+
+    def _prefill_fn(self, bucket):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+
+        def prefill(params, buffers, pages, ids, true_len, pages_vec,
+                    rng):
+            s_b = ids.shape[1]
+            mask = (jnp.arange(s_b)[None, :]
+                    < true_len).astype(jnp.int32)
+            out = functional_call(self.model, params, buffers,
+                                  Tensor(ids), attention_mask=Tensor(mask),
+                                  use_cache=True)
+            logits_t, caches = out
+            logits = logits_t._value if isinstance(logits_t, Tensor) \
+                else logits_t
+
+            def arr(x):
+                return x._value if isinstance(x, Tensor) else x
+
+            new_pages = []
+            for (k, v, ks, vs), layer in zip(pages, caches):
+                new_pages.append(write_prompt_kv(
+                    k, v, ks, vs, arr(layer[0]), arr(layer[1]),
+                    pages_vec))
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], true_len - 1, keepdims=False)
+            rng, sub = jax.random.split(rng)
+            tok = self._sample(last[None, :], sub)[0]
+            return tok, new_pages, rng
+
+        fn = self._counting(f"prefill_{bucket}", prefill,
+                            donate_argnums=(2,))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    # -- host-side scheduling ----------------------------------------------
+
+    def _evict(self):
+        for b in range(self.max_slots):
+            slot = self._slots[b]
+            if slot is None or not self._done[b]:
+                continue
+            req = slot.req
+            self._finished.append({
+                "id": req.rid,
+                "prompt": req.prompt.tolist(),
+                "tokens": slot.out_tokens[:req.max_new_tokens],
+            })
+            self._free_pages.extend(slot.pages)
+            self._slots[b] = None
+            self._active[b] = False
+            self._page_table[b, :] = TRASH_PAGE
+            self._seq_lens[b] = 0
+            self._emitted[b] = 0
+            self._eos[b] = -1
+            self._dev_sched = None  # host state diverged from device
+
+    def _admit(self):
+        while self._queue:
+            req = self._queue[0]
+            free_slot = next((b for b in range(self.max_slots)
+                              if self._slots[b] is None), None)
+            need_pages = -(-(len(req.prompt) + req.max_new_tokens)
+                           // self.page_size)
+            if free_slot is None or len(self._free_pages) < need_pages:
+                return  # back-pressure: retry next boundary
+            self._queue.popleft()
+            self._admit_one(free_slot, req, need_pages)
+
+    def _admit_one(self, b, req, need_pages):
+        ps = self.page_size
+        lp = len(req.prompt)
+        # pow2 bucket, rounded UP to whole pages: write_prompt_kv
+        # reshapes the bucket into page blocks, and a page_size that is
+        # a multiple of 8 but not a power of two (e.g. 24) would
+        # otherwise leave bucket % ps != 0. Bucket count stays bounded
+        # (one per pow2 size), so the no-fresh-trace property holds.
+        bucket = min(max(_next_pow2(lp), ps), self.max_seq_len)
+        bucket = min(-(-bucket // ps) * ps, self.max_seq_len)
+        nb = bucket // ps
+        pages = [self._free_pages.pop() for _ in range(need_pages)]
+        # bucket tail blocks beyond the allocation write to the trash
+        # page (write_prompt_kv's contract)
+        pages_vec = np.full((nb,), TRASH_PAGE, np.int32)
+        pages_vec[:min(need_pages, nb)] = pages[:nb]
+        ids = np.full((1, bucket), self.pad_token_id, np.int32)
+        ids[0, :lp] = req.prompt
+
+        fn = self._prefill_fn(bucket)
+        tok, new_pages, self._rng = fn(
+            self._params, self._buffers, self._pages, jnp.asarray(ids),
+            jnp.int32(lp), jnp.asarray(pages_vec), self._rng)
+        self._pages = new_pages
+        tok = int(tok)
+
+        self._slots[b] = _Slot(req, pages)
+        self._slots[b].out_tokens.append(tok)
+        row = np.full((self.max_pages_per_seq,), TRASH_PAGE, np.int32)
+        row[:need_pages] = pages
+        self._page_table[b] = row
+        self._seq_lens[b] = lp
+        self._last_tokens[b] = tok
+        self._emitted[b] = 1
+        self._max_new[b] = req.max_new_tokens
+        self._eos[b] = -1 if req.eos_token_id is None \
+            else int(req.eos_token_id)
+        self._active[b] = True
+        self._done[b] = bool(req.max_new_tokens <= 1
+                             or (req.eos_token_id is not None
+                                 and tok == req.eos_token_id))
+        self._dev_sched = None  # host state diverged from device
+
+    def _dispatch_decode(self):
+        emitted_before = self._emitted.copy()
+        t0 = time.perf_counter()
+        if self._dev_sched is None:
+            self._dev_sched = tuple(
+                jnp.asarray(a) for a in
+                (self._page_table, self._seq_lens, self._last_tokens,
+                 self._active, self._done, self._emitted,
+                 self._max_new, self._eos))
+        (pt_d, sl_d, lt_d, ac_d, dn_d, em_d, mn_d, eos_d) = \
+            self._dev_sched
+        (toks, pages, seq_lens, last, done, emitted,
+         self._rng) = self._decode_fn(
+            self._params, self._buffers, self._pages,
+            pt_d, sl_d, lt_d, ac_d, dn_d, em_d, mn_d, eos_d, self._rng)
+        self._pages = pages
+        # decode only advances these four; the rest stay device-valid
+        self._dev_sched = (pt_d, seq_lens, last, ac_d, done, emitted,
+                           mn_d, eos_d)
+        toks = np.asarray(toks)                     # [steps, B]
+        # np.array (copy): np.asarray of a jax array is a read-only
+        # view, and eviction writes these in place
+        self._seq_lens = np.array(seq_lens)
+        self._last_tokens = np.array(last)
+        self._done = np.array(done)
+        self._emitted = np.array(emitted)
+        # the np.array() conversions above force the device sync, so
+        # this timestamp bounds real work, not async dispatch
+        self.decode_seconds += time.perf_counter() - t0
+        self.decode_tokens += int((self._emitted - emitted_before).sum())
+        self.decode_dispatches += 1
+        for b in range(self.max_slots):
+            slot = self._slots[b]
+            if slot is None:
+                continue
+            n = int(self._emitted[b] - emitted_before[b])
+            if n:
+                # live steps are the first n of the scan (done is
+                # monotonic within a dispatch)
+                slot.out_tokens.extend(int(t) for t in toks[:n, b])

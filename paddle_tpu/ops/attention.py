@@ -90,6 +90,50 @@ def flash_decode(q, k_cache, v_cache, kv_lens, sm_scale=None):
                                kv_lens=kv_lens)
 
 
+def paged_flash_available(head_dim, page_size, use_flash=None):
+    """Gate for the paged GQA decode kernel (serving engine /
+    nlp/paged_cache.py). Mirrors flash_decode's caution: the Pallas
+    decode path stays OFF by default on hardware until
+    PADDLE_TPU_FLASH_DECODE=1 (round-2 wedge, BENCHLOG), but an
+    explicit use_flash=True forces it anywhere the SHAPE supports
+    (interpret mode off-TPU — the CPU ladder/tests exercise the
+    identical kernel); a forced-but-unsupported shape falls back to
+    the jnp reference with a stderr warning (callers that report
+    results must echo the effective gate, e.g. bench --serve's
+    flash_kernel field).
+
+    use_flash: True -> force on; False -> off; None -> auto (TPU +
+    env gate + supported shape)."""
+    shape_ok = head_dim in _PALLAS_HEAD_DIMS and page_size % 8 == 0
+    if use_flash is False:
+        return False
+    if use_flash is True:
+        if not shape_ok:
+            import sys
+            print(f"paged_flash_available: use_flash=True refused — "
+                  f"head_dim={head_dim} not in {_PALLAS_HEAD_DIMS} or "
+                  f"page_size={page_size} % 8 != 0; running the jnp "
+                  "reference path", file=sys.stderr, flush=True)
+        return shape_ok
+    import os
+    return (shape_ok and _platform() == "tpu"
+            and os.environ.get("PADDLE_TPU_FLASH_DECODE") == "1")
+
+
+def paged_flash_decode(q, k_pages, v_pages, page_table, lens,
+                       k_scale=None, v_scale=None, sm_scale=None):
+    """Paged GQA decode attention — Pallas kernel entry used by
+    paged_cache.paged_update_and_attend when the layer cache is built
+    with use_flash=True (the caller owns the gate via
+    paged_flash_available). Runs the kernel natively on TPU, in
+    interpret mode elsewhere so CPU tests/ladder rungs execute the
+    identical kernel."""
+    from .pallas.flash_decode import paged_flash_decode as kernel
+    return kernel(q, k_pages, v_pages, page_table, lens,
+                  k_scale=k_scale, v_scale=v_scale, sm_scale=sm_scale,
+                  interpret=_platform() != "tpu")
+
+
 def reference_attention(q, k, v, causal=False, sm_scale=None, kv_lens=None,
                         dropout_p=0.0, dropout_seed=0):
     if sm_scale is None:

@@ -121,7 +121,10 @@ class TestDETR:
 
 class TestAuctionMatch:
     def test_matches_scipy_optimum(self):
-        scipy_opt = pytest.importorskip("scipy.optimize")
+        scipy_opt = pytest.importorskip(
+            "scipy.optimize", reason="environmental gate: scipy is an "
+            "optional dependency — linear_sum_assignment is only the "
+            "REFERENCE optimum the auction matcher is checked against")
         rng = np.random.default_rng(0)
         for trial in range(10):
             q, m = 16, 5

@@ -1,0 +1,130 @@
+"""Paged GQA flash-decode kernel (ops/pallas/flash_decode.py) vs the
+jnp dense reference (nlp/paged_cache.paged_attention_ref), interpret
+mode — the identical kernel/lowering path the TPU runs.
+
+Covers: MHA and GQA head groupings, fp32/bf16/int8 cache dtypes (int8
+with per-token f32 scale sidecars), ragged per-slot lengths including
+zero (inactive slot -> zero row), trash-page routing, and the
+write-path helpers the serving engine builds on.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nlp import paged_cache as pc
+from paddle_tpu.ops.pallas.flash_decode import paged_flash_decode
+
+
+def _case(b=3, hkv=2, g=2, d=64, ps=16, p=9, mp=4, seed=0, dtype="float32"):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, hkv, g, d)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((hkv, p, ps, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((hkv, p, ps, d)), jnp.float32)
+    pt = jnp.asarray(rng.integers(1, p, (b, mp)), jnp.int32)
+    lens = jnp.asarray(rng.integers(0, mp * ps + 1, (b,)), jnp.int32)
+    if dtype == "int8":
+        kq, ks = pc.quantize_rows(kp)
+        vq, vs = pc.quantize_rows(vp)
+        return q, kq, vq, pt, lens, ks, vs
+    dt = jnp.dtype(dtype)
+    return q, kp.astype(dt), vp.astype(dt), pt, lens, None, None
+
+
+def _both(q, kp, vp, pt, lens, ks, vs):
+    ref = pc.paged_attention_ref(q, kp, vp, pt, lens,
+                                 k_scale=ks, v_scale=vs)
+    out = paged_flash_decode(q, kp, vp, pt, lens, k_scale=ks,
+                             v_scale=vs, interpret=True)
+    return np.asarray(ref, np.float32), np.asarray(out, np.float32)
+
+
+class TestKernelVsReference:
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+    def test_gqa(self, dtype):
+        ref, out = _both(*_case(dtype=dtype))
+        assert np.allclose(ref, out, atol=3e-5), \
+            np.abs(ref - out).max()
+
+    def test_mha_groups_1(self):
+        ref, out = _both(*_case(hkv=4, g=1, seed=2))
+        assert np.allclose(ref, out, atol=3e-5)
+
+    def test_wide_group_pads_sublanes(self):
+        # G=12 > the 8-sublane minimum: exercises the pad/unpad path
+        ref, out = _both(*_case(hkv=1, g=12, seed=3))
+        assert np.allclose(ref, out, atol=3e-5)
+
+    def test_zero_len_slot_is_zero_row(self):
+        q, kp, vp, pt, lens, ks, vs = _case(seed=4)
+        lens = lens.at[1].set(0)
+        ref, out = _both(q, kp, vp, pt, lens, ks, vs)
+        assert np.allclose(out[1], 0.0)
+        assert np.allclose(ref, out, atol=3e-5)
+
+    def test_single_token_history(self):
+        q, kp, vp, pt, lens, ks, vs = _case(seed=5)
+        lens = jnp.ones_like(lens)
+        ref, out = _both(q, kp, vp, pt, lens, ks, vs)
+        assert np.allclose(ref, out, atol=3e-5)
+
+    def test_trash_table_rows_ignored(self):
+        """Entries past a slot's allocation point at the trash page —
+        masked by lens, they must not perturb the output."""
+        q, kp, vp, pt, lens, ks, vs = _case(seed=6)
+        lens = jnp.asarray([10, 20, 16], jnp.int32)  # <= 2 pages each
+        pt_trash = pt.at[:, 2:].set(pc.TRASH_PAGE)
+        ref, out = _both(q, kp, vp, pt_trash, lens, ks, vs)
+        ref2, out2 = _both(q, kp, vp, pt, lens, ks, vs)
+        assert np.allclose(out, out2, atol=3e-5)
+        assert np.allclose(ref, out, atol=3e-5)
+        assert np.allclose(ref, ref2, atol=3e-5)
+
+
+class TestWritePath:
+    def test_token_write_lands_at_position(self):
+        hkv, d, ps, p, b, mp = 2, 8, 8, 5, 2, 3
+        kp, vp, ks, vs = pc.alloc_pages(p, ps, hkv, d, "float32")
+        pt = np.array([[1, 2, 0], [3, 4, 0]], np.int32)
+        pos = jnp.asarray([3, 9], jnp.int32)   # page 0-row 3 / page 1-row 1
+        cache = pc.PagedLayerCache(kp, vp, jnp.asarray(pt), pos)
+        k_new = jnp.arange(b * hkv * d, dtype=jnp.float32).reshape(
+            b, hkv, d)
+        kp2, vp2, _, _ = pc.write_token_kv(cache, k_new, k_new + 1.0,
+                                           jnp.ones((b,), bool))
+        np.testing.assert_allclose(np.asarray(kp2[:, 1, 3]),
+                                   np.asarray(k_new[0]).swapaxes(0, 0))
+        np.testing.assert_allclose(np.asarray(kp2[:, 4, 1]),
+                                   np.asarray(k_new[1]))
+        np.testing.assert_allclose(np.asarray(vp2[:, 4, 1]),
+                                   np.asarray(k_new[1]) + 1.0)
+
+    def test_prompt_write_blocks(self):
+        hkv, d, ps = 1, 4, 8
+        kp, vp, _, _ = pc.alloc_pages(4, ps, hkv, d, "float32")
+        s_b = 16
+        k_full = jnp.arange(s_b * hkv * d, dtype=jnp.float32).reshape(
+            1, s_b, hkv, d)
+        pages_vec = jnp.asarray([2, 3], jnp.int32)
+        kp2, vp2, _, _ = pc.write_prompt_kv(kp, vp, None, None, k_full,
+                                            k_full, pages_vec)
+        got = np.asarray(kp2[0, 2])            # first page, head 0
+        want = np.asarray(k_full[0, :ps, 0])
+        np.testing.assert_allclose(got, want)
+        got2 = np.asarray(kp2[0, 3])
+        np.testing.assert_allclose(got2, np.asarray(k_full[0, ps:, 0]))
+
+    def test_int8_quantize_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((4, 3, 64)) * 5, jnp.float32)
+        q, s = pc.quantize_rows(x)
+        back = np.asarray(q, np.float32) * np.asarray(s)
+        err = np.abs(back - np.asarray(x)).max()
+        amax = np.abs(np.asarray(x)).max()
+        assert err <= amax / 127.0 * 0.51 + 1e-6
+
+    def test_quantize_zero_row_safe(self):
+        q, s = pc.quantize_rows(jnp.zeros((2, 3, 8)))
+        assert np.all(np.asarray(q) == 0)
+        assert np.all(np.isfinite(np.asarray(s)))

@@ -15,7 +15,10 @@ class TestDlpack:
         assert cap is not None
 
     def test_from_torch(self):
-        torch = pytest.importorskip("torch")
+        torch = pytest.importorskip(
+            "torch", reason="environmental gate: torch-cpu (baked into "
+            "the image) provides the producer side of the dlpack "
+            "exchange under test")
         from paddle_tpu.utils import from_dlpack
         t = torch.arange(12, dtype=torch.float32).reshape(3, 4)
         out = from_dlpack(t)

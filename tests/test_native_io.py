@@ -8,8 +8,11 @@ import pytest
 from paddle_tpu.io import native
 
 
-pytestmark = pytest.mark.skipif(not native.native_available(),
-                                reason="native lib unavailable (no g++)")
+pytestmark = pytest.mark.skipif(
+    not native.native_available(),
+    reason="environmental gate: csrc/libptio.so needs a host g++ to "
+           "build (io.native compiles it lazily); without a toolchain "
+           "the pure-python DataLoader fallback is the covered path")
 
 
 def test_queue_fifo_and_backpressure():

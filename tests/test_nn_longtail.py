@@ -7,7 +7,10 @@ import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 
-torch = pytest.importorskip("torch")
+torch = pytest.importorskip(
+    "torch",
+    reason="environmental gate: torch-cpu (baked into the image) is the "
+           "reference implementation these numerics pin against")
 
 
 def _np(t):

@@ -13,6 +13,13 @@ hung compile costs one child process — never the terminal:
 
 Run on the TPU terminal:  python tools/decode_probe.py
 Each stage prints PASS/FAIL(timeout) + seconds; results feed BENCHLOG.
+
+`--paged` runs the round-7 serving bisection instead: the paged GQA
+flash-decode kernel alone (AOT lower/compile/run + reference parity),
+then a small ServingEngine batch-1-vs-8 A/B with per-program compile
+counts and a steady-state zero-recompile assertion. On a dead tunnel
+both stages run on CPU, so the artifact still carries a machine-
+relative A/B row.
 """
 from __future__ import annotations
 
@@ -79,6 +86,84 @@ def probe_full():
     _generate_probe(use_flash=True)
 
 
+@stage("paged_kernel")
+def probe_paged_kernel():
+    """Paged GQA flash-decode kernel alone (ops/pallas/flash_decode):
+    AOT lower + compile + run against the jnp paged reference. The
+    serving analogue of the 'kernel' stage — proves the Mosaic compile
+    in a killable child before bench_serve_flashk arms it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.nlp.paged_cache import paged_attention_ref
+    from paddle_tpu.ops.pallas.flash_decode import paged_flash_decode
+    b, hkv, g, d, ps, p, mp = 8, 4, 4, 64, 128, 33, 4
+    interp = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, hkv, g, d)), jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((hkv, p, ps, d)), jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((hkv, p, ps, d)), jnp.bfloat16)
+    pt = jnp.asarray(rng.integers(1, p, (b, mp)), jnp.int32)
+    lens = jnp.asarray(rng.integers(1, mp * ps, (b,)), jnp.int32)
+    t0 = time.perf_counter()
+    lowered = jax.jit(lambda *a: paged_flash_decode(
+        *a, interpret=interp)).lower(q, kp, vp, pt, lens)
+    print(f"lowered in {time.perf_counter()-t0:.1f}s", flush=True)
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    print(f"compiled in {time.perf_counter()-t0:.1f}s", flush=True)
+    t0 = time.perf_counter()
+    out = compiled(q, kp, vp, pt, lens)
+    s0 = float(jnp.sum(out.astype(jnp.float32)))
+    print(f"ran in {time.perf_counter()-t0:.1f}s sum={s0}", flush=True)
+    ref = paged_attention_ref(q, kp, vp, pt, lens)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    print(f"max |kernel - ref| = {err:.2e}", flush=True)
+    assert err < 5e-2, "paged kernel diverges from the jnp reference"
+
+
+@stage("paged_serve")
+def probe_paged_serve():
+    """ServingEngine smoke: batch-1 vs batch-8 steady-state decode
+    tok/s + per-program compile counts. On a dead tunnel this runs on
+    CPU, so the bisection still yields a machine-relative A/B row
+    instead of nothing."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+    from paddle_tpu.nlp.serving import ServingEngine
+    on_tpu = jax.default_backend() == "tpu"
+    paddle.seed(0)
+    cfg = "gpt2-en" if on_tpu else "gpt-tiny"
+    model = GPTForCausalLM(_resolve_config(
+        cfg, hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0))
+    model.eval()
+    rng = np.random.default_rng(0)
+    vocab = model.config.vocab_size
+    new_tok = 32 if on_tpu else 8
+    rows = {}
+    for batch in (1, 8):
+        eng = ServingEngine(model, max_slots=batch, page_size=16,
+                            max_seq_len=64, steps_per_dispatch=4)
+        prompts = [rng.integers(0, vocab, (12,)) for _ in range(batch)]
+        eng.generate(prompts, max_new_tokens=new_tok)   # warmup/compile
+        counts = eng.compile_counts()
+        eng.reset_counters()
+        eng.generate([rng.integers(0, vocab, (12,))
+                      for _ in range(2 * batch)], max_new_tokens=new_tok)
+        assert eng.compile_counts() == counts, (
+            "steady-state recompile", counts, eng.compile_counts())
+        tok_s = eng.decode_tokens / max(eng.decode_seconds, 1e-9)
+        rows[batch] = round(tok_s, 1)
+        print(f"batch {batch}: {rows[batch]} tok/s decode "
+              f"(compiles {counts}, steady recompiles 0)", flush=True)
+    print(json.dumps({"paged_serve": rows,
+                      "b8_vs_b1": round(rows[8] / rows[1], 2),
+                      "backend": jax.default_backend()}), flush=True)
+
+
 def _generate_probe(use_flash):
     import jax
     import jax.numpy as jnp
@@ -129,7 +214,12 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         run_stage_child(sys.argv[2])
         return
-    order = sys.argv[1:] or ["kernel", "scan_noflash", "full"]
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--paged":
+        # the serving-path bisection: kernel first (the piece that can
+        # wedge a terminal), then the engine with compile counts
+        argv = argv[1:] or ["paged_kernel", "paged_serve"]
+    order = argv or ["kernel", "scan_noflash", "full"]
     results = {}
     for name in order:
         print(f"=== stage {name} (timeout {STAGE_TIMEOUT}s) ===", flush=True)

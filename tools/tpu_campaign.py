@@ -120,7 +120,20 @@ STAGES = [
                                "--layout", "nhwc", "--s2d"], 2400, {}),
     ("bench_gpt_bf16m", [PY, "bench.py", "--model", "gpt",
                          "--moment-dtype", "bfloat16"], 2400, {}),
+    # continuous-batching serving ladder (nlp/serving.py): batch x
+    # cache-dtype cross product, zero-recompile asserted per rung.
+    # Hardware flash rungs stay gated until decode_probe --paged
+    # proves the paged kernel (bench_serve_flashk below arms it).
+    ("bench_serve_gpt", [PY, "bench.py", "--serve"], 3600, {}),
+    ("bench_serve_llama", [PY, "bench.py", "--serve", "--serve-model",
+                           "llama"], 3600, {}),
+    # llama pretrain: the GQA flagship's first-ever training number
+    ("bench_llama", [PY, "bench.py", "--model", "llama"], 2400, {}),
     ("decode_probe", [PY, "tools/decode_probe.py"], 2400, {}),
+    # paged-path bisection: GQA kernel alone, then the serving engine
+    # with per-rung compile counts (killable children — r2 lesson)
+    ("decode_probe_paged", [PY, "tools/decode_probe.py", "--paged"],
+     2400, {}),
     ("bench_decode", [PY, "bench.py", "--decode"], 2400, {}),
     ("bench_decode_bf16kv", [PY, "bench.py", "--decode",
                              "--cache-dtype", "bfloat16"], 2400, {}),
@@ -139,6 +152,12 @@ STAGES = [
     ("bench_decode_flashk", [PY, "bench.py", "--decode", "--cache-dtype",
                              "bfloat16"], 2400,
      {"PADDLE_TPU_FLASH_DECODE": "1"}),
+    # flash rungs of the serving ladder with the paged Pallas kernel
+    # armed (run AFTER decode_probe_paged passes — same caution as
+    # bench_decode_flashk); --flash-only skips the ref rungs
+    # bench_serve_gpt already measured
+    ("bench_serve_flashk", [PY, "bench.py", "--serve", "--flash-only"],
+     3600, {"PADDLE_TPU_FLASH_DECODE": "1"}),
     ("fusion_audit", [PY, "tools/fusion_audit.py", "--out",
                       "campaign_out/fusion_audit.md"], 3600, {}),
     ("fusion_audit_nhwc", [PY, "tools/fusion_audit.py", "--model",
@@ -214,7 +233,8 @@ STAGES = [
 # (bench_full's workload list already includes gpt-1.3b — running the
 # standalone stage too would duplicate up to 2400s on a fragile tunnel)
 RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
-              "bench_decode_flashk", "bench_gpt_fusedqkv",
+              "bench_decode_flashk", "bench_serve_flashk",
+              "bench_gpt_fusedqkv",
               "bench_ernie_fusedqkv", "step_anatomy", "step_anatomy_fused",
               "bench_gpt_s4k", "pipeline_overhead", "bench_gpt_fusedln",
               "bench_gpt_fusedboth", "bench_ernie_fusedln", "bench_resnet_serve",

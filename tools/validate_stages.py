@@ -23,6 +23,16 @@ from tpu_campaign import REPO, STAGES  # noqa: E402
 _BUDGET_S = 120
 _INSTANT_S = 3.0  # a real stage spends longer than this just importing
 
+# stages the current round's measurement plan depends on: a rename or
+# accidental drop in tpu_campaign.STAGES must fail preflight loudly,
+# not surface as tunnel_watch silently skipping "unknown" stages
+REQUIRED_STAGES = {
+    "probe", "bench_full", "bench_gpt13b_scan_cce",
+    # round-7 serving + llama rungs
+    "bench_serve_gpt", "bench_serve_llama", "bench_serve_flashk",
+    "bench_llama", "decode_probe_paged",
+}
+
 
 def _child_pgids(pid):
     """Process groups of `pid`'s direct children: bench.py/decode_probe
@@ -70,6 +80,10 @@ def _run_stage(cmd, env):
 
 
 def main():
+    missing = REQUIRED_STAGES - {s[0] for s in STAGES}
+    if missing:
+        print(f"MISSING REQUIRED STAGES: {sorted(missing)}")
+        return 1
     tmp = tempfile.mkdtemp(prefix="stage_preflight_")
     env = dict(os.environ)
     env.update({"BENCH_PROBE_TIMEOUT": "5", "BENCH_WORK_TIMEOUT": "5",
