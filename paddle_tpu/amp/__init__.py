@@ -106,6 +106,30 @@ class GradScaler:
         self._good = 0
         self._bad = 0
         self._found_inf = False
+        # lifetime observability counters (mirrors
+        # criterion.last_mlm_overflow from PR 1): how many steps saw
+        # non-finite grads / were skipped. Surfaced in hapi fit() logs
+        # when the scaler rides a resilience.TrainGuard, and bumped by
+        # the eager unscale_/step paths too.
+        self._found_inf_count = 0
+        self._skip_count = 0
+
+    @property
+    def found_inf_count(self):
+        """Steps that observed a non-finite loss/grad (lifetime)."""
+        return self._found_inf_count
+
+    @property
+    def skip_count(self):
+        """Optimizer updates skipped because of non-finite grads."""
+        return self._skip_count
+
+    def note_step(self, found_inf):
+        """Record one guarded-step outcome (called by TrainGuard; the
+        dynamic-scale arithmetic itself runs in-step functionally)."""
+        if found_inf:
+            self._found_inf_count += 1
+            self._skip_count += 1
 
     def is_enable(self):
         return self._enable
@@ -133,6 +157,12 @@ class GradScaler:
                 found = found or not finite
                 p._grad_value = g
         self._found_inf = found
+        # latch so a following step() does NOT unscale again — the
+        # explicit unscale_-then-clip-then-step pattern must divide by
+        # the scale exactly once
+        self._unscaled = True
+        if found:
+            self._found_inf_count += 1
 
     def step(self, optimizer):
         if not self._enable:
@@ -142,6 +172,8 @@ class GradScaler:
             self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
+        else:
+            self._skip_count += 1
         self._unscaled = False
 
     def update(self):
@@ -180,6 +212,9 @@ class GradScaler:
         self._found_inf = found
         if not found:
             optimizer.step()
+        else:
+            self._found_inf_count += 1
+            self._skip_count += 1
 
     # -- functional core for the jitted path --------------------------------
     @staticmethod
@@ -203,12 +238,16 @@ class GradScaler:
     def state_dict(self):
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio, "good": self._good,
-                "bad": self._bad}
+                "bad": self._bad,
+                "found_inf_count": self._found_inf_count,
+                "skip_count": self._skip_count}
 
     def load_state_dict(self, state):
         self._scale = state["scale"]
         self._good = state.get("good", 0)
         self._bad = state.get("bad", 0)
+        self._found_inf_count = state.get("found_inf_count", 0)
+        self._skip_count = state.get("skip_count", 0)
 
 
 from . import debugging  # noqa: F401,E402

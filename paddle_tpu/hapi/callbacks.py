@@ -9,7 +9,7 @@ import numpy as np
 
 __all__ = ["WandbCallback", "Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "LRScheduler", "EarlyStopping", "VisualDL", "ReduceLROnPlateau",
-           "config_callbacks"]
+           "PreemptionCheckpoint", "config_callbacks"]
 
 
 class Callback:
@@ -286,6 +286,77 @@ class ReduceLROnPlateau(Callback):
                     opt._lr = max(float(opt._lr) * self.factor, self.min_lr)
                 self.cool = self.cooldown
                 self.wait = 0
+
+
+class PreemptionCheckpoint(Callback):
+    """Preemption-safe checkpointing (resilience subsystem).
+
+    Installs SIGTERM/SIGINT handlers on train begin; when a signal
+    lands, the NEXT batch boundary writes a full training-state
+    checkpoint (params + optimizer moments + update counters + LR
+    schedule + scaler) through a CheckpointManager — whose COMPLETE-
+    marker finalize makes the write crash-safe — then stops fit
+    cleanly. Resume with `resilience.preemption.restore_training_state
+    (model, manager)` before the next fit: loss-exact continuation.
+
+    every_n_steps > 0 also writes periodic checkpoints at that engine-
+    step cadence, so an un-graceful kill (SIGKILL, node loss) costs at
+    most that window.
+    """
+
+    def __init__(self, manager, every_n_steps=0, install_handlers=True,
+                 metric_key=None):
+        super().__init__()
+        self.manager = manager
+        self.every_n_steps = int(every_n_steps)
+        self.install_handlers = install_handlers
+        self.metric_key = metric_key
+        self.preempted = False
+        self.saved_step = None
+
+    def _metric(self, logs):
+        v = (logs or {}).get(self.metric_key) if self.metric_key else None
+        if isinstance(v, (list, tuple)):
+            v = v[0] if v else None
+        return float(v) if isinstance(v, numbers.Number) else None
+
+    def _save(self, logs):
+        from ..resilience.preemption import save_training_state
+        self.saved_step = save_training_state(
+            self.model, self.manager, metric=self._metric(logs))
+        return self.saved_step
+
+    def on_train_begin(self, logs=None):
+        # a reused callback object (resumed fit in the same process)
+        # must be able to checkpoint a SECOND preemption
+        self.preempted = False
+        self.saved_step = None
+        if self.install_handlers:
+            from ..resilience import preemption
+            preemption.install()
+
+    def on_train_batch_end(self, step, logs=None):
+        from ..resilience import preemption
+        eng = self.model._engine
+        if (self.every_n_steps and eng is not None
+                and eng._step % self.every_n_steps == 0):
+            self._save(logs)
+        if preemption.requested() and not self.preempted:
+            self.preempted = True
+            self._save(logs)
+            self.manager.wait()  # the checkpoint MUST be on disk and
+            #                      finalized before fit returns — the
+            #                      grace window may be nearly spent
+            self.model.stop_training = True
+
+    def on_train_end(self, logs=None):
+        # a signal that landed after the last batch boundary (eval,
+        # epoch end) still gets its checkpoint
+        from ..resilience import preemption
+        if preemption.requested() and not self.preempted:
+            self.preempted = True
+            self._save(logs)
+        self.manager.wait()
 
 
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,

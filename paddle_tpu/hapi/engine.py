@@ -33,7 +33,7 @@ def _unwrap(x):
 class Engine:
     def __init__(self, network: Layer, loss=None, optimizer=None,
                  metrics=None, amp_dtype=None, mesh=None,
-                 donate_params=True):
+                 donate_params=True, guard=None):
         self.network = network
         self.loss = loss
         self.optimizer = optimizer
@@ -44,6 +44,13 @@ class Engine:
         self.amp_dtype = amp_dtype
         self.mesh = mesh
         self.donate = donate_params
+        # resilience.TrainGuard: when set, train_batch compiles the
+        # guarded step variant (fused all-finite check, masked update,
+        # optional in-step GradScaler state) — see _build_guarded_fn.
+        # A property: assigning engine.guard (attach OR detach) drops
+        # the compiled step, whose signature depends on guard presence
+        self._guard = guard
+        self._scaler_state = None
         self._params, self._buffers = network.raw_state()
         self._opt_state = None
         self._step = 0
@@ -151,7 +158,117 @@ class Engine:
             return l_arr.astype(jnp.float32), (_unwrap(outs), new_buf)
         return loss_fn
 
+    @property
+    def guard(self):
+        return self._guard
+
+    @guard.setter
+    def guard(self, g):
+        # the guarded and plain steps have different signatures; a
+        # stale executable from the other mode would mis-bind args.
+        # The scaler state belongs to the outgoing guard's scaler —
+        # a new guard's scaler re-initializes from ITS init scale
+        self._guard = g
+        self._train_fn = None
+        self._multi_fns = {}
+        self._scaler_state = None
+
+    def attach_guard(self, guard):
+        """Attach (or with None, detach) a resilience.TrainGuard: the
+        next train_batch builds the matching step variant."""
+        self.guard = guard
+        return guard
+
+    def _build_guarded_fn(self):
+        """Guarded train step (resilience.TrainGuard's compiled half).
+
+        Same single-dispatch structure as _build_train_fn plus, fused
+        into the SAME XLA program (the finite-checks are reductions
+        over tensors the step already produced — no extra launch):
+
+        - `fault_scale` scalar multiplied into the loss pre-autodiff
+          (1.0 normally; the nan_grads injector passes NaN, poisoning
+          loss and every grad at once);
+        - an all-finite flag over loss + every gradient leaf;
+        - param/buffer/optimizer updates MASKED by that flag — a bad
+          step is a perfect no-op on model state (the host also skips
+          the opt_step increment, so Adam bias correction and the
+          GradScaler never see skipped steps);
+        - optional GradScaler state threaded through: loss scaled
+          pre-grad, grads unscaled pre-check, dynamic scale updated
+          from the found-inf flag (functional_update).
+        """
+        network = self.network
+        loss_layer = self.loss
+        opt = self.optimizer
+        clip = getattr(opt, "_grad_clip", None)
+        amp_dt = self.amp_dtype
+        trainable_keys = self._trainable_keys()
+        grad_shardings = self._grad_shardings(trainable_keys)
+        make_loss_fn = self._make_loss_fn
+        scaler = self.guard.scaler if self.guard is not None else None
+        use_scaler = scaler is not None
+        if use_scaler:
+            from ..amp import GradScaler as _GS
+            s_incr, s_decr = scaler._incr_ratio, scaler._decr_ratio
+            s_incr_n, s_decr_n = scaler._incr_every, scaler._decr_every
+
+        def train_step(params, buffers, opt_state, scaler_state, lr,
+                       step_i, opt_step_i, rng, fault_scale, inputs,
+                       labels):
+            rng = jax.random.fold_in(rng, step_i)
+            frozen = {k: v for k, v in params.items()
+                      if k not in trainable_keys}
+            live = {k: v for k, v in params.items() if k in trainable_keys}
+            loss_fn = make_loss_fn(network, loss_layer, amp_dt, frozen,
+                                   buffers, inputs, labels, rng)
+
+            def guarded_loss(p):
+                l, (outs, new_buf) = loss_fn(p)
+                l = l * fault_scale
+                ls = l * scaler_state["scale"] if use_scaler else l
+                return ls, (l, outs, new_buf)
+
+            (_, (loss_v, outs, new_buf)), grads = jax.value_and_grad(
+                guarded_loss, has_aux=True)(live)
+            if use_scaler:
+                inv = 1.0 / scaler_state["scale"]
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+            if grad_shardings is not None:
+                grads = jax.lax.with_sharding_constraint(
+                    grads, grad_shardings)
+            ok = jnp.isfinite(loss_v)
+            for g in jax.tree_util.tree_leaves(grads):
+                ok = ok & jnp.all(jnp.isfinite(g))
+            if clip is not None:
+                grads = clip.apply(grads)
+            new_live, new_opt = opt.update(live, grads, opt_state,
+                                           lr, opt_step_i)
+
+            def mask(new, old):
+                # elementwise select, NOT arithmetic: NaNs in the
+                # discarded branch must not propagate
+                return jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(ok, n, o)
+                    if hasattr(n, "dtype") else n, new, old)
+
+            new_live = mask(new_live, live)
+            new_opt = mask(new_opt, opt_state)
+            new_buf = mask(new_buf, buffers)
+            if use_scaler:
+                scaler_state = _GS.functional_update(
+                    scaler_state, ~ok, incr_ratio=s_incr,
+                    decr_ratio=s_decr, incr_every=s_incr_n,
+                    decr_every=s_decr_n)
+            return ({**frozen, **new_live}, new_buf, new_opt,
+                    scaler_state, loss_v, ok, outs)
+
+        donate = (0, 1, 2) if self.donate else ()
+        return jax.jit(train_step, donate_argnums=donate)
+
     def _build_train_fn(self):
+        if self.guard is not None:
+            return self._build_guarded_fn()
         network = self.network
         loss_layer = self.loss
         opt = self.optimizer
@@ -276,6 +393,13 @@ class Engine:
         """One microbatch of gradient accumulation; pass
         apply_update=True on the last microbatch to run the optimizer on
         the averaged gradients. Returns (loss, outs, applied)."""
+        if self.guard is not None:
+            raise ValueError(
+                "TrainGuard covers the fused train_batch path only — "
+                "gradient accumulation splits the step into two "
+                "programs and a half-guarded window would mask grads "
+                "but not the accumulator. Detach (engine.guard = None)"
+                " or use accumulate_grad_batches=1.")
         if self.network.training is False:
             self.network.train()
         self._ensure_opt_state()
@@ -375,6 +499,8 @@ class Engine:
 
     def train_batch(self, inputs, labels):
         """One optimizer step. inputs/labels: lists of Tensors/arrays."""
+        if self.guard is not None:
+            return self._train_batch_guarded(inputs, labels)
         if self.network.training is False:
             self.network.train()
         self._ensure_opt_state()
@@ -405,6 +531,67 @@ class Engine:
             self.network.load_raw_state(self._params, self._buffers)
         return loss_v, outs
 
+    def _train_batch_guarded(self, inputs, labels):
+        """train_batch through the TrainGuard: guarded step dispatch
+        with transient-error retry, host-synced finite flag, skip/
+        snapshot/rollback bookkeeping. Returns (loss, outs) like
+        train_batch — on a skipped step the loss is the (non-finite)
+        observed value and model state is unchanged."""
+        from ..resilience import faults
+        from ..resilience.retry import call_with_retries
+        guard = self.guard
+        if self.network.training is False:
+            self.network.train()
+        self._ensure_opt_state()
+        if self._micro_count:
+            self.flush_accum()
+        if self._train_fn is None:
+            self._train_fn = self._build_train_fn()
+        if guard.scaler is not None and self._scaler_state is None:
+            from ..amp import GradScaler
+            self._scaler_state = GradScaler.functional_init(
+                guard.scaler._scale)
+        guard.before_first_step(self)
+        in_arrs = self._shard_batch(_unwrap(list(inputs)))
+        lab_arrs = self._shard_batch(_unwrap(list(labels)))
+        lr = np.float32(self._lr_now())
+        self._step += 1
+        step = self._step
+        # injection seams: NaN-poison scalar rides the stable step
+        # signature (no recompile); slow/dispatch faults drill the
+        # watchdog + retry paths
+        fault_scale = np.float32(faults.nan_scale(step))
+        faults.maybe_sleep("slow_step", step)
+
+        def dispatch():
+            # injected transients fire BEFORE the execute call, so a
+            # retry re-submits un-consumed (un-donated) buffers
+            faults.maybe_raise("dispatch_error", step)
+            return self._train_fn(
+                self._params, self._buffers, self._opt_state,
+                self._scaler_state, lr, np.int32(step),
+                np.int32(self._opt_step + 1), self._rng_key,
+                fault_scale, in_arrs, lab_arrs)
+
+        from ..resilience.retry import retryable_for
+        (self._params, self._buffers, self._opt_state,
+         self._scaler_state, loss_v, ok_flag,
+         outs) = call_with_retries(
+            dispatch, retries=guard.retries,
+            retryable=retryable_for(self.donate),
+            base_delay=guard.retry_base_delay, stats=guard.retry_stats)
+        # ONE host sync for the flag (Model.train_batch syncs the loss
+        # anyway); the tentative opt_step+1 the step saw is only
+        # committed on a good step, so skips never advance Adam's bias
+        # correction
+        ok = bool(np.asarray(ok_flag))
+        if ok:
+            self._opt_step += 1
+        if self.donate:
+            self.network.load_raw_state(self._params, self._buffers)
+        guard.after_step(self, ok)
+        return loss_v, outs
+
     def train_batch_multi(self, inputs, labels, lr_values=None):
         """Run K optimizer steps in ONE device dispatch: inputs/labels
         are lists of STACKED arrays [K, batch, ...] and the K steps run
@@ -421,6 +608,13 @@ class Engine:
         Returns (losses [K], None) — per-step model outputs are not
         materialized (that would double-compute the last forward); use
         train_batch when outputs/metrics are needed."""
+        if self.guard is not None:
+            raise ValueError(
+                "TrainGuard and train_batch_multi are mutually "
+                "exclusive: the guarded step's signature (fault scalar,"
+                " scaler state, finite flag) does not fit the K-step "
+                "scan closure. Use train_batch, or detach the guard "
+                "(engine.guard = None).")
         if self.network.training is False:
             self.network.train()
         self._ensure_opt_state()
@@ -548,6 +742,11 @@ class Engine:
         # fused path kept it == step
         self._opt_step = d.get("opt_step", d["step"])
         self.reset_accum_window()
+        if self.guard is not None:
+            # snapshots taken before the restore are now the WRONG
+            # last-good state — a rollback must never resurrect them;
+            # the ring reseeds from the restored state on first step
+            self.guard.ring.clear()
         # resume path: re-apply ZeRO placement and rebuild the compiled
         # programs so baked-in grad constraints / frozen-param constants
         # match the (re)placed params — the accumulation programs bake

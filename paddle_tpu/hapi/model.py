@@ -8,6 +8,7 @@ import numpy as np
 
 from ..metric import Metric
 from ..nn.layer import Layer
+from ..resilience import faults, preemption
 from ..serialization import load as _load
 from ..serialization import save as _save
 from ..tensor import Tensor
@@ -38,7 +39,10 @@ class Model:
 
     # ------------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, guard=None):
+        """guard: optional resilience.TrainGuard — compiles the NaN/
+        inf-guarded train step (skip + snapshot/rollback semantics,
+        docs/robustness.md) instead of the plain one."""
         self._optimizer = optimizer
         self._loss = loss
         ms = _to_list(metrics)
@@ -58,7 +62,7 @@ class Model:
         self._engine = Engine(self.network, loss=self._loss,
                               optimizer=self._optimizer,
                               metrics=self._metrics, amp_dtype=amp_np,
-                              mesh=self._mesh)
+                              mesh=self._mesh, guard=guard)
 
     def _ensure_engine(self):
         if self._engine is None:
@@ -72,7 +76,12 @@ class Model:
         loss_v, outs = eng.train_batch(_to_list(inputs), _to_list(labels))
         metrics_out = self._update_metrics(outs, labels)
         # advance lr scheduler per-step like the reference's hapi loop
-        self._lr_step_after_update()
+        # — except on a guard-SKIPPED step, where no update was applied
+        # and the schedule position must track opt_step
+        if eng.guard is None or eng.guard.last_outcome == "ok":
+            self._lr_step_after_update()
+            if eng.guard is not None:
+                eng.guard.note_lr_stepped(eng)
         loss = float(np.asarray(loss_v))
         return ([loss], metrics_out) if metrics_out else [loss]
 
@@ -191,9 +200,21 @@ class Model:
                 else:
                     out = self.train_batch(ins, labs)
                 logs = self._make_logs(out)
+                if eng.guard is not None:
+                    # skip/rollback/found-inf counters ride the batch
+                    # logs (ProgBar prints them, VisualDL persists)
+                    logs.update(eng.guard.log_scalars())
                 logs["batch_size"] = len(np.asarray(ins[0]._value)) \
                     if isinstance(ins[0], Tensor) else batch_size
+                # resilience seams, host step boundary: the sigterm
+                # injector delivers the signal BEFORE on_batch_end so a
+                # PreemptionCheckpoint callback observes the flag at
+                # this same boundary and checkpoints; the post-callback
+                # check then ends fit cleanly either way
+                faults.maybe_sigterm(eng._step)
                 cbks.on_batch_end("train", step, logs)
+                if preemption.requested():
+                    self.stop_training = True
                 if self.stop_training:
                     break
             if accumulate_grad_batches > 1:
@@ -203,6 +224,11 @@ class Model:
                 if eng.flush_accum():
                     self._lr_step_after_update()
             cbks.on_epoch_end(epoch, logs)
+            if preemption.requested():
+                # the SIGTERM grace window is for the checkpoint (the
+                # PreemptionCheckpoint callback already wrote it), not
+                # for an eval pass over the whole eval set
+                break
             if eval_loader is not None and (epoch % eval_freq == 0
                                             or epoch == epochs - 1):
                 eval_logs = self.evaluate(eval_loader, verbose=0,
@@ -213,6 +239,13 @@ class Model:
                 break
         cbks.on_end("train", logs)
         self._sync_weights_back()
+        if preemption.requested():
+            # the flag has been SERVICED: this fit stopped for it and
+            # every checkpoint callback (incl. on_train_end) has run.
+            # Left set, the process-global flag would kill any later
+            # fit in this process after one batch. Supervisors should
+            # read PreemptionCheckpoint.preempted, not the raw flag.
+            preemption.clear()
         return self
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

@@ -7,6 +7,11 @@ supports exact resume (params + opt state + lr + scaler + rng). Here:
 - CheckpointManager: save(step, state) with an async background thread
   (train loop never blocks on disk), keep_max rolling retention +
   best-metric pinning, latest()/best() lookup, exact-resume payloads.
+- Crash-safe finalize (docs/robustness.md): every save ends by writing
+  a COMPLETE marker after the payload's atomic rename; latest()/best()/
+  restore() consider only finalized dirs and fall back past corrupt
+  ones, so a preemption or crash at ANY byte of a save costs that save,
+  never the ability to restore an older one.
 - Backend: sharded=True routes every jax.Array leaf through orbax
   (per-shard tensorstore writes driven by the array's NamedSharding — the
   full tree is NEVER gathered to one host; on a pod each host writes only
@@ -133,9 +138,19 @@ class CheckpointManager:
     def _load_index(self):
         try:
             with open(self._index_path()) as f:
-                return json.load(f)
+                idx = json.load(f)
         except (OSError, json.JSONDecodeError):
-            return {"steps": [], "best_step": None, "best_metric": None}
+            return {"steps": [], "best_step": None, "best_metric": None,
+                    "format": 2, "legacy_steps": []}
+        if "format" not in idx:
+            # index written before the COMPLETE-marker format: those
+            # steps were finalized by the old atomic-rename contract,
+            # so grandfather them — an upgrade must not silently turn
+            # every existing checkpoint unrestorable
+            idx["legacy_steps"] = list(idx.get("steps", []))
+            idx["format"] = 2
+        idx.setdefault("legacy_steps", [])
+        return idx
 
     def _write_index(self):
         tmp = self._index_path() + ".tmp"
@@ -207,6 +222,23 @@ class CheckpointManager:
         if os.path.exists(d):
             shutil.rmtree(d)
         os.replace(tmp, d)
+        # crash-safe finalize: the COMPLETE marker lands strictly AFTER
+        # the payload rename. A crash (or preemption deadline) anywhere
+        # in _write leaves either no step dir, or a dir without the
+        # marker — and restore/latest skip unmarked dirs instead of
+        # loading a torn state file. The torn_ckpt injector simulates
+        # exactly that crash: payload truncated, marker suppressed.
+        from ..resilience import faults as _faults
+        torn = _faults.pull("torn_ckpt", step)
+        if torn is not None:
+            state_file = os.path.join(
+                d, "skeleton.pd" if self.sharded else "state.pdparams")
+            keep = int(torn.get("keep_bytes",
+                                os.path.getsize(state_file) // 2))
+            with open(state_file, "r+b") as f:
+                f.truncate(keep)
+        else:
+            self._finalize(d, step)
         with self._lock:
             idx = self._index
             if step not in idx["steps"]:
@@ -224,13 +256,22 @@ class CheckpointManager:
             self._write_index()
 
     def _gc(self):
+        # retention counts FINALIZED checkpoints only: an unfinalized
+        # (torn/crashed) dir is garbage, and letting it occupy a
+        # keep_max slot could age out every restorable checkpoint —
+        # the exact crash-safety the marker exists to provide
         idx = self._index
-        keep = set(idx["steps"][-self.keep_max:])
-        if idx["best_step"] is not None:
+        final = [s for s in idx["steps"]
+                 if self._finalized_unlocked(s)]
+        keep = set(final[-self.keep_max:])
+        if idx["best_step"] is not None \
+                and self._finalized_unlocked(idx["best_step"]):
             keep.add(idx["best_step"])
         for s in list(idx["steps"]):
             if s not in keep:
                 idx["steps"].remove(s)
+                if s in idx.get("legacy_steps", ()):
+                    idx["legacy_steps"].remove(s)
                 shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
     def wait(self):
@@ -245,22 +286,64 @@ class CheckpointManager:
             self._error = None
             raise RuntimeError("async checkpoint save failed") from err
 
+    # -- finalize marker ---------------------------------------------------
+    _MARKER = "COMPLETE"
+
+    def _marker_path(self, d):
+        return os.path.join(d, self._MARKER)
+
+    def _finalize(self, d, step):
+        """Write the COMPLETE marker and make it durable. Only a dir
+        carrying this marker is eligible for latest()/best()/restore —
+        the contract that makes every save crash-safe."""
+        path = self._marker_path(d)
+        with open(path, "w") as f:
+            json.dump({"step": step, "time": time.time()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _finalized_unlocked(self, step):
+        return (os.path.exists(self._marker_path(self._step_dir(step)))
+                or step in self._index.get("legacy_steps", ()))
+
+    def is_finalized(self, step):
+        with self._lock:
+            return self._finalized_unlocked(step)
+
     # -- restore -----------------------------------------------------------
     def latest_step(self):
+        """Newest FINALIZED step (unfinalized/torn dirs — a crash mid-
+        save, a stale index entry — are skipped, not crashed on)."""
         with self._lock:
-            return self._index["steps"][-1] if self._index["steps"] else None
+            steps = list(self._index["steps"])
+        for s in reversed(steps):
+            if self.is_finalized(s):
+                return s
+        return None
 
     def best_step(self):
         with self._lock:
-            return self._index["best_step"]
+            s = self._index["best_step"]
+        return s if s is not None and self.is_finalized(s) else None
 
     def all_steps(self):
         with self._lock:
             return list(self._index["steps"])
 
+    def finalized_steps(self):
+        with self._lock:
+            steps = list(self._index["steps"])
+        return [s for s in steps if self.is_finalized(s)]
+
     def restore(self, step=None, best=False, target=None):
         """Load a snapshot (default: latest). Returns the saved pytree with
-        numpy leaves, or None when the directory is empty.
+        numpy leaves, or None when the directory holds nothing usable.
+
+        Resilience contract: with step=None, unfinalized dirs are never
+        candidates, and a finalized-but-unreadable one (bit rot, manual
+        tampering) is skipped with a warning, falling back to the next-
+        older finalized step. An EXPLICIT step= asks for that exact
+        payload, so its failures raise.
 
         sharded manager: `target` may be a pytree matching the saved state
         whose array leaves are jax.ShapeDtypeStruct(shape, dtype,
@@ -272,12 +355,29 @@ class CheckpointManager:
             step = self.best_step()
             if step is None:
                 raise ValueError(
-                    "restore(best=True) but no checkpoint was saved with a "
-                    "metric - pass metric= to save(), or restore latest")
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            return None
+                    "restore(best=True) but no finalized checkpoint was "
+                    "saved with a metric - pass metric= to save(), or "
+                    "restore latest")
+        if step is not None:
+            return self._restore_one(step, target)
+        last_err = None
+        for s in reversed(self.finalized_steps()):
+            try:
+                return self._restore_one(s, target)
+            except Exception as e:  # noqa: BLE001 — corrupt payload class
+                last_err = e
+                import warnings
+                warnings.warn(
+                    f"checkpoint step_{s} is finalized but unreadable "
+                    f"({type(e).__name__}: {e}); falling back to an "
+                    "older checkpoint")
+        if last_err is not None:
+            import warnings
+            warnings.warn("no readable checkpoint found (all finalized "
+                          "candidates failed to load)")
+        return None
+
+    def _restore_one(self, step, target):
         if self.sharded:
             return self._restore_sharded(step, target)
         return serialization.load(

@@ -29,6 +29,15 @@ only their kv heads; `cache_dtype` float32/bfloat16/int8 trades HBM
 decode bandwidth for precision (int8 carries per-token-per-head f32
 scale sidecars).
 
+Degradation under load is first-class (docs/robustness.md): per-
+request deadlines and cancel() resolve at host step boundaries (never
+mid-dispatch, never a recompile), admission back-pressure can reject
+or evict-lowest-priority when KV pages run out, a resilience.Watchdog
+flags wedged dispatches, transient dispatch errors ride a bounded
+retry, and health() exposes the whole picture. Every path drills
+deterministically via resilience.faults (page_exhaustion, slow_step,
+dispatch_error).
+
 Single-threaded by design (one engine owns one chip's decode loop);
 wrap submissions in your own queue for multi-producer serving.
 """
@@ -42,6 +51,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..nn.layer import functional_call
+from ..resilience import faults
+from ..resilience.retry import call_with_retries
 from ..tensor import Tensor
 from .paged_cache import PagedLayerCache, alloc_pages, write_prompt_kv, \
     TRASH_PAGE
@@ -50,24 +61,37 @@ __all__ = ["ServingEngine", "ServeRequest"]
 
 
 class ServeRequest:
-    """One queued generation request."""
+    """One queued generation request.
 
-    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id")
+    deadline: absolute time.monotonic() seconds (None = no deadline) —
+    checked at host step boundaries only, preserving zero-recompile.
+    priority: larger = more important; the evict admission policy may
+    preempt a strictly-lower-priority running request.
+    """
 
-    def __init__(self, rid, prompt, max_new_tokens, eos_token_id):
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
+                 "deadline", "priority", "submitted_at")
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
+                 deadline=None, priority=0):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token_id = eos_token_id
+        self.deadline = deadline
+        self.priority = int(priority)
+        self.submitted_at = time.monotonic()
 
 
 class _Slot:
-    __slots__ = ("req", "pages", "out_tokens")
+    __slots__ = ("req", "pages", "out_tokens", "status", "admit_seq")
 
-    def __init__(self, req, pages):
+    def __init__(self, req, pages, admit_seq=0):
         self.req = req
         self.pages = pages          # page ids owned by this sequence
         self.out_tokens = []        # generated tokens (host ints)
+        self.status = "ok"          # ok | expired | cancelled | evicted
+        self.admit_seq = admit_seq  # admission order (evict tie-break)
 
 
 def _next_pow2(n):
@@ -95,6 +119,18 @@ class ServingEngine:
         the Pallas paged kernel (interpret mode off-TPU), False jnp ref.
     steps_per_dispatch: decode tokens per compiled call (the scan
         length) — admission/eviction happen at dispatch boundaries.
+    admission_policy: what to do with the queue head when pages run
+        out — 'wait' (back-pressure, retry next boundary), 'reject'
+        (finish it immediately with status='rejected'), or 'evict'
+        (preempt the lowest-priority strictly-lower-priority running
+        request, finishing it with status='evicted' and its partial
+        tokens; falls back to waiting when no such victim exists).
+    watchdog_timeout: seconds; when set, a resilience.Watchdog daemon
+        monitors every decode/prefill dispatch and flags a wedge in
+        health() when one stays in flight past the timeout (it cannot
+        cancel a running XLA execute — detection only).
+    dispatch_retries: bounded deterministic backoff for transient
+        RESOURCE_EXHAUSTED-style dispatch errors (resilience.retry).
     donate: donate the page pool to the decode/prefill programs
         (in-place HBM updates). Turn OFF when running under a
         persistent compilation cache on jax 0.4.x (reloading donated
@@ -105,7 +141,9 @@ class ServingEngine:
     def __init__(self, model, *, max_slots=8, page_size=16,
                  max_seq_len=256, num_pages=None, cache_dtype="float32",
                  use_flash=None, temperature=0.0, top_k=0, seed=0,
-                 pad_token_id=0, steps_per_dispatch=8, donate=True):
+                 pad_token_id=0, steps_per_dispatch=8, donate=True,
+                 admission_policy="wait", watchdog_timeout=None,
+                 dispatch_retries=2):
         if page_size % 8:
             raise ValueError(f"page_size must be a multiple of 8 "
                              f"(Mosaic sublane tiling), got {page_size}")
@@ -174,6 +212,27 @@ class ServingEngine:
         self._finished = []
         self._next_rid = 0
 
+        # -- resilience/degradation state (all host-side: deadlines,
+        # cancellation, admission policy and the watchdog never touch
+        # the compiled programs, so zero-recompile survives chaos)
+        if admission_policy not in ("wait", "reject", "evict"):
+            raise ValueError(f"admission_policy {admission_policy!r}: "
+                             "expected wait | reject | evict")
+        self.admission_policy = admission_policy
+        self.dispatch_retries = int(dispatch_retries)
+        from ..resilience.retry import RetryStats
+        self.retry_stats = RetryStats()
+        self._watchdog = None
+        if watchdog_timeout is not None:
+            from ..resilience.watchdog import Watchdog
+            self._watchdog = Watchdog(timeout_s=watchdog_timeout).start()
+        self._rounds = 0
+        self._admit_seq = 0
+        self._cancel_pending = set()
+        self.last_dispatch_s = 0.0
+        self.status_counts = {"ok": 0, "expired": 0, "cancelled": 0,
+                              "rejected": 0, "evicted": 0}
+
         self._trace_counts = {}
         self._decode_fn = self._build_decode_fn()
         self._prefill_fns = {}
@@ -192,9 +251,16 @@ class ServingEngine:
 
     # -- public API ---------------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens=16, eos_token_id=None):
+    def submit(self, prompt, max_new_tokens=16, eos_token_id=None,
+               deadline_ms=None, priority=0):
         """Queue one request; returns its id. Admitted at the next
-        step() boundary (slot + pages permitting)."""
+        step() boundary (slot + pages permitting).
+
+        deadline_ms: wall budget from NOW for the whole request
+            (queueing + prefill + decode). Expiry is detected at host
+            step boundaries; the request finishes with
+            status='expired' and whatever tokens it produced.
+        priority: larger = more important (evict admission policy)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if not len(prompt):
             raise ValueError("empty prompt")
@@ -206,23 +272,48 @@ class ServingEngine:
                 f"{self.max_seq_len}")
         need_pages = -(-need // self.page_size)
         if need_pages > self.num_pages - 1:
-            # would never admit: back-pressure can free at most the
-            # whole pool (page 0 is reserved)
+            # would otherwise sit in the admission queue FOREVER:
+            # back-pressure can free at most the whole pool (page 0 is
+            # reserved), so this request can never be admitted
             raise ValueError(
-                f"request needs {need_pages} pages but the pool only "
-                f"has {self.num_pages - 1} usable")
+                f"request needs {need_pages} KV pages (prompt "
+                f"{len(prompt)} + {int(max_new_tokens)} new tokens @ "
+                f"page_size={self.page_size}) but the pool only has "
+                f"{self.num_pages - 1} usable — it would wedge the "
+                "admission queue. Raise num_pages or shorten the "
+                "request.")
+        deadline = None if deadline_ms is None \
+            else time.monotonic() + float(deadline_ms) / 1e3
         rid = self._next_rid
         self._next_rid += 1
         self._queue.append(ServeRequest(rid, prompt, max_new_tokens,
-                                        eos_token_id))
+                                        eos_token_id, deadline=deadline,
+                                        priority=priority))
         return rid
 
+    def cancel(self, rid):
+        """Request cancellation of a queued or running request. Takes
+        effect at the next step() boundary (never mid-dispatch — a
+        compiled decode program is never interrupted): the request
+        finishes with status='cancelled' and its partial tokens.
+        Returns True when `rid` is still queued or running, False when
+        unknown or already finished."""
+        if any(r.rid == rid for r in self._queue) or any(
+                s is not None and s.req.rid == rid for s in self._slots):
+            self._cancel_pending.add(rid)
+            return True
+        return False
+
     def step(self):
-        """One scheduling round: evict finished slots, admit queued
-        requests, run ONE batched decode dispatch
+        """One scheduling round: apply cancellations and deadline
+        expiry, evict finished slots, admit queued requests (per the
+        admission policy), run ONE batched decode dispatch
         (steps_per_dispatch tokens x all live slots). Returns the list
         of requests finished this round as dicts
-        {id, prompt, tokens} (tokens = generated only)."""
+        {id, prompt, tokens, status} (tokens = generated only)."""
+        self._rounds += 1
+        self._apply_cancels()
+        self._expire_deadlines()
         self._evict()
         self._admit()
         if self._active.any() and not (self._done | ~self._active).all():
@@ -261,6 +352,48 @@ class ServingEngine:
     @property
     def free_page_count(self):
         return len(self._free_pages)
+
+    def close(self):
+        """Release host-side resources (the watchdog's polling
+        thread). Call when retiring an engine; safe to call twice.
+        Compiled programs and the page pool are plain GC'd objects."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+
+    def __del__(self):
+        wd = getattr(self, "_watchdog", None)
+        if wd is not None:
+            # signal only — joining a thread from a finalizer can
+            # deadlock interpreter shutdown
+            wd._stop.set()
+
+    def health(self):
+        """One host-side snapshot of engine liveness and degradation
+        state — the thing a load balancer or operator pages on. Pure
+        bookkeeping reads: no device sync, no compilation."""
+        running = sum(1 for s in self._slots if s is not None)
+        now = time.monotonic()
+        h = {"running": running,
+             "queued": len(self._queue),
+             "oldest_queued_s": round(
+                 max((now - r.submitted_at for r in self._queue),
+                     default=0.0), 6),
+             "free_pages": len(self._free_pages),
+             "total_pages": self.num_pages - 1,
+             "rounds": self._rounds,
+             "decode_dispatches": self.decode_dispatches,
+             "decode_tokens": self.decode_tokens,
+             "last_dispatch_s": round(self.last_dispatch_s, 6),
+             "results_pending": len(self._finished),
+             "cancels_pending": len(self._cancel_pending),
+             "admission_policy": self.admission_policy,
+             "dispatch_retries": self.retry_stats.retries,
+             "status_counts": dict(self.status_counts),
+             "compile_counts": self.compile_counts()}
+        if self._watchdog is not None:
+            h["watchdog"] = self._watchdog.health()
+        return h
 
     # -- sampling (one strategy per engine == per compiled program) ---------
 
@@ -392,37 +525,139 @@ class ServingEngine:
 
     # -- host-side scheduling ----------------------------------------------
 
+    def _finish_request(self, req, status, tokens=None):
+        """Finish a request that never reached (or is leaving) a slot.
+        age_s — submit-to-finish latency — rides the result so tail
+        latency is measurable per request, not just per dispatch."""
+        self.status_counts[status] = self.status_counts.get(status, 0) + 1
+        self._finished.append({"id": req.rid,
+                               "prompt": req.prompt.tolist(),
+                               "tokens": list(tokens or []),
+                               "status": status,
+                               "age_s": round(
+                                   time.monotonic() - req.submitted_at,
+                                   6)})
+        self._cancel_pending.discard(req.rid)
+
+    def _finish_slot(self, b, status=None):
+        """Release slot b and emit its result (status defaults to the
+        slot's recorded degradation status, 'ok' for a natural
+        finish). Pages return to the free list immediately."""
+        slot = self._slots[b]
+        req = slot.req
+        self._finish_request(req, status or slot.status,
+                             slot.out_tokens[:req.max_new_tokens])
+        self._free_pages.extend(slot.pages)
+        self._slots[b] = None
+        self._active[b] = False
+        self._done[b] = True
+        self._page_table[b, :] = TRASH_PAGE
+        self._seq_lens[b] = 0
+        self._emitted[b] = 0
+        self._eos[b] = -1
+        self._dev_sched = None  # host state diverged from device
+
     def _evict(self):
         for b in range(self.max_slots):
+            if self._slots[b] is not None and self._done[b]:
+                self._finish_slot(b)
+
+    def _apply_cancels(self):
+        """Host boundary resolution of cancel(): queued requests leave
+        the queue; running ones are marked done for the sweep."""
+        if not self._cancel_pending:
+            return
+        kept = collections.deque()
+        for req in self._queue:
+            if req.rid in self._cancel_pending:
+                self._finish_request(req, "cancelled")
+            else:
+                kept.append(req)
+        self._queue = kept
+        for b in range(self.max_slots):
             slot = self._slots[b]
-            if slot is None or not self._done[b]:
+            if slot is not None and slot.req.rid in self._cancel_pending:
+                self._cancel_pending.discard(slot.req.rid)
+                slot.status = "cancelled"
+                self._done[b] = True
+                self._dev_sched = None
+
+    def _expire_deadlines(self):
+        """Deadline expiry, host boundaries only (zero-recompile): a
+        queued request past its deadline never admits; a running one
+        stops decoding this round and returns its partial tokens."""
+        now = time.monotonic()
+        kept = collections.deque()
+        for req in self._queue:
+            if req.deadline is not None and now > req.deadline:
+                self._finish_request(req, "expired")
+            else:
+                kept.append(req)
+        self._queue = kept
+        for b in range(self.max_slots):
+            slot = self._slots[b]
+            if slot is None or self._done[b]:
                 continue
-            req = slot.req
-            self._finished.append({
-                "id": req.rid,
-                "prompt": req.prompt.tolist(),
-                "tokens": slot.out_tokens[:req.max_new_tokens],
-            })
-            self._free_pages.extend(slot.pages)
-            self._slots[b] = None
-            self._active[b] = False
-            self._page_table[b, :] = TRASH_PAGE
-            self._seq_lens[b] = 0
-            self._emitted[b] = 0
-            self._eos[b] = -1
-            self._dev_sched = None  # host state diverged from device
+            dl = slot.req.deadline
+            if dl is not None and now > dl:
+                slot.status = "expired"
+                self._done[b] = True
+                self._dev_sched = None
+
+    def _victim_slot(self, priority):
+        """Lowest-priority running slot strictly below `priority`
+        (ties: latest admission goes first — it has sunk the least
+        decode work)."""
+        best = None
+        key = None
+        for b in range(self.max_slots):
+            slot = self._slots[b]
+            if slot is None or self._done[b]:
+                continue
+            if slot.req.priority >= priority:
+                continue
+            k = (slot.req.priority, -slot.admit_seq)
+            if key is None or k < key:
+                best, key = b, k
+        return best
 
     def _admit(self):
+        # injected page exhaustion: the free list READS as empty for
+        # this round (pages are not actually lost), driving the
+        # admission policy exactly like a real shortage
+        exhausted = faults.pull("page_exhaustion", self._rounds) \
+            is not None
         while self._queue:
             req = self._queue[0]
             free_slot = next((b for b in range(self.max_slots)
                               if self._slots[b] is None), None)
             need_pages = -(-(len(req.prompt) + req.max_new_tokens)
                            // self.page_size)
-            if free_slot is None or len(self._free_pages) < need_pages:
-                return  # back-pressure: retry next boundary
-            self._queue.popleft()
-            self._admit_one(free_slot, req, need_pages)
+            have = 0 if exhausted else len(self._free_pages)
+            short_pages = have < need_pages
+            if free_slot is not None and not short_pages:
+                self._queue.popleft()
+                self._admit_one(free_slot, req, need_pages)
+                continue
+            if self.admission_policy == "reject" and short_pages \
+                    and free_slot is not None:
+                # pages are the scarce resource here; a merely-full
+                # slot pool turns over every round and is not worth a
+                # rejection
+                self._queue.popleft()
+                self._finish_request(req, "rejected")
+                continue
+            if self.admission_policy == "evict" and not exhausted:
+                # preemption frees a slot AND its pages, so it covers
+                # both shortages; under INJECTED exhaustion freed
+                # pages would still read as absent — evicting then
+                # would be a death spiral, so fall through to wait
+                victim = self._victim_slot(req.priority)
+                if victim is None:
+                    return  # nobody lower-priority: back-pressure
+                self._finish_slot(victim, "evicted")
+                continue  # re-check the head against freed capacity
+            return  # back-pressure: retry next boundary
 
     def _admit_one(self, b, req, need_pages):
         ps = self.page_size
@@ -444,13 +679,16 @@ class ServingEngine:
         ids[0, :lp] = req.prompt
 
         fn = self._prefill_fn(bucket)
-        tok, new_pages, self._rng = fn(
-            self._params, self._buffers, self._pages, jnp.asarray(ids),
-            jnp.int32(lp), jnp.asarray(pages_vec), self._rng)
+        with self._watch(f"prefill_{bucket}"):
+            tok, new_pages, self._rng = fn(
+                self._params, self._buffers, self._pages,
+                jnp.asarray(ids), jnp.int32(lp), jnp.asarray(pages_vec),
+                self._rng)
         self._pages = new_pages
         tok = int(tok)
 
-        self._slots[b] = _Slot(req, pages)
+        self._admit_seq += 1
+        self._slots[b] = _Slot(req, pages, admit_seq=self._admit_seq)
         self._slots[b].out_tokens.append(tok)
         row = np.full((self.max_pages_per_seq,), TRASH_PAGE, np.int32)
         row[:need_pages] = pages
@@ -467,6 +705,14 @@ class ServingEngine:
                                  and tok == req.eos_token_id))
         self._dev_sched = None  # host state diverged from device
 
+    def _watch(self, op):
+        """Watchdog heartbeat around one dispatch (nullcontext when no
+        watchdog is armed)."""
+        import contextlib
+        if self._watchdog is None:
+            return contextlib.nullcontext()
+        return self._watchdog.watch(op)
+
     def _dispatch_decode(self):
         emitted_before = self._emitted.copy()
         t0 = time.perf_counter()
@@ -478,10 +724,26 @@ class ServingEngine:
                  self._max_new, self._eos))
         (pt_d, sl_d, lt_d, ac_d, dn_d, em_d, mn_d, eos_d) = \
             self._dev_sched
-        (toks, pages, seq_lens, last, done, emitted,
-         self._rng) = self._decode_fn(
-            self._params, self._buffers, self._pages,
-            pt_d, sl_d, lt_d, ac_d, dn_d, em_d, mn_d, eos_d, self._rng)
+
+        def dispatch():
+            # injected transients fire BEFORE the execute, so a retry
+            # re-submits a page pool that was never donated away
+            faults.maybe_raise("dispatch_error", self._rounds)
+            return self._decode_fn(
+                self._params, self._buffers, self._pages,
+                pt_d, sl_d, lt_d, ac_d, dn_d, em_d, mn_d, eos_d,
+                self._rng)
+
+        from ..resilience.retry import retryable_for
+        with self._watch("decode"):
+            # slow-step seam sits inside the watchdog window: a wedged
+            # dispatch and an injected stall look identical to health()
+            faults.maybe_sleep("slow_step", self._rounds)
+            (toks, pages, seq_lens, last, done, emitted,
+             self._rng) = call_with_retries(
+                dispatch, retries=self.dispatch_retries,
+                retryable=retryable_for(self.donate),
+                stats=self.retry_stats)
         self._pages = pages
         # decode only advances these four; the rest stay device-valid
         self._dev_sched = (pt_d, seq_lens, last, ac_d, done, emitted,
@@ -495,7 +757,8 @@ class ServingEngine:
         self._emitted = np.array(emitted)
         # the np.array() conversions above force the device sync, so
         # this timestamp bounds real work, not async dispatch
-        self.decode_seconds += time.perf_counter() - t0
+        self.last_dispatch_s = time.perf_counter() - t0
+        self.decode_seconds += self.last_dispatch_s
         self.decode_tokens += int((self._emitted - emitted_before).sum())
         self.decode_dispatches += 1
         for b in range(self.max_slots):

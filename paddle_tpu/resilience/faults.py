@@ -1,0 +1,252 @@
+"""Deterministic fault-injection registry — the chaos-campaign backbone.
+
+A production TPU stack dies from unhandled faults (NaN storms, pod
+preemption, page exhaustion, wedged dispatches), not slow kernels.
+Every resilience behavior in this repo is therefore driven by a seam
+that consults this registry, so the whole failure model is testable on
+CPU tier-1 with zero nondeterminism:
+
+    with faults.scenario(("nan_grads", {"step": 5}),
+                         ("nan_grads", {"step": 6})):
+        model.fit(...)
+
+or from the environment (chaos_smoke campaign stage)::
+
+    PADDLE_TPU_FAULTS="nan_grads@10x3,sigterm@25,slow_step@5:seconds=0.5"
+
+Entry grammar: ``kind[@step][xCOUNT][:k=v;k=v]`` — ``@step`` pins the
+fault to a seam step, ``xCOUNT`` arms COUNT firings (default 1), and
+``:k=v`` pairs ride as the payload (floats/ints auto-coerced). A
+pinned fault with COUNT > 1 is a STORM: it matches the window
+[step, step + COUNT), i.e. ``nan_grads@10x3`` poisons steps 10-12 —
+exactly the consecutive-bad-step shape that drills rollback.
+
+Seams and their kinds (each seam passes its own step counter):
+
+==================  =====================================================
+kind                consulted by
+==================  =====================================================
+nan_grads           Engine guarded train step (loss *= NaN pre-grad)
+slow_step           ServingEngine decode dispatch (host sleep; trips the
+                    watchdog), Engine guarded step
+dispatch_error      Engine guarded step / ServingEngine dispatch — raises
+                    a transient RESOURCE_EXHAUSTED-style error that the
+                    retry wrapper absorbs
+torn_ckpt           CheckpointManager._write — truncates the state file
+                    and suppresses the COMPLETE marker (simulated crash
+                    mid-finalize)
+sigterm             hapi fit() batch boundary — raises SIGTERM in-process
+page_exhaustion     ServingEngine admission — pretends the free list is
+                    empty for the matching round
+==================  =====================================================
+
+The registry is process-global and consult-only-on-armed: ``pull`` on
+an empty registry is a tuple check, so production paths pay nothing.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import threading
+import time
+
+__all__ = ["Fault", "inject", "clear", "armed", "pull", "scenario",
+           "load_env", "fired_log", "nan_scale", "maybe_sleep",
+           "maybe_raise", "maybe_sigterm", "TransientError"]
+
+
+class TransientError(RuntimeError):
+    """Injected stand-in for a transient runtime/dispatch failure
+    (RESOURCE_EXHAUSTED, UNAVAILABLE, ...). The retry wrapper treats it
+    — and real errors whose message matches the same grammar — as
+    retryable."""
+
+
+class Fault:
+    """One armed fault: fires up to `count` times, optionally pinned to
+    a seam step. `payload` rides back to the seam on each firing."""
+
+    __slots__ = ("kind", "step", "count", "payload", "fired")
+
+    def __init__(self, kind, step=None, count=1, **payload):
+        self.kind = str(kind)
+        self.step = None if step is None else int(step)
+        self.count = int(count)
+        self.payload = dict(payload)
+        self.fired = 0
+
+    @property
+    def remaining(self):
+        return self.count - self.fired
+
+    def __repr__(self):
+        at = "" if self.step is None else f"@{self.step}"
+        return (f"Fault({self.kind}{at} x{self.count} "
+                f"fired={self.fired} {self.payload})")
+
+
+_lock = threading.Lock()
+_registry: list[Fault] = []
+_fired_log: list[tuple[str, int | None]] = []
+_env_loaded = False
+
+
+def inject(kind, step=None, count=1, **payload):
+    """Arm one fault. Returns the Fault (inspect `.fired` later)."""
+    f = Fault(kind, step=step, count=count, **payload)
+    with _lock:
+        _registry.append(f)
+    return f
+
+
+def clear():
+    """Disarm everything and forget the firing log."""
+    with _lock:
+        _registry.clear()
+        _fired_log.clear()
+
+
+def armed(kind=None):
+    """Any un-exhausted fault (of `kind`, or at all) still armed?"""
+    with _lock:
+        return any(f.remaining > 0 and (kind is None or f.kind == kind)
+                   for f in _registry)
+
+
+def pull(kind, step=None):
+    """Consume one firing of `kind` matching `step`; returns its payload
+    dict, or None when nothing armed matches. A fault armed with
+    step=None matches any seam step; a pinned fault matches its storm
+    window [step, step + count) — each seam consults a given step once,
+    so a pinned count is a run of consecutive steps, not N firings at
+    one step. Cheap when the registry is empty (the common case)."""
+    if not _registry:          # unlocked fast path: seams in hot loops
+        return None
+    with _lock:
+        for f in _registry:
+            if f.kind != kind or f.remaining <= 0:
+                continue
+            if f.step is not None:
+                if step is None:
+                    continue
+                if not (f.step <= step < f.step + f.count):
+                    continue
+            f.fired += 1
+            _fired_log.append((kind, step))
+            return dict(f.payload)
+    return None
+
+
+def fired_log():
+    """(kind, step) tuples in firing order — chaos-test assertions."""
+    with _lock:
+        return list(_fired_log)
+
+
+@contextlib.contextmanager
+def scenario(*specs):
+    """Arm a set of faults for the `with` body, restoring the previous
+    registry after. Each spec is a Fault, a kind string, or a
+    (kind, kwargs) pair."""
+    with _lock:
+        saved = list(_registry)
+        saved_log = list(_fired_log)
+        _registry.clear()
+        _fired_log.clear()   # fired_log() inside the scenario reports
+        #                      ONLY the scenario's own firings
+    for s in specs:
+        if isinstance(s, Fault):
+            with _lock:
+                _registry.append(s)
+        elif isinstance(s, str):
+            inject(s)
+        else:
+            kind, kw = s
+            inject(kind, **kw)
+    try:
+        yield
+    finally:
+        with _lock:
+            _registry[:] = saved
+            _fired_log[:] = saved_log
+
+
+def _coerce(v):
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            pass
+    return v
+
+
+def load_env(force=False):
+    """Parse PADDLE_TPU_FAULTS (once per process unless force=True).
+    Called lazily by the resilience package import; safe to re-call."""
+    global _env_loaded
+    if _env_loaded and not force:
+        return
+    _env_loaded = True
+    spec = os.environ.get("PADDLE_TPU_FAULTS", "").strip()
+    if not spec:
+        return
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        payload = {}
+        if ":" in entry:
+            entry, raw = entry.split(":", 1)
+            for pair in raw.split(";"):
+                if "=" in pair:
+                    k, v = pair.split("=", 1)
+                    payload[k.strip()] = _coerce(v.strip())
+        count = 1
+        if "x" in entry:
+            # only a trailing xN with numeric N is a count suffix —
+            # kinds themselves may contain 'x' (page_exhaustion)
+            head, c = entry.rsplit("x", 1)
+            if c.isdigit():
+                entry, count = head, int(c)
+        step = None
+        if "@" in entry:
+            entry, s = entry.split("@", 1)
+            step = int(s)
+        inject(entry.strip(), step=step, count=count, **payload)
+
+
+# -- seam helpers (one per fault kind, so seams stay one-liners) ----------
+
+def nan_scale(step=None):
+    """Guarded-train-step seam: a scalar the step multiplies into the
+    loss BEFORE autodiff — NaN poisons the loss and every gradient in
+    one shot; 1.0 is the no-fault value. Returned as a host float so it
+    rides the step's stable scalar signature (no recompile)."""
+    return float("nan") if pull("nan_grads", step) is not None else 1.0
+
+
+def maybe_sleep(kind="slow_step", step=None):
+    """Host-side stall seam (watchdog drills). Payload: seconds."""
+    p = pull(kind, step)
+    if p is not None:
+        time.sleep(float(p.get("seconds", 0.05)))
+    return p
+
+
+def maybe_raise(kind="dispatch_error", step=None):
+    """Transient-dispatch-failure seam. Payload: message."""
+    p = pull(kind, step)
+    if p is not None:
+        raise TransientError(p.get(
+            "message", f"RESOURCE_EXHAUSTED: injected {kind} "
+                       f"(step={step})"))
+
+
+def maybe_sigterm(step=None):
+    """Preemption seam: deliver SIGTERM to this process at a step
+    boundary, exactly like a pod preemption notice."""
+    if pull("sigterm", step) is not None:
+        signal.raise_signal(signal.SIGTERM)
+        return True
+    return False

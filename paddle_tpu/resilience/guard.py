@@ -1,0 +1,234 @@
+"""TrainGuard — NaN/inf skip, snapshot ring, rollback.
+
+A NaN storm (bad batch, overflowed bf16 reduction, cosmic-ray HBM
+flip) must cost skipped steps, not a dead run or a silently-poisoned
+model. The guard splits the work across the compile boundary:
+
+in-step (compiled, zero extra dispatch — see Engine._build_train_fn's
+guarded variant):
+  - an all-finite check over loss AND every gradient leaf, fused into
+    the same XLA program as the step (the reductions fuse into the
+    grad computation's epilogue; nothing extra launches);
+  - the param/buffer/optimizer update is masked by that flag, so a bad
+    step is a perfect no-op on model state;
+  - when a GradScaler is attached, its dynamic-scale state lives
+    in-step too (loss scaled pre-grad, grads unscaled pre-check,
+    functional_update on the found-inf flag).
+
+host-side (this object):
+  - skip counters + consecutive-bad tracking;
+  - a last-good snapshot ring (params + buffers + opt state + update
+    counters, device_get to host numpy so donation can't invalidate
+    it) refreshed every `snapshot_every` good steps;
+  - rollback to the newest ring entry after `rollback_after`
+    consecutive bad steps — the backstop for corruption the in-step
+    mask can't catch (state that was already non-finite when the
+    guard attached, or a poisoned running stat from an unguarded
+    phase);
+  - a bounded retry/backoff around the dispatch for transient
+    RESOURCE_EXHAUSTED-style runtime errors (retry.py).
+
+Attach via ``Model.prepare(..., guard=TrainGuard(...))`` or
+``engine.attach_guard(TrainGuard(...))``. Applies to the fused
+train_batch path; gradient accumulation keeps its own two-program
+structure and refuses a guard loudly rather than half-protecting.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax
+import numpy as np
+
+from .retry import RetryStats
+
+__all__ = ["TrainGuard"]
+
+
+def _to_host(tree):
+    """Snapshot copy: device_get every array leaf to host numpy.
+    Donation-proof (the engine's next step may delete the device
+    buffers; numpy copies survive) and mesh-agnostic (device_get
+    consolidates sharded arrays; restore re-places them lazily)."""
+    def one(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(jax.device_get(x))
+        return x
+    return jax.tree_util.tree_map(one, tree)
+
+
+def _to_device(tree):
+    import jax.numpy as jnp
+
+    def one(x):
+        if isinstance(x, np.ndarray):
+            return jnp.asarray(x)
+        return x
+    return jax.tree_util.tree_map(one, tree)
+
+
+class TrainGuard:
+    """Host-side half of the guarded train step.
+
+    snapshot_every: good steps between snapshot-ring refreshes. COST:
+        each snapshot device_gets params + buffers + optimizer state
+        to host numpy (a full HBM->host fetch, ~3x param bytes under
+        Adam) and the ring holds ring_size such copies. The defaults
+        suit small/medium models; for multi-GB models raise
+        snapshot_every to a few hundred, set ring_size=1, or skip the
+        ring entirely and lean on PreemptionCheckpoint(every_n_steps=)
+        whose CheckpointManager write is async and disk-backed.
+    ring_size: retained snapshots (newest wins on rollback; older
+        entries are the defense against a corrupt newest).
+    rollback_after: consecutive bad steps that trigger a rollback.
+    scaler: optional amp.GradScaler — its dynamic loss scale compiles
+        into the step and its found-inf/skip counters track the guard.
+    retries / retry_base_delay: transient-dispatch retry budget.
+    """
+
+    def __init__(self, snapshot_every=10, ring_size=2, rollback_after=3,
+                 scaler=None, retries=2, retry_base_delay=0.05):
+        if snapshot_every < 1:
+            raise ValueError("snapshot_every must be >= 1")
+        if rollback_after < 1:
+            raise ValueError("rollback_after must be >= 1")
+        self.snapshot_every = int(snapshot_every)
+        self.rollback_after = int(rollback_after)
+        self.scaler = scaler
+        self.retries = int(retries)
+        self.retry_base_delay = float(retry_base_delay)
+        self.ring = collections.deque(maxlen=int(ring_size))
+        self.retry_stats = RetryStats()
+        # counters (log_scalars surfaces these in fit() logs)
+        self.good_steps = 0
+        self.skipped_steps = 0
+        self.consecutive_bad = 0
+        self.rollbacks = 0
+        self.last_outcome = "ok"   # ok | skipped | rolled_back
+        self._since_snapshot = 0
+        self._lr_refresh_pending = False
+
+    # -- snapshots ---------------------------------------------------------
+    @staticmethod
+    def _lr_sched(engine):
+        from ..optimizer.lr import LRScheduler
+        opt = engine.optimizer
+        lr = getattr(opt, "_lr", None)
+        return lr if isinstance(lr, LRScheduler) else None
+
+    def snapshot(self, engine):
+        """Capture last-good training state (host copies) — including
+        the LR scheduler position: a rollback that rewinds opt_step
+        but left the schedule ahead would replay the window under the
+        wrong learning rates."""
+        import copy
+        sched = self._lr_sched(engine)
+        self.ring.append({
+            "params": _to_host(engine._params),
+            "buffers": _to_host(engine._buffers),
+            "opt_state": _to_host(engine._opt_state),
+            "scaler_state": _to_host(engine._scaler_state),
+            "opt_step": engine._opt_step,
+            "lr_sched": None if sched is None
+            else copy.deepcopy(sched.state_dict()),
+        })
+        self._since_snapshot = 0
+        # hapi steps the scheduler AFTER the engine call this snapshot
+        # ran inside of; note_lr_stepped refreshes the captured
+        # position so it matches the snapshot's opt_step
+        self._lr_refresh_pending = True
+
+    def note_lr_stepped(self, engine):
+        """Call right after advancing the LR scheduler for an applied
+        update (hapi does): re-captures the newest snapshot's
+        scheduler position if that snapshot was taken this step."""
+        if getattr(self, "_lr_refresh_pending", False) and self.ring:
+            sched = self._lr_sched(engine)
+            if sched is not None:
+                import copy
+                self.ring[-1]["lr_sched"] = copy.deepcopy(
+                    sched.state_dict())
+        self._lr_refresh_pending = False
+
+    def rollback(self, engine):
+        """Restore the newest snapshot into the engine. Returns True if
+        a snapshot existed. On a single-device engine the compiled step
+        is reused (structurally identical trees — no recompile); under
+        GroupSharded/ZeRO the restored leaves must be RE-PLACED on
+        their shardings and the programs rebuilt, mirroring
+        Engine.load_opt_state_dict — a default-device restore would
+        materialize the full tree on one chip mid-recovery."""
+        if not self.ring:
+            return False
+        snap = self.ring[-1]
+        engine._params = _to_device(snap["params"])
+        engine._buffers = _to_device(snap["buffers"])
+        engine._opt_state = _to_device(snap["opt_state"])
+        engine._scaler_state = _to_device(snap["scaler_state"])
+        engine._opt_step = snap["opt_step"]
+        if getattr(engine.optimizer, "_group_sharded", None) is not None:
+            engine._apply_zero_placement()
+            engine._train_fn = None
+            engine._multi_fns = {}
+        sched = self._lr_sched(engine)
+        if sched is not None and snap.get("lr_sched") is not None:
+            import copy
+            sched.set_state_dict(copy.deepcopy(snap["lr_sched"]))
+        engine.network.load_raw_state(engine._params, engine._buffers)
+        engine.reset_accum_window()
+        self.rollbacks += 1
+        self.consecutive_bad = 0
+        return True
+
+    # -- per-step bookkeeping ---------------------------------------------
+    def before_first_step(self, engine):
+        """Seed the ring so a storm in the first window can roll back
+        to the initialization state."""
+        if not self.ring:
+            self.snapshot(engine)
+
+    def after_step(self, engine, ok):
+        """Called by the engine with the step's host-synced finite
+        flag. Returns 'ok' | 'skipped' | 'rolled_back' (also kept on
+        .last_outcome — hapi gates the LR-scheduler step on it, so the
+        schedule position tracks APPLIED updates like opt_step does)."""
+        if self.scaler is not None:
+            self.scaler.note_step(found_inf=not ok)
+        # only a snapshot taken THIS step may have its LR position
+        # refreshed by a following note_lr_stepped
+        self._lr_refresh_pending = False
+        if ok:
+            self.good_steps += 1
+            self.consecutive_bad = 0
+            self._since_snapshot += 1
+            if self._since_snapshot >= self.snapshot_every:
+                self.snapshot(engine)
+            self.last_outcome = "ok"
+        else:
+            self.skipped_steps += 1
+            self.consecutive_bad += 1
+            if self.consecutive_bad >= self.rollback_after \
+                    and self.rollback(engine):
+                self.last_outcome = "rolled_back"
+            else:
+                self.last_outcome = "skipped"
+        return self.last_outcome
+
+    # -- reporting ---------------------------------------------------------
+    def log_scalars(self):
+        """Flat numeric dict for hapi fit() logs / health snapshots."""
+        out = {"skipped": self.skipped_steps,
+               "rollbacks": self.rollbacks}
+        if self.retry_stats.retries:
+            out["retries"] = self.retry_stats.retries
+        if self.scaler is not None:
+            out["found_inf"] = self.scaler.found_inf_count
+        return out
+
+    def stats(self):
+        return {"good_steps": self.good_steps,
+                "skipped_steps": self.skipped_steps,
+                "consecutive_bad": self.consecutive_bad,
+                "rollbacks": self.rollbacks,
+                "snapshots": len(self.ring),
+                **self.retry_stats.as_dict()}

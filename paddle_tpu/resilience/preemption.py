@@ -1,0 +1,142 @@
+"""Preemption-safe shutdown: SIGTERM/SIGINT -> checkpoint-and-exit.
+
+Pod preemption delivers SIGTERM with a grace window. The handler here
+only sets a flag — all real work (finishing the in-flight step,
+writing the checkpoint through CheckpointManager's crash-safe finalize
+path) happens at the next host step boundary, where training state is
+consistent. hapi's fit() polls `requested()` every batch; the
+PreemptionCheckpoint callback (hapi/callbacks.py) turns the flag into
+a finalized checkpoint + clean stop, and `restore_training_state`
+resumes loss-exact.
+
+The chaos suite injects the signal itself via the `sigterm` fault kind
+(faults.maybe_sigterm at the same fit() boundary), so the whole path
+drills deterministically in-process.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+
+__all__ = ["install", "installed", "requested", "request", "clear",
+           "save_training_state", "restore_training_state"]
+
+_flag = threading.Event()
+_installed_for: dict[int, object] = {}   # signum -> previous handler
+
+
+def install(signals=(signal.SIGTERM, signal.SIGINT), chain=True):
+    """Install flag-setting handlers (idempotent). chain=True also
+    invokes the previously-installed USER handler — a supervisor's own
+    SIGTERM bookkeeping keeps working underneath ours. Python's
+    default SIGINT handler is NOT chained: it raises
+    KeyboardInterrupt mid-step, which is exactly the unclean unwind
+    this module exists to replace with a boundary checkpoint."""
+    for signum in signals:
+        if signum in _installed_for:
+            continue
+        prev = signal.getsignal(signum)
+        _installed_for[signum] = prev
+        chain_prev = (chain and callable(prev)
+                      and prev is not signal.default_int_handler)
+
+        def _handler(num, frame, _prev=prev, _chain=chain_prev):
+            _flag.set()
+            if _chain:
+                _prev(num, frame)
+
+        signal.signal(signum, _handler)
+
+
+def uninstall():
+    """Restore the pre-install handlers (test hygiene)."""
+    for signum, prev in list(_installed_for.items()):
+        try:
+            signal.signal(signum, prev)
+        except (ValueError, TypeError):
+            pass
+        del _installed_for[signum]
+
+
+def installed():
+    return bool(_installed_for)
+
+
+def requested():
+    """True once a preemption signal arrived (sticky until clear())."""
+    return _flag.is_set()
+
+
+def request():
+    """Programmatic preemption (tests, external orchestrators)."""
+    _flag.set()
+
+
+def clear():
+    _flag.clear()
+
+
+# -- full-training-state payloads (exact resume) --------------------------
+
+def save_training_state(model, manager, metric=None):
+    """Checkpoint EVERYTHING exact resume needs through a
+    CheckpointManager: params, optimizer moments + update counters, LR
+    scheduler position, scaler counters. Returns the step saved at.
+    The manager's COMPLETE-marker finalize makes the write crash-safe;
+    callers exiting on preemption should manager.wait() after."""
+    eng = model._ensure_engine()
+    eng.sync_to_layer()
+    step = eng._step
+    state = {"model": model.network.state_dict(),
+             "opt": eng.opt_state_dict(),
+             "scaler_state": eng._scaler_state}
+    opt = model._optimizer
+    if opt is not None:
+        from ..optimizer.lr import LRScheduler
+        if isinstance(opt._lr, LRScheduler):
+            state["lr_sched"] = opt._lr.state_dict()
+    guard = getattr(eng, "guard", None)
+    if guard is not None and guard.scaler is not None:
+        state["scaler"] = guard.scaler.state_dict()
+    manager.save(step, state, metric=metric)
+    return step
+
+
+def restore_training_state(model, manager, step=None):
+    """Inverse of save_training_state: load the latest finalized
+    checkpoint (or `step`) into the model/engine. Returns the restored
+    step, or None when the manager holds nothing usable.
+
+    Also resets the preemption flag and the model's stop_training
+    latch: restoring IS the start of the resumed incarnation, and a
+    flag left over from the previous fit (in-process restarts,
+    supervisors that re-enter) would otherwise kill the resumed fit
+    after one batch."""
+    state = manager.restore(step=step)
+    if state is None:
+        return None
+    clear()
+    model.stop_training = False
+    model.network.set_state_dict(state["model"])
+    eng = model._ensure_engine()
+    eng.sync_from_layer()
+    import jax
+    import jax.numpy as jnp
+
+    def dev(x):
+        import numpy as np
+        return jnp.asarray(x) if isinstance(x, np.ndarray) else x
+    eng.load_opt_state_dict(jax.tree_util.tree_map(dev, state["opt"]))
+    if state.get("scaler_state") is not None:
+        eng._scaler_state = jax.tree_util.tree_map(
+            dev, state["scaler_state"])
+    opt = model._optimizer
+    if opt is not None and "lr_sched" in state:
+        from ..optimizer.lr import LRScheduler
+        if isinstance(opt._lr, LRScheduler):
+            opt._lr.set_state_dict(state["lr_sched"])
+    guard = getattr(eng, "guard", None)
+    if guard is not None and guard.scaler is not None \
+            and "scaler" in state:
+        guard.scaler.load_state_dict(state["scaler"])
+    return state["opt"]["step"]

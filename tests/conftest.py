@@ -62,6 +62,10 @@ def pytest_configure(config):
         "markers",
         "slow: heavy variant with a cheaper sibling in the default run; "
         "included when PADDLE_TPU_RUN_SLOW=1")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection resilience suite "
+        "(standalone: pytest -m chaos; campaign stage chaos_smoke)")
 
 
 def pytest_collection_modifyitems(config, items):

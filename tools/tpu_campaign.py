@@ -104,6 +104,14 @@ def _driver_bench_active(max_age_s=45 * 60):
 
 STAGES = [
     ("probe", [PY, "bench.py", "--worker", "probe"], 600, {}),
+    # resilience chaos drill (ISSUE 3): fault-injection suite with a
+    # fixed seed, forced onto CPU — it validates the build's failure
+    # handling (guard/rollback, preemption resume, serving
+    # degradation) WITHOUT burning tunnel window, so it runs first
+    ("chaos_smoke", [PY, "-m", "pytest", "tests/test_resilience.py",
+                     "-q", "-m", "chaos", "-p", "no:cacheprovider",
+                     "-p", "no:randomly"], 1800,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
     ("bench_full", [PY, "bench.py"], 7200, {}),
     ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
      2400, {}),

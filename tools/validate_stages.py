@@ -31,6 +31,8 @@ REQUIRED_STAGES = {
     # round-7 serving + llama rungs
     "bench_serve_gpt", "bench_serve_llama", "bench_serve_flashk",
     "bench_llama", "decode_probe_paged",
+    # round-8 resilience drill (CPU-only, seeded — ISSUE 3)
+    "chaos_smoke",
 }
 
 
