@@ -98,6 +98,146 @@ class _Watchdog:
 
 
 # --------------------------------------------------------------------------
+# worker-side run telemetry (docs/observability.md): every bench worker
+# writes telemetry.jsonl + a final registry snapshot metrics.json into
+# the stage's telemetry dir (BENCH_TELEMETRY_DIR when the campaign sets
+# it per stage, else campaign_out/telemetry/<worker>), next to the
+# BENCH json the orchestrator assembles. Worker-side only — these
+# helpers import paddle_tpu, which the orchestrator never does.
+# --------------------------------------------------------------------------
+
+_TELEMETRY = {}
+
+
+def _obs_mod(name):
+    """paddle_tpu.observability.<name> WITHOUT paying the full
+    paddle_tpu package import in lean workers: the probe worker is
+    deliberately jax-only (time-to-first-signal measures the backend
+    handshake), and the observability modules are stdlib-only by
+    contract — so when the package isn't already imported, load the
+    module straight from its file under a private key. Workers that
+    imported paddle_tpu get the real module (same registry/tracer
+    singletons the Engine publishes into)."""
+    if "paddle_tpu" in sys.modules:
+        import importlib
+        return importlib.import_module(
+            f"paddle_tpu.observability.{name}")
+    key = f"_bench_obs_{name}"
+    mod = sys.modules.get(key)
+    if mod is None:
+        import importlib.util
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "paddle_tpu", "observability", f"{name}.py")
+        spec = importlib.util.spec_from_file_location(key, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[key] = mod
+        spec.loader.exec_module(mod)
+    return mod
+
+
+def _telemetry_dir(worker):
+    return (os.environ.get("BENCH_TELEMETRY_DIR")
+            or os.path.join(CAMPAIGN_OUT, "telemetry", worker))
+
+
+def _emit(kind, **fields):
+    """One structured record into the worker's telemetry.jsonl (logger
+    created lazily so the probe stays lean until it has a result)."""
+    lg = _TELEMETRY.get("logger")
+    if lg is None:
+        worker = _TELEMETRY.get("worker")
+        if worker is None:
+            return None   # orchestrator process: no telemetry
+        lg = _TELEMETRY["logger"] = _obs_mod(
+            "telemetry").TelemetryLogger(_telemetry_dir(worker))
+    return lg.emit(kind, **fields)
+
+
+def _report(payload):
+    """The bench output contract (one JSON line per completed workload)
+    + the same record mirrored into telemetry.jsonl."""
+    print(json.dumps(payload), flush=True)
+    try:
+        _emit("workload_result", worker=_TELEMETRY.get("worker"),
+              **payload)
+    except Exception as e:  # noqa: BLE001 — telemetry never kills a result
+        log(f"telemetry emit failed: {e}")
+
+
+def _hist_ms(h, scale=1e3):
+    """Histogram rollup row (ms): the --serve ladder's latency shape,
+    not just a mean."""
+    if h is None or not h.count:
+        return None
+    return {"count": h.count,
+            "mean": round(h.mean() * scale, 3),
+            "p50": round(h.quantile(0.5) * scale, 3),
+            "p99": round(h.quantile(0.99) * scale, 3),
+            "max": round(h.max * scale, 3)}
+
+
+def _finalize_worker_telemetry(worker):
+    """Write the stage's metrics.json: the process-global registry
+    snapshot + the recompile report, MERGED over earlier workers of the
+    same stage (bench_full runs four workers into one dir). Runs in a
+    finally: a failed workload still leaves its partial run facts."""
+    try:
+        _metrics = _obs_mod("metrics")
+        MetricsRegistry = _metrics.MetricsRegistry
+        get_registry = _metrics.get_registry
+        report_all = _obs_mod("trace").report_all
+        lg = _TELEMETRY.get("logger")
+        if lg is None:
+            _emit("run_end", worker=worker)   # creates the logger
+            lg = _TELEMETRY.get("logger")
+            if lg is None:
+                return
+        else:
+            lg.emit("run_end", worker=worker,
+                    records=lg.records)
+        lg.flush()
+        lg.close()
+        rep = report_all()
+        for t in rep["tracers"]:
+            t["worker"] = worker
+        workers = [worker]
+        merged = MetricsRegistry()
+        path = os.path.join(lg.run_dir, "metrics.json")
+        # merge an earlier snapshot ONLY if it came from THIS bench
+        # invocation (the orchestrator stamps one BENCH_RUN_ID and
+        # multi-worker stages share a dir). Any re-invocation — direct
+        # or with BENCH_TELEMETRY_DIR pointed at a persisting dir —
+        # gets a fresh id and overwrites: merging across runs would
+        # compound stale counters and carry a historical unexpected
+        # retrace into every future report.
+        run_id = os.environ.get("BENCH_RUN_ID")
+        if run_id is not None and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    old = json.load(f)
+                if old.get("run_id") == run_id:
+                    merged.merge(old)
+                    oldrep = old.get("recompile_report") or {}
+                    rep["tracers"] = (oldrep.get("tracers") or []) \
+                        + rep["tracers"]
+                    rep["unexpected_retraces"] += oldrep.get(
+                        "unexpected_retraces", 0)
+                    workers = (old.get("workers") or []) + workers
+            except (OSError, ValueError, KeyError,
+                    json.JSONDecodeError):
+                pass  # a torn earlier snapshot must not lose this one
+        merged.merge(get_registry().snapshot())
+        merged.dump(path, extra={"recompile_report": rep,
+                                 "workers": workers,
+                                 "run_id": run_id})
+        log(f"telemetry: {os.path.relpath(lg.path)} + "
+            f"{os.path.relpath(path)}")
+    except Exception as e:  # noqa: BLE001
+        log(f"telemetry finalize failed: {e}")
+
+
+# --------------------------------------------------------------------------
 # worker-side workloads (only these import jax; orchestrator never does)
 # --------------------------------------------------------------------------
 
@@ -331,10 +471,10 @@ def worker_probe():
     n = len(jax.devices())
     x = jnp.ones((8, 128), jnp.bfloat16)
     s = float((x * 2).sum())  # forces compile + transfer
-    print(json.dumps({
+    _report({
         "probe": "ok", "backend": backend, "devices": n,
         "result": s, "seconds": round(time.perf_counter() - t0, 1),
-    }), flush=True)
+    })
 
 
 def worker_decode(args, on_tpu):
@@ -386,7 +526,7 @@ def worker_decode(args, on_tpu):
         _Watchdog.pet()
     float(jnp.sum(out._value if hasattr(out, "_value") else out))
     dt = (time.perf_counter() - t0) / reps
-    print(json.dumps({
+    _report({
         "metric": "gpt_decode_tokens_per_sec_per_chip",
         "value": round(batch * new_tok / dt, 1),
         "unit": "tokens/s/chip",
@@ -398,7 +538,7 @@ def worker_decode(args, on_tpu):
         "serve_dtype": args.serve_dtype,
         "cache_dtype": cache_dt,
         "backend": jax.default_backend(),
-    }), flush=True)
+    })
 
 
 SERVE_DTYPES = ("float32", "bfloat16", "int8")
@@ -455,6 +595,8 @@ def worker_serve(args, on_tpu):
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.nlp.serving import ServingEngine
+    from paddle_tpu.observability.metrics import (MetricsRegistry,
+                                                  get_registry)
 
     smoke = args.smoke or not on_tpu
     paddle.seed(0)
@@ -497,10 +639,17 @@ def worker_serve(args, on_tpu):
             skipped.append(tag)
             continue
         use_flash = True if flash else False
+        # per-rung private registry: warmup publishes then
+        # reset_counters() zeroes it, so the histograms below cover
+        # exactly the timed wave; merged into the process registry
+        # after the rung, which is how the stage's metrics.json holds
+        # the ladder-wide latency shape
+        rung_reg = MetricsRegistry()
         eng = ServingEngine(model, max_slots=batch, page_size=page_size,
                             max_seq_len=max_seq, cache_dtype=dtype,
                             use_flash=use_flash,
-                            steps_per_dispatch=spd, donate=donate)
+                            steps_per_dispatch=spd, donate=donate,
+                            registry=rung_reg)
         def wave(n):
             prompts = [rng.integers(0, vocab,
                                     (prompt_lens[i % len(prompt_lens)],))
@@ -538,10 +687,23 @@ def worker_serve(args, on_tpu):
                                    * 1e3, 3),
                "wall_tok_s": round(toks / wall, 1),
                "decode_dispatches": eng.decode_dispatches,
-               "steady_recompiles": 0}
+               "steady_recompiles": 0,
+               # the latency SHAPE, not just the mean (the ladder's
+               # p99 is the serving number a deployment pages on)
+               "decode_tok_ms": _hist_ms(
+                   rung_reg.get("serve_decode_token_seconds")),
+               "ttft_ms": _hist_ms(rung_reg.get("serve_ttft_seconds")),
+               "queue_wait_ms": _hist_ms(
+                   rung_reg.get("serve_queue_wait_seconds"))}
         rows.append(row)
+        try:
+            _emit("serve_rung", model=kind, **row)
+        except Exception as e:  # noqa: BLE001 — telemetry never kills a result
+            log(f"telemetry emit failed: {e}")
+        get_registry().merge(rung_reg.snapshot())
         log(f"serve {tag}: {row['tok_s']} tok/s decode "
-            f"({row['wall_tok_s']} wall; {toks} toks), recompiles 0")
+            f"({row['wall_tok_s']} wall; {toks} toks), recompiles 0, "
+            f"p99 {((row['decode_tok_ms'] or {}).get('p99'))} ms/tok")
         del eng
     by_rung = {(r["batch"], r["cache_dtype"], r["flash"]): r["tok_s"]
                for r in rows}
@@ -549,7 +711,7 @@ def worker_serve(args, on_tpu):
     b8 = by_rung.get((8, "float32", False))
     speedup = round(b8 / b1, 2) if b1 and b8 else None
     best = max(rows, key=lambda r: r["tok_s"]) if rows else None
-    print(json.dumps({
+    _report({
         "metric": f"serve_{kind}_decode_tokens_per_sec_per_chip",
         "value": best["tok_s"] if best else None,
         "unit": "tokens/s/chip", "vs_baseline": None,
@@ -557,9 +719,11 @@ def worker_serve(args, on_tpu):
         "steps_per_dispatch": spd, "new_tokens": new_tok,
         "b8_vs_b1_speedup": speedup,
         "steady_recompiles": 0,
+        "decode_tok_ms": best["decode_tok_ms"] if best else None,
+        "ttft_ms": best["ttft_ms"] if best else None,
         "ladder": rows, "skipped_rungs": skipped,
         "backend": jax.default_backend(),
-    }), flush=True)
+    })
 
 
 def worker_llama(args, on_tpu):
@@ -604,14 +768,14 @@ def worker_llama(args, on_tpu):
                  amp_dtype=jnp.bfloat16 if amp else None)
     tput = run(eng, batch, seq, steps, warmup)
     fpt = gpt_flops_per_token(eng.network, seq)  # same 6N+12Lhs conv.
-    print(json.dumps({
+    _report({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": round(tput, 1), "unit": "tokens/s/chip",
         "vs_baseline": None,
         "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
         "config": cfg, "batch": batch, "seq": seq, "flash": use_flash,
         "backend": jax.default_backend(),
-    }), flush=True)
+    })
 
 
 def worker_resnet(args, on_tpu):
@@ -634,7 +798,7 @@ def worker_resnet(args, on_tpu):
     # 4.1 GFLOP fwd inference at 224px, x3 for fwd+bwd; scaled for
     # smaller images
     flops_per_img = 3 * 4.1e9 * (hw / 224.0) ** 2
-    print(json.dumps({
+    _report({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(tput, 1),
         "unit": "images/s/chip",
@@ -649,7 +813,7 @@ def worker_resnet(args, on_tpu):
         "layout": eng.network._layout,
         "fused_bottleneck": bool(args.fused_bottleneck),
         "backend": jax.default_backend(),
-    }), flush=True)
+    })
 
 
 def _resnet_serve(args, on_tpu, batch, steps, hw):
@@ -696,7 +860,7 @@ def _resnet_serve(args, on_tpu, batch, steps, hw):
     float(out.sum())
     dt = time.perf_counter() - t0
     tput = batch * steps / dt
-    print(json.dumps({
+    _report({
         "metric": "resnet50_serve_images_per_sec_per_chip",
         "value": round(tput, 1), "unit": "images/s/chip",
         "vs_baseline": None, "fold_bn": bool(args.fold_bn),
@@ -704,7 +868,7 @@ def _resnet_serve(args, on_tpu, batch, steps, hw):
         "layout": model._layout,
         "fused_bottleneck": bool(args.fused_bottleneck),
         "backend": jax.default_backend(),
-    }), flush=True)
+    })
 
 
 def worker_ernie(args, on_tpu):
@@ -724,7 +888,7 @@ def worker_ernie(args, on_tpu):
                              mlm_gather=args.mlm_gather)
     tput = run_ernie(eng, batch, seq, steps, warmup)
     fpt = gpt_flops_per_token(eng.network, seq)  # same 6N+12Lhs conv.
-    print(json.dumps({
+    _report({
         "metric": "ernie3_base_pretrain_tokens_per_sec_per_chip",
         "value": round(tput, 1),
         "unit": "tokens/s/chip",
@@ -736,7 +900,7 @@ def worker_ernie(args, on_tpu):
         "fused_ln": args.fused_ln, "mlm_gather": args.mlm_gather, "chunked_ce": args.chunked_ce,
         "fused_adamw": args.fused_adamw,
         "backend": jax.default_backend(),
-    }), flush=True)
+    })
 
 
 def worker_gpt(args, on_tpu, big=False):
@@ -797,7 +961,7 @@ def worker_gpt(args, on_tpu, big=False):
         tput = run(eng, batch, seq, steps, warmup,
                    scan_steps=args.scan_steps)
     fpt = gpt_flops_per_token(eng.network, seq)
-    print(json.dumps({
+    _report({
         # the 1.3B metric name only when the 1.3B config actually ran
         # (smoke mode and --config overrides fall back to the generic one)
         "metric": ("gpt3_1p3b_pretrain_tokens_per_sec_per_chip"
@@ -815,7 +979,7 @@ def worker_gpt(args, on_tpu, big=False):
         "fused_ln": args.fused_ln, "chunked_ce": args.chunked_ce,
         "fused_adamw": args.fused_adamw,
         "backend": jax.default_backend(),
-    }), flush=True)
+    })
 
 
 def worker_input_pipeline(args, on_tpu):
@@ -855,14 +1019,14 @@ def worker_input_pipeline(args, on_tpu):
     for w in worker_counts:
         timed(f"proc_{w}", num_workers=w, use_process_workers=True)
     best = max(results.values())
-    print(json.dumps({
+    _report({
         "metric": "input_pipeline_img_per_sec", "value": best,
         "unit": "img/s", "vs_baseline": round(best / 2225.0, 4),
         "host_cores": multiprocessing.cpu_count(),
         "batch": batch, "images": n, "modes": results,
         "note": "vs_baseline compares against the r4 ResNet-50 TPU "
                 "consumer rate (2225 img/s); scaling needs host cores",
-    }), flush=True)
+    })
 
 
 WORKERS = {
@@ -1330,24 +1494,39 @@ def main():
     if args.dryrun:
         args.smoke = True
 
+    # one id per bench invocation, inherited by spawned workers: the
+    # telemetry finalize merges an existing metrics.json only when it
+    # was written under the SAME id (multi-worker stages share a dir;
+    # re-invocations overwrite instead of compounding stale counters)
+    os.environ.setdefault("BENCH_RUN_ID",
+                          f"{int(time.time() * 1e3)}-{os.getpid()}")
+
     if args.worker:
         # ---- child mode: the only place jax is imported ----
         if args.smoke:
             import _cpu_env  # noqa: F401  (axon bypass; precede jax import)
         _Watchdog.start()
-        if args.worker == "input-pipeline":
-            # host-side workload: never touch jax (a dead tunnel would
-            # hang backend init for a bench that doesn't need the chip)
-            import _cpu_env  # noqa: F401
-            worker_input_pipeline(args, False)
-            return
-        _BENCH_CACHE_ARMED["on"] = _maybe_enable_bench_cache(args.worker)
-        if args.worker == "probe":
-            worker_probe()
-            return
-        import jax
-        on_tpu = jax.default_backend() == "tpu"
-        WORKERS[args.worker](args, on_tpu)
+        _TELEMETRY["worker"] = args.worker
+        try:
+            if args.worker == "input-pipeline":
+                # host-side workload: never touch jax (a dead tunnel
+                # would hang backend init for a bench that doesn't
+                # need the chip)
+                import _cpu_env  # noqa: F401
+                worker_input_pipeline(args, False)
+                return
+            _BENCH_CACHE_ARMED["on"] = _maybe_enable_bench_cache(
+                args.worker)
+            if args.worker == "probe":
+                worker_probe()
+                return
+            import jax
+            on_tpu = jax.default_backend() == "tpu"
+            WORKERS[args.worker](args, on_tpu)
+        finally:
+            # every stage leaves telemetry.jsonl + metrics.json — on
+            # failure too (the partial run facts ARE the diagnostic)
+            _finalize_worker_telemetry(args.worker)
         return
 
     # ---- orchestrator mode: jax-free ----

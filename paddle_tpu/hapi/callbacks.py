@@ -7,9 +7,11 @@ import time
 
 import numpy as np
 
+from ..observability.telemetry import TelemetryCallback  # noqa: F401
+
 __all__ = ["WandbCallback", "Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "LRScheduler", "EarlyStopping", "VisualDL", "ReduceLROnPlateau",
-           "PreemptionCheckpoint", "config_callbacks"]
+           "PreemptionCheckpoint", "TelemetryCallback", "config_callbacks"]
 
 
 class Callback:

@@ -11,6 +11,7 @@ grad psum — the moral equivalent of fleet's allreduce hooks).
 """
 from __future__ import annotations
 
+import weakref
 from functools import partial
 from typing import Callable, Optional
 
@@ -19,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..nn.layer import Layer, functional_call
+from ..observability.trace import RecompileTracer
 from ..optimizer.lr import LRScheduler
 from ..tensor import Tensor
 
@@ -28,6 +30,19 @@ def _unwrap(x):
         lambda t: t._value if isinstance(t, Tensor) else (
             jnp.asarray(t) if isinstance(t, np.ndarray) else t), x,
         is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _global_grad_norm(grads):
+    """Global L2 norm over every gradient leaf, fp32. Computed INSIDE
+    the compiled step (the reductions fuse into the backward pass's
+    epilogue — no extra dispatch); surfaced as Engine.last_grad_norm
+    for the telemetry layer, which syncs it lazily."""
+    leaves = [g for g in jax.tree_util.tree_leaves(grads)
+              if hasattr(g, "dtype")]
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves))
 
 
 class Engine:
@@ -59,6 +74,29 @@ class Engine:
         self._eval_fn = None
         self._pred_fn = None
         self._rng_key = jax.random.PRNGKey(0)
+        # recompile accounting (docs/observability.md): every jitted
+        # entry point below is wrapped by this tracer, so "the train
+        # step retraced mid-run" is a queryable run fact, not a
+        # mystery slowdown. The device-resident grad norm of the last
+        # fused step rides here for telemetry (no sync until read).
+        from ..observability.metrics import get_registry
+        self.tracer = RecompileTracer(name="engine",
+                                      registry=get_registry())
+        # retire the tracer when this Engine is collected: repeated
+        # Engine construction (sweeps, notebooks, pytest) must not grow
+        # the process-wide live-tracer list; close() keeps the site
+        # aggregates visible to report_all() via the bounded
+        # closed-report ring
+        weakref.finalize(self, self.tracer.close)
+        # grad-norm telemetry is OPT-IN: the reduction is fused into
+        # the step but is still a real all-gradients fp32 reduce XLA
+        # cannot dead-code-eliminate (it is a program output) — a bare
+        # Engine run stays measurement-neutral vs pre-telemetry
+        # baselines. TelemetryCallback enables it at train begin,
+        # before the step first builds.
+        self.collect_grad_norm = False
+        self.last_grad_norm = None
+        self._train_fn_collects_gnorm = False
         # gradient accumulation (two extra jitted programs, built lazily)
         self._grad_fn = None
         self._apply_fn = None
@@ -179,6 +217,16 @@ class Engine:
         self.guard = guard
         return guard
 
+    def enable_grad_norm(self):
+        """Ask the compiled train step to also output the global grad
+        L2 norm (Engine.last_grad_norm, synced lazily). Takes effect
+        when the step next builds: enabling before the first batch
+        (TelemetryCallback does this at train begin) is free; enabling
+        mid-run deliberately does NOT drop an already-compiled step —
+        that rebuild would be exactly the unexpected retrace the
+        tracer exists to catch."""
+        self.collect_grad_norm = True
+
     def _build_guarded_fn(self):
         """Guarded train step (resilience.TrainGuard's compiled half).
 
@@ -206,6 +254,8 @@ class Engine:
         trainable_keys = self._trainable_keys()
         grad_shardings = self._grad_shardings(trainable_keys)
         make_loss_fn = self._make_loss_fn
+        collect_gnorm = self.collect_grad_norm
+        self._train_fn_collects_gnorm = collect_gnorm
         scaler = self.guard.scaler if self.guard is not None else None
         use_scaler = scaler is not None
         if use_scaler:
@@ -240,6 +290,8 @@ class Engine:
             ok = jnp.isfinite(loss_v)
             for g in jax.tree_util.tree_leaves(grads):
                 ok = ok & jnp.all(jnp.isfinite(g))
+            gnorm = _global_grad_norm(grads) if collect_gnorm \
+                else jnp.float32(0.0)
             if clip is not None:
                 grads = clip.apply(grads)
             new_live, new_opt = opt.update(live, grads, opt_state,
@@ -261,10 +313,11 @@ class Engine:
                     decr_ratio=s_decr, incr_every=s_incr_n,
                     decr_every=s_decr_n)
             return ({**frozen, **new_live}, new_buf, new_opt,
-                    scaler_state, loss_v, ok, outs)
+                    scaler_state, loss_v, ok, gnorm, outs)
 
         donate = (0, 1, 2) if self.donate else ()
-        return jax.jit(train_step, donate_argnums=donate)
+        return self.tracer.jit("train_step_guarded", train_step,
+                               donate_argnums=donate)
 
     def _build_train_fn(self):
         if self.guard is not None:
@@ -277,6 +330,8 @@ class Engine:
         trainable_keys = self._trainable_keys()
         grad_shardings = self._grad_shardings(trainable_keys)
         make_loss_fn = self._make_loss_fn
+        collect_gnorm = self.collect_grad_norm
+        self._train_fn_collects_gnorm = collect_gnorm
 
         def train_step(params, buffers, opt_state, lr, step_i, opt_step_i,
                        rng, inputs, labels):
@@ -297,14 +352,18 @@ class Engine:
             if grad_shardings is not None:
                 grads = jax.lax.with_sharding_constraint(
                     grads, grad_shardings)
+            gnorm = _global_grad_norm(grads) if collect_gnorm \
+                else jnp.float32(0.0)
             if clip is not None:
                 grads = clip.apply(grads)
             new_live, new_opt = opt.update(live, grads, opt_state,
                                            lr, opt_step_i)
-            return {**frozen, **new_live}, new_buf, new_opt, loss_v, outs
+            return ({**frozen, **new_live}, new_buf, new_opt, loss_v,
+                    gnorm, outs)
 
         donate = (0, 1, 2) if self.donate else ()
-        return jax.jit(train_step, donate_argnums=donate)
+        return self.tracer.jit("train_step", train_step,
+                               donate_argnums=donate)
 
     def _build_accum_fns(self):
         """Gradient accumulation as TWO compiled programs (ref: the
@@ -365,10 +424,12 @@ class Engine:
             new_acc = jax.tree_util.tree_map(jnp.zeros_like, acc)
             return {**frozen, **new_live}, new_opt, new_acc
 
-        grad_jit = jax.jit(grad_step,
-                           donate_argnums=(2,) if self.donate else ())
-        apply_jit = jax.jit(apply_step,
-                            donate_argnums=(0, 1, 2) if self.donate else ())
+        grad_jit = self.tracer.jit(
+            "grad_step", grad_step,
+            donate_argnums=(2,) if self.donate else ())
+        apply_jit = self.tracer.jit(
+            "apply_step", apply_step,
+            donate_argnums=(0, 1, 2) if self.donate else ())
         return grad_jit, apply_jit
 
     def _ensure_opt_state(self):
@@ -427,6 +488,9 @@ class Engine:
         self._acc_grads, self._buffers, loss_v, outs = self._grad_fn(
             self._params, self._buffers, self._acc_grads,
             np.int32(self._step), self._rng_key, in_arrs, lab_arrs)
+        # this path computes no global grad norm: clear the fused-step
+        # value so telemetry never reports a stale one as current
+        self.last_grad_norm = None
         self._micro_count += 1
         applied = False
         if apply_update:
@@ -485,7 +549,7 @@ class Engine:
                 l_arr = (l._value if isinstance(l, Tensor) else l).astype(jnp.float32)
             return _unwrap(outs), l_arr
 
-        return jax.jit(eval_step)
+        return self.tracer.jit("eval_step", eval_step)
 
     # ------------------------------------------------------------------
     def _lr_now(self):
@@ -520,10 +584,13 @@ class Engine:
         self._step += 1
         self._opt_step += 1
         (self._params, self._buffers, self._opt_state, loss_v,
-         outs) = self._train_fn(self._params, self._buffers, self._opt_state,
-                                lr, np.int32(self._step),
-                                np.int32(self._opt_step), self._rng_key,
-                                in_arrs, lab_arrs)
+         gnorm, outs) = self._train_fn(
+            self._params, self._buffers, self._opt_state,
+            lr, np.int32(self._step),
+            np.int32(self._opt_step), self._rng_key,
+            in_arrs, lab_arrs)
+        self.last_grad_norm = gnorm if self._train_fn_collects_gnorm \
+            else None
         # donation deleted the old param/buffer jax arrays: rebind the live
         # Parameter tensors to the new ones so direct network access (eager
         # forward, state_dict, .numpy()) stays valid mid-fit
@@ -575,11 +642,13 @@ class Engine:
 
         from ..resilience.retry import retryable_for
         (self._params, self._buffers, self._opt_state,
-         self._scaler_state, loss_v, ok_flag,
+         self._scaler_state, loss_v, ok_flag, gnorm,
          outs) = call_with_retries(
             dispatch, retries=guard.retries,
             retryable=retryable_for(self.donate),
             base_delay=guard.retry_base_delay, stats=guard.retry_stats)
+        self.last_grad_norm = gnorm if self._train_fn_collects_gnorm \
+            else None
         # ONE host sync for the flag (Model.train_batch syncs the loss
         # anyway); the tentative opt_step+1 the step saw is only
         # committed on a good step, so skips never advance Adam's bias
@@ -651,7 +720,7 @@ class Engine:
                 def body(carry, xs):
                     p, b, s = carry
                     i, lr_i, xi, yi = xs
-                    p, b, s, loss_i, _ = fn(
+                    p, b, s, loss_i, _gn, _ = fn(
                         p, b, s, lr_i, step0 + i, opt_step0 + i, rng,
                         list(xi), list(yi))
                     return (p, b, s), loss_i
@@ -664,9 +733,9 @@ class Engine:
                 # should use train_batch
                 return p, b, s, losses
 
-            multi = jax.jit(multi_step,
-                            donate_argnums=(0, 1, 2) if self.donate
-                            else ())
+            multi = self.tracer.jit("train_step_multi", multi_step,
+                                    donate_argnums=(0, 1, 2)
+                                    if self.donate else ())
             if len(self._multi_fns) > 8:
                 self._multi_fns.clear()
             self._multi_fns[cache_key] = multi
@@ -677,6 +746,9 @@ class Engine:
             self._params, self._buffers, self._opt_state, lrs,
             np.int32(step0), np.int32(opt_step0), self._rng_key,
             in_arrs, lab_arrs)
+        # the scan discards per-step grad norms: clear the fused-step
+        # value so telemetry never reports a stale one as current
+        self.last_grad_norm = None
         if self.donate:
             self.network.load_raw_state(self._params, self._buffers)
         return losses, None

@@ -164,6 +164,8 @@ class Model:
                                       num_workers=num_workers)
         else:
             train_loader = train_data
+        if isinstance(train_loader, DataLoader):
+            train_loader._obs_role = "train"
         eval_loader = None
         if eval_data is not None:
             if isinstance(eval_data, Dataset):
@@ -257,6 +259,8 @@ class Model:
                                 num_workers=num_workers)
         else:
             loader = eval_data
+        if isinstance(loader, DataLoader):
+            loader._obs_role = "eval"
         for m in self._metrics:
             m.reset()
         losses = []
@@ -287,6 +291,8 @@ class Model:
                                 num_workers=num_workers)
         else:
             loader = test_data
+        if isinstance(loader, DataLoader):
+            loader._obs_role = "predict"
         outputs = []
         for batch in loader:
             ins, _ = self._split_batch(batch, predict=True)

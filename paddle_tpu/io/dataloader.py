@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 import queue as _queue
 
 import numpy as np
@@ -107,6 +108,35 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        # batch-wait telemetry: the time the consumer spends blocked in
+        # next() is THE input-bound-run diagnostic (an input-starved
+        # accelerator shows up here, not in step_time). One histogram
+        # observe per batch, host-side only (docs/observability.md).
+        from ..observability.metrics import get_registry
+        reg = get_registry()
+        # role label keeps eval/predict loaders out of the train
+        # batch-wait series (hapi stamps _obs_role; standalone loaders
+        # default to the train diagnostic)
+        role = getattr(self, "_obs_role", "train")
+        hist = reg.histogram(
+            "dataloader_batch_wait_seconds",
+            help="time the consuming loop waited for the next batch",
+            labels={"role": role})
+        ctr = reg.counter("dataloader_batches_total",
+                          help="batches produced by DataLoader",
+                          labels={"role": role})
+        it = self._iter_batches()
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            hist.observe(time.perf_counter() - t0)
+            ctr.inc()
+            yield batch
+
+    def _iter_batches(self):
         if self.num_workers == 0:
             for b in self._gen_batches():
                 yield _to_tensors(b)

@@ -51,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..nn.layer import functional_call
+from ..observability.metrics import MetricsRegistry
 from ..resilience import faults
 from ..resilience.retry import call_with_retries
 from ..tensor import Tensor
@@ -131,6 +132,18 @@ class ServingEngine:
         cancel a running XLA execute — detection only).
     dispatch_retries: bounded deterministic backoff for transient
         RESOURCE_EXHAUSTED-style dispatch errors (resilience.retry).
+    registry: observability.MetricsRegistry the engine publishes its
+        serve_* series into (docs/observability.md metric catalogue);
+        default a PRIVATE per-engine registry, so two engines in one
+        process never alias each other's counters and reset_counters()
+        on one cannot zero another's window — pass
+        observability.metrics.get_registry() (or merge
+        engine.registry.snapshot()) to land the series in the
+        process-global export. Everything is recorded at host step
+        boundaries AFTER the dispatch's existing device sync —
+        instrumentation adds no host sync and no trace inputs, so the
+        zero-recompile contract is untouched. reset_counters() zeroes
+        every serve_* series (incl. retry/watchdog counts) uniformly.
     donate: donate the page pool to the decode/prefill programs
         (in-place HBM updates). Turn OFF when running under a
         persistent compilation cache on jax 0.4.x (reloading donated
@@ -143,7 +156,7 @@ class ServingEngine:
                  use_flash=None, temperature=0.0, top_k=0, seed=0,
                  pad_token_id=0, steps_per_dispatch=8, donate=True,
                  admission_policy="wait", watchdog_timeout=None,
-                 dispatch_retries=2):
+                 dispatch_retries=2, registry=None):
         if page_size % 8:
             raise ValueError(f"page_size must be a multiple of 8 "
                              f"(Mosaic sublane tiling), got {page_size}")
@@ -230,10 +243,81 @@ class ServingEngine:
         self._admit_seq = 0
         self._cancel_pending = set()
         self.last_dispatch_s = 0.0
-        self.status_counts = {"ok": 0, "expired": 0, "cancelled": 0,
-                              "rejected": 0, "evicted": 0}
 
-        self._trace_counts = {}
+        # -- observability: every counter the engine keeps lives in the
+        # registry (status_counts/health() are snapshot VIEWS of it),
+        # so reset_counters() has exactly one reset semantic. Default
+        # is a private registry: series like serve_requests_total are
+        # identified by name alone, so sharing the process-global one
+        # between engines would alias their counters (and reset would
+        # zero a sibling engine's measurement window)
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        reg = self.registry
+        self._own_series = []
+
+        def own(m):
+            self._own_series.append(m)
+            return m
+        self._m_queue_wait = own(reg.histogram(
+            "serve_queue_wait_seconds",
+            help="submit -> admission (prefill start) wait"))
+        self._m_ttft = own(reg.histogram(
+            "serve_ttft_seconds",
+            help="submit -> first generated token (incl. queue wait "
+                 "and prefill)"))
+        self._m_tok = own(reg.histogram(
+            "serve_decode_token_seconds",
+            help="per-token batched-decode latency (dispatch wall / "
+                 "tokens, count-weighted)"))
+        self._m_dispatch = own(reg.histogram(
+            "serve_dispatch_seconds",
+            help="batched decode dispatch wall time"))
+        self._m_decode_tokens = own(reg.counter(
+            "serve_decode_tokens_total",
+            help="tokens generated by batched decode"))
+        self._m_decode_dispatches = own(reg.counter(
+            "serve_decode_dispatches_total",
+            help="batched decode dispatches"))
+        self._m_deadline = own(reg.counter(
+            "serve_deadline_misses_total",
+            help="requests finished with status=expired"))
+        self._m_evictions = own(reg.counter(
+            "serve_evictions_total",
+            help="running requests preempted by the evict admission "
+                 "policy"))
+        self._m_retries = own(reg.counter(
+            "serve_dispatch_retries_total",
+            help="transient dispatch errors absorbed by the retry "
+                 "wrapper"))
+        self._m_wedges = own(reg.counter(
+            "serve_watchdog_wedges_total",
+            help="dispatches the watchdog flagged past its timeout"))
+        self._g_free_pages = own(reg.gauge(
+            "serve_free_pages", help="KV pages on the free list"))
+        self._g_occupancy = own(reg.gauge(
+            "serve_page_occupancy",
+            help="fraction of usable KV pages in use"))
+        self._g_queue_depth = own(reg.gauge(
+            "serve_queue_depth", help="requests awaiting admission"))
+        self._g_running = own(reg.gauge(
+            "serve_running", help="requests occupying a slot"))
+        self._m_req = {}            # status -> serve_requests_total
+        for status in ("ok", "expired", "cancelled", "rejected",
+                       "evicted"):
+            self._status_counter(status)
+        self._seen_retries = 0
+        self._seen_wedges = 0
+        self._update_gauges()
+
+        # the trace counters ARE a RecompileTracer's (same dict): the
+        # zero-recompile assertion's ground truth and the queryable
+        # recompile report (observability.trace.report_all) share one
+        # source of truth
+        from ..observability.trace import RecompileTracer
+        self.tracer = RecompileTracer(name="serving",
+                                      registry=self.registry)
+        self._trace_counts = self.tracer._counts
         self._decode_fn = self._build_decode_fn()
         self._prefill_fns = {}
         # decode-dispatch accounting: batched-decode throughput is THE
@@ -244,10 +328,58 @@ class ServingEngine:
         self.decode_tokens = 0
         self.decode_dispatches = 0
 
+    def _status_counter(self, status):
+        c = self._m_req.get(status)
+        if c is None:
+            c = self.registry.counter(
+                "serve_requests_total",
+                help="finished requests by terminal status",
+                labels={"status": status})
+            self._own_series.append(c)
+            self._m_req[status] = c
+        return c
+
+    @property
+    def status_counts(self):
+        """Snapshot view of serve_requests_total{status=...}."""
+        return {s: int(c.value) for s, c in self._m_req.items()}
+
+    def _update_gauges(self):
+        self._g_free_pages.set(len(self._free_pages))
+        usable = max(self.num_pages - 1, 1)
+        self._g_occupancy.set(
+            round(1.0 - len(self._free_pages) / usable, 6))
+        self._g_queue_depth.set(len(self._queue))
+        self._g_running.set(
+            sum(1 for s in self._slots if s is not None))
+
+    def _sync_registry(self):
+        """Fold the monotonic retry/watchdog sources into registry
+        counters (diffed, so a registry reset restarts them at 0 —
+        the uniform-reset semantics health() reports through)."""
+        r = self.retry_stats.retries
+        if r > self._seen_retries:
+            self._m_retries.inc(r - self._seen_retries)
+        self._seen_retries = r
+        if self._watchdog is not None:
+            w = self._watchdog.wedge_count
+            if w > self._seen_wedges:
+                self._m_wedges.inc(w - self._seen_wedges)
+            self._seen_wedges = w
+        self._update_gauges()
+
     def reset_counters(self):
+        """Zero EVERY serve counter uniformly: decode throughput, the
+        per-status request totals, latency histograms, and the retry/
+        watchdog counts (which previously survived a reset and made
+        health() diverge from the window being measured)."""
         self.decode_seconds = 0.0
         self.decode_tokens = 0
         self.decode_dispatches = 0
+        self._sync_registry()     # consume pending source increments
+        for m in self._own_series:
+            m.reset()
+        self._update_gauges()     # gauges reflect live state, not 0
 
     # -- public API ---------------------------------------------------------
 
@@ -319,6 +451,7 @@ class ServingEngine:
         if self._active.any() and not (self._done | ~self._active).all():
             self._dispatch_decode()
         self._evict()
+        self._sync_registry()
         out, self._finished = self._finished, []
         return out
 
@@ -355,11 +488,13 @@ class ServingEngine:
 
     def close(self):
         """Release host-side resources (the watchdog's polling
-        thread). Call when retiring an engine; safe to call twice.
-        Compiled programs and the page pool are plain GC'd objects."""
+        thread, the tracer's slot in the process-wide report set).
+        Call when retiring an engine; safe to call twice. Compiled
+        programs and the page pool are plain GC'd objects."""
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
+        self.tracer.close()
 
     def __del__(self):
         wd = getattr(self, "_watchdog", None)
@@ -367,11 +502,20 @@ class ServingEngine:
             # signal only — joining a thread from a finalizer can
             # deadlock interpreter shutdown
             wd._stop.set()
+        tr = getattr(self, "tracer", None)
+        if tr is not None:
+            # an engine retired without close() must not pin a live
+            # tracer in the process-wide report set forever
+            tr.close()
 
     def health(self):
         """One host-side snapshot of engine liveness and degradation
         state — the thing a load balancer or operator pages on. Pure
-        bookkeeping reads: no device sync, no compilation."""
+        bookkeeping reads: no device sync, no compilation. Counter
+        fields are views of the registry's serve_* series, so this and
+        metrics.json can never disagree and reset_counters() resets
+        both at once."""
+        self._sync_registry()
         running = sum(1 for s in self._slots if s is not None)
         now = time.monotonic()
         h = {"running": running,
@@ -381,6 +525,7 @@ class ServingEngine:
                      default=0.0), 6),
              "free_pages": len(self._free_pages),
              "total_pages": self.num_pages - 1,
+             "page_occupancy": self._g_occupancy.value,
              "rounds": self._rounds,
              "decode_dispatches": self.decode_dispatches,
              "decode_tokens": self.decode_tokens,
@@ -388,11 +533,14 @@ class ServingEngine:
              "results_pending": len(self._finished),
              "cancels_pending": len(self._cancel_pending),
              "admission_policy": self.admission_policy,
-             "dispatch_retries": self.retry_stats.retries,
+             "dispatch_retries": int(self._m_retries.value),
+             "deadline_misses": int(self._m_deadline.value),
+             "evictions": int(self._m_evictions.value),
              "status_counts": dict(self.status_counts),
              "compile_counts": self.compile_counts()}
         if self._watchdog is not None:
-            h["watchdog"] = self._watchdog.health()
+            h["watchdog"] = dict(self._watchdog.health(),
+                                 wedge_count=int(self._m_wedges.value))
         return h
 
     # -- sampling (one strategy per engine == per compiled program) ---------
@@ -412,20 +560,19 @@ class ServingEngine:
     # -- compiled programs --------------------------------------------------
 
     def _counting(self, name, fn, donate_argnums=()):
-        """jit with a trace counter: the counter bumps exactly when jax
-        (re)traces, i.e. on every compile — the zero-recompile
-        assertion's ground truth."""
-        counts = self._trace_counts
-
+        """jit through the RecompileTracer: its per-site counter bumps
+        exactly when jax (re)traces, i.e. on every compile — the
+        zero-recompile assertion's ground truth — and each trace lands
+        in the recompile report with its signature + compile wall time.
+        Steady-state host overhead is two dict reads per call."""
         def wrapped(*args):
-            counts[name] = counts.get(name, 0) + 1
             from ..autograd import no_grad
             with no_grad():
                 return fn(*args)
 
-        if self.donate and donate_argnums:
-            return jax.jit(wrapped, donate_argnums=donate_argnums)
-        return jax.jit(wrapped)
+        kw = {"donate_argnums": donate_argnums} \
+            if (self.donate and donate_argnums) else {}
+        return self.tracer.jit(name, wrapped, **kw)
 
     def _layer_caches(self, pages, page_table, positions):
         return [PagedLayerCache(k, v, page_table, positions,
@@ -529,7 +676,11 @@ class ServingEngine:
         """Finish a request that never reached (or is leaving) a slot.
         age_s — submit-to-finish latency — rides the result so tail
         latency is measurable per request, not just per dispatch."""
-        self.status_counts[status] = self.status_counts.get(status, 0) + 1
+        self._status_counter(status).inc()
+        if status == "expired":
+            self._m_deadline.inc()
+        elif status == "evicted":
+            self._m_evictions.inc()
         self._finished.append({"id": req.rid,
                                "prompt": req.prompt.tolist(),
                                "tokens": list(tokens or []),
@@ -660,6 +811,7 @@ class ServingEngine:
             return  # back-pressure: retry next boundary
 
     def _admit_one(self, b, req, need_pages):
+        self._m_queue_wait.observe(time.monotonic() - req.submitted_at)
         ps = self.page_size
         lp = len(req.prompt)
         # pow2 bucket, rounded UP to whole pages: write_prompt_kv
@@ -685,7 +837,8 @@ class ServingEngine:
                 jnp.asarray(ids), jnp.int32(lp), jnp.asarray(pages_vec),
                 self._rng)
         self._pages = new_pages
-        tok = int(tok)
+        tok = int(tok)  # host sync: the first token exists NOW
+        self._m_ttft.observe(time.monotonic() - req.submitted_at)
 
         self._admit_seq += 1
         self._slots[b] = _Slot(req, pages, admit_seq=self._admit_seq)
@@ -758,9 +911,18 @@ class ServingEngine:
         # the np.array() conversions above force the device sync, so
         # this timestamp bounds real work, not async dispatch
         self.last_dispatch_s = time.perf_counter() - t0
+        n_new = int((self._emitted - emitted_before).sum())
         self.decode_seconds += self.last_dispatch_s
-        self.decode_tokens += int((self._emitted - emitted_before).sum())
+        self.decode_tokens += n_new
         self.decode_dispatches += 1
+        # histograms ride the sync that already happened above — one
+        # count-weighted observe per dispatch, nothing per token
+        self._m_dispatch.observe(self.last_dispatch_s)
+        self._m_decode_dispatches.inc()
+        if n_new:
+            self._m_tok.observe(self.last_dispatch_s / n_new,
+                                count=n_new)
+            self._m_decode_tokens.inc(n_new)
         for b in range(self.max_slots):
             slot = self._slots[b]
             if slot is None:

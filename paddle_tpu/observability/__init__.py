@@ -1,0 +1,17 @@
+"""Unified telemetry subsystem (metrics registry, recompile tracer,
+structured run telemetry) — docs/observability.md.
+
+Layering: ``metrics`` and ``telemetry`` are pure stdlib (importable
+from the jax-free bench orchestrator and worker processes); ``trace``
+imports jax lazily inside the wrapping calls.
+"""
+from . import metrics, telemetry, trace  # noqa: F401
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
+                      default_time_buckets, get_registry)
+from .telemetry import TelemetryCallback, TelemetryLogger  # noqa: F401
+from .trace import RecompileTracer, get_tracer, report_all  # noqa: F401
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "default_time_buckets", "get_registry",
+           "TelemetryCallback", "TelemetryLogger", "RecompileTracer",
+           "get_tracer", "report_all", "metrics", "telemetry", "trace"]

@@ -1,0 +1,253 @@
+"""RecompileTracer — every XLA trace becomes a queryable run fact.
+
+"Zero-recompile" was a bench-only assertion (ServingEngine counted
+traces privately; the Engine counted nothing). This tracer is the one
+mechanism both ride: ``tracer.jit(site, fn, **jit_kwargs)`` returns a
+jitted callable whose body bumps a per-site counter exactly when jax
+(re)traces — the same ground truth the serving zero-recompile contract
+already used — and whose host wrapper, ONLY on a call that traced,
+records an event carrying:
+
+- the site name ("decode", "prefill_32", "train_step", ...);
+- the argument shape/dtype signature (computed lazily, never on the
+  steady-state hot path);
+- a wall timestamp and the call's wall time (trace + compile +
+  dispatch — the cost a recompile cliff actually charges);
+- whether the trace was UNEXPECTED: a signature this site has already
+  traced once. First-time signatures (a new prefill bucket, an
+  intentional shape change) are expected; re-tracing a seen signature
+  means a compiled program was dropped and rebuilt — the cliff the
+  MLPerf/TPU-pod postmortems say to hunt first.
+
+Per-call steady-state overhead is two dict reads and a perf_counter —
+no device sync, no shape walking. Tracers register in a process-wide
+WeakSet; ``report_all()`` merges every live tracer's report into the
+run report bench.py exports next to metrics.json.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+import time
+
+__all__ = ["RecompileTracer", "get_tracer", "all_tracers", "report_all"]
+
+# REENTRANT: close() runs from GC finalizers (Engine's
+# weakref.finalize, ServingEngine.__del__), and a cyclic collection
+# can fire on an allocation made while this same thread already holds
+# the lock (report_all builds dicts under it) — a plain Lock would
+# self-deadlock there
+_all_lock = threading.RLock()
+# strong refs, deliberately: a bench worker's Engine (and its tracer)
+# is often garbage before the end-of-run report is written — a weak
+# registry would silently drop exactly the sites the report is for.
+# Cost is bounded per tracer (counts + a maxlen event deque), and a
+# long-lived host that retires engines bounds the COUNT by calling
+# tracer.close() (Engine/ServingEngine finalizers do), which folds the
+# tracer's aggregates into _closed_agg — a CUMULATIVE per-tracer-name
+# rollup, never evicted, so an unexpected retrace recorded by engine
+# #3 of a 500-engine sweep still shows in the final report (a bounded
+# list of individual reports would silently drop it).
+_all_tracers = []
+_closed_agg = {}
+
+
+def _leaf_sig(x):
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return f"{x.dtype}{list(x.shape)}"
+    return type(x).__name__
+
+
+def _signature(args, kwargs):
+    import jax
+    leaves = jax.tree_util.tree_leaves((args, kwargs))
+    parts = [_leaf_sig(l) for l in leaves]
+    s = ";".join(parts)
+    if len(s) > 512:
+        digest = hashlib.sha1(s.encode()).hexdigest()[:12]
+        s = f"{parts[0]};...;{parts[-1]} ({len(parts)} leaves, " \
+            f"sha1:{digest})"
+    return s
+
+
+class RecompileTracer:
+    """Per-subsystem trace accounting (Engine and ServingEngine each
+    own one; ad-hoc code can share ``get_tracer()``)."""
+
+    def __init__(self, name="default", registry=None, max_events=256):
+        self.name = name
+        self._counts = {}          # site -> total traces
+        self._sigs = {}            # site -> set of seen signatures
+        self._unexpected = {}      # site -> retraces of a seen sig
+        self._events = collections.deque(maxlen=max_events)
+        self._registry = registry
+        self._closed = False
+        with _all_lock:
+            _all_tracers.append(self)
+
+    # -- wrapping ----------------------------------------------------------
+    def jit(self, site, fn, **jit_kwargs):
+        """jax.jit(fn) with trace accounting at `site`. The inner bump
+        runs exactly when jax traces (compiles); the outer wrapper
+        stays host-side and records the event + signature only on a
+        call that traced."""
+        import jax
+        counts = self._counts
+
+        def traced(*args, **kw):
+            counts[site] = counts.get(site, 0) + 1
+            return fn(*args, **kw)
+
+        jfn = jax.jit(traced, **jit_kwargs)
+        tracer = self
+
+        def call(*args, **kw):
+            before = counts.get(site, 0)
+            t0 = time.perf_counter()
+            out = jfn(*args, **kw)
+            if counts.get(site, 0) != before:
+                tracer._note(site, args, kw,
+                             time.perf_counter() - t0)
+            return out
+
+        call.site = site
+        call.jitted = jfn
+        # drop-in for a bare jax.jit: callers introspect the compiled
+        # function (Engine AOT-lowers grad/apply steps to audit
+        # donation; tests clear one function's executable cache)
+        for attr in ("lower", "clear_cache", "eval_shape", "trace"):
+            if hasattr(jfn, attr):
+                setattr(call, attr, getattr(jfn, attr))
+        return call
+
+    def _note(self, site, args, kwargs, wall_s):
+        try:
+            sig = _signature(args, kwargs)
+        except Exception:  # noqa: BLE001 — accounting must never kill a step
+            sig = "<unavailable>"
+        seen = self._sigs.setdefault(site, set())
+        unexpected = sig in seen
+        seen.add(sig)
+        if unexpected:
+            self._unexpected[site] = self._unexpected.get(site, 0) + 1
+        self._events.append({
+            "site": site, "signature": sig,
+            "ts": round(time.time(), 6),
+            "compile_s": round(wall_s, 6),
+            "unexpected": unexpected,
+        })
+        reg = self._registry
+        if reg is not None:
+            reg.counter("recompile_traces_total",
+                        help="XLA traces (== compiles) per jit site",
+                        labels={"tracer": self.name,
+                                "site": site}).inc()
+            if unexpected:
+                reg.counter(
+                    "recompile_unexpected_retraces_total",
+                    help="re-traces of an already-seen signature",
+                    labels={"tracer": self.name, "site": site}).inc()
+            reg.histogram("recompile_wall_seconds",
+                          help="wall time of calls that traced",
+                          labels={"tracer": self.name}).observe(wall_s)
+
+    # -- manual accounting (sites not built via .jit) ----------------------
+    def count_trace(self, site):
+        """Bump `site` from inside a hand-rolled traced body (legacy
+        callers); no signature/event is recorded."""
+        self._counts[site] = self._counts.get(site, 0) + 1
+
+    # -- queries -----------------------------------------------------------
+    def counts(self):
+        return dict(self._counts)
+
+    def unexpected_retraces(self):
+        return sum(self._unexpected.values())
+
+    def events(self, site=None):
+        return [e for e in self._events
+                if site is None or e["site"] == site]
+
+    def report(self):
+        """The queryable recompile report: per-site trace totals,
+        distinct signatures, unexpected retraces, plus the bounded
+        event log."""
+        sites = {}
+        for site, n in sorted(self._counts.items()):
+            sites[site] = {
+                "traces": n,
+                "signatures": len(self._sigs.get(site, ())),
+                "unexpected_retraces": self._unexpected.get(site, 0),
+            }
+        return {"tracer": self.name, "sites": sites,
+                "unexpected_retraces": self.unexpected_retraces(),
+                "events": list(self._events)}
+
+    def close(self):
+        """Retire this tracer: drop it from the live set (so repeated
+        engine construction can't grow memory for the process
+        lifetime) while keeping its site aggregates — minus the event
+        log and signature sets — visible to report_all(), folded into
+        the cumulative per-name rollup. Safe to call twice; the
+        wrapped jitted callables keep working, they just stop
+        contributing new facts to the merged report."""
+        with _all_lock:
+            try:
+                _all_tracers.remove(self)
+            except ValueError:
+                return  # already closed
+            self._closed = True
+            rep = self.report()
+            if not rep["sites"]:
+                return
+            agg = _closed_agg.setdefault(
+                self.name, {"tracer": self.name, "sites": {},
+                            "unexpected_retraces": 0, "events": [],
+                            "closed": True, "closed_tracers": 0})
+            for site, row in rep["sites"].items():
+                dst = agg["sites"].setdefault(
+                    site, {"traces": 0, "signatures": 0,
+                           "unexpected_retraces": 0})
+                dst["traces"] += row["traces"]
+                # distinct-per-tracer counts summed: an upper bound on
+                # process-wide distinct signatures (the sets are gone)
+                dst["signatures"] += row["signatures"]
+                dst["unexpected_retraces"] += row["unexpected_retraces"]
+            agg["unexpected_retraces"] += rep["unexpected_retraces"]
+            agg["closed_tracers"] += 1
+
+
+_default = RecompileTracer(name="default")
+
+
+def get_tracer():
+    return _default
+
+
+def all_tracers():
+    with _all_lock:
+        return list(_all_tracers)
+
+
+def report_all():
+    """Merge every live tracer's report (plus the compact reports of
+    closed ones) — the `recompile_report` section of the exported run
+    report. `unexpected_retraces` == 0 is the queryable form of the
+    zero-recompile claim."""
+    with _all_lock:
+        # one lock acquisition across live builds AND the closed-agg
+        # read, plus a final _closed re-check: a tracer whose GC
+        # finalizer closes it mid-report (the RLock re-entry the module
+        # comment anticipates) folds into _closed_agg and is then
+        # dropped from the live pass — counted once, never twice
+        pairs = [(t, t.report()) for t in list(_all_tracers)]
+        tracers = [{**r, "sites": {s: dict(v)
+                                   for s, v in r["sites"].items()}}
+                   for r in list(_closed_agg.values())]
+        tracers += [r for t, r in pairs if not t._closed]
+    tracers = [t for t in tracers if t["sites"]]
+    tracers.sort(key=lambda t: t["tracer"])
+    return {"tracers": tracers,
+            "unexpected_retraces": sum(t["unexpected_retraces"]
+                                       for t in tracers)}

@@ -60,7 +60,7 @@ class Profiler:
     """
 
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
-                 timer_only=True, trace_dir=None):
+                 timer_only=True, trace_dir=None, registry=None):
         # timer_only=False (paddle parity: collect more than step timers)
         # turns on the jax trace even without an explicit trace_dir
         if not timer_only and trace_dir is None:
@@ -74,6 +74,23 @@ class Profiler:
         self._step_t0 = None
         self._steps = 0
         self._active = False
+        # registry bridge (docs/observability.md): every timed region
+        # also lands in the metrics registry as
+        # profiler_region_seconds{region=...}, so profiler numbers ride
+        # the same metrics.json export as train/serve telemetry.
+        # registry=False disables the bridge; None uses the global one.
+        if registry is None:
+            from .observability.metrics import get_registry
+            registry = get_registry()
+        self.registry = registry or None
+
+    def _publish(self, name, dt):
+        if self.registry is None or name.startswith("__"):
+            return
+        self.registry.histogram(
+            "profiler_region_seconds",
+            help="profiler-timed region wall time",
+            labels={"region": name}).observe(dt)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -106,6 +123,7 @@ class Profiler:
         if self._step_t0 is not None:
             st = self._events["train_step"]
             st.add(now - self._step_t0)
+            self._publish("train_step", now - self._step_t0)
             if num_samples:
                 self._events["__samples__"].add(num_samples)
         self._step_t0 = now
@@ -124,7 +142,9 @@ class Profiler:
         yield
         if sync:
             _device_sync()
-        self._events[name].add(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        self._events[name].add(dt)
+        self._publish(name, dt)
 
     # -- reporting ---------------------------------------------------------
     def summary(self, sorted_by="total", time_unit="ms"):
@@ -172,8 +192,9 @@ class RecordEvent:
 
     def __exit__(self, *a):
         if self.profiler is not None and self._t0 is not None:
-            self.profiler._events[self.name].add(
-                time.perf_counter() - self._t0)
+            dt = time.perf_counter() - self._t0
+            self.profiler._events[self.name].add(dt)
+            self.profiler._publish(self.name, dt)
         self._t0 = None
 
 
@@ -189,8 +210,47 @@ def profile(trace_dir=None, **kw):
 
 def export_chrome_tracing(dir_name, worker_name=None):
     """ref: paddle.profiler.export_chrome_tracing — returns an
-    on_trace_ready callback. JAX's trace already lands in Perfetto/TB
-    format under trace_dir; this just records where."""
+    on_trace_ready callback that COPIES the JAX trace artifacts
+    (xplane protos + Perfetto/Chrome json, which land under
+    trace_dir/plugins/profile/<run>/) into `dir_name`, so the export
+    dir holds the trace instead of merely knowing where it was.
+    worker_name prefixes the copied file names (multi-host runs)."""
+    import shutil
+
     def cb(prof):
         prof._export_dir = dir_name
+        prof._exported = []
+        if not prof.trace_dir or not os.path.isdir(prof.trace_dir):
+            return
+        os.makedirs(dir_name, exist_ok=True)
+        src_root = os.path.abspath(prof.trace_dir)
+        dst_root = os.path.abspath(dir_name)
+        taken = set()
+        for root, _dirs, files in os.walk(src_root):
+            aroot = os.path.abspath(root)
+            if aroot == dst_root or aroot.startswith(dst_root + os.sep):
+                continue  # exporting into trace_dir itself: no cycles
+            for fn in sorted(files):
+                if not fn.endswith((".json", ".json.gz", ".pb",
+                                    ".perfetto-trace", ".trace")):
+                    continue
+                src = os.path.join(root, fn)
+                name = f"{worker_name}.{fn}" if worker_name else fn
+                if name in taken:
+                    # two profiling runs under trace_dir carrying
+                    # same-named artifacts: a flat copy would clobber
+                    # the earlier one — disambiguate with the source
+                    # subpath flattened into the name
+                    rel = os.path.relpath(aroot, src_root)
+                    rel = "root" if rel == "." else rel.replace(
+                        os.sep, ".")
+                    name = (f"{worker_name}.{rel}.{fn}"
+                            if worker_name else f"{rel}.{fn}")
+                taken.add(name)
+                dst = os.path.join(dst_root, name)
+                try:
+                    shutil.copy2(src, dst)
+                except OSError:
+                    continue  # a torn trace file must not kill stop()
+                prof._exported.append(dst)
     return cb

@@ -1,0 +1,794 @@
+"""Observability subsystem (ISSUE 4): metrics registry, recompile
+tracer, structured run telemetry, and their wiring into hapi / serving
+/ dataloader / profiler.
+
+Pins the contracts docs/observability.md documents:
+- histogram bucket math (log-spaced 1-2-5 ladder, count-weighted
+  observe, bucket-interpolated quantiles) and snapshot MERGE;
+- Prometheus-text and JSON export golden strings;
+- RecompileTracer: an intentional shape change is a trace with a fresh
+  signature (expected), re-tracing a seen signature is UNEXPECTED, and
+  a zero-recompile serve wave records nothing after warmup;
+- TelemetryCallback: skip/rollback counts consistent with TrainGuard
+  under an injected NaN storm (resilience.faults seams);
+- TelemetryLogger JSONL rotation + torn-line-tolerant summarize();
+- ServingEngine health()/reset_counters() uniform reset through the
+  registry (the retry/watchdog-survives-reset divergence, fixed).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability.metrics import (Counter, Gauge, Histogram,
+                                              MetricsRegistry,
+                                              default_time_buckets,
+                                              get_registry)
+from paddle_tpu.observability.telemetry import (TelemetryCallback,
+                                                TelemetryLogger)
+from paddle_tpu.observability.trace import RecompileTracer, report_all
+from paddle_tpu.resilience import TrainGuard, faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# -- histogram math -------------------------------------------------------
+
+class TestHistogram:
+    def test_default_buckets_are_125_ladder(self):
+        b = default_time_buckets(-2, 0)
+        assert b == (0.01, 0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0)
+        assert list(b) == sorted(b)
+
+    def test_observe_bucketing_and_overflow(self):
+        h = Histogram("h", buckets=(1.0, 2.0, 5.0))
+        for v in (0.5, 1.0, 1.5, 4.0, 100.0):
+            h.observe(v)
+        # counts: (..1], (1..2], (2..5], overflow
+        assert h.counts == [2, 1, 1, 1]
+        assert h.count == 5
+        assert h.min == 0.5 and h.max == 100.0
+        assert h.sum == pytest.approx(107.0)
+
+    def test_count_weighted_observe(self):
+        h = Histogram("h", buckets=(1.0, 10.0))
+        h.observe(0.25, count=8)   # a K-token dispatch in O(1)
+        assert h.count == 8
+        assert h.counts == [8, 0, 0]
+        assert h.sum == pytest.approx(2.0)
+        assert h.mean() == pytest.approx(0.25)
+
+    def test_quantiles_interpolate_within_min_max(self):
+        h = Histogram("h", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.5, 1.6, 3.0):
+            h.observe(v)
+        assert h.quantile(0.0) >= h.min
+        assert h.quantile(1.0) == pytest.approx(h.max)
+        p50 = h.quantile(0.5)
+        assert 1.0 <= p50 <= 2.0, "median sits in the (1,2] bucket"
+        assert Histogram("e").quantile(0.5) is None
+
+    def test_merge_adds_buckets_and_tracks_extrema(self):
+        a = Histogram("h", buckets=(1.0, 2.0))
+        b = Histogram("h", buckets=(1.0, 2.0))
+        a.observe(0.5)
+        b.observe(1.5)
+        b.observe(9.0)
+        a.merge(b.snapshot())
+        assert a.count == 3
+        assert a.counts == [1, 1, 1]
+        assert a.min == 0.5 and a.max == 9.0
+        assert a.sum == pytest.approx(11.0)
+
+    def test_merge_rejects_mismatched_bounds(self):
+        a = Histogram("h", buckets=(1.0, 2.0))
+        b = Histogram("h", buckets=(1.0, 2.0, 4.0))
+        with pytest.raises(ValueError, match="mismatched bucket"):
+            a.merge(b.snapshot())
+
+
+# -- registry: series identity, merge, reset ------------------------------
+
+class TestRegistry:
+    def test_series_identity_and_type_guard(self):
+        reg = MetricsRegistry()
+        c1 = reg.counter("req", labels={"status": "ok"})
+        c2 = reg.counter("req", labels={"status": "ok"})
+        c3 = reg.counter("req", labels={"status": "bad"})
+        assert c1 is c2 and c1 is not c3
+        with pytest.raises(TypeError, match="already registered"):
+            reg.gauge("req", labels={"status": "ok"})
+
+    def test_merge_counters_add_gauges_last_win(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("n").inc(2)
+        b.counter("n").inc(3)
+        a.gauge("g").set(1.0)
+        b.gauge("g").set(7.0)
+        b.histogram("h", buckets=(1.0,)).observe(0.5)
+        a.merge(b.snapshot())
+        assert a.counter("n").value == 5
+        assert a.gauge("g").value == 7.0
+        assert a.get("h").count == 1
+
+    def test_reset_zeroes_in_place(self):
+        reg = MetricsRegistry()
+        c = reg.counter("n")
+        h = reg.histogram("h", buckets=(1.0,))
+        c.inc(4)
+        h.observe(0.5)
+        reg.reset()
+        assert c.value == 0, "the held handle must stay live"
+        assert h.count == 0 and h.min is None
+
+    def test_concurrent_scrape_during_registration(self):
+        # a scrape thread iterating the registry while the main thread
+        # lazily registers new series must not crash with "dictionary
+        # changed size during iteration"
+        import threading
+        reg = MetricsRegistry()
+        stop = threading.Event()
+        errs = []
+
+        def scrape():
+            while not stop.is_set():
+                try:
+                    reg.to_prometheus()
+                    reg.snapshot()
+                    reg.names()
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+                    return
+
+        t = threading.Thread(target=scrape)
+        t.start()
+        try:
+            for i in range(300):
+                reg.counter("c", labels={"i": str(i)}).inc()
+                reg.histogram("h", labels={"i": str(i)}).observe(0.1)
+        finally:
+            stop.set()
+            t.join()
+        assert not errs, errs
+
+    def test_dump_is_parseable_with_extra(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("n").inc()
+        p = reg.dump(str(tmp_path / "metrics.json"),
+                     extra={"recompile_report": {"unexpected": 0}})
+        doc = json.loads(open(p).read())
+        assert doc["metrics"]["n"]["value"] == 1
+        assert doc["recompile_report"] == {"unexpected": 0}
+
+
+# -- export golden strings ------------------------------------------------
+
+class TestExports:
+    def _golden_registry(self):
+        reg = MetricsRegistry()
+        reg.counter("requests_total", help="served requests",
+                    labels={"status": "ok"}).inc(3)
+        reg.gauge("free_pages").set(5)
+        h = reg.histogram("latency_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5, count=2)
+        return reg
+
+    def test_prometheus_golden(self):
+        text = self._golden_registry().to_prometheus()
+        assert text == (
+            "# TYPE free_pages gauge\n"
+            "free_pages 5\n"
+            "# TYPE latency_seconds histogram\n"
+            'latency_seconds_bucket{le="0.1"} 1\n'
+            'latency_seconds_bucket{le="1.0"} 3\n'
+            'latency_seconds_bucket{le="+Inf"} 3\n'
+            "latency_seconds_sum 1.05\n"
+            "latency_seconds_count 3\n"
+            "# HELP requests_total served requests\n"
+            "# TYPE requests_total counter\n"
+            'requests_total{status="ok"} 3\n')
+
+    def test_json_golden_roundtrip(self):
+        doc = json.loads(self._golden_registry().to_json())
+        m = doc["metrics"]
+        assert m['requests_total{status="ok"}'] == {
+            "name": "requests_total", "labels": {"status": "ok"},
+            "type": "counter", "value": 3}
+        assert m["latency_seconds"]["counts"] == [1, 2, 0]
+        assert m["latency_seconds"]["sum"] == pytest.approx(1.05)
+        fresh = MetricsRegistry()
+        fresh.merge(doc)   # a dumped snapshot is a mergeable snapshot
+        assert fresh.get("free_pages").value == 5
+
+
+# -- recompile tracer -----------------------------------------------------
+
+class TestRecompileTracer:
+    def test_trace_once_then_silent(self):
+        import jax.numpy as jnp
+        reg = MetricsRegistry()
+        tr = RecompileTracer(name="t", registry=reg)
+        f = tr.jit("add", lambda x: x + 1)
+        for _ in range(3):
+            f(jnp.zeros((4,)))
+        assert tr.counts() == {"add": 1}
+        assert tr.unexpected_retraces() == 0
+        [e] = tr.events()
+        assert e["site"] == "add" and not e["unexpected"]
+        assert "[4]" in e["signature"] and "float" in e["signature"]
+        assert reg.counter("recompile_traces_total",
+                           labels={"tracer": "t",
+                                   "site": "add"}).value == 1
+
+    def test_shape_change_is_expected_new_signature(self):
+        import jax.numpy as jnp
+        tr = RecompileTracer(name="t")
+        f = tr.jit("add", lambda x: x + 1)
+        f(jnp.zeros((4,)))
+        f(jnp.zeros((8,)))   # intentional retrace: NEW signature
+        assert tr.counts()["add"] == 2
+        assert tr.unexpected_retraces() == 0
+        rep = tr.report()
+        assert rep["sites"]["add"] == {"traces": 2, "signatures": 2,
+                                       "unexpected_retraces": 0}
+
+    def test_seen_signature_retrace_is_unexpected(self):
+        import jax.numpy as jnp
+        tr = RecompileTracer(name="t", registry=MetricsRegistry())
+        f = tr.jit("add", lambda x: x + 1)
+        f(jnp.zeros((4,)))
+        # drop THIS function's compiled program (the cliff), without
+        # jax.clear_caches() nuking other tests' warm programs
+        f.jitted.clear_cache()
+        f(jnp.zeros((4,)))
+        assert tr.counts()["add"] == 2
+        assert tr.unexpected_retraces() == 1
+        assert [e["unexpected"] for e in tr.events()] == [False, True]
+
+    def test_report_all_merges_live_tracers(self):
+        import jax.numpy as jnp
+        tr = RecompileTracer(name="zz-report-all-test")
+        tr.jit("f", lambda x: x * 2)(jnp.ones(()))
+        rep = report_all()
+        names = [t["tracer"] for t in rep["tracers"]]
+        assert "zz-report-all-test" in names
+
+    def test_serve_wave_traces_warmup_only(self, tmp_path):
+        """The acceptance shape: a zero-recompile serve wave records
+        warmup traces and NOTHING after — and the instrumentation
+        itself (histograms, health snapshots) induces no retrace."""
+        from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+        from paddle_tpu.nlp.serving import ServingEngine
+        paddle.seed(0)
+        model = GPTForCausalLM(_resolve_config("gpt-tiny"))
+        reg = MetricsRegistry()
+        eng = ServingEngine(model, max_slots=2, page_size=16,
+                            max_seq_len=48, steps_per_dispatch=2,
+                            registry=reg)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 256, (6,)) for _ in range(4)]
+        eng.generate(prompts, max_new_tokens=4)      # warmup wave
+        events_after_warmup = len(eng.tracer.events())
+        eng.reset_counters()
+        eng.generate(prompts, max_new_tokens=4)      # steady wave
+        eng.health()
+        assert len(eng.tracer.events()) == events_after_warmup, \
+            "steady-state wave must record zero trace events"
+        assert eng.tracer.unexpected_retraces() == 0
+        assert reg.get("serve_ttft_seconds").count == 4
+        assert reg.get("serve_decode_token_seconds").count > 0
+
+
+# -- telemetry logger: JSONL + rotation -----------------------------------
+
+class TestTelemetryLogger:
+    def test_emit_and_summarize(self, tmp_path):
+        lg = TelemetryLogger(str(tmp_path))
+        lg.emit("train_step", step=1, loss=2.0)
+        lg.emit("train_step", step=2, loss=1.0)
+        lg.emit("serve_request", ttft_ms=5.0)
+        s = lg.summarize()
+        assert s["records"] == 3
+        st = s["by_kind"]["train_step"]["fields"]["loss"]
+        assert st == {"min": 1.0, "max": 2.0, "last": 1.0, "mean": 1.5}
+        lg.close()
+
+    def test_rotation_keeps_bounded_files(self, tmp_path):
+        lg = TelemetryLogger(str(tmp_path), rotate_bytes=200,
+                             max_rotated=2)
+        for i in range(50):
+            lg.emit("r", i=i, pad="x" * 40)
+        assert lg.rotations >= 3
+        lg.flush()
+        files = lg.files()
+        assert [os.path.basename(f) for f in files] == [
+            "telemetry.jsonl.2", "telemetry.jsonl.1",
+            "telemetry.jsonl"]
+        recs = list(lg.iter_records())
+        assert recs, "retained files must still parse"
+        # newest record survives; the oldest rotated out
+        assert recs[-1]["i"] == 49
+        assert recs[0]["i"] > 0
+        lg.close()
+
+    def test_nan_loss_emits_valid_json(self, tmp_path):
+        """A NaN loss (the storm the guard records) must land as RFC
+        JSON (null), never a bare NaN token jq/JS consumers reject."""
+        lg = TelemetryLogger(str(tmp_path))
+        lg.emit("train_step", loss=float("nan"), step_time_s=0.1,
+                nested={"g": float("inf")})
+        lg.close()
+        raw = open(lg.path).read()
+        assert "NaN" not in raw and "Infinity" not in raw
+        rec = json.loads(raw.splitlines()[0])
+        assert rec["loss"] is None and rec["nested"]["g"] is None
+        assert rec["step_time_s"] == 0.1
+
+    def test_nan_gauge_dumps_valid_json(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.gauge("train_loss").set(float("nan"))
+        reg.counter("ok_total").inc(2)
+        path = reg.dump(str(tmp_path / "metrics.json"))
+        raw = open(path).read()
+        assert "NaN" not in raw
+        doc = json.loads(raw)
+        assert doc["metrics"]["train_loss"]["value"] is None
+        assert reg.to_json()  # parseable too
+        assert "NaN" not in reg.to_json()
+
+    def test_torn_line_does_not_kill_rollup(self, tmp_path):
+        lg = TelemetryLogger(str(tmp_path))
+        lg.emit("r", i=1)
+        lg.flush()
+        with open(lg.path, "a") as f:
+            f.write('{"kind": "r", "i": 2')   # torn crash write
+        assert lg.summarize()["records"] == 1
+        lg.close()
+
+
+# -- TelemetryCallback under a NaN storm ----------------------------------
+
+class TestTelemetryCallback:
+    def _fit(self, tmp_path, registry, storm=None):
+        paddle.seed(0)
+        net = paddle.nn.Linear(8, 4)
+        model = paddle.Model(net)
+        guard = TrainGuard(snapshot_every=1, rollback_after=3)
+        model.prepare(
+            paddle.optimizer.AdamW(1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss(), guard=guard)
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((32, 8)).astype("float32")
+        Y = rng.integers(0, 4, (32,)).astype("int64")
+        cb = TelemetryCallback(run_dir=str(tmp_path), registry=registry)
+        if storm:
+            faults.inject("nan_grads", step=storm[0], count=storm[1])
+        model.fit(paddle.io.TensorDataset([X, Y]), epochs=1,
+                  batch_size=4, verbose=0, shuffle=False,
+                  callbacks=[cb])
+        return guard, cb
+
+    def test_storm_counts_match_guard(self, tmp_path):
+        reg = MetricsRegistry()
+        guard, cb = self._fit(tmp_path, reg, storm=(3, 3))
+        assert guard.skipped_steps == 3
+        assert guard.rollbacks == 1
+        assert reg.counter("train_skipped_steps_total").value == 3
+        assert reg.counter("train_rollbacks_total").value == 1
+        assert reg.counter("train_steps_total").value == 8
+        assert reg.get("train_step_seconds").count == 8
+        assert reg.gauge("train_loss").value > 0
+        assert reg.gauge("train_samples_per_s").value > 0
+        assert reg.gauge("train_grad_norm").value >= 0
+        # JSONL records carry the same story, step by step
+        recs = [r for r in cb.logger.iter_records()
+                if r["kind"] == "train_step"]
+        assert len(recs) == 8
+        assert [r["outcome"] for r in recs] == (
+            ["ok", "ok", "skipped", "skipped", "rolled_back",
+             "ok", "ok", "ok"])
+        assert recs[-1]["skipped"] == 3 and recs[-1]["rollbacks"] == 1
+        end = [r for r in cb.logger.iter_records()
+               if r["kind"] == "train_end"]
+        assert end and end[0]["skipped_steps"] == 3
+
+    def test_clean_run_exports_zero_counters(self, tmp_path):
+        """A clean run exports the guard counters AT ZERO — absent
+        metrics are indistinguishable from broken wiring."""
+        reg = MetricsRegistry()
+        guard, cb = self._fit(tmp_path, reg)
+        assert reg.counter("train_skipped_steps_total").value == 0
+        assert reg.counter("train_rollbacks_total").value == 0
+        assert cb.metrics_path and os.path.exists(cb.metrics_path)
+        doc = json.load(open(cb.metrics_path))
+        assert "recompile_report" in doc
+        # scope to THIS fit's engine: report_all() spans every tracer
+        # the process ever made, including other tests' deliberate
+        # retraces (tracers register strongly — see trace.py)
+        assert cb.model._engine.tracer.unexpected_retraces() == 0
+
+    def test_second_fit_does_not_recount_history(self, tmp_path):
+        """Guard/scaler totals are lifetime-absolute on the guard; a
+        second fit() on the same model must baseline them at
+        train_begin and diff only ITS OWN skips into the registry."""
+        reg = MetricsRegistry()
+        paddle.seed(0)
+        net = paddle.nn.Linear(8, 4)
+        model = paddle.Model(net)
+        guard = TrainGuard(snapshot_every=1, rollback_after=3)
+        model.prepare(
+            paddle.optimizer.AdamW(1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss(), guard=guard)
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((32, 8)).astype("float32")
+        Y = rng.integers(0, 4, (32,)).astype("int64")
+        ds = paddle.io.TensorDataset([X, Y])
+        faults.inject("nan_grads", step=3, count=3)
+        model.fit(ds, epochs=1, batch_size=4, verbose=0, shuffle=False,
+                  callbacks=[TelemetryCallback(run_dir=str(tmp_path),
+                                               registry=reg)])
+        assert guard.skipped_steps == 3
+        assert reg.counter("train_skipped_steps_total").value == 3
+        # clean second fit: fresh callback, same guard + registry —
+        # the counters must NOT double to 6/2
+        model.fit(ds, epochs=1, batch_size=4, verbose=0, shuffle=False,
+                  callbacks=[TelemetryCallback(run_dir=str(tmp_path),
+                                               registry=reg)])
+        assert guard.skipped_steps == 3
+        assert reg.counter("train_skipped_steps_total").value == 3
+        assert reg.counter("train_rollbacks_total").value == 1
+        assert reg.counter("train_steps_total").value == 16
+
+    def test_grad_norm_is_opt_in(self, tmp_path):
+        """A bare Engine (no TelemetryCallback) must not pay the
+        in-step grad-norm reduction: last_grad_norm stays None and the
+        compiled step matches pre-telemetry baselines. With the
+        callback attached, the same step exports a real norm."""
+        paddle.seed(0)
+        net = paddle.nn.Linear(8, 4)
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.AdamW(1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        eng = model._engine
+        assert not eng.collect_grad_norm
+        x = np.zeros((4, 8), dtype="float32")
+        y = np.zeros((4,), dtype="int64")
+        model.train_batch([x], [y])
+        assert eng.last_grad_norm is None
+
+        reg = MetricsRegistry()
+        guard, cb = self._fit(tmp_path, reg)
+        recs = [r for r in cb.logger.iter_records()
+                if r["kind"] == "train_step"]
+        assert all(r.get("grad_norm") is not None for r in recs)
+        assert cb.model._engine.collect_grad_norm
+
+    def test_grad_norm_cleared_on_accum_and_multi_paths(self):
+        """train_batch_accum / train_batch_multi compute no global
+        grad norm; they must CLEAR last_grad_norm so a later telemetry
+        read never reports a stale fused-step value as current."""
+        paddle.seed(0)
+        net = paddle.nn.Linear(8, 4)
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.AdamW(1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        eng = model._engine
+        eng.enable_grad_norm()
+        x = np.zeros((4, 8), dtype="float32")
+        y = np.zeros((4,), dtype="int64")
+        model.train_batch([x], [y])
+        assert eng.last_grad_norm is not None
+        eng.train_batch_accum([x], [y], apply_update=True)
+        assert eng.last_grad_norm is None
+
+        model.train_batch([x], [y])
+        assert eng.last_grad_norm is not None
+        xs = np.stack([x, x])
+        ys = np.stack([y, y])
+        eng.train_batch_multi([xs], [ys])
+        assert eng.last_grad_norm is None
+
+    def test_dataloader_batch_wait_lands_in_global_registry(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        reg = get_registry()
+        train = {"role": "train"}
+        before = reg.get("dataloader_batches_total", labels=train)
+        before = before.value if before else 0
+        X = np.zeros((8, 3), "float32")
+        n = sum(1 for _ in DataLoader(TensorDataset([X]), batch_size=2))
+        assert n == 4
+        assert reg.counter("dataloader_batches_total",
+                           labels=train).value == before + 4
+        assert reg.get("dataloader_batch_wait_seconds",
+                       labels=train).count >= 4
+
+    def test_dataloader_role_label_separates_eval_from_train(self):
+        # eval/predict loaders must not pollute the train batch-wait
+        # series (the input-bound-run diagnostic)
+        from paddle_tpu.io import DataLoader, TensorDataset
+        reg = get_registry()
+        train = reg.counter("dataloader_batches_total",
+                            labels={"role": "train"}).value
+        X = np.zeros((6, 3), "float32")
+        loader = DataLoader(TensorDataset([X]), batch_size=2)
+        loader._obs_role = "eval"
+        assert sum(1 for _ in loader) == 3
+        assert reg.counter("dataloader_batches_total",
+                           labels={"role": "eval"}).value >= 3
+        assert reg.counter("dataloader_batches_total",
+                           labels={"role": "train"}).value == train
+
+
+# -- serving reset/health uniformity (the ISSUE 4 divergence fix) ---------
+
+class TestServeResetUniformity:
+    @pytest.fixture(scope="class")
+    def engine(self):
+        from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+        from paddle_tpu.nlp.serving import ServingEngine
+        paddle.seed(0)
+        model = GPTForCausalLM(_resolve_config("gpt-tiny"))
+        eng = ServingEngine(model, max_slots=2, page_size=16,
+                            max_seq_len=48, steps_per_dispatch=2,
+                            dispatch_retries=2,
+                            registry=MetricsRegistry())
+        yield eng
+        eng.close()
+
+    def test_reset_clears_retry_and_status_fields(self, engine):
+        rng = np.random.default_rng(0)
+        faults.inject("dispatch_error", count=1)
+        engine.generate([rng.integers(0, 256, (6,))], max_new_tokens=4)
+        h = engine.health()
+        assert h["dispatch_retries"] == 1
+        assert h["status_counts"]["ok"] == 1
+        assert h["deadline_misses"] == 0
+        engine.reset_counters()
+        h2 = engine.health()
+        assert h2["dispatch_retries"] == 0, \
+            "retry count must not survive reset_counters()"
+        assert h2["status_counts"]["ok"] == 0
+        assert h2["decode_tokens"] == 0
+        # live state (pages, queue) is NOT a counter: still truthful
+        assert h2["free_pages"] == engine.free_page_count
+
+    def test_counters_resume_after_reset(self, engine):
+        rng = np.random.default_rng(1)
+        engine.generate([rng.integers(0, 256, (6,))], max_new_tokens=4)
+        h = engine.health()
+        assert h["status_counts"]["ok"] == 1
+        assert h["page_occupancy"] == 0.0, "drained pool reads empty"
+
+
+class TestServeRegistryIsolation:
+    def test_default_registries_are_per_engine(self):
+        """Two engines with the default registry must not alias each
+        other's serve_* series: counts stay per-engine and one
+        engine's reset cannot zero a sibling's window."""
+        from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+        from paddle_tpu.nlp.serving import ServingEngine
+        from paddle_tpu.observability.metrics import get_registry
+        paddle.seed(0)
+        model = GPTForCausalLM(_resolve_config("gpt-tiny"))
+        a = ServingEngine(model, max_slots=1, page_size=16,
+                          max_seq_len=48, steps_per_dispatch=2)
+        b = ServingEngine(model, max_slots=1, page_size=16,
+                          max_seq_len=48, steps_per_dispatch=2)
+        try:
+            assert a.registry is not b.registry
+            assert a.registry is not get_registry()
+            rng = np.random.default_rng(0)
+            a.generate([rng.integers(0, 256, (6,))], max_new_tokens=4)
+            assert a.health()["status_counts"]["ok"] == 1
+            assert b.health()["status_counts"]["ok"] == 0
+            b.reset_counters()
+            assert a.health()["status_counts"]["ok"] == 1, \
+                "a sibling's reset_counters() must not zero this engine"
+        finally:
+            a.close()
+            b.close()
+
+    def test_closed_tracer_report_retained(self):
+        """close() deregisters the tracer (no unbounded growth across
+        engine reloads) but its site aggregates stay in report_all."""
+        from paddle_tpu.observability.trace import (RecompileTracer,
+                                                    all_tracers,
+                                                    report_all)
+        tr = RecompileTracer(name="retired", registry=MetricsRegistry())
+        f = tr.jit("square", lambda x: x * x)
+        f(np.arange(4.0, dtype=np.float32))
+        tr.close()
+        assert tr not in all_tracers()
+        tr.close()  # idempotent
+        mine = [t for t in report_all()["tracers"]
+                if t["tracer"] == "retired"]
+        assert len(mine) == 1 and mine[0]["closed"]
+        assert mine[0]["sites"]["square"]["traces"] == 1
+        assert mine[0]["events"] == []
+
+    def test_closed_aggregate_never_evicts(self):
+        """An unexpected retrace recorded by an early engine must
+        survive ANY number of later tracer retirements — closed
+        tracers fold into a cumulative per-name rollup, not a bounded
+        list that silently evicts the one fact the report exists to
+        keep."""
+        import jax.numpy as jnp
+        from paddle_tpu.observability.trace import (RecompileTracer,
+                                                    report_all)
+        early = RecompileTracer(name="agg-victim")
+        f = early.jit("hot", lambda x: x + 1)
+        f(jnp.zeros((4,)))
+        f.jitted.clear_cache()
+        f(jnp.zeros((4,)))
+        early.close()
+        for _ in range(70):   # > the old deque's maxlen of 64
+            tr = RecompileTracer(name="agg-churn")
+            tr.jit("g", lambda x: x * 2)(jnp.ones(()))
+            tr.close()
+        rep = report_all()
+        victim = [t for t in rep["tracers"]
+                  if t["tracer"] == "agg-victim"]
+        assert len(victim) == 1 and victim[0]["closed"]
+        assert victim[0]["unexpected_retraces"] == 1
+        churn = [t for t in rep["tracers"]
+                 if t["tracer"] == "agg-churn"]
+        assert len(churn) == 1, "same-name closes fold into ONE row"
+        assert churn[0]["closed_tracers"] == 70
+        assert churn[0]["sites"]["g"]["traces"] == 70
+        assert rep["unexpected_retraces"] >= 1
+
+    def test_engine_gc_retires_tracer(self):
+        """Engines register tracers STRONGLY (bench reports outlive
+        the engine) — so a collected Engine must retire its tracer or
+        repeated construction grows the live set forever."""
+        import gc
+        from paddle_tpu.observability.trace import all_tracers
+        net = paddle.nn.Linear(4, 2)
+        model = paddle.Model(net)
+        model.prepare(
+            paddle.optimizer.AdamW(1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        tr = model._engine.tracer
+        assert tr in all_tracers()
+        del model, net
+        gc.collect()
+        assert tr not in all_tracers()
+
+
+# -- profiler bridge ------------------------------------------------------
+
+class TestProfilerBridge:
+    def test_record_event_lands_in_registry(self):
+        import jax.numpy as jnp
+        from paddle_tpu.profiler import Profiler, RecordEvent
+        reg = MetricsRegistry()
+        p = Profiler(registry=reg).start()
+        with p.record_event("region_a"):
+            float(jnp.ones((4,)).sum())
+        with RecordEvent("region_b", p):
+            pass
+        p.step()
+        p.stop()
+        for region in ("region_a", "region_b", "train_step"):
+            h = reg.get("profiler_region_seconds",
+                        {"region": region})
+            assert h is not None and h.count == 1, region
+
+    def test_registry_false_disables_bridge(self):
+        from paddle_tpu.profiler import Profiler
+        p = Profiler(registry=False).start()
+        with p.record_event("quiet", sync=False):
+            pass
+        p.stop()
+        assert p.registry is None
+
+    def test_export_chrome_tracing_copies_artifacts(self, tmp_path):
+        from paddle_tpu.profiler import export_chrome_tracing
+
+        class FakeProf:
+            trace_dir = str(tmp_path / "trace")
+        run = tmp_path / "trace" / "plugins" / "profile" / "run1"
+        run.mkdir(parents=True)
+        (run / "host.trace.json.gz").write_bytes(b"x")
+        (run / "host.xplane.pb").write_bytes(b"y")
+        (run / "notes.txt").write_bytes(b"ignored")
+        out = tmp_path / "export"
+        cb = export_chrome_tracing(str(out), worker_name="w0")
+        prof = FakeProf()
+        cb(prof)
+        names = sorted(os.listdir(out))
+        assert names == ["w0.host.trace.json.gz", "w0.host.xplane.pb"]
+        assert prof._export_dir == str(out)
+        assert len(prof._exported) == 2
+
+    def test_export_disambiguates_same_named_runs(self, tmp_path):
+        """Two profiling runs under one trace_dir with same-named
+        artifacts must BOTH survive the flat export (the colliding
+        copy carries its source subpath in the name)."""
+        from paddle_tpu.profiler import export_chrome_tracing
+
+        class FakeProf:
+            trace_dir = str(tmp_path / "trace")
+        for run in ("run1", "run2"):
+            d = tmp_path / "trace" / "plugins" / "profile" / run
+            d.mkdir(parents=True)
+            (d / "host.xplane.pb").write_bytes(run.encode())
+        out = tmp_path / "export"
+        prof = FakeProf()
+        export_chrome_tracing(str(out))(prof)
+        assert len(prof._exported) == 2
+        payloads = {open(p, "rb").read() for p in prof._exported}
+        assert payloads == {b"run1", b"run2"}
+
+
+# -- bench worker telemetry (subprocess: the real finalize path) ----------
+
+class TestBenchTelemetry:
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def _run(self, code, argv, env_extra, timeout=120):
+        import subprocess
+        import sys as _sys
+        env = dict(os.environ, CAMPAIGN_CHILD="1", **env_extra)
+        return subprocess.run([_sys.executable, "-c", code] + argv,
+                              cwd=self.REPO, env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout)
+
+    def test_probe_worker_telemetry_stays_framework_free(self, tmp_path):
+        """The probe's time-to-first-signal measures the backend
+        handshake — its telemetry must not charge it the full
+        paddle_tpu package import (the stdlib-only observability
+        modules are file-loaded instead, bench._obs_mod)."""
+        code = (
+            "import sys; sys.argv = ['bench.py']\n"
+            "import bench, json, os\n"
+            "bench._TELEMETRY['worker'] = 'probe'\n"
+            "bench.worker_probe()\n"
+            "bench._finalize_worker_telemetry('probe')\n"
+            "assert 'paddle_tpu' not in sys.modules, 'full import paid'\n"
+            "d = os.path.join(bench.CAMPAIGN_OUT, 'telemetry', 'probe')\n"
+            "doc = json.load(open(os.path.join(d, 'metrics.json')))\n"
+            "assert doc['workers'] == ['probe'], doc\n"
+            "print('LEAN-OK')\n")
+        proc = self._run(code, [], {"JAX_PLATFORMS": "cpu",
+                                    "BENCH_CAMPAIGN_DIR": str(tmp_path)})
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "LEAN-OK" in proc.stdout
+
+    def test_metrics_merge_scoped_to_run_id(self, tmp_path):
+        """Cross-worker merge spans ONE bench invocation (shared
+        BENCH_RUN_ID); a re-invocation with the same telemetry dir
+        OVERWRITES — it must not compound the previous run's counters
+        or resurrect its retraces."""
+        code = (
+            "import sys\n"
+            "workers = sys.argv[1:]; sys.argv = ['bench.py']\n"
+            "import bench\n"
+            "for w in workers:\n"
+            "    bench._TELEMETRY.clear()\n"
+            "    bench._TELEMETRY['worker'] = w\n"
+            "    bench._emit('run_note', worker=w)\n"
+            "    bench._finalize_worker_telemetry(w)\n")
+        env = {"BENCH_TELEMETRY_DIR": str(tmp_path),
+               "BENCH_CAMPAIGN_DIR": str(tmp_path)}
+        p = self._run(code, ["w1", "w2"],
+                      {**env, "BENCH_RUN_ID": "r1"}, timeout=60)
+        assert p.returncode == 0, p.stderr[-2000:]
+        doc = json.load(open(tmp_path / "metrics.json"))
+        assert doc["workers"] == ["w1", "w2"]   # same-run merge
+        p = self._run(code, ["w3"],
+                      {**env, "BENCH_RUN_ID": "r2"}, timeout=60)
+        assert p.returncode == 0, p.stderr[-2000:]
+        doc = json.load(open(tmp_path / "metrics.json"))
+        assert doc["workers"] == ["w3"]         # re-invocation overwrote

@@ -1,0 +1,146 @@
+"""telemetry_smoke — the campaign's CPU observability drill.
+
+Runs the acceptance shape of docs/observability.md end to end without
+burning tunnel window: a 5-step guarded Model.fit (with one injected
+NaN step, so the guard counters are provably live) and a 4-request
+serve wave, both publishing into the process registry, then asserts
+the expected metric names exist, the latency histograms have non-zero
+counts, and the RecompileTracer saw 0 unexpected retraces — and writes
+telemetry.jsonl + metrics.json exactly like a bench stage.
+
+Output dir: $BENCH_TELEMETRY_DIR (tpu_campaign sets it per stage) or
+campaign_out/telemetry/telemetry_smoke. Last stdout line is a JSON
+verdict; exit 0 only when every assertion holds.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+EXPECTED_TRAIN = [
+    "train_step_seconds", "train_steps_total", "train_loss",
+    "train_samples_per_s", "train_skipped_steps_total",
+    "train_rollbacks_total",
+]
+EXPECTED_SERVE = [
+    "serve_ttft_seconds", "serve_decode_token_seconds",
+    "serve_queue_wait_seconds", "serve_dispatch_seconds",
+    "serve_requests_total", "serve_page_occupancy", "serve_free_pages",
+    "serve_decode_tokens_total", "serve_deadline_misses_total",
+    "serve_evictions_total",
+]
+EXPECTED_LOADER = ["dataloader_batch_wait_seconds",
+                   "dataloader_batches_total"]
+# histograms the acceptance criterion requires to hold real samples
+NONZERO_HISTS = ["train_step_seconds", "serve_ttft_seconds",
+                 "serve_decode_token_seconds"]
+
+
+def run_guarded_fit(run_dir):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.hapi.callbacks import TelemetryCallback
+    from paddle_tpu.resilience import TrainGuard, faults
+
+    paddle.seed(0)
+    net = paddle.nn.Linear(8, 4)
+    model = paddle.Model(net)
+    guard = TrainGuard(snapshot_every=1, rollback_after=3)
+    model.prepare(paddle.optimizer.AdamW(1e-2,
+                                         parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss(), guard=guard)
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((20, 8)).astype("float32")
+    Y = rng.integers(0, 4, (20,)).astype("int64")
+    cb = TelemetryCallback(run_dir=run_dir, write_metrics=False)
+    faults.clear()
+    faults.inject("nan_grads", step=3)   # one provably-skipped step
+    model.fit(paddle.io.TensorDataset([X, Y]), epochs=1, batch_size=4,
+              verbose=0, shuffle=False, callbacks=[cb])
+    faults.clear()
+    return {"skipped": guard.skipped_steps,
+            "good_steps": guard.good_steps,
+            "jsonl_records": cb.logger.records}
+
+
+def run_serve_wave(n_requests=4):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+    from paddle_tpu.nlp.serving import ServingEngine
+
+    from paddle_tpu.observability.metrics import get_registry
+
+    paddle.seed(0)
+    model = GPTForCausalLM(_resolve_config("gpt-tiny",
+                                           num_attention_heads=1))
+    # an engine's registry is private by default; the smoke asserts the
+    # whole catalogue in one process-global export, so share it
+    eng = ServingEngine(model, max_slots=2, page_size=8, max_seq_len=32,
+                        steps_per_dispatch=2, registry=get_registry())
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model.config.vocab_size, (6 + i,))
+               for i in range(n_requests)]
+    out = eng.generate(prompts, max_new_tokens=4)
+    h = eng.health()
+    return {"requests": len(out),
+            "tokens": sum(len(t) for t in out),
+            "unexpected_retraces": eng.tracer.unexpected_retraces(),
+            "ok": h["status_counts"]["ok"]}
+
+
+def main():
+    t0 = time.perf_counter()
+    run_dir = (os.environ.get("BENCH_TELEMETRY_DIR")
+               or os.path.join(REPO, "campaign_out", "telemetry",
+                               "telemetry_smoke"))
+    fit = run_guarded_fit(run_dir)
+    serve = run_serve_wave()
+
+    from paddle_tpu.observability.metrics import get_registry
+    from paddle_tpu.observability.trace import report_all
+    reg = get_registry()
+    names = set(reg.names())
+    problems = []
+    for name in EXPECTED_TRAIN + EXPECTED_SERVE + EXPECTED_LOADER:
+        if name not in names:
+            problems.append(f"metric missing: {name}")
+    for name in NONZERO_HISTS:
+        series = [m for m in reg.series() if m.name == name]
+        if series and not sum(m.count for m in series):
+            problems.append(f"histogram empty: {name}")
+    if fit["skipped"] != 1:
+        problems.append(f"guard skipped {fit['skipped']} steps, "
+                        "expected exactly 1 (injected NaN)")
+    if serve["ok"] != serve["requests"]:
+        problems.append(f"serve wave finished {serve['ok']}/"
+                        f"{serve['requests']} ok")
+    rep = report_all()
+    if rep["unexpected_retraces"]:
+        problems.append(f"{rep['unexpected_retraces']} unexpected "
+                        "retraces — a compiled program was rebuilt")
+
+    metrics_path = reg.dump(os.path.join(run_dir, "metrics.json"),
+                            extra={"recompile_report": rep})
+    verdict = {
+        "telemetry_smoke": "ok" if not problems else "FAIL",
+        "problems": problems,
+        "fit": fit, "serve": serve,
+        "metric_names": len(names),
+        "unexpected_retraces": rep["unexpected_retraces"],
+        "metrics_json": os.path.relpath(metrics_path, REPO),
+        "seconds": round(time.perf_counter() - t0, 1),
+    }
+    print(json.dumps(verdict), flush=True)
+    return 0 if not problems else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -44,6 +45,17 @@ def run(cmd, timeout, log_name, env_extra=None):
     # stages must not trigger bench.py's driver-preemption path (which
     # exists to kill *us* when the round-end driver bench starts)
     env["CAMPAIGN_CHILD"] = "1"
+    # per-stage telemetry dir: bench workers (and telemetry_smoke)
+    # write telemetry.jsonl + metrics.json here, next to <stage>.log —
+    # validate_stages checks completed stages produced a parseable one.
+    # Cleared first: the worker-side finalize MERGES an existing
+    # metrics.json (same-run multi-worker stages), so a previous run's
+    # leftovers would pollute this run's counters and keep a
+    # historical unexpected-retrace in the report forever
+    tele_dir = os.path.join(OUT, "telemetry",
+                            os.path.splitext(log_name)[0])
+    shutil.rmtree(tele_dir, ignore_errors=True)
+    env["BENCH_TELEMETRY_DIR"] = tele_dir
     env.update(env_extra or {})
     pid_path = os.path.join(OUT, "current_stage.pid")
     t0 = time.monotonic()
@@ -111,6 +123,11 @@ STAGES = [
     ("chaos_smoke", [PY, "-m", "pytest", "tests/test_resilience.py",
                      "-q", "-m", "chaos", "-p", "no:cacheprovider",
                      "-p", "no:randomly"], 1800,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
+    # observability drill (ISSUE 4, CPU): 5-step guarded fit + serve
+    # wave, asserts the metric catalogue + zero unexpected retraces and
+    # writes the same telemetry.jsonl/metrics.json shape bench stages do
+    ("telemetry_smoke", [PY, "tools/telemetry_smoke.py"], 1200,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
     ("bench_full", [PY, "bench.py"], 7200, {}),
     ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
@@ -267,7 +284,12 @@ def main():
     # collapse after a fresh checkout; bench.py's null-run diagnostic
     # sorts on this). Dict-shaped so readers iterating stage entries
     # skip it via the missing "ok" key.
-    summary = {"_captured_at": {"epoch": int(time.time())}}
+    # _telemetry marks a summary produced by a campaign that exports
+    # per-stage telemetry dirs — validate_stages only enforces the
+    # metrics.json check on such summaries (a pre-telemetry archive
+    # must not read as an observability regression)
+    summary = {"_captured_at": {"epoch": int(time.time())},
+               "_telemetry": 1}
     stages = [s for s in STAGES if s[0] not in RETRY_ONLY]
     if only:  # run in the order the caller listed, not STAGES order
         by_name = {s[0]: s for s in STAGES}

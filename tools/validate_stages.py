@@ -10,6 +10,7 @@ window time): python tools/validate_stages.py
 """
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
@@ -18,7 +19,7 @@ import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from tpu_campaign import REPO, STAGES  # noqa: E402
+from tpu_campaign import OUT, REPO, STAGES  # noqa: E402
 
 _BUDGET_S = 120
 _INSTANT_S = 3.0  # a real stage spends longer than this just importing
@@ -33,7 +34,61 @@ REQUIRED_STAGES = {
     "bench_llama", "decode_probe_paged",
     # round-8 resilience drill (CPU-only, seeded — ISSUE 3)
     "chaos_smoke",
+    # round-9 observability drill (CPU-only — ISSUE 4)
+    "telemetry_smoke",
 }
+
+
+def _emits_metrics(cmd):
+    """Stages built on bench.py workers or telemetry_smoke write
+    telemetry.jsonl + metrics.json into campaign_out/telemetry/<stage>;
+    bare tools (decode_probe, fusion_audit, pytest suites) do not."""
+    return any(os.path.basename(str(a)) in ("bench.py",
+                                            "telemetry_smoke.py")
+               for a in cmd)
+
+
+def check_completed_stage_metrics():
+    """Every COMPLETED stage of the live campaign summary that is
+    expected to emit run telemetry must have left a parseable
+    metrics.json — a stage that measured but exported nothing is a
+    silent observability regression. Returns (problems, checked):
+    the list of problems plus how many stages were actually
+    inspected (0 when there is nothing eligible to validate)."""
+    path = os.path.join(OUT, "summary.json")
+    try:
+        with open(path) as f:
+            summary = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return [], 0   # no live campaign to validate
+    if not summary.get("_telemetry"):
+        # summary predates the telemetry subsystem: its stages never
+        # wrote metrics.json — historical artifacts are not a regression
+        return [], 0
+    by_name = {s[0]: s[1] for s in STAGES}
+    problems = []
+    checked = 0
+    for name, row in summary.items():
+        if name.startswith("_") or not isinstance(row, dict) \
+                or not row.get("ok"):
+            continue
+        cmd = by_name.get(name)
+        if cmd is None or not _emits_metrics(cmd):
+            continue
+        checked += 1
+        mpath = os.path.join(OUT, "telemetry", name, "metrics.json")
+        try:
+            with open(mpath) as f:
+                doc = json.load(f)
+            if not isinstance(doc.get("metrics"), dict):
+                problems.append(
+                    f"{name}: {mpath} parses but has no 'metrics' map")
+        except OSError:
+            problems.append(f"{name}: completed but left no "
+                            f"metrics.json at {mpath}")
+        except json.JSONDecodeError as e:
+            problems.append(f"{name}: unparseable metrics.json ({e})")
+    return problems, checked
 
 
 def _child_pgids(pid):
@@ -86,6 +141,9 @@ def main():
     if missing:
         print(f"MISSING REQUIRED STAGES: {sorted(missing)}")
         return 1
+    metric_problems, metrics_checked = check_completed_stage_metrics()
+    for p in metric_problems:
+        print(f"  metrics: SUSPECT ({p})", flush=True)
     tmp = tempfile.mkdtemp(prefix="stage_preflight_")
     env = dict(os.environ)
     env.update({"BENCH_PROBE_TIMEOUT": "5", "BENCH_WORK_TIMEOUT": "5",
@@ -99,7 +157,12 @@ def main():
         e = dict(env)
         e.update(env_extra)
         # a stage that COMPLETES must not clobber real campaign
-        # artifacts with preflight junk — point any --out at a temp dir
+        # artifacts with preflight junk — point any --out at a temp
+        # dir, and the telemetry finalize (which MERGES into an
+        # existing metrics.json) at preflight-private dirs so it can
+        # never pollute or double-count real campaign telemetry
+        e["BENCH_CAMPAIGN_DIR"] = os.path.join(tmp, "campaign_out")
+        e["BENCH_TELEMETRY_DIR"] = os.path.join(tmp, "telemetry", name)
         cmd = list(cmd)
         for i, a in enumerate(cmd):
             if a == "--out" and i + 1 < len(cmd):
@@ -122,12 +185,19 @@ def main():
             print(f"  {name}: SUSPECT ({bad[-1][1]})", flush=True)
         else:
             print(f"  {name}: ok (rc={rc} in {dt:.1f}s)", flush=True)
-    if bad:
+    if bad or metric_problems:
         print("\nBROKEN/SUSPECT STAGES:")
         for name, line in bad:
             print(f"  {name}: {line}")
+        for p in metric_problems:
+            print(f"  metrics: {p}")
         return 1
-    print(f"\nall {len(STAGES)} stage command lines parse")
+    # claim the metrics verification ONLY when stages were actually
+    # inspected — a pre-telemetry archive (or no summary) is skipped,
+    # not validated
+    print(f"\nall {len(STAGES)} stage command lines parse"
+          + (f"; {metrics_checked} completed stages all exported "
+             "metrics.json" if metrics_checked else ""))
     return 0
 
 
