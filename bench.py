@@ -35,10 +35,13 @@ import time
 
 BASELINE_TOKENS_PER_SEC_PER_CHIP = 3500.0
 
-# bf16 matmul peak of one v5e chip (the bench target hardware). MFU is
-# reported against this regardless of the amp dtype actually used, so an
-# fp32 run shows honestly low MFU rather than flattering itself.
-TPU_PEAK_FLOPS = 197e12
+# Peak FLOPs for MFU denominators resolve per device kind at runtime
+# (env PADDLE_TPU_PEAK_FLOPS override > observability.introspect's
+# per-device-kind table — the old hardcoded v5e 197e12 lives there
+# now). MFU stays reported against the bf16 peak regardless of the amp
+# dtype actually used, so an fp32 run shows honestly low MFU rather
+# than flattering itself. Unresolvable (CPU, no override) -> both MFU
+# legs are null, never computed against a made-up peak.
 
 BASELINE_RESNET50_IMG_PER_SEC_PER_CHIP = 2900.0  # SURVEY §6: A100 fp16
 
@@ -253,6 +256,46 @@ def gpt_flops_per_token(model, seq):
     cfg = model.config
     n = count_params(model)
     return 6 * n + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+
+
+def mfu_fields(tput, units_per_call, analytic_flops_per_unit,
+               sites=("train_step",)):
+    """The MFU stanza every training workload reports
+    (docs/observability.md "analytic vs measured"):
+
+    - ``mfu``            analytic convention (hand-derived FLOPs/unit x
+                         throughput / peak) — comparable across rounds;
+    - ``mfu_measured``   what XLA actually compiled: the train-step
+                         executable's cost_analysis FLOPs over the
+                         measured per-call wall (units_per_call /
+                         tput), same peak. Null where cost analysis is
+                         unavailable (backend reports no flops key, or
+                         introspection skipped/disabled);
+    - ``peak_flops_used`` / ``peak_flops_source`` — the resolved
+                         denominator, so both numbers are auditable.
+
+    Drift between the two legs is the signal, not an error: the
+    analytic convention ignores what XLA fused, rematerialized or
+    skipped — and XLA's cost model counts a lax.scan body ONCE
+    regardless of trip count, so scan-shaped sites (train_step_multi,
+    scan_layers stacks) read K/L-fold low on the measured leg
+    (docs/observability.md "Loop caveat")."""
+    intro = _obs_mod("introspect")
+    peak, src = intro.resolve_peak_flops()
+    out = {"mfu": None, "mfu_measured": None,
+           "peak_flops_used": peak, "peak_flops_source": src}
+    if not peak or not tput:
+        return out
+    out["mfu"] = round(tput * analytic_flops_per_unit / peak, 4)
+    seconds_per_call = units_per_call / tput
+    for site in sites:
+        e = intro.site_cost(site, tracer="engine")
+        if e and e.get("flops"):
+            out["mfu_measured"] = round(
+                e["flops"] / seconds_per_call / peak, 4)
+            out["measured_flops_site"] = site
+            break
+    return out
 
 
 def build_engine(cfg_name, batch, seq, amp, use_flash=True, recompute=False,
@@ -772,7 +815,7 @@ def worker_llama(args, on_tpu):
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": round(tput, 1), "unit": "tokens/s/chip",
         "vs_baseline": None,
-        "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
+        **mfu_fields(tput, batch * seq, fpt),
         "config": cfg, "batch": batch, "seq": seq, "flash": use_flash,
         "backend": jax.default_backend(),
     })
@@ -807,8 +850,7 @@ def worker_resnet(args, on_tpu):
         "vs_baseline": round(
             tput / BASELINE_RESNET50_IMG_PER_SEC_PER_CHIP, 4)
         if on_tpu else None,
-        "mfu": round(tput * flops_per_img / TPU_PEAK_FLOPS, 4)
-        if on_tpu else None,
+        **mfu_fields(tput, batch, flops_per_img),
         "batch": batch, "image": hw, "s2d_stem": args.s2d,
         "layout": eng.network._layout,
         "fused_bottleneck": bool(args.fused_bottleneck),
@@ -895,7 +937,7 @@ def worker_ernie(args, on_tpu):
         "vs_baseline": round(
             tput / BASELINE_ERNIE_TOKENS_PER_SEC_PER_CHIP, 4)
         if on_tpu else None,
-        "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
+        **mfu_fields(tput, batch * seq, fpt),
         "batch": batch, "seq": seq, "fused_qkv": args.fused_qkv,
         "fused_ln": args.fused_ln, "mlm_gather": args.mlm_gather, "chunked_ce": args.chunked_ce,
         "fused_adamw": args.fused_adamw,
@@ -961,6 +1003,10 @@ def worker_gpt(args, on_tpu, big=False):
         tput = run(eng, batch, seq, steps, warmup,
                    scan_steps=args.scan_steps)
     fpt = gpt_flops_per_token(eng.network, seq)
+    # --scan-steps compiles ONE K-step program (train_step_multi): its
+    # cost analysis covers K optimizer steps, so the measured leg's
+    # per-call window is K steps of tokens
+    k = int(args.scan_steps or 0)
     _report({
         # the 1.3B metric name only when the 1.3B config actually ran
         # (smoke mode and --config overrides fall back to the generic one)
@@ -973,7 +1019,9 @@ def worker_gpt(args, on_tpu, big=False):
         # the real chip
         "vs_baseline": round(tput / BASELINE_TOKENS_PER_SEC_PER_CHIP, 4)
         if on_tpu else None,
-        "mfu": round(tput * fpt / TPU_PEAK_FLOPS, 4) if on_tpu else None,
+        **mfu_fields(tput, batch * seq * (k or 1), fpt,
+                     sites=(("train_step_multi",) if k
+                            else ("train_step",))),
         "config": cfg, "batch": batch, "seq": seq, "flash": use_flash,
         "scan_layers": scan_layers, "fused_qkv": args.fused_qkv,
         "fused_ln": args.fused_ln, "chunked_ce": args.chunked_ce,
@@ -1296,7 +1344,9 @@ def _orchestrate_impl(workloads, args, passthrough, skip_probe=False):
                     excluded_decode.append(name)
                     continue
                 row = {k: res[k] for k in ("metric", "value", "unit",
-                                           "vs_baseline", "mfu")
+                                           "vs_baseline", "mfu",
+                                           "mfu_measured",
+                                           "peak_flops_used")
                        if k in res and not isinstance(res[k],
                                                       (dict, list))}
                 if row:

@@ -185,63 +185,80 @@ class Model:
                                 verbose=verbose,
                                 metrics=self._metrics_name())
         cbks.on_begin("train")
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            for step, batch in enumerate(train_loader):
-                if num_iters is not None and step >= num_iters:
-                    break
-                cbks.on_batch_begin("train", step, logs)
-                ins, labs = self._split_batch(batch)
+        try:
+            for epoch in range(epochs):
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                for step, batch in enumerate(train_loader):
+                    if num_iters is not None and step >= num_iters:
+                        break
+                    cbks.on_batch_begin("train", step, logs)
+                    ins, labs = self._split_batch(batch)
+                    if accumulate_grad_batches > 1:
+                        out = self._train_batch_accum(
+                            ins, labs,
+                            apply=(step + 1) % accumulate_grad_batches
+                            == 0)
+                    else:
+                        out = self.train_batch(ins, labs)
+                    logs = self._make_logs(out)
+                    if eng.guard is not None:
+                        # skip/rollback/found-inf counters ride the
+                        # batch logs (ProgBar prints them, VisualDL
+                        # persists)
+                        logs.update(eng.guard.log_scalars())
+                    logs["batch_size"] = len(np.asarray(ins[0]._value)) \
+                        if isinstance(ins[0], Tensor) else batch_size
+                    # resilience seams, host step boundary: the sigterm
+                    # injector delivers the signal BEFORE on_batch_end
+                    # so a PreemptionCheckpoint callback observes the
+                    # flag at this same boundary and checkpoints; the
+                    # post-callback check then ends fit cleanly either
+                    # way
+                    faults.maybe_sigterm(eng._step)
+                    cbks.on_batch_end("train", step, logs)
+                    if preemption.requested():
+                        self.stop_training = True
+                    if self.stop_training:
+                        break
                 if accumulate_grad_batches > 1:
-                    out = self._train_batch_accum(
-                        ins, labs,
-                        apply=(step + 1) % accumulate_grad_batches == 0)
-                else:
-                    out = self.train_batch(ins, labs)
-                logs = self._make_logs(out)
-                if eng.guard is not None:
-                    # skip/rollback/found-inf counters ride the batch
-                    # logs (ProgBar prints them, VisualDL persists)
-                    logs.update(eng.guard.log_scalars())
-                logs["batch_size"] = len(np.asarray(ins[0]._value)) \
-                    if isinstance(ins[0], Tensor) else batch_size
-                # resilience seams, host step boundary: the sigterm
-                # injector delivers the signal BEFORE on_batch_end so a
-                # PreemptionCheckpoint callback observes the flag at
-                # this same boundary and checkpoints; the post-callback
-                # check then ends fit cleanly either way
-                faults.maybe_sigterm(eng._step)
-                cbks.on_batch_end("train", step, logs)
+                    # tail microbatches (epoch end / early stop /
+                    # num_iters): apply the partial window instead of
+                    # dropping it or leaking it into the next epoch
+                    if eng.flush_accum():
+                        self._lr_step_after_update()
+                cbks.on_epoch_end(epoch, logs)
                 if preemption.requested():
-                    self.stop_training = True
+                    # the SIGTERM grace window is for the checkpoint
+                    # (the PreemptionCheckpoint callback already wrote
+                    # it), not for an eval pass over the whole eval set
+                    break
+                if eval_loader is not None and (epoch % eval_freq == 0
+                                                or epoch == epochs - 1):
+                    eval_logs = self.evaluate(eval_loader, verbose=0,
+                                              callbacks=None,
+                                              _internal=True)
+                    logs.update({f"eval_{k}": v
+                                 for k, v in eval_logs.items()})
+                    cbks.on_eval_end(eval_logs)
                 if self.stop_training:
                     break
-            if accumulate_grad_batches > 1:
-                # tail microbatches (epoch end / early stop / num_iters):
-                # apply the partial window instead of dropping it or
-                # leaking it into the next epoch
-                if eng.flush_accum():
-                    self._lr_step_after_update()
-            cbks.on_epoch_end(epoch, logs)
-            if preemption.requested():
-                # the SIGTERM grace window is for the checkpoint (the
-                # PreemptionCheckpoint callback already wrote it), not
-                # for an eval pass over the whole eval set
-                break
-            if eval_loader is not None and (epoch % eval_freq == 0
-                                            or epoch == epochs - 1):
-                eval_logs = self.evaluate(eval_loader, verbose=0,
-                                          callbacks=None, _internal=True)
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
-                cbks.on_eval_end(eval_logs)
-            if self.stop_training:
-                break
+        except Exception as e:
+            # an unhandled exception in fit is a flight-recorder
+            # trigger (docs/observability.md): the last N step records
+            # + registry snapshot survive the crash
+            self._flight_dump("fit_exception", step=eng._step,
+                              error=f"{type(e).__name__}: {e}")
+            raise
         cbks.on_end("train", logs)
         self._sync_weights_back()
         if preemption.requested():
+            # preemption is a flight trigger too — the dump is the
+            # post-mortem complement of the checkpoint the
+            # PreemptionCheckpoint callback wrote
+            self._flight_dump("preemption", step=eng._step)
             # the flag has been SERVICED: this fit stopped for it and
             # every checkpoint callback (incl. on_train_end) has run.
             # Left set, the process-global flag would kill any later
@@ -249,6 +266,40 @@ class Model:
             # read PreemptionCheckpoint.preempted, not the raw flag.
             preemption.clear()
         return self
+
+    @staticmethod
+    def _flight_dump(reason, **extra):
+        try:
+            from ..observability import flightrec
+            flightrec.dump(reason, extra=extra or None)
+        except Exception:  # noqa: BLE001 — a broken disk must not mask
+            pass           # the failure being recorded
+
+    def serve_metrics(self, port=0, host="127.0.0.1"):
+        """Attach a live HTTP metrics exporter to this training run:
+        /metrics is the process-global registry (everything
+        TelemetryCallback and the DataLoader publish), /healthz a
+        liveness doc carrying the engine step + guard stats, /report
+        the recompile + compiled-cost reports. Returns the exporter
+        (read .port when port=0, .close() to stop — its thread is a
+        daemon, so SIGTERM'd runs exit without it). A second call
+        replaces the first."""
+        from ..observability.exporter import MetricsExporter
+        eng = self._ensure_engine()
+
+        def health():
+            doc = {"phase": "train", "step": eng._step,
+                   "opt_step": eng._opt_step}
+            if eng.guard is not None:
+                doc["guard"] = eng.guard.stats()
+            return doc
+
+        old = getattr(self, "_exporter", None)
+        if old is not None:
+            old.close()
+        self._exporter = MetricsExporter(port=port, host=host,
+                                         health_fn=health)
+        return self._exporter
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None,

@@ -74,7 +74,12 @@ def summary(net: Layer, input_size=None, dtypes=None, input=None):
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
     """Rough analytic FLOPs (matmul/conv dominate; mirrors paddle.flops
-    accounting: multiply-adds counted once)."""
+    accounting: multiply-adds counted once). Counts nn.Linear, the mpu
+    Column/RowParallelLinear projections (what GPT/Llama/ERNIE blocks
+    are actually built from — tests/test_flops_drift.py pins this
+    mirror against XLA cost_analysis), and conv layers."""
+    from ..distributed.fleet.mpu import (ColumnParallelLinear,
+                                         RowParallelLinear)
     from ..nn.layers_common import Linear
     from ..nn.layers_conv import _ConvNd
     total = [0]
@@ -92,7 +97,8 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         total[0] += out_elems * k
 
     for _, sub in net.named_sublayers(include_self=True):
-        if isinstance(sub, Linear):
+        if isinstance(sub, (Linear, ColumnParallelLinear,
+                            RowParallelLinear)):
             hooks.append(sub.register_forward_post_hook(linear_hook))
         elif isinstance(sub, _ConvNd):
             hooks.append(sub.register_forward_post_hook(conv_hook))

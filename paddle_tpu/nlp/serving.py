@@ -44,6 +44,7 @@ wrap submissions in your own queue for multi-producer serving.
 from __future__ import annotations
 
 import collections
+import threading
 import time
 
 import jax
@@ -71,7 +72,7 @@ class ServeRequest:
     """
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
-                 "deadline", "priority", "submitted_at")
+                 "deadline", "priority", "submitted_at", "submitted_pc")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  deadline=None, priority=0):
@@ -82,6 +83,8 @@ class ServeRequest:
         self.deadline = deadline
         self.priority = int(priority)
         self.submitted_at = time.monotonic()
+        # span clock (perf_counter): the queue-wait span's start
+        self.submitted_pc = time.perf_counter()
 
 
 class _Slot:
@@ -308,6 +311,10 @@ class ServingEngine:
             self._status_counter(status)
         self._seen_retries = 0
         self._seen_wedges = 0
+        # _sync_registry runs on the step() thread AND (via health())
+        # on metrics-exporter HTTP threads — the diff-and-increment
+        # must not race
+        self._sync_lock = threading.Lock()
         self._update_gauges()
 
         # the trace counters ARE a RecompileTracer's (same dict): the
@@ -317,6 +324,14 @@ class ServingEngine:
         from ..observability.trace import RecompileTracer
         self.tracer = RecompileTracer(name="serving",
                                       registry=self.registry)
+        # per-request span timeline (queue -> prefill -> decode
+        # dispatches -> finish, with page/eviction instants) — a
+        # bounded ring of host timestamps recorded at the step
+        # boundaries the engine already owns; export via
+        # observability.spans.export_chrome (docs/observability.md)
+        from ..observability.spans import SpanRecorder
+        self.spans = SpanRecorder(name="serving")
+        self._exporter = None
         self._trace_counts = self.tracer._counts
         self._decode_fn = self._build_decode_fn()
         self._prefill_fns = {}
@@ -356,17 +371,30 @@ class ServingEngine:
     def _sync_registry(self):
         """Fold the monotonic retry/watchdog sources into registry
         counters (diffed, so a registry reset restarts them at 0 —
-        the uniform-reset semantics health() reports through)."""
-        r = self.retry_stats.retries
-        if r > self._seen_retries:
-            self._m_retries.inc(r - self._seen_retries)
-        self._seen_retries = r
-        if self._watchdog is not None:
-            w = self._watchdog.wedge_count
-            if w > self._seen_wedges:
-                self._m_wedges.inc(w - self._seen_wedges)
-            self._seen_wedges = w
-        self._update_gauges()
+        the uniform-reset semantics health() reports through).
+
+        Locked: health() runs this from the metrics exporter's HTTP
+        threads too (serve_metrics), and the _seen_* read-modify-write
+        racing the step() thread would double-count a wedge/retry —
+        and double-dump the wedge flight record."""
+        with self._sync_lock:
+            r = self.retry_stats.retries
+            if r > self._seen_retries:
+                self._m_retries.inc(r - self._seen_retries)
+            self._seen_retries = r
+            if self._watchdog is not None:
+                w = self._watchdog.wedge_count
+                if w > self._seen_wedges:
+                    self._m_wedges.inc(w - self._seen_wedges)
+                    # a wedged dispatch is a flight-recorder trigger:
+                    # the recent dispatch/request ring + which op
+                    # wedged
+                    from ..observability import flightrec
+                    flightrec.dump("wedge", extra={
+                        "op": self._watchdog.last_wedge_op,
+                        "wedge_count": int(w), "round": self._rounds})
+                self._seen_wedges = w
+            self._update_gauges()
 
     def reset_counters(self):
         """Zero EVERY serve counter uniformly: decode throughput, the
@@ -442,7 +470,21 @@ class ServingEngine:
         admission policy), run ONE batched decode dispatch
         (steps_per_dispatch tokens x all live slots). Returns the list
         of requests finished this round as dicts
-        {id, prompt, tokens, status} (tokens = generated only)."""
+        {id, prompt, tokens, status} (tokens = generated only).
+
+        An unhandled exception here is a flight-recorder trigger: the
+        ring of recent dispatch/request records dumps to
+        flight_serve_exception.json before the error propagates."""
+        try:
+            return self._step_impl()
+        except Exception as e:
+            from ..observability import flightrec
+            flightrec.dump("serve_exception",
+                           extra={"error": f"{type(e).__name__}: {e}",
+                                  "round": self._rounds})
+            raise
+
+    def _step_impl(self):
         self._rounds += 1
         self._apply_cancels()
         self._expire_deadlines()
@@ -486,14 +528,32 @@ class ServingEngine:
     def free_page_count(self):
         return len(self._free_pages)
 
+    def serve_metrics(self, port=0, host="127.0.0.1"):
+        """Attach a live HTTP exporter to THIS engine: /metrics is the
+        engine's registry, /healthz is health(), /report the
+        recompile + cost reports. Returns the exporter (read .port
+        when port=0); close() (and engine close()) shuts it down. A
+        second call replaces the first."""
+        from ..observability.exporter import MetricsExporter
+        if self._exporter is not None:
+            self._exporter.close()
+        self._exporter = MetricsExporter(registry=self.registry,
+                                         port=port, host=host,
+                                         health_fn=self.health)
+        return self._exporter
+
     def close(self):
         """Release host-side resources (the watchdog's polling
-        thread, the tracer's slot in the process-wide report set).
-        Call when retiring an engine; safe to call twice. Compiled
-        programs and the page pool are plain GC'd objects."""
+        thread, the metrics exporter's port + thread, the tracer's
+        slot in the process-wide report set). Call when retiring an
+        engine; safe to call twice. Compiled programs and the page
+        pool are plain GC'd objects."""
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
+        if self._exporter is not None:
+            self._exporter.close()
+            self._exporter = None
         self.tracer.close()
 
     def __del__(self):
@@ -502,6 +562,12 @@ class ServingEngine:
             # signal only — joining a thread from a finalizer can
             # deadlock interpreter shutdown
             wd._stop.set()
+        ex = getattr(self, "_exporter", None)
+        if ex is not None:
+            try:
+                ex.close()
+            except Exception:  # noqa: BLE001 — finalizer safety
+                pass
         tr = getattr(self, "tracer", None)
         if tr is not None:
             # an engine retired without close() must not pin a live
@@ -681,14 +747,20 @@ class ServingEngine:
             self._m_deadline.inc()
         elif status == "evicted":
             self._m_evictions.inc()
+        age = round(time.monotonic() - req.submitted_at, 6)
         self._finished.append({"id": req.rid,
                                "prompt": req.prompt.tolist(),
                                "tokens": list(tokens or []),
                                "status": status,
-                               "age_s": round(
-                                   time.monotonic() - req.submitted_at,
-                                   6)})
+                               "age_s": age})
         self._cancel_pending.discard(req.rid)
+        self.spans.instant("finish", tid=f"req{req.rid}", cat="serve",
+                           args={"status": status,
+                                 "tokens": len(tokens or []),
+                                 "age_s": age})
+        from ..observability import flightrec
+        flightrec.note("serve_finish", rid=req.rid, status=status,
+                       tokens=len(tokens or []), age_s=age)
 
     def _finish_slot(self, b, status=None):
         """Release slot b and emit its result (status defaults to the
@@ -698,6 +770,10 @@ class ServingEngine:
         req = slot.req
         self._finish_request(req, status or slot.status,
                              slot.out_tokens[:req.max_new_tokens])
+        self.spans.instant("release_pages", tid="sched", cat="serve",
+                           args={"rid": req.rid, "slot": b,
+                                 "pages": len(slot.pages),
+                                 "status": status or slot.status})
         self._free_pages.extend(slot.pages)
         self._slots[b] = None
         self._active[b] = False
@@ -812,6 +888,11 @@ class ServingEngine:
 
     def _admit_one(self, b, req, need_pages):
         self._m_queue_wait.observe(time.monotonic() - req.submitted_at)
+        # span: the queue-wait leg closes at admission (one lane per
+        # request — Perfetto shows queue -> prefill -> finish stacked)
+        self.spans.add("queue_wait", req.submitted_pc,
+                       tid=f"req{req.rid}", cat="serve",
+                       args={"rid": req.rid, "slot": b})
         ps = self.page_size
         lp = len(req.prompt)
         # pow2 bucket, rounded UP to whole pages: write_prompt_kv
@@ -831,6 +912,7 @@ class ServingEngine:
         ids[0, :lp] = req.prompt
 
         fn = self._prefill_fn(bucket)
+        t_pre = time.perf_counter()
         with self._watch(f"prefill_{bucket}"):
             tok, new_pages, self._rng = fn(
                 self._params, self._buffers, self._pages,
@@ -839,6 +921,10 @@ class ServingEngine:
         self._pages = new_pages
         tok = int(tok)  # host sync: the first token exists NOW
         self._m_ttft.observe(time.monotonic() - req.submitted_at)
+        # the int(tok) sync above bounds the span at real prefill work
+        self.spans.add(f"prefill_{bucket}", t_pre, tid=f"req{req.rid}",
+                       cat="serve", args={"rid": req.rid, "slot": b,
+                                          "pages": need_pages})
 
         self._admit_seq += 1
         self._slots[b] = _Slot(req, pages, admit_seq=self._admit_seq)
@@ -912,6 +998,17 @@ class ServingEngine:
         # this timestamp bounds real work, not async dispatch
         self.last_dispatch_s = time.perf_counter() - t0
         n_new = int((self._emitted - emitted_before).sum())
+        live = int(sum(1 for s in self._slots if s is not None))
+        # all live requests share one batched dispatch — ONE span on
+        # the shared decode lane, carrying who rode it
+        self.spans.add("decode", t0, t0 + self.last_dispatch_s,
+                       tid="decode", cat="serve",
+                       args={"round": self._rounds, "tokens": n_new,
+                             "live_slots": live})
+        from ..observability import flightrec
+        flightrec.note("serve_dispatch", round=self._rounds,
+                       tokens=n_new, live_slots=live,
+                       wall_s=round(self.last_dispatch_s, 6))
         self.decode_seconds += self.last_dispatch_s
         self.decode_tokens += n_new
         self.decode_dispatches += 1

@@ -1,17 +1,29 @@
 """Unified telemetry subsystem (metrics registry, recompile tracer,
-structured run telemetry) — docs/observability.md.
+structured run telemetry, compiled-cost introspection, live exporter,
+spans, crash flight recorder) — docs/observability.md.
 
-Layering: ``metrics`` and ``telemetry`` are pure stdlib (importable
-from the jax-free bench orchestrator and worker processes); ``trace``
-imports jax lazily inside the wrapping calls.
+Layering: ``metrics``, ``telemetry``, ``exporter``, ``spans`` and
+``flightrec`` are pure stdlib (importable from the jax-free bench
+orchestrator and worker processes); ``trace`` and ``introspect``
+import jax lazily inside the wrapping calls.
 """
-from . import metrics, telemetry, trace  # noqa: F401
+from . import (exporter, flightrec, introspect, metrics,  # noqa: F401
+               spans, telemetry, trace)
+from .exporter import MetricsExporter, serve_metrics  # noqa: F401
+from .flightrec import FlightRecorder  # noqa: F401
+from .introspect import (cost_report, measured_mfu,  # noqa: F401
+                         resolve_peak_flops)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       default_time_buckets, get_registry)
+from .spans import SpanRecorder, export_chrome  # noqa: F401
 from .telemetry import TelemetryCallback, TelemetryLogger  # noqa: F401
 from .trace import RecompileTracer, get_tracer, report_all  # noqa: F401
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "default_time_buckets", "get_registry",
            "TelemetryCallback", "TelemetryLogger", "RecompileTracer",
-           "get_tracer", "report_all", "metrics", "telemetry", "trace"]
+           "get_tracer", "report_all", "MetricsExporter",
+           "serve_metrics", "SpanRecorder", "export_chrome",
+           "FlightRecorder", "cost_report", "measured_mfu",
+           "resolve_peak_flops", "metrics", "telemetry", "trace",
+           "introspect", "exporter", "spans", "flightrec"]

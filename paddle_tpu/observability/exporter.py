@@ -1,0 +1,194 @@
+"""Live metrics endpoint — scrape a RUNNING engine, not its files.
+
+A stdlib ``http.server`` on a daemon thread serving three endpoints:
+
+- ``/metrics``  — Prometheus text exposition of a MetricsRegistry
+  (what a prometheus/grafana scraper or ``curl`` reads mid-run);
+- ``/healthz``  — JSON health snapshot (ServingEngine.health() when
+  attached there; a minimal liveness doc otherwise) — the thing a
+  load balancer probes;
+- ``/report``   — JSON recompile report + compiled-cost report
+  (trace.report_all + introspect.cost_report): the "what did XLA
+  build and did anything retrace" question, answered live.
+
+Every read happens under the registry's own lock (to_prometheus /
+snapshot take it), so a scrape landing mid-serve-dispatch sees a
+consistent registry — never a torn histogram whose ``_count``
+disagrees with its ``+Inf`` bucket.
+
+Attachment is one call: ``ServingEngine.serve_metrics(port=...)`` or
+``Model.serve_metrics(port=...)`` (port 0 picks a free one —
+``exporter.port`` tells you which). ``close()`` is idempotent and
+releases the port immediately (``allow_reuse_address`` covers the
+TIME_WAIT rebind); the serving thread is a daemon, so SIGTERM'd
+processes exit without joining it.
+
+Stdlib-only by contract (standalone-loadable via bench._obs_mod);
+the /report handler imports sibling modules lazily and degrades to
+an empty section when they are unavailable.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["MetricsExporter", "serve_metrics"]
+
+
+def _finite(obj):
+    """Non-finite floats -> None (RFC-valid JSON). Duplicated across
+    the stdlib-only observability modules on purpose: each stays
+    standalone-loadable (bench._obs_mod) with no intra-package imports
+    at module scope."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _finite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_finite(v) for v in obj]
+    return obj
+
+
+class MetricsExporter:
+    """HTTP exporter for one registry (+ optional health/report fns).
+
+    registry: MetricsRegistry to expose (None -> the process-global
+        one, resolved lazily so a standalone load can still pass one).
+    health_fn: zero-arg callable returning a JSON-able dict
+        (ServingEngine.health); None serves a minimal liveness doc.
+    report_fn: zero-arg callable returning extra /report sections
+        merged over the defaults.
+    host/port: bind address; port 0 = ephemeral (read .port after).
+    """
+
+    def __init__(self, registry=None, port=0, host="127.0.0.1",
+                 health_fn=None, report_fn=None):
+        if registry is None:
+            from .metrics import get_registry
+            registry = get_registry()
+        self.registry = registry
+        self.health_fn = health_fn
+        self.report_fn = report_fn
+        self._started = time.time()
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # scrapes every few seconds would spam stderr
+            def log_message(self, *a):  # noqa: D102
+                pass
+
+            def _send(self, code, body, ctype):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _send_json(self, doc, code=200):
+                try:
+                    body = json.dumps(doc, allow_nan=False)
+                except ValueError:
+                    # a NaN loss in a health/report doc must still
+                    # answer as valid JSON (the storm runs this layer
+                    # exists to observe)
+                    body = json.dumps(_finite(doc), allow_nan=False)
+                self._send(code, body, "application/json")
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        self._send(200, exporter.registry.to_prometheus(),
+                                   "text/plain; version=0.0.4; "
+                                   "charset=utf-8")
+                    elif path == "/healthz":
+                        self._send_json(exporter._health())
+                    elif path == "/report":
+                        self._send_json(exporter._report())
+                    else:
+                        self._send_json(
+                            {"error": f"unknown path {path!r}",
+                             "endpoints": ["/metrics", "/healthz",
+                                           "/report"]}, code=404)
+                except Exception as e:  # noqa: BLE001 — a handler bug must
+                    # answer 500, not silently drop the connection
+                    try:
+                        self._send_json({"error": f"{type(e).__name__}: "
+                                                  f"{e}"}, code=500)
+                    except OSError:
+                        pass
+
+        Handler.protocol_version = "HTTP/1.1"
+        # a close()d exporter's port rebinds immediately (no TIME_WAIT
+        # stall between bench rungs/tests): http.server's HTTPServer
+        # already sets allow_reuse_address
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            daemon=True, name=f"paddle-tpu-metrics-{self.port}")
+        self._thread.start()
+        self._closed = False
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def _health(self):
+        doc = {"status": "ok", "ts": round(time.time(), 6),
+               "uptime_s": round(time.time() - self._started, 3)}
+        if self.health_fn is not None:
+            doc.update(self.health_fn())
+        return doc
+
+    def _report(self):
+        doc = {"ts": round(time.time(), 6)}
+        try:
+            from .trace import report_all
+            doc["recompile_report"] = report_all()
+        except ImportError:
+            doc["recompile_report"] = None
+        try:
+            from .introspect import cost_report
+            doc["cost_report"] = cost_report()
+        except ImportError:
+            doc["cost_report"] = None
+        if self.report_fn is not None:
+            doc.update(self.report_fn())
+        return doc
+
+    def close(self):
+        """Stop serving and release the port. Idempotent — engines
+        call this from close() AND finalizers."""
+        if self._closed:
+            return
+        self._closed = True
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=2.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter-shutdown safety
+            pass
+
+
+def serve_metrics(port=0, registry=None, host="127.0.0.1",
+                  health_fn=None, report_fn=None):
+    """Start a MetricsExporter (the one-call attach the docs show);
+    returns it — read ``.port`` / ``.url``, call ``.close()``."""
+    return MetricsExporter(registry=registry, port=port, host=host,
+                           health_fn=health_fn, report_fn=report_fn)

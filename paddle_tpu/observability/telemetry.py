@@ -175,6 +175,21 @@ class TelemetryCallback:
     registry counters). Per run: a train_begin/train_end pair, the
     summarize() rollup, and a metrics.json export.
 
+    Beyond the counters, each step also publishes MFU two ways
+    (docs/observability.md "analytic vs measured"): `train_mfu_measured`
+    divides the compiled executable's XLA cost_analysis FLOPs
+    (introspect.site_cost of the engine's train-step site) by step wall
+    and the resolved chip peak; `train_mfu_analytic` does the same with
+    the hand-derived `flops_per_step=` the caller supplies (omitted ->
+    measured only). Either gauge is absent — never fabricated — when
+    its FLOPs leg or the peak is unresolvable (CPU without
+    PADDLE_TPU_PEAK_FLOPS). A per-step span lands on the callback's
+    SpanRecorder (`.spans`, lane "train", guard outcomes as instants)
+    and is exported to `spans.json` at train end — merge it with
+    engine/serving/profiler recorders via spans.export_chrome for one
+    Perfetto timeline. Every step is also note()d into the crash
+    flight recorder.
+
     jsonl_every: emit a JSONL record every N batches (registry metrics
     update every batch regardless).
     """
@@ -182,10 +197,13 @@ class TelemetryCallback:
     METRIC_NAMES = ("train_step_seconds", "train_steps_total",
                     "train_loss", "train_samples_per_s",
                     "train_grad_norm", "train_skipped_steps_total",
-                    "train_rollbacks_total", "train_found_inf_total")
+                    "train_rollbacks_total", "train_found_inf_total",
+                    "train_mfu_measured", "train_mfu_analytic",
+                    "train_peak_flops")
 
     def __init__(self, run_dir=None, logger=None, registry=None,
-                 jsonl_every=1, write_metrics=True):
+                 jsonl_every=1, write_metrics=True, flops_per_step=None,
+                 write_spans=True):
         if run_dir is None and logger is None:
             raise ValueError("TelemetryCallback needs run_dir= or "
                              "logger=")
@@ -194,6 +212,8 @@ class TelemetryCallback:
         self._owns_logger = logger is None
         self.jsonl_every = max(1, int(jsonl_every))
         self.write_metrics = write_metrics
+        self.write_spans = write_spans
+        self.flops_per_step = flops_per_step
         self._registry = registry
         self.model = None
         self.params = {}
@@ -201,6 +221,22 @@ class TelemetryCallback:
         self._seen = {}
         self.last_summary = None
         self.metrics_path = None
+        self.spans_path = None
+        # sibling modules are optional under standalone file-loading
+        # (bench._obs_mod loads telemetry.py without the package)
+        try:
+            from . import introspect as _intro
+            from .flightrec import note as _fnote
+            from .spans import SpanRecorder
+            self._intro = _intro
+            self._fnote = _fnote
+            self.spans = SpanRecorder(name="train")
+        except ImportError:
+            self._intro = None
+            self._fnote = None
+            self.spans = None
+        self._peak = None
+        self._peak_src = None
 
     # -- Callback protocol (duck-typed; hapi never imported here) ----------
     def set_params(self, params):
@@ -238,12 +274,34 @@ class TelemetryCallback:
                 self._seen["found_inf"] = int(
                     guard.scaler.found_inf_count)
         self._t0 = None
+        # one peak-FLOPs resolution per run (env override > device-kind
+        # table > None); publishing the denominator makes every MFU
+        # gauge auditable from the export alone
+        if self._intro is not None:
+            self._peak, self._peak_src = self._intro.resolve_peak_flops()
+            if self._peak:
+                self._reg().gauge(
+                    "train_peak_flops",
+                    help="peak FLOPs MFU is computed against "
+                         f"({self._peak_src})").set(self._peak)
         self.logger.emit("train_begin",
                          epochs=self.params.get("epochs"),
                          steps=self.params.get("steps"))
 
     def on_train_batch_begin(self, step, logs=None):
         self._t0 = time.perf_counter()
+
+    def _measured_flops(self):
+        """XLA cost_analysis FLOPs of the engine's compiled train-step
+        site (whichever variant this run built); None before the first
+        compile or where the backend reports no flops key."""
+        if self._intro is None:
+            return None
+        for site in ("train_step_guarded", "train_step"):
+            e = self._intro.site_cost(site, tracer="engine")
+            if e and e.get("flops"):
+                return e["flops"]
+        return None
 
     @staticmethod
     def _scalar(v):
@@ -323,13 +381,50 @@ class TelemetryCallback:
         found_inf = self._diff_counter(
             reg, "train_found_inf_total", "found_inf", found_inf)
 
+        # MFU both ways (docs/observability.md): measured rides the
+        # compiled executable's cost_analysis, analytic the caller's
+        # convention — published side by side so drift is queryable
+        mfu_measured = mfu_analytic = None
+        if self._peak and dt:
+            cf = self._measured_flops()
+            if cf:
+                mfu_measured = cf / dt / self._peak
+                reg.gauge("train_mfu_measured",
+                          help="compiled-FLOPs MFU (XLA cost_analysis "
+                               "/ step wall / chip peak)").set(
+                              mfu_measured)
+            if self.flops_per_step:
+                mfu_analytic = self.flops_per_step / dt / self._peak
+                reg.gauge("train_mfu_analytic",
+                          help="analytic-FLOPs MFU (caller convention "
+                               "/ step wall / chip peak)").set(
+                              mfu_analytic)
+
+        outcome = guard.last_outcome if guard is not None else None
+        step_n = getattr(eng, "_step", None)
+        if self.spans is not None and dt is not None:
+            self.spans.add("train_step", now - dt, now, tid="train",
+                           cat="train",
+                           args={"step": step_n, "loss": loss})
+            if outcome in ("skipped", "rolled_back"):
+                self.spans.instant(f"guard_{outcome}", tid="train",
+                                   cat="train", args={"step": step_n})
+        if self._fnote is not None:
+            self._fnote("train_step", step=step_n, loss=loss,
+                        step_time_s=None if dt is None else round(dt, 6),
+                        outcome=outcome)
+
         n = int(reg.counter("train_steps_total").value)
         if n % self.jsonl_every == 0:
             rec = {"step": getattr(eng, "_step", n), "loss": loss,
                    "step_time_s": None if dt is None else round(dt, 6),
                    "samples_per_s": None if samples_per_s is None
                    else round(samples_per_s, 3),
-                   "grad_norm": grad_norm, "batch_size": bs}
+                   "grad_norm": grad_norm, "batch_size": bs,
+                   "mfu_measured": None if mfu_measured is None
+                   else round(mfu_measured, 5),
+                   "mfu_analytic": None if mfu_analytic is None
+                   else round(mfu_analytic, 5)}
             if guard is not None:
                 rec.update(skipped=skipped, rollbacks=rollbacks,
                            outcome=guard.last_outcome)
@@ -360,6 +455,13 @@ class TelemetryCallback:
             self.metrics_path = self._reg().dump(
                 os.path.join(self.run_dir, "metrics.json"),
                 extra={"recompile_report": report_all()})
+        if self.write_spans and self.spans is not None \
+                and self.spans.events():
+            # the run's host-scheduling timeline, Perfetto-openable on
+            # its own; merge more lanes (engine serving spans, profiler
+            # regions) via spans.export_chrome([...]) instead
+            self.spans_path = self.spans.export(
+                os.path.join(self.run_dir, "spans.json"))
         if self._owns_logger:
             self.logger.close()
 

@@ -91,24 +91,40 @@ class RecompileTracer:
         """jax.jit(fn) with trace accounting at `site`. The inner bump
         runs exactly when jax traces (compiles); the outer wrapper
         stays host-side and records the event + signature only on a
-        call that traced."""
+        call that traced. On such a call the site's compiled
+        executable is also introspected (cost/memory analysis — see
+        introspect.py) via an AOT replay whose re-trace is SUPPRESSED
+        from all accounting here: both the counter bump and the
+        host-side note check ``introspecting()``, so the replay can
+        never masquerade as a recompile (nested sites included —
+        train_step re-traced inside train_step_multi's replay stays
+        silent too)."""
         import jax
+        try:
+            from .introspect import introspecting
+        except ImportError:  # standalone file-load (bench._obs_mod)
+            def introspecting():
+                return False
         counts = self._counts
 
         def traced(*args, **kw):
-            counts[site] = counts.get(site, 0) + 1
+            if not introspecting():
+                counts[site] = counts.get(site, 0) + 1
             return fn(*args, **kw)
 
         jfn = jax.jit(traced, **jit_kwargs)
         tracer = self
 
         def call(*args, **kw):
+            if introspecting():
+                return jfn(*args, **kw)
             before = counts.get(site, 0)
             t0 = time.perf_counter()
             out = jfn(*args, **kw)
             if counts.get(site, 0) != before:
-                tracer._note(site, args, kw,
-                             time.perf_counter() - t0)
+                wall = time.perf_counter() - t0
+                tracer._note(site, args, kw, wall)
+                tracer._introspect(site, jfn, args, kw, wall)
             return out
 
         call.site = site
@@ -151,6 +167,17 @@ class RecompileTracer:
             reg.histogram("recompile_wall_seconds",
                           help="wall time of calls that traced",
                           labels={"tracer": self.name}).observe(wall_s)
+
+    def _introspect(self, site, jfn, args, kwargs, wall_s):
+        """Capture the freshly-compiled executable's cost/memory
+        analysis (introspect.capture_site). Failure-proof: a broken
+        AOT path records a skip reason, never kills the step."""
+        try:
+            from .introspect import capture_site
+            capture_site(self.name, site, jfn, args, kwargs,
+                         wall_s=wall_s, registry=self._registry)
+        except Exception:  # noqa: BLE001 — accounting must never kill a step
+            pass
 
     # -- manual accounting (sites not built via .jit) ----------------------
     def count_trace(self, site):

@@ -83,9 +83,20 @@ class Profiler:
             from .observability.metrics import get_registry
             registry = get_registry()
         self.registry = registry or None
+        # span bridge (docs/observability.md): every timed region also
+        # lands on this recorder with its real timestamps, so profiler
+        # regions merge into ONE Perfetto timeline with serving/train
+        # host spans via observability.spans.export_chrome
+        from .observability.spans import SpanRecorder
+        self.spans = SpanRecorder(name="profiler")
 
-    def _publish(self, name, dt):
-        if self.registry is None or name.startswith("__"):
+    def _publish(self, name, dt, t0=None):
+        if name.startswith("__"):
+            return
+        if t0 is not None:
+            self.spans.add(name, t0, t0 + dt, tid="regions",
+                           cat="profiler")
+        if self.registry is None:
             return
         self.registry.histogram(
             "profiler_region_seconds",
@@ -123,7 +134,8 @@ class Profiler:
         if self._step_t0 is not None:
             st = self._events["train_step"]
             st.add(now - self._step_t0)
-            self._publish("train_step", now - self._step_t0)
+            self._publish("train_step", now - self._step_t0,
+                          t0=self._step_t0)
             if num_samples:
                 self._events["__samples__"].add(num_samples)
         self._step_t0 = now
@@ -144,7 +156,7 @@ class Profiler:
             _device_sync()
         dt = time.perf_counter() - t0
         self._events[name].add(dt)
-        self._publish(name, dt)
+        self._publish(name, dt, t0=t0)
 
     # -- reporting ---------------------------------------------------------
     def summary(self, sorted_by="total", time_unit="ms"):
@@ -194,7 +206,7 @@ class RecordEvent:
         if self.profiler is not None and self._t0 is not None:
             dt = time.perf_counter() - self._t0
             self.profiler._events[self.name].add(dt)
-            self.profiler._publish(self.name, dt)
+            self.profiler._publish(self.name, dt, t0=self._t0)
         self._t0 = None
 
 

@@ -204,15 +204,47 @@ class TrainGuard:
             if self._since_snapshot >= self.snapshot_every:
                 self.snapshot(engine)
             self.last_outcome = "ok"
+            rolled = False
         else:
             self.skipped_steps += 1
             self.consecutive_bad += 1
-            if self.consecutive_bad >= self.rollback_after \
-                    and self.rollback(engine):
-                self.last_outcome = "rolled_back"
-            else:
-                self.last_outcome = "skipped"
+            rolled = self.consecutive_bad >= self.rollback_after \
+                and self.rollback(engine)
+            self.last_outcome = "rolled_back" if rolled else "skipped"
+        # every guarded step leaves a flight-recorder breadcrumb — the
+        # rollback dump below must contain the storm's own step
+        # records, so the note lands BEFORE the dump (and only a step
+        # that ROLLED BACK dumps: a storm outlasting rollback_after
+        # keeps skipping afterwards, it does not re-dump per step)
+        self._flight_note(engine, ok)
+        if rolled:
+            self._flight_dump(engine)
         return self.last_outcome
+
+    def _flight_note(self, engine, ok):
+        try:
+            from ..observability import flightrec
+            flightrec.note("guard_step", step=engine._step, ok=bool(ok),
+                           outcome=self.last_outcome,
+                           consecutive_bad=self.consecutive_bad,
+                           skipped_steps=self.skipped_steps)
+        except Exception:  # noqa: BLE001 — accounting never kills a step
+            pass
+
+    def _flight_dump(self, engine):
+        """Rollback is a flight-recorder trigger (docs/observability.md):
+        the ring of recent step records + guard stats lands in
+        flight_rollback.json so the postmortem sees WHICH steps fed
+        the storm. Never raises — recovery must not die to disk."""
+        try:
+            from ..observability import flightrec
+            flightrec.note("guard_rollback", step=engine._step,
+                           **self.stats())
+            flightrec.dump("rollback",
+                           extra={"guard": self.stats(),
+                                  "step": engine._step})
+        except Exception:  # noqa: BLE001
+            pass
 
     # -- reporting ---------------------------------------------------------
     def log_scalars(self):

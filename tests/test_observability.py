@@ -792,3 +792,692 @@ class TestBenchTelemetry:
         assert p.returncode == 0, p.stderr[-2000:]
         doc = json.load(open(tmp_path / "metrics.json"))
         assert doc["workers"] == ["w3"]         # re-invocation overwrote
+
+
+# =========================================================================
+# Round-10 deep-introspection layer (ISSUE 5): compiled-cost capture,
+# live exporter, span timelines, crash flight recorder.
+# =========================================================================
+
+from paddle_tpu.observability import (exporter as obs_exporter,  # noqa: E402
+                                      flightrec, introspect)
+from paddle_tpu.observability.spans import (SpanRecorder,  # noqa: E402
+                                            export_chrome)
+
+
+@pytest.fixture(autouse=True)
+def _clean_introspection(monkeypatch, tmp_path):
+    """Introspection + flight state are process-global; isolate each
+    test and point stray dumps at a tmp dir."""
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    introspect.clear()
+    flightrec.get_recorder().clear()
+    yield
+    introspect.clear()
+    flightrec.get_recorder().clear()
+
+
+class TestIntrospect:
+    def test_normalize_cost_handles_both_jax_shapes(self):
+        # jax 0.4.x: list of dicts; 0.6.x: dict; CPU builds may omit keys
+        lst = introspect.normalize_cost(
+            [{"flops": 10.0, "bytes accessed": 5.0}])
+        assert lst == {"flops": 10.0, "bytes_accessed": 5.0,
+                       "transcendentals": None}
+        dct = introspect.normalize_cost({"flops": 3})
+        assert dct["flops"] == 3.0
+        assert introspect.normalize_cost(None) is None
+        assert introspect.normalize_cost([]) == {
+            "flops": None, "bytes_accessed": None,
+            "transcendentals": None}
+        assert introspect.normalize_cost("bogus") is None
+
+    def test_resolve_peak_env_override_beats_table(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_PEAK_FLOPS", "123e9")
+        peak, src = introspect.resolve_peak_flops()
+        assert peak == 123e9 and src == "env:PADDLE_TPU_PEAK_FLOPS"
+
+    def test_resolve_peak_table_by_device_kind(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_PEAK_FLOPS", raising=False)
+        peak, src = introspect.resolve_peak_flops("TPU v5 lite")
+        assert peak == 197e12 and src.startswith("table:")
+        peak, src = introspect.resolve_peak_flops("TPU v4")
+        assert peak == 275e12
+        peak, src = introspect.resolve_peak_flops("Quantum9000")
+        assert peak is None and "unknown-device-kind" in src
+
+    def test_resolve_peak_null_on_cpu_without_override(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_PEAK_FLOPS", raising=False)
+        peak, src = introspect.resolve_peak_flops()   # CPU backend
+        assert peak is None and src == "no-table:cpu"
+
+    def test_measured_mfu_null_honesty(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_PEAK_FLOPS", raising=False)
+        assert introspect.measured_mfu(None, 0.1) is None
+        assert introspect.measured_mfu(1e9, 0) is None
+        assert introspect.measured_mfu(1e9, 0.1) is None  # no peak
+        assert introspect.measured_mfu(1e9, 0.1, peak=1e12) == \
+            pytest.approx(0.01)
+
+    def test_capture_rides_the_tracer_without_recompile_noise(self):
+        """A traced site is introspected exactly once per compile, the
+        AOT replay never bumps trace counters, and the capture carries
+        real non-zero FLOPs on CPU."""
+        import jax.numpy as jnp
+        reg = MetricsRegistry()
+        tr = RecompileTracer(name="intro_t", registry=reg)
+        f = tr.jit("mm", lambda a, b: jnp.dot(a, b) + 1.0)
+        a = jnp.ones((16, 16), jnp.float32)
+        for _ in range(3):
+            f(a, a)
+        assert tr._counts["mm"] == 1          # replay stayed silent
+        assert tr.unexpected_retraces() == 0
+        e = introspect.site_cost("mm", tracer="intro_t")
+        assert e is not None and e["captures"] == 1
+        if e["flops"] is not None:            # key present on this jax
+            assert e["flops"] >= 2 * 16 * 16 * 16
+        # registry gauge published under (tracer, site) labels
+        g = reg.get("xla_cost_flops",
+                    labels={"tracer": "intro_t", "site": "mm"})
+        assert (g is None) == (e["flops"] is None)
+        rep = introspect.cost_report()
+        assert "intro_t/mm" in rep["sites"]
+        tr.close()
+
+    def test_compile_budget_skips_with_reason(self):
+        out = introspect.capture_site("t", "slow_site", None, (), {},
+                                      wall_s=1e9)
+        assert out is None
+        assert "budget" in introspect.cost_report()["skipped"]["t/slow_site"]
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_INTROSPECT", "0")
+        assert not introspect.enabled()
+        assert introspect.capture_site("t", "s", None, (), {}) is None
+        assert introspect.cost_report()["sites"] == {}
+
+    def test_broken_aot_records_reason_not_raise(self):
+        class Boom:
+            def lower(self, *a, **k):
+                raise RuntimeError("no AOT here")
+        out = introspect.capture_site("t", "broken", Boom(), (), {})
+        assert out is None
+        skipped = introspect.cost_report()["skipped"]
+        assert "RuntimeError" in skipped["t/broken"]
+
+
+def _parse_prom(text):
+    """Prometheus text -> {series_key: float} (comments skipped)."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        out[key] = float(val)
+    return out
+
+
+class TestExporter:
+    def test_endpoints_roundtrip(self):
+        import urllib.error
+        import urllib.request
+        reg = MetricsRegistry()
+        reg.counter("c").inc(7)
+        reg.histogram("h", buckets=(1.0,)).observe(0.5)
+        ex = obs_exporter.MetricsExporter(
+            registry=reg, health_fn=lambda: {"queue": 3},
+            report_fn=lambda: {"extra_section": True})
+        try:
+            txt = urllib.request.urlopen(
+                ex.url + "/metrics", timeout=10).read().decode()
+            assert txt == reg.to_prometheus()
+            h = json.load(urllib.request.urlopen(ex.url + "/healthz",
+                                                 timeout=10))
+            assert h["status"] == "ok" and h["queue"] == 3
+            r = json.load(urllib.request.urlopen(ex.url + "/report",
+                                                 timeout=10))
+            assert "recompile_report" in r and "cost_report" in r
+            assert r["extra_section"] is True
+            try:
+                urllib.request.urlopen(ex.url + "/nope", timeout=10)
+                assert False, "404 expected"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+                assert "endpoints" in json.load(e)
+        finally:
+            ex.close()
+
+    def test_close_releases_port_for_immediate_rebind(self):
+        reg = MetricsRegistry()
+        ex1 = obs_exporter.MetricsExporter(registry=reg)
+        port = ex1.port
+        ex1.close()
+        ex2 = obs_exporter.MetricsExporter(registry=reg, port=port)
+        assert ex2.port == port
+        ex2.close()
+
+    def test_double_close_is_idempotent(self):
+        ex = obs_exporter.MetricsExporter(registry=MetricsRegistry())
+        ex.close()
+        ex.close()   # second close: no error, no hang
+        with obs_exporter.MetricsExporter(
+                registry=MetricsRegistry()) as ex2:
+            pass
+        ex2.close()  # context exit already closed it
+
+    def test_scrape_after_close_refused(self):
+        import urllib.error
+        import urllib.request
+        ex = obs_exporter.MetricsExporter(registry=MetricsRegistry())
+        url = ex.url
+        ex.close()
+        with pytest.raises((urllib.error.URLError, OSError)):
+            urllib.request.urlopen(url + "/metrics", timeout=2)
+
+
+class TestServeObservability:
+    """One 2-request serve wave, scraped live from a second thread:
+    pins the span-timeline golden AND the no-torn-histogram scrape
+    contract in a single compile."""
+
+    @pytest.fixture(scope="class")
+    def wave(self):
+        import threading
+        import urllib.request
+        import paddle_tpu as paddle
+        from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+        from paddle_tpu.nlp.serving import ServingEngine
+
+        paddle.seed(0)
+        model = GPTForCausalLM(_resolve_config("gpt-tiny",
+                                               num_attention_heads=1))
+        eng = ServingEngine(model, max_slots=2, page_size=8,
+                            max_seq_len=32, steps_per_dispatch=2)
+        ex = eng.serve_metrics(port=0)
+        rng = np.random.default_rng(0)
+        rids = [eng.submit(rng.integers(
+            0, model.config.vocab_size, (5 + i,)), max_new_tokens=4)
+            for i in range(2)]
+        scrapes, stop = [], threading.Event()
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    scrapes.append(urllib.request.urlopen(
+                        ex.url + "/metrics", timeout=10).read().decode())
+                except OSError:
+                    pass
+        t = threading.Thread(target=scraper, daemon=True)
+        t.start()
+        finished = []
+        rounds = 0
+        while eng._queue or any(s is not None for s in eng._slots):
+            finished.extend(eng.step())
+            rounds += 1
+            assert rounds < 500
+        stop.set()
+        t.join(timeout=5)
+        final = urllib.request.urlopen(
+            ex.url + "/metrics", timeout=10).read().decode()
+        data = {"eng": eng, "exporter": ex, "rids": rids,
+                "finished": finished, "scrapes": scrapes,
+                "final": final,
+                "events": eng.spans.events(),
+                "prom": eng.registry.to_prometheus()}
+        yield data
+        eng.close()
+
+    def test_wave_completed_ok(self, wave):
+        assert {r["id"] for r in wave["finished"]} == set(wave["rids"])
+        assert all(r["status"] == "ok" for r in wave["finished"])
+
+    def test_span_timeline_golden(self, wave):
+        """The host-scheduling story for a 2-request wave: each request
+        lane tells queue_wait -> prefill_<bucket> -> finish, the shared
+        decode lane carries batched dispatches, sched releases pages."""
+        by_lane = {}
+        for ev in wave["events"]:
+            by_lane.setdefault(ev["tid"], []).append(ev)
+        for rid in wave["rids"]:
+            lane = by_lane[f"req{rid}"]
+            names = [e["name"] for e in lane]
+            assert names[0] == "queue_wait"
+            assert names[1].startswith("prefill_")
+            assert names[-1] == "finish"
+            assert lane[-1]["args"]["status"] == "ok"
+            # spans on one lane are time-ordered
+            ts = [e["ts"] for e in lane]
+            assert ts == sorted(ts)
+        decode = by_lane.get("decode", [])
+        assert decode and all(e["name"] == "decode" for e in decode)
+        assert sum(e["args"]["tokens"] for e in decode) > 0
+        sched = by_lane.get("sched", [])
+        assert len([e for e in sched
+                    if e["name"] == "release_pages"]) == 2
+
+    def test_chrome_export_merges_lanes(self, wave, tmp_path):
+        rec2 = SpanRecorder(name="other")
+        rec2.add("x", rec2.now())
+        path = export_chrome(str(tmp_path / "tl.json"),
+                             [wave["eng"].spans, rec2])
+        doc = json.load(open(path))
+        evs = doc["traceEvents"]
+        pids = {e["pid"] for e in evs}
+        assert pids == {1, 2}
+        names = {e["args"]["name"] for e in evs
+                 if e["name"] == "process_name"}
+        assert names == {"serving", "other"}
+        # integer tids + thread_name metadata for every named lane
+        assert all(isinstance(e["tid"], int) for e in evs)
+        lanes = {e["args"]["name"] for e in evs
+                 if e["name"] == "thread_name" and e["pid"] == 1}
+        assert {"decode", "sched"} <= lanes
+
+    def test_concurrent_scrapes_never_torn(self, wave):
+        """Every mid-wave scrape is internally consistent: for each
+        histogram, the +Inf bucket equals its _count — a torn read
+        (count bumped, bucket not yet) would break this."""
+        assert wave["scrapes"], "scraper thread never landed a scrape"
+        for txt in wave["scrapes"]:
+            vals = _parse_prom(txt)
+            counts = {k: v for k, v in vals.items()
+                      if k.endswith("_count") and "{" not in k}
+            for ck, cv in counts.items():
+                base = ck[:-len("_count")]
+                inf_key = base + '_bucket{le="+Inf"}'
+                if inf_key in vals:
+                    assert vals[inf_key] == cv, (ck, txt[:400])
+
+    def test_final_scrape_matches_registry(self, wave):
+        assert wave["final"] == wave["prom"]
+
+    def test_engine_close_shuts_exporter(self, wave):
+        import urllib.request
+        eng = wave["eng"]
+        url = wave["exporter"].url
+        eng.close()
+        with pytest.raises(OSError):
+            urllib.request.urlopen(url + "/metrics", timeout=2)
+
+
+class TestFlightRecorder:
+    def test_ring_keeps_last_n_in_arrival_order(self):
+        rec = flightrec.FlightRecorder(capacity=4)
+        for i in range(10):
+            rec.note("step", i=i)
+        got = rec.records()
+        assert [r["i"] for r in got] == [6, 7, 8, 9]
+        assert [r["seq"] for r in got] == [6, 7, 8, 9]
+
+    def test_dump_parses_and_never_clobbers(self, tmp_path):
+        rec = flightrec.FlightRecorder(capacity=8,
+                                       run_dir=str(tmp_path))
+        rec.note("step", loss=float("nan"), i=1)
+        p1 = rec.dump("boom", extra={"x": 1})
+        p2 = rec.dump("boom")
+        assert p1 != p2 and os.path.basename(p1) == "flight_boom.json"
+        doc = json.load(open(p1))
+        assert doc["reason"] == "boom" and doc["x"] == 1
+        assert doc["records"][0]["loss"] is None   # NaN -> null
+        assert isinstance(doc.get("registry"), dict)
+        assert rec.dumps == [p1, p2]
+
+    def test_reason_sanitized_into_filename(self, tmp_path):
+        rec = flightrec.FlightRecorder(run_dir=str(tmp_path))
+        p = rec.dump("we/ird reason!")
+        assert os.path.basename(p) == "flight_we_ird_reason_.json"
+
+    def test_dump_failure_returns_none(self):
+        rec = flightrec.FlightRecorder(
+            run_dir="/dev/null/not_a_dir")
+        assert rec.dump("x") is None   # never raises
+
+    def test_env_dir_resolution(self, tmp_path, monkeypatch):
+        d = tmp_path / "env_dir"
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(d))
+        rec = flightrec.FlightRecorder()
+        p = rec.dump("envtest")
+        assert p is not None and os.path.dirname(p) == str(d)
+
+    def test_serve_step_exception_dumps(self, tmp_path, monkeypatch):
+        import paddle_tpu as paddle
+        from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+        from paddle_tpu.nlp.serving import ServingEngine
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        paddle.seed(0)
+        model = GPTForCausalLM(_resolve_config("gpt-tiny",
+                                               num_attention_heads=1))
+        eng = ServingEngine(model, max_slots=1, page_size=8,
+                            max_seq_len=32)
+        monkeypatch.setattr(
+            eng, "_step_impl",
+            lambda: (_ for _ in ()).throw(RuntimeError("kaboom")))
+        with pytest.raises(RuntimeError, match="kaboom"):
+            eng.step()
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight_serve_exception")]
+        assert len(dumps) == 1
+        doc = json.load(open(tmp_path / dumps[0]))
+        assert "kaboom" in doc["error"]
+        eng.close()
+
+    def test_fit_exception_dumps(self, tmp_path, monkeypatch):
+        import paddle_tpu as paddle
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 2)
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.AdamW(
+            1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        X = np.zeros((8, 4), "float32")
+        Y = np.zeros((8,), "int64")
+
+        class BoomCB:
+            def __getattr__(self, name):
+                return lambda *a, **k: None
+
+            def on_train_batch_end(self, step, logs=None):
+                if step == 1:
+                    raise RuntimeError("cb boom")
+        with pytest.raises(RuntimeError, match="cb boom"):
+            model.fit(paddle.io.TensorDataset([X, Y]), epochs=1,
+                      batch_size=4, verbose=0, shuffle=False,
+                      callbacks=[BoomCB()])
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight_fit_exception")]
+        assert len(dumps) == 1
+        doc = json.load(open(tmp_path / dumps[0]))
+        assert "cb boom" in doc["error"]
+
+    def test_guard_rollback_dump_contains_storm_records(
+            self, tmp_path, monkeypatch):
+        """The acceptance shape: a guard-tripping run leaves a
+        parseable flight_rollback.json whose ring holds the rollback
+        window's own guard_step records."""
+        import paddle_tpu as paddle
+        from paddle_tpu.resilience import TrainGuard
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        paddle.seed(0)
+        net = paddle.nn.Linear(8, 4)
+        model = paddle.Model(net)
+        guard = TrainGuard(snapshot_every=1, rollback_after=3)
+        model.prepare(paddle.optimizer.AdamW(
+            1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss(), guard=guard)
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((24, 8)).astype("float32")
+        Y = rng.integers(0, 4, (24,)).astype("int64")
+        faults.inject("nan_grads", step=2, count=3)
+        model.fit(paddle.io.TensorDataset([X, Y]), epochs=1,
+                  batch_size=4, verbose=0, shuffle=False)
+        assert guard.rollbacks == 1
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight_rollback")]
+        assert len(dumps) == 1
+        doc = json.load(open(tmp_path / dumps[0]))
+        bad = [r for r in doc["records"]
+               if r["kind"] == "guard_step" and not r["ok"]]
+        assert len(bad) == 3            # the storm's own records
+        assert bad[-1]["outcome"] == "rolled_back"
+        assert doc["guard"]["rollbacks"] == 1
+        assert any(r["kind"] == "guard_rollback"
+                   for r in doc["records"])
+
+
+class TestSpanRecorder:
+    def test_bounded_ring_and_clear(self):
+        rec = SpanRecorder(maxlen=3)
+        for i in range(5):
+            rec.instant(f"i{i}")
+        assert [e["name"] for e in rec.events()] == ["i2", "i3", "i4"]
+        rec.clear()
+        assert rec.events() == []
+
+    def test_span_context_manager_and_args(self):
+        rec = SpanRecorder()
+        with rec.span("work", tid="lane", detail=7):
+            pass
+        ev = rec.events()[0]
+        assert ev["name"] == "work" and ev["ph"] == "X"
+        assert ev["args"] == {"detail": 7} and ev["dur"] >= 0
+
+    def test_recorders_share_one_clock(self, tmp_path):
+        a, b = SpanRecorder(name="a"), SpanRecorder(name="b")
+        t = SpanRecorder.now()
+        a.add("first", t, t + 0.001)
+        b.add("second", t + 0.002, t + 0.003)
+        path = export_chrome(str(tmp_path / "m.json"), [a, b])
+        evs = [e for e in json.load(open(path))["traceEvents"]
+               if e["ph"] == "X"]
+        assert evs[0]["name"] == "first"    # cross-recorder ordering
+        assert evs[1]["ts"] > evs[0]["ts"]
+
+    def test_profiler_regions_land_on_span_bridge(self):
+        from paddle_tpu.profiler import Profiler, RecordEvent
+        prof = Profiler(registry=False)
+        with prof.record_event("regionA", sync=False):
+            pass
+        with RecordEvent("regionB", profiler=prof):
+            pass
+        names = [e["name"] for e in prof.spans.events()]
+        assert names == ["regionA", "regionB"]
+        assert all(e["tid"] == "regions"
+                   for e in prof.spans.events())
+
+
+class TestMeasuredMFUGauges:
+    def test_callback_publishes_measured_mfu(self, tmp_path,
+                                             monkeypatch):
+        import paddle_tpu as paddle
+        from paddle_tpu.observability.telemetry import TelemetryCallback
+        # a small peak so the tiny model's MFU survives the JSONL
+        # rounding (the gauges are unrounded either way)
+        monkeypatch.setenv("PADDLE_TPU_PEAK_FLOPS", "1e8")
+        paddle.seed(0)
+        net = paddle.nn.Linear(8, 4)
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.AdamW(
+            1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        X = np.random.default_rng(0).standard_normal(
+            (16, 8)).astype("float32")
+        Y = np.random.default_rng(0).integers(0, 4, (16,)).astype("int64")
+        reg = MetricsRegistry()
+        cb = TelemetryCallback(run_dir=str(tmp_path), registry=reg,
+                               write_metrics=False,
+                               flops_per_step=2 * 8 * 4 * 4 * 3)
+        model.fit(paddle.io.TensorDataset([X, Y]), epochs=1,
+                  batch_size=4, verbose=0, shuffle=False,
+                  callbacks=[cb])
+        assert reg.get("train_peak_flops").value == 1e8
+        m = reg.get("train_mfu_measured")
+        assert m is not None and 0 < m.value < 1
+        a = reg.get("train_mfu_analytic")
+        assert a is not None and 0 < a.value < 1
+        # JSONL records carry both legs
+        recs = [r for r in cb.logger.iter_records()
+                if r["kind"] == "train_step"]
+        assert recs and recs[-1]["mfu_measured"] > 0
+        # spans export landed next to the jsonl
+        assert cb.spans_path and os.path.exists(cb.spans_path)
+
+    def test_mfu_gauges_absent_without_peak(self, tmp_path,
+                                            monkeypatch):
+        import paddle_tpu as paddle
+        from paddle_tpu.observability.telemetry import TelemetryCallback
+        monkeypatch.delenv("PADDLE_TPU_PEAK_FLOPS", raising=False)
+        paddle.seed(0)
+        net = paddle.nn.Linear(8, 4)
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.AdamW(
+            1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        X = np.zeros((8, 8), "float32")
+        Y = np.zeros((8,), "int64")
+        reg = MetricsRegistry()
+        cb = TelemetryCallback(run_dir=str(tmp_path), registry=reg,
+                               write_metrics=False)
+        model.fit(paddle.io.TensorDataset([X, Y]), epochs=1,
+                  batch_size=4, verbose=0, shuffle=False,
+                  callbacks=[cb])
+        # no resolvable peak on CPU -> honest absence, not a made-up 0
+        assert reg.get("train_mfu_measured") is None
+        assert reg.get("train_mfu_analytic") is None
+
+
+class TestMetricsDiffTool:
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def _dump(self, path, fill):
+        reg = MetricsRegistry()
+        fill(reg)
+        reg.dump(str(path))
+        return str(path)
+
+    def _run(self, *argv):
+        import subprocess
+        import sys as _sys
+        return subprocess.run(
+            [_sys.executable, "tools/metrics_diff.py", *argv],
+            cwd=self.REPO, capture_output=True, text=True, timeout=60)
+
+    def test_diff_reports_deltas_added_removed(self, tmp_path):
+        a = self._dump(tmp_path / "a.json", lambda r: (
+            r.counter("steps").inc(10), r.gauge("gone").set(1)))
+        b = self._dump(tmp_path / "b.json", lambda r: (
+            r.counter("steps").inc(13), r.gauge("fresh").set(2)))
+        p = self._run(a, b)
+        assert p.returncode == 0, p.stderr[-1000:]
+        rep = json.loads(p.stdout.strip().splitlines()[-1])
+        assert rep["ok"] is True
+        assert rep["counters"]["steps"]["delta"] == 3
+        assert rep["added"] == ["fresh"] and rep["removed"] == ["gone"]
+
+    def test_fail_on_quantile_regression(self, tmp_path):
+        def fast(r):
+            h = r.histogram("lat", buckets=(0.001, 0.01, 0.1))
+            for _ in range(10):
+                h.observe(0.002)
+
+        def slow(r):
+            h = r.histogram("lat", buckets=(0.001, 0.01, 0.1))
+            for _ in range(10):
+                h.observe(0.05)
+        a = self._dump(tmp_path / "a.json", fast)
+        b = self._dump(tmp_path / "b.json", slow)
+        p = self._run(a, b, "--fail-on", "lat:p99>10%")
+        assert p.returncode == 1
+        rep = json.loads(p.stdout.strip().splitlines()[-1])
+        assert not rep["ok"]
+        assert rep["failures"][0]["series"] == "lat"
+        # reversed direction: improvement passes the same gate
+        p = self._run(b, a, "--fail-on", "lat:p99>10%")
+        assert p.returncode == 0
+
+    def test_fail_on_counter_increase_and_throughput_drop(
+            self, tmp_path):
+        a = self._dump(tmp_path / "a.json", lambda r: (
+            r.counter("retraces").inc(0), r.gauge("tok_s").set(100)))
+        b = self._dump(tmp_path / "b.json", lambda r: (
+            r.counter("retraces").inc(1), r.gauge("tok_s").set(80)))
+        p = self._run(a, b, "--fail-on", "retraces>0%",
+                      "--fail-on", "tok_s<10%")
+        assert p.returncode == 1
+        rep = json.loads(p.stdout.strip().splitlines()[-1])
+        assert {f["series"] for f in rep["failures"]} == \
+            {"retraces", "tok_s"}
+
+    def test_bad_spec_is_an_argparse_error(self, tmp_path):
+        a = self._dump(tmp_path / "a.json", lambda r: None)
+        p = self._run(a, a, "--fail-on", "nonsense")
+        assert p.returncode == 2
+        assert "grammar" in p.stderr
+
+
+class TestValidateStagesFlightCheck:
+    """check_flight_dumps: the preflight gate that chaos-family
+    campaign stages actually left their post-mortem dumps."""
+
+    @pytest.fixture()
+    def vs(self, tmp_path, monkeypatch):
+        import sys as _sys
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        monkeypatch.syspath_prepend(os.path.join(repo, "tools"))
+        monkeypatch.syspath_prepend(repo)
+        import validate_stages as mod
+        monkeypatch.setattr(mod, "OUT", str(tmp_path))
+        return mod
+
+    def _summary(self, vs, doc):
+        with open(os.path.join(vs.OUT, "summary.json"), "w") as f:
+            json.dump(doc, f)
+
+    def test_pre_flightrec_archives_not_flagged(self, vs):
+        assert vs.check_flight_dumps() == ([], 0)   # no summary
+        self._summary(vs, {"_telemetry": 1,
+                           "chaos_smoke": {"ok": True}})
+        assert vs.check_flight_dumps() == ([], 0)   # no _flightrec
+
+    def test_completed_chaos_stage_without_dump_is_a_problem(self, vs):
+        self._summary(vs, {"_flightrec": 1,
+                           "chaos_smoke": {"ok": True},
+                           "telemetry_smoke": {"ok": False}})
+        problems, checked = vs.check_flight_dumps()
+        assert checked == 1                       # failed stage skipped
+        assert "left no flight_" in problems[0]
+
+    def test_parseable_dump_passes_torn_dump_fails(self, vs):
+        self._summary(vs, {"_flightrec": 1,
+                           "chaos_smoke": {"ok": True}})
+        td = os.path.join(vs.OUT, "telemetry", "chaos_smoke")
+        os.makedirs(td)
+        with open(os.path.join(td, "flight_rollback.json"), "w") as f:
+            json.dump({"reason": "rollback",
+                       "records": [{"kind": "guard_step"}]}, f)
+        assert vs.check_flight_dumps() == ([], 1)
+        with open(os.path.join(td, "flight_torn.json"), "w") as f:
+            f.write("{torn")
+        problems, _ = vs.check_flight_dumps()
+        assert "unparseable flight dump" in problems[0]
+
+
+class TestGuardOutcomeAfterRollback:
+    def test_storm_outlasting_rollback_keeps_skipping_one_dump(
+            self, tmp_path, monkeypatch):
+        """Review regression: a storm LONGER than rollback_after must
+        report the post-rollback bad steps as 'skipped' (consecutive
+        count restarted) and dump exactly one flight record — not
+        re-report 'rolled_back' and re-dump every further bad step."""
+        import paddle_tpu as paddle
+        from paddle_tpu.resilience import TrainGuard
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        paddle.seed(0)
+        net = paddle.nn.Linear(8, 4)
+        model = paddle.Model(net)
+        guard = TrainGuard(snapshot_every=1, rollback_after=3)
+        model.prepare(paddle.optimizer.AdamW(
+            1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss(), guard=guard)
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((32, 8)).astype("float32")
+        Y = rng.integers(0, 4, (32,)).astype("int64")
+        faults.inject("nan_grads", step=2, count=4)   # 4-step storm
+        model.fit(paddle.io.TensorDataset([X, Y]), epochs=1,
+                  batch_size=4, verbose=0, shuffle=False)
+        assert guard.rollbacks == 1
+        assert guard.skipped_steps == 4
+        assert guard.last_outcome == "ok"     # recovered after storm
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight_rollback")]
+        assert len(dumps) == 1                # ONE dump, not per step
+        doc = json.load(open(tmp_path / dumps[0]))
+        outcomes = [r["outcome"] for r in doc["records"]
+                    if r["kind"] == "guard_step" and not r["ok"]]
+        assert outcomes == ["skipped", "skipped", "rolled_back"]
+        # the 4th bad step (after the dump) went back to 'skipped'
+        ring = flightrec.get_recorder().records()
+        post = [r for r in ring if r["kind"] == "guard_step"
+                and not r["ok"]][-1]
+        assert post["outcome"] == "skipped"

@@ -1,0 +1,218 @@
+"""metrics_diff — compare two metrics.json snapshots.
+
+BENCHLOG claims like "decode p99 held under 2 ms" or "zero extra
+retraces vs round 9" become CHECKABLE: point this at two bench/campaign
+`metrics.json` artifacts (the registry snapshots every stage exports)
+and it reports counter deltas, histogram quantile shifts (p50/p99/mean,
+rebuilt from the snapshot's buckets with the registry's own
+interpolation), and series added/removed between the runs — optionally
+failing on regression thresholds so a campaign preflight can gate on
+them.
+
+Usage:
+  python tools/metrics_diff.py old/metrics.json new/metrics.json
+  python tools/metrics_diff.py A.json B.json \
+      --fail-on 'serve_decode_token_seconds:p99>10%' \
+      --fail-on 'recompile_unexpected_retraces_total:value>0%'
+
+--fail-on SPEC grammar: ``name[:stat]{>|<}PCT%`` — `name` matches a
+series key exactly or every series of that metric name; `stat` is
+``value`` (counter/gauge, the default) or ``p50``/``p99``/``mean``/
+``count`` (histogram, default p50); ``>`` fails when B exceeds A by
+more than PCT percent (latency-like: bigger is worse), ``<`` fails
+when B undershoots A by more than PCT (throughput-like: smaller is
+worse). A series missing from either side never trips a threshold (it
+shows up under added/removed instead). PCT may be 0 ("any increase").
+
+Last stdout line is a JSON report; exit 0 iff no --fail-on tripped.
+Stdlib-only (loads the registry module straight from its file via
+bench._obs_mod — no jax, no package import).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+from bench import _obs_mod  # noqa: E402
+
+_SPEC_RE = re.compile(
+    r"^(?P<name>[^:<>]+?)(?::(?P<stat>value|count|mean|p\d{1,2}))?"
+    r"(?P<op>[<>])(?P<pct>\d+(?:\.\d+)?)%?$")
+
+
+def parse_spec(s):
+    m = _SPEC_RE.match(s.strip())
+    if not m:
+        raise argparse.ArgumentTypeError(
+            f"bad --fail-on spec {s!r} (grammar: name[:stat]{{>|<}}PCT%)")
+    return {"name": m.group("name"), "stat": m.group("stat"),
+            "op": m.group("op"), "pct": float(m.group("pct")),
+            "spec": s.strip()}
+
+
+def load_snapshot(path):
+    with open(path) as f:
+        doc = json.load(f)
+    metrics = doc.get("metrics")
+    if not isinstance(metrics, dict):
+        raise ValueError(f"{path}: no 'metrics' map — not a registry "
+                         "snapshot/dump")
+    return doc
+
+
+def _hist_stats(entry):
+    """Rebuild a Histogram from its snapshot and read the rollup stats
+    with the registry's own quantile interpolation."""
+    H = _obs_mod("metrics").Histogram
+    h = H(entry["name"], buckets=entry["bounds"])
+    h.merge(entry)
+    if not h.count:
+        return {"count": 0}
+    return {"count": h.count, "mean": h.mean(),
+            "p50": h.quantile(0.5), "p99": h.quantile(0.99),
+            "min": h.min, "max": h.max}
+
+
+def _pct(a, b):
+    if a is None or b is None:
+        return None
+    if a == 0:
+        return None if b == 0 else float("inf")
+    return (b - a) / abs(a) * 100.0
+
+
+def _round(v, n=4):
+    if v is None:
+        return None
+    if v in (float("inf"), float("-inf")):
+        return None  # JSON-safe; the raw a/b values tell the story
+    return round(v, n)
+
+
+def diff(a_doc, b_doc):
+    a, b = a_doc["metrics"], b_doc["metrics"]
+    report = {"counters": {}, "gauges": {}, "histograms": {},
+              "added": sorted(set(b) - set(a)),
+              "removed": sorted(set(a) - set(b))}
+    for key in sorted(set(a) & set(b)):
+        ea, eb = a[key], b[key]
+        if ea["type"] != eb["type"]:
+            report.setdefault("type_changed", []).append(key)
+            continue
+        if ea["type"] in ("counter", "gauge"):
+            row = {"a": ea["value"], "b": eb["value"],
+                   "delta": eb["value"] - ea["value"],
+                   "pct": _round(_pct(ea["value"], eb["value"]), 2)}
+            bucket = ("counters" if ea["type"] == "counter"
+                      else "gauges")
+            report[bucket][key] = row
+        else:
+            try:
+                sa, sb = _hist_stats(ea), _hist_stats(eb)
+            except (KeyError, ValueError) as e:
+                report.setdefault("unreadable", []).append(
+                    f"{key}: {e}")
+                continue
+            row = {"a": {k: _round(v, 6) for k, v in sa.items()},
+                   "b": {k: _round(v, 6) for k, v in sb.items()}}
+            for stat in ("mean", "p50", "p99"):
+                row[f"{stat}_shift_pct"] = _round(
+                    _pct(sa.get(stat), sb.get(stat)), 2)
+            report["histograms"][key] = row
+    return report
+
+
+def _series_stat(doc, key, stat):
+    entry = doc["metrics"].get(key)
+    if entry is None:
+        return None
+    if entry["type"] in ("counter", "gauge"):
+        return entry["value"] if stat in (None, "value") else None
+    stat = stat or "p50"
+    if stat in ("count", "mean"):
+        return _hist_stats(entry).get(stat)
+    m = re.match(r"p(\d{1,2})$", stat)
+    if m:
+        H = _obs_mod("metrics").Histogram
+        h = H(entry["name"], buckets=entry["bounds"])
+        h.merge(entry)
+        return h.quantile(int(m.group(1)) / 100.0) if h.count else None
+    return None
+
+
+def check_fail_on(a_doc, b_doc, specs):
+    """Evaluate each spec against every matching series present in
+    BOTH snapshots; returns the list of failures."""
+    failures = []
+    for spec in specs:
+        keys = [k for k in a_doc["metrics"]
+                if k in b_doc["metrics"]
+                and (k == spec["name"]
+                     or a_doc["metrics"][k]["name"] == spec["name"])]
+        for key in keys:
+            va = _series_stat(a_doc, key, spec["stat"])
+            vb = _series_stat(b_doc, key, spec["stat"])
+            if va is None or vb is None:
+                continue
+            lim = spec["pct"] / 100.0
+            if spec["op"] == ">":
+                bad = vb > va + abs(va) * lim if va else vb > va
+            else:
+                bad = vb < va - abs(va) * lim if va else vb < va
+            if bad:
+                failures.append({
+                    "spec": spec["spec"], "series": key,
+                    "a": _round(va, 6), "b": _round(vb, 6),
+                    "shift_pct": _round(_pct(va, vb), 2)})
+    return failures
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="diff two metrics.json registry snapshots")
+    ap.add_argument("a", help="baseline metrics.json")
+    ap.add_argument("b", help="candidate metrics.json")
+    ap.add_argument("--fail-on", action="append", type=parse_spec,
+                    default=[], metavar="name[:stat]{>|<}PCT%",
+                    help="regression threshold (repeatable)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the human-readable section")
+    args = ap.parse_args(argv)
+
+    a_doc, b_doc = load_snapshot(args.a), load_snapshot(args.b)
+    report = diff(a_doc, b_doc)
+    failures = check_fail_on(a_doc, b_doc, args.fail_on)
+    report.update({"a": args.a, "b": args.b,
+                   "fail_on": [s["spec"] for s in args.fail_on],
+                   "failures": failures, "ok": not failures})
+
+    if not args.quiet:
+        changed = [(k, r) for k, r in report["counters"].items()
+                   if r["delta"]]
+        for k, r in changed[:40]:
+            print(f"  counter {k}: {r['a']} -> {r['b']} "
+                  f"({r['delta']:+})", file=sys.stderr)
+        for k, r in list(report["histograms"].items())[:40]:
+            if r.get("p99_shift_pct") is not None:
+                print(f"  hist {k}: p50 {r['a'].get('p50')} -> "
+                      f"{r['b'].get('p50')}, p99 {r['a'].get('p99')} "
+                      f"-> {r['b'].get('p99')} "
+                      f"({r['p99_shift_pct']:+}%)", file=sys.stderr)
+        for k in report["added"][:20]:
+            print(f"  added   {k}", file=sys.stderr)
+        for k in report["removed"][:20]:
+            print(f"  removed {k}", file=sys.stderr)
+        for f in failures:
+            print(f"  FAIL {f['spec']}: {f['series']} {f['a']} -> "
+                  f"{f['b']} ({f['shift_pct']}%)", file=sys.stderr)
+    print(json.dumps(report, default=str))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

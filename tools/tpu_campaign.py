@@ -287,9 +287,11 @@ def main():
     # _telemetry marks a summary produced by a campaign that exports
     # per-stage telemetry dirs — validate_stages only enforces the
     # metrics.json check on such summaries (a pre-telemetry archive
-    # must not read as an observability regression)
+    # must not read as an observability regression). _flightrec
+    # likewise marks that chaos-family stages dump crash flight
+    # records into their telemetry dir (round-10 introspection layer)
     summary = {"_captured_at": {"epoch": int(time.time())},
-               "_telemetry": 1}
+               "_telemetry": 1, "_flightrec": 1}
     stages = [s for s in STAGES if s[0] not in RETRY_ONLY]
     if only:  # run in the order the caller listed, not STAGES order
         by_name = {s[0]: s for s in STAGES}

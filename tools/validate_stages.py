@@ -91,6 +91,60 @@ def check_completed_stage_metrics():
     return problems, checked
 
 
+# chaos-family stages: each drives at least one guard rollback, so a
+# completed run must have left parseable flight-recorder dump(s) in
+# its telemetry dir (the dumps land there because the campaign exports
+# BENCH_TELEMETRY_DIR per stage — flightrec's dump-dir fallback)
+FLIGHT_STAGES = {"chaos_smoke", "telemetry_smoke"}
+
+
+def check_flight_dumps():
+    """Completed chaos-family stages of a _flightrec-marked campaign
+    summary must have left at least one parseable flight_*.json whose
+    ring actually holds records — a chaos stage that tripped the guard
+    but dumped nothing (or dumped garbage) is a silent loss of the
+    post-mortem path. Returns (problems, checked)."""
+    path = os.path.join(OUT, "summary.json")
+    try:
+        with open(path) as f:
+            summary = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return [], 0
+    if not summary.get("_flightrec"):
+        return [], 0   # pre-flight-recorder archive: nothing to hold
+    problems = []
+    checked = 0
+    for name in sorted(FLIGHT_STAGES):
+        row = summary.get(name)
+        if not isinstance(row, dict) or not row.get("ok"):
+            continue
+        checked += 1
+        tdir = os.path.join(OUT, "telemetry", name)
+        try:
+            dumps = sorted(f for f in os.listdir(tdir)
+                           if f.startswith("flight_")
+                           and f.endswith(".json"))
+        except OSError:
+            dumps = []
+        if not dumps:
+            problems.append(f"{name}: completed but left no "
+                            f"flight_*.json under {tdir}")
+            continue
+        for fn in dumps:
+            fp = os.path.join(tdir, fn)
+            try:
+                with open(fp) as f:
+                    doc = json.load(f)
+                if not isinstance(doc.get("records"), list) \
+                        or not doc.get("reason"):
+                    problems.append(f"{name}: {fn} parses but has no "
+                                    "records ring / reason")
+            except (OSError, json.JSONDecodeError) as e:
+                problems.append(f"{name}: unparseable flight dump "
+                                f"{fn} ({e})")
+    return problems, checked
+
+
 def _child_pgids(pid):
     """Process groups of `pid`'s direct children: bench.py/decode_probe
     start their workers with start_new_session=True, so killpg on the
@@ -142,6 +196,9 @@ def main():
         print(f"MISSING REQUIRED STAGES: {sorted(missing)}")
         return 1
     metric_problems, metrics_checked = check_completed_stage_metrics()
+    flight_problems, flights_checked = check_flight_dumps()
+    metric_problems += flight_problems
+    metrics_checked += flights_checked
     for p in metric_problems:
         print(f"  metrics: SUSPECT ({p})", flush=True)
     tmp = tempfile.mkdtemp(prefix="stage_preflight_")
