@@ -73,6 +73,7 @@ from . import compat  # noqa: E402,F401
 from . import device  # noqa: E402,F401
 from . import resilience  # noqa: E402,F401
 from . import observability  # noqa: E402,F401
+from . import serving_fleet  # noqa: E402,F401
 from . import version  # noqa: E402,F401
 from .framework import (  # noqa: E402,F401
     get_rng_state, set_rng_state, get_cuda_rng_state, set_cuda_rng_state,

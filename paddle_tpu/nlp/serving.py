@@ -39,7 +39,11 @@ deterministically via resilience.faults (page_exhaustion, slow_step,
 dispatch_error).
 
 Single-threaded by design (one engine owns one chip's decode loop);
-wrap submissions in your own queue for multi-producer serving.
+wrap submissions in your own queue for multi-producer serving — or
+run N engines as a fault-tolerant fleet behind
+``serving_fleet.FleetRouter`` (health-routed balancing, failover with
+token-exact prefix dedup, hedging, graceful drain/rejoin via
+``drain()``/``resume()``/``export_inflight()`` below).
 """
 from __future__ import annotations
 
@@ -246,6 +250,10 @@ class ServingEngine:
         self._admit_seq = 0
         self._cancel_pending = set()
         self.last_dispatch_s = 0.0
+        # lifecycle: serving -> (draining <-> serving) -> closed. A
+        # router/LB reads this through health()["state"] to tell
+        # "busy" from "going away" (docs/robustness.md fleet section)
+        self._state = "serving"
 
         # -- observability: every counter the engine keeps lives in the
         # registry (status_counts/health() are snapshot VIEWS of it),
@@ -421,6 +429,12 @@ class ServingEngine:
             step boundaries; the request finishes with
             status='expired' and whatever tokens it produced.
         priority: larger = more important (evict admission policy)."""
+        if self._state != "serving":
+            if self._state == "closed":
+                raise RuntimeError("ServingEngine is closed")
+            raise RuntimeError(
+                "ServingEngine is draining (not admitting); resume() "
+                "re-opens admission")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if not len(prompt):
             raise ValueError("empty prompt")
@@ -475,6 +489,8 @@ class ServingEngine:
         An unhandled exception here is a flight-recorder trigger: the
         ring of recent dispatch/request records dumps to
         flight_serve_exception.json before the error propagates."""
+        if self._state == "closed":
+            raise RuntimeError("ServingEngine is closed")
         try:
             return self._step_impl()
         except Exception as e:
@@ -486,10 +502,18 @@ class ServingEngine:
 
     def _step_impl(self):
         self._rounds += 1
+        if self._state == "draining":
+            # draining: nothing new admits, and anything still QUEUED
+            # resolves as cancelled NOW (a router re-places it on a
+            # healthy replica); in-flight slots keep decoding below
+            # until they finish token-exactly
+            while self._queue:
+                self._finish_request(self._queue.popleft(), "cancelled")
         self._apply_cancels()
         self._expire_deadlines()
         self._evict()
-        self._admit()
+        if self._state == "serving":
+            self._admit()
         if self._active.any() and not (self._done | ~self._active).all():
             self._dispatch_decode()
         self._evict()
@@ -528,6 +552,81 @@ class ServingEngine:
     def free_page_count(self):
         return len(self._free_pages)
 
+    @property
+    def state(self):
+        """Lifecycle state: 'serving' | 'draining' | 'closed'. Also in
+        health()/'/healthz' so an external LB can tell a busy replica
+        from one that is going away."""
+        return self._state
+
+    @property
+    def idle(self):
+        """True when nothing is queued and no slot is occupied — the
+        'drain complete' condition a replica worker polls."""
+        return not self._queue and all(s is None for s in self._slots)
+
+    def drain(self):
+        """Stop admitting (graceful shutdown / preemption notice):
+        queued requests resolve as status='cancelled' at the next
+        step() boundary so a router can re-place them, while in-flight
+        requests keep decoding to their normal finish, token-exactly.
+        Idempotent; submit() during the drain raises. resume()
+        re-opens admission (rejoin), close() retires the engine."""
+        if self._state == "closed":
+            raise RuntimeError("ServingEngine is closed")
+        self._state = "draining"
+
+    def resume(self):
+        """Re-open admission after drain() (fleet rejoin). The engine
+        keeps its compiled programs, so a drain/rejoin cycle costs
+        zero recompiles."""
+        if self._state == "closed":
+            raise RuntimeError("ServingEngine is closed")
+        self._state = "serving"
+
+    def drain_to_completion(self, max_rounds=10_000):
+        """drain(), then step() until every slot finishes; returns the
+        finished-request dicts (in-flight complete token-exactly,
+        queued come back cancelled). Bounded by max_rounds — the drain
+        path never wedges."""
+        self.drain()
+        results = []
+        rounds = 0
+        while not self.idle:
+            results.extend(self.step())
+            rounds += 1
+            if rounds > max_rounds:
+                raise RuntimeError("drain did not complete within "
+                                   f"{max_rounds} rounds")
+        return results
+
+    def export_inflight(self):
+        """Host-side snapshot of every unfinished request: in-flight
+        slots with their partial tokens (queued=False) and
+        still-queued requests (queued=True, no tokens). The fleet
+        failover path reads this off a dead/wedged replica to
+        continuation-resubmit elsewhere with the completed prefix
+        deduped; in a subprocess deployment the same facts arrive over
+        the streaming token channel. Pure bookkeeping — no device
+        sync, no compilation."""
+        out = []
+        for slot in self._slots:
+            if slot is None:
+                continue
+            r = slot.req
+            out.append({"rid": r.rid, "prompt": r.prompt.tolist(),
+                        "tokens": list(slot.out_tokens),
+                        "max_new_tokens": r.max_new_tokens,
+                        "eos_token_id": r.eos_token_id,
+                        "priority": r.priority, "queued": False})
+        for r in self._queue:
+            out.append({"rid": r.rid, "prompt": r.prompt.tolist(),
+                        "tokens": [],
+                        "max_new_tokens": r.max_new_tokens,
+                        "eos_token_id": r.eos_token_id,
+                        "priority": r.priority, "queued": True})
+        return out
+
     def serve_metrics(self, port=0, host="127.0.0.1"):
         """Attach a live HTTP exporter to THIS engine: /metrics is the
         engine's registry, /healthz is health(), /report the
@@ -543,11 +642,32 @@ class ServingEngine:
         return self._exporter
 
     def close(self):
-        """Release host-side resources (the watchdog's polling
-        thread, the metrics exporter's port + thread, the tracer's
-        slot in the process-wide report set). Call when retiring an
-        engine; safe to call twice. Compiled programs and the page
-        pool are plain GC'd objects."""
+        """Retire the engine: every queued request resolves as
+        status='cancelled', every running one finishes with its
+        partial tokens as 'cancelled', ALL pages return to the free
+        list, then host-side resources are released (the watchdog's
+        polling thread, the metrics exporter's port + thread, the
+        tracer's slot in the process-wide report set). Idempotent, and
+        composes with the drain path: drain_to_completion() then
+        close() is the graceful shutdown; a bare close() is the
+        impatient one — neither wedges. Returns the finished-request
+        dicts resolved by the close (cancelled work keeps its partial
+        tokens) plus any earlier results not yet collected — step()
+        raises after close, so this is the last chance to read them.
+        After close(), submit()/step() raise
+        RuntimeError('ServingEngine is closed'). Compiled programs and
+        the page pool are plain GC'd objects."""
+        if self._state == "closed":
+            return []
+        while self._queue:
+            self._finish_request(self._queue.popleft(), "cancelled")
+        for b in range(self.max_slots):
+            if self._slots[b] is not None:
+                # a done-but-unswept slot keeps its natural status;
+                # live ones are cancelled with their partial tokens
+                self._finish_slot(
+                    b, None if self._done[b] else "cancelled")
+        self._state = "closed"
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
@@ -555,6 +675,8 @@ class ServingEngine:
             self._exporter.close()
             self._exporter = None
         self.tracer.close()
+        out, self._finished = self._finished, []
+        return out
 
     def __del__(self):
         wd = getattr(self, "_watchdog", None)
@@ -584,7 +706,8 @@ class ServingEngine:
         self._sync_registry()
         running = sum(1 for s in self._slots if s is not None)
         now = time.monotonic()
-        h = {"running": running,
+        h = {"state": self._state,
+             "running": running,
              "queued": len(self._queue),
              "oldest_queued_s": round(
                  max((now - r.submitted_at for r in self._queue),

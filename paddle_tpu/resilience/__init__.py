@@ -20,12 +20,13 @@ from . import faults  # noqa: F401
 from . import preemption  # noqa: F401
 from .faults import TransientError, inject, scenario  # noqa: F401
 from .guard import TrainGuard  # noqa: F401
-from .retry import RetryStats, call_with_retries, is_transient  # noqa: F401
+from .retry import (RetryStats, backoff_schedule,  # noqa: F401
+                    call_with_retries, is_transient)
 from .watchdog import Watchdog  # noqa: F401
 
 __all__ = ["faults", "preemption", "TrainGuard", "Watchdog",
            "TransientError", "RetryStats", "inject", "scenario",
-           "call_with_retries", "is_transient"]
+           "call_with_retries", "backoff_schedule", "is_transient"]
 
 # arm any env-specified faults at first import of the subsystem — the
 # chaos_smoke campaign stage and the SIGTERM drill ride this

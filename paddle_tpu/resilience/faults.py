@@ -38,7 +38,24 @@ torn_ckpt           CheckpointManager._write — truncates the state file
 sigterm             hapi fit() batch boundary — raises SIGTERM in-process
 page_exhaustion     ServingEngine admission — pretends the free list is
                     empty for the matching round
+replica_crash       serving_fleet replica worker round — the replica
+                    thread dies mid-decode (failover drill)
+replica_wedge       serving_fleet replica worker round — the worker stops
+                    heartbeating for ``seconds`` (wedge-detection drill)
+replica_slow        serving_fleet replica worker round — host sleep per
+                    round (tail-latency / hedging drill)
+scrape_timeout      FleetRouter health scrape — the scrape raises a
+                    transient DEADLINE_EXCEEDED
+flaky_transport     ReplicaClient transport op — transient error before
+                    (or, with ``after=1``, AFTER) delivery; the retry
+                    wrapper + rid idempotency absorb it
 ==================  =====================================================
+
+Fleet faults target ONE replica via payload (``replica_crash:replica=r1``
+or ``inject("replica_crash", replica="r1")``): seams pass their own
+identity through ``pull(..., match={"replica": name})`` and a fault
+whose payload pins a different identity is skipped without being
+consumed. A fault with no ``replica`` payload matches any replica.
 
 The registry is process-global and consult-only-on-armed: ``pull`` on
 an empty registry is a tuple check, so production paths pay nothing.
@@ -114,13 +131,20 @@ def armed(kind=None):
                    for f in _registry)
 
 
-def pull(kind, step=None):
+def pull(kind, step=None, match=None):
     """Consume one firing of `kind` matching `step`; returns its payload
     dict, or None when nothing armed matches. A fault armed with
     step=None matches any seam step; a pinned fault matches its storm
     window [step, step + count) — each seam consults a given step once,
     so a pinned count is a run of consecutive steps, not N firings at
-    one step. Cheap when the registry is empty (the common case)."""
+    one step. Cheap when the registry is empty (the common case).
+
+    `match` narrows by payload identity (fleet seams): for every key in
+    `match`, a fault that PINS that key in its payload must pin the
+    same value, or it is skipped WITHOUT being consumed — so
+    ``inject("replica_crash", replica="r1")`` fires only for the seam
+    pulling with ``match={"replica": "r1"}``, while an unpinned fault
+    still matches any puller."""
     if not _registry:          # unlocked fast path: seams in hot loops
         return None
     with _lock:
@@ -132,6 +156,9 @@ def pull(kind, step=None):
                     continue
                 if not (f.step <= step < f.step + f.count):
                     continue
+            if match and any(k in f.payload and f.payload[k] != v
+                             for k, v in match.items()):
+                continue
             f.fired += 1
             _fired_log.append((kind, step))
             return dict(f.payload)
@@ -226,17 +253,18 @@ def nan_scale(step=None):
     return float("nan") if pull("nan_grads", step) is not None else 1.0
 
 
-def maybe_sleep(kind="slow_step", step=None):
-    """Host-side stall seam (watchdog drills). Payload: seconds."""
-    p = pull(kind, step)
+def maybe_sleep(kind="slow_step", step=None, match=None):
+    """Host-side stall seam (watchdog/hedging drills). Payload:
+    seconds."""
+    p = pull(kind, step, match=match)
     if p is not None:
         time.sleep(float(p.get("seconds", 0.05)))
     return p
 
 
-def maybe_raise(kind="dispatch_error", step=None):
+def maybe_raise(kind="dispatch_error", step=None, match=None):
     """Transient-dispatch-failure seam. Payload: message."""
-    p = pull(kind, step)
+    p = pull(kind, step, match=match)
     if p is not None:
         raise TransientError(p.get(
             "message", f"RESOURCE_EXHAUSTED: injected {kind} "

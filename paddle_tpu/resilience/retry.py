@@ -9,17 +9,23 @@ errors, OOM of the program itself, assertion failures) must propagate
 untouched.
 
 Deterministic by design: delays are a fixed exponential ladder (no
-jitter) so chaos tests assert exact retry counts and the campaign
-replays identically under a fixed seed.
+jitter by default) so chaos tests assert exact retry counts and the
+campaign replays identically under a fixed seed. Jitter is OPT-IN and
+itself seeded (``jitter=``/``jitter_seed=``): N fleet replicas
+retrying the same transient fault would otherwise back off in
+lockstep and re-collide as a thundering herd — each replica passes its
+own seed, so the schedules de-synchronize but any single schedule
+still replays bit-identically.
 """
 from __future__ import annotations
 
+import random
 import time
 
 from .faults import TransientError
 
 __all__ = ["TransientError", "is_transient", "retryable_for",
-           "call_with_retries", "RetryStats"]
+           "call_with_retries", "backoff_schedule", "RetryStats"]
 
 # status-code grammar shared by PJRT/XLA runtime errors; matched against
 # str(exc) because the concrete exception types vary by jaxlib version
@@ -65,12 +71,32 @@ class RetryStats:
         return {"retries": self.retries, "gave_up": self.gave_up}
 
 
+def backoff_schedule(retries, base_delay=0.05, max_delay=2.0,
+                     jitter=0.0, jitter_seed=0):
+    """The exact delays call_with_retries would sleep, precomputed:
+    delay[i] = min(base_delay * 2**i, max_delay), each stretched by a
+    factor in [1, 1+jitter) drawn from ``random.Random(jitter_seed)``.
+    jitter=0 (the default) is the historical exact ladder; with jitter
+    on, the schedule is a pure function of the seed — two replicas
+    with different seeds spread out, one replica replays identically."""
+    rng = random.Random(jitter_seed)
+    out = []
+    for attempt in range(max(0, int(retries))):
+        d = min(base_delay * (2 ** attempt), max_delay)
+        if jitter:
+            d *= 1.0 + float(jitter) * rng.random()
+        out.append(d)
+    return out
+
+
 def call_with_retries(fn, *args, retries=3, base_delay=0.05,
                       max_delay=2.0, retryable=is_transient,
-                      stats=None, on_retry=None, **kwargs):
+                      stats=None, on_retry=None, jitter=0.0,
+                      jitter_seed=0, **kwargs):
     """Run fn(*args, **kwargs); on a retryable error, back off
-    (base_delay * 2**attempt, capped) and retry up to `retries` times.
-    The final failure re-raises the last error unchanged.
+    (base_delay * 2**attempt, capped; optionally seeded-jittered — see
+    backoff_schedule) and retry up to `retries` times. The final
+    failure re-raises the last error unchanged.
 
     CAUTION at donating seams: a retry re-submits the same argument
     arrays, which is only safe when the failure happened before the
@@ -78,6 +104,8 @@ def call_with_retries(fn, *args, retries=3, base_delay=0.05,
     therefore pass a narrowed `retryable` when donation is on —
     injected TransientErrors (raised BEFORE the execute) retry, real
     runtime errors from the execute itself propagate."""
+    delays = backoff_schedule(retries, base_delay, max_delay,
+                              jitter=jitter, jitter_seed=jitter_seed)
     attempt = 0
     while True:
         try:
@@ -91,5 +119,5 @@ def call_with_retries(fn, *args, retries=3, base_delay=0.05,
                 stats.retries += 1
             if on_retry is not None:
                 on_retry(e, attempt)
-            time.sleep(min(base_delay * (2 ** attempt), max_delay))
+            time.sleep(delays[attempt])
             attempt += 1

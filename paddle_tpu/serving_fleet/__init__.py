@@ -1,0 +1,31 @@
+"""Fault-tolerant multi-replica serving fleet.
+
+A host-side layer over N ServingEngine replicas: health-routed load
+balancing, crash/wedge failover with completed-prefix dedup,
+tail-latency hedging, graceful drain/rejoin through the resilience
+preemption seam, and priority load shedding — all chaos-testable on
+CPU via resilience.faults (replica_crash / replica_wedge /
+replica_slow / scrape_timeout / flaky_transport) and all host-side
+bookkeeping, so every replica's zero-recompile contract survives the
+whole failure model.
+
+- InprocReplica:  one engine + worker thread behind a transport seam
+                  (replica.py; a subprocess replica speaks the same
+                  verbs over a wire)
+- ReplicaClient:  idempotent-by-rid transport with seeded-jitter
+                  retry (client.py)
+- FleetRouter:    global queue, scrape-scored placement, failover/
+                  hedging/drain/shed + its own MetricsRegistry and
+                  /metrics endpoint (router.py)
+
+See docs/robustness.md ("Fleet serving") for the contracts and
+docs/observability.md for the fleet_* metric catalogue. Chaos suite:
+tests/test_fleet_serving.py (pytest -m chaos); campaign stage
+fleet_chaos_smoke.
+"""
+from .client import ReplicaClient  # noqa: F401
+from .replica import InprocReplica, ReplicaCrash  # noqa: F401
+from .router import FleetRouter  # noqa: F401
+
+__all__ = ["FleetRouter", "InprocReplica", "ReplicaClient",
+           "ReplicaCrash"]

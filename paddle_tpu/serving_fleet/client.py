@@ -1,0 +1,97 @@
+"""Idempotent-by-rid replica transport client with jittered retry.
+
+The router never talks to a replica's transport directly — every
+request-plane op (submit / cancel / poll) goes through a
+``ReplicaClient`` that
+
+- retries transient transport failures on the resilience.retry
+  ladder, with SEEDED jitter (each replica's client gets its own
+  ``jitter_seed``, so N clients retrying the same fleet-wide blip
+  de-synchronize instead of thundering back in lockstep — and any one
+  schedule still replays bit-identically under its seed);
+- stays safe to retry because submits are idempotent BY FLEET RID at
+  the replica (a duplicate delivery of the same rid is dropped), so
+  the classic "ack lost after delivery" uncertainty cannot duplicate
+  a request or its tokens.
+
+The ``flaky_transport`` fault kind drills both halves: by default it
+raises BEFORE delivery (retry resends, nothing duplicated); with
+payload ``after=1`` it delivers and THEN raises (ack lost — the retry
+double-delivers and the rid dedup must absorb it). Target one replica
+with payload ``replica=<name>``.
+"""
+from __future__ import annotations
+
+from ..resilience import faults
+from ..resilience.retry import RetryStats, call_with_retries, \
+    is_transient
+
+__all__ = ["ReplicaClient"]
+
+
+class ReplicaClient:
+    """Request-plane client for one replica transport.
+
+    replica: the transport (InprocReplica or anything with
+        enqueue/pop_results).
+    retries/base_delay/max_delay: the bounded backoff ladder.
+    jitter/jitter_seed: seeded backoff stretch (resilience.retry.
+        backoff_schedule) — pass a distinct seed per replica client.
+    stats: RetryStats to accumulate into (default: own).
+    """
+
+    def __init__(self, replica, *, retries=3, base_delay=0.005,
+                 max_delay=0.25, jitter=0.5, jitter_seed=0,
+                 stats=None):
+        self.replica = replica
+        self.retries = int(retries)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        self.jitter_seed = int(jitter_seed)
+        self.stats = stats if stats is not None else RetryStats()
+        self._op = 0
+
+    def _call(self, fn, *args):
+        """One transport op under the retry ladder + flaky seam."""
+        self._op += 1
+        op_id = self._op
+        name = getattr(self.replica, "name", None)
+
+        def send():
+            p = faults.pull("flaky_transport", op_id,
+                            match={"replica": name})
+            if p is not None and not p.get("after"):
+                raise faults.TransientError(
+                    f"UNAVAILABLE: injected flaky_transport to "
+                    f"{name} (op {op_id})")
+            out = fn(*args)
+            if p is not None and p.get("after"):
+                # delivered, ack lost: the retry re-delivers and the
+                # replica's rid idempotency must absorb the duplicate
+                raise faults.TransientError(
+                    f"UNAVAILABLE: injected flaky_transport ack loss "
+                    f"to {name} (op {op_id})")
+            return out
+
+        return call_with_retries(
+            send, retries=self.retries, base_delay=self.base_delay,
+            max_delay=self.max_delay, retryable=is_transient,
+            stats=self.stats, jitter=self.jitter,
+            jitter_seed=self.jitter_seed)
+
+    # -- verbs -----------------------------------------------------------
+
+    def submit(self, rid, prompt, max_new_tokens, eos_token_id=None,
+               priority=0):
+        """Deliver one request (idempotent by rid at the replica)."""
+        self._call(self.replica.enqueue,
+                   ("submit", rid, list(prompt), int(max_new_tokens),
+                    eos_token_id, int(priority)))
+
+    def cancel(self, rid):
+        self._call(self.replica.enqueue, ("cancel", rid))
+
+    def poll(self):
+        """Fetch finished-request dicts accumulated at the replica."""
+        return self._call(self.replica.pop_results)

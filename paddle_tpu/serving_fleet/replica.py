@@ -1,0 +1,320 @@
+"""One serving replica behind a transport seam — the fleet's unit.
+
+An ``InprocReplica`` owns a ``ServingEngine`` and drives it from a
+dedicated daemon worker thread, speaking exactly the verbs a
+subprocess/remote replica would speak over a wire:
+
+- ``enqueue(op)``        — submit/cancel commands (the request plane);
+- ``pop_results()``      — finished-request dicts (the response plane);
+- ``scrape()``           — the last published health/metrics snapshot
+  (what scraping the round-10 ``/metrics``+``/healthz`` endpoint of a
+  real replica process returns);
+- ``drain()`` / ``kill()`` / ``rejoin()`` — lifecycle control;
+- ``export_inflight()``  — partial tokens of a dead/wedged replica's
+  unfinished requests (in a subprocess deployment these facts arrive
+  over the streaming token channel; in-process the carcass is
+  readable directly).
+
+EVERY engine touch happens on the worker thread: submits and cancels
+ride the inbox queue, health is published as an immutable snapshot
+under a lock, results are appended under a lock. The router never
+calls into the engine of a LIVE replica, so the single-threaded
+engine contract holds; ``export_inflight`` is only read once the
+worker is provably not running (dead, wedged-asleep, or drained).
+
+Chaos seams (resilience.faults, payload-targeted by replica name —
+``inject("replica_crash", replica="r1")``):
+
+- ``replica_crash`` — the worker thread dies at a round boundary
+  (consulted only once the replica is BUSY, so an unpinned fault
+  deterministically fires mid-decode with partial tokens in flight);
+- ``replica_wedge`` — the worker stops heartbeating for ``seconds``
+  (router detects via scrape staleness and fails over);
+- ``replica_slow``  — host sleep per round (tail-latency/hedging
+  drill).
+
+The worker also polls ``resilience.preemption.requested()``: a
+process-level SIGTERM drains every replica gracefully through the
+same path as ``drain()`` — the fleet analogue of the round-8
+checkpoint-and-exit contract.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from ..resilience import faults, preemption
+
+__all__ = ["InprocReplica", "ReplicaCrash"]
+
+
+class ReplicaCrash(RuntimeError):
+    """Injected stand-in for a replica process dying (OOM-kill, chip
+    reset, node loss). Raised inside the worker loop; the thread dies
+    and the router's failover path takes over."""
+
+
+class InprocReplica:
+    """One ServingEngine + one worker thread + transport-shaped edges.
+
+    name: replica identity (fault targeting, routing labels).
+    engine: a ServingEngine this replica takes ownership of driving.
+    poll_s: idle-loop sleep (the worker never busy-spins).
+    heartbeat_s: min interval between health-snapshot publishes.
+    honor_preemption: drain when resilience.preemption.requested()
+        (process SIGTERM → every replica drains gracefully).
+    """
+
+    def __init__(self, name, engine, *, poll_s=0.001, heartbeat_s=0.01,
+                 honor_preemption=True):
+        self.name = str(name)
+        self.engine = engine
+        self.poll_s = float(poll_s)
+        self.heartbeat_s = float(heartbeat_s)
+        self.honor_preemption = bool(honor_preemption)
+        self._inbox = queue.Queue()
+        self._out_lock = threading.Lock()
+        self._outbox = []
+        self._health_lock = threading.Lock()
+        self._health = {}
+        self._accepted = {}     # fleet rid -> engine rid (idempotency)
+        self._rid_map = {}      # engine rid -> fleet rid
+        self._precancel = set()  # cancel arrived before its submit
+        self._drain = threading.Event()
+        self._stop = threading.Event()
+        self._round = 0
+        self._last_publish = 0.0
+        self._state = "serving"
+        self.error = None
+        self._thread = None
+        self._start()
+
+    # -- router-facing transport verbs (never touch the engine) ----------
+
+    @property
+    def state(self):
+        """serving | draining | drained | dead (worker-written)."""
+        return self._state
+
+    @property
+    def alive(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def enqueue(self, op):
+        """Queue one command for the worker: ("submit", fleet_rid,
+        prompt, max_new_tokens, eos_token_id, priority) or
+        ("cancel", fleet_rid). Submits are idempotent by fleet rid —
+        a transport retry that double-delivers is absorbed."""
+        self._inbox.put(tuple(op))
+
+    def pop_results(self):
+        """Drain the outbox (fleet-rid-keyed finished dicts). Pure
+        lock swap — works even after the worker died, which is how a
+        drained replica's last results are harvested."""
+        with self._out_lock:
+            out, self._outbox = self._outbox, []
+        return out
+
+    def scrape(self):
+        """Last published health snapshot (dict copy). The
+        ``scrape_timeout`` fault makes this raise a transient
+        DEADLINE_EXCEEDED exactly like a real scrape timing out; the
+        router keeps routing on its previous snapshot. Deliberately
+        NOT retried — the next heartbeat is fresher than a retry."""
+        if faults.pull("scrape_timeout", self._round,
+                       match={"replica": self.name}) is not None:
+            raise faults.TransientError(
+                f"DEADLINE_EXCEEDED: injected scrape_timeout "
+                f"({self.name})")
+        with self._health_lock:
+            return dict(self._health)
+
+    def drain(self):
+        """Graceful: stop admitting, finish in-flight token-exactly,
+        bounce queued work back to the router, then park (state
+        'drained'). Idempotent."""
+        self._drain.set()
+
+    def kill(self, join_timeout=2.0):
+        """Hard stop the worker (wedge recovery). The thread exits at
+        its next check — including from inside a wedge sleep."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=join_timeout)
+
+    def rejoin(self):
+        """Restart a drained/dead replica's worker on the SAME engine,
+        so every compiled program carries over — a rejoin costs zero
+        recompiles. Leftover in-flight work from a crash (the router
+        already failed it over) is cancelled and flushed; the router
+        drops those stale results by resolved-rid dedup."""
+        if self.alive:
+            raise RuntimeError(f"replica {self.name} is still running")
+        if self.engine.state == "closed":
+            raise RuntimeError("engine is closed — cannot rejoin")
+        if self.engine.state == "draining":
+            self.engine.resume()
+        for ent in self.engine.export_inflight():
+            self.engine.cancel(ent["rid"])
+        while not self.engine.idle:
+            for res in self.engine.step():
+                self._emit_engine(res)
+        # forget the previous incarnation's accepted rids: the router
+        # may legitimately re-place a failed-over/bounced rid back
+        # HERE, and the idempotency check must not drop it as a
+        # duplicate delivery. (_rid_map keeps its old entries — engine
+        # rids never repeat, and stale results still need translating
+        # so the router can dedup them by resolved rid.)
+        self._accepted = {}
+        self._precancel = set()
+        self._drain = threading.Event()
+        self._stop = threading.Event()
+        self._state = "serving"
+        self.error = None
+        self._start()
+
+    def export_inflight(self):
+        """Fleet-rid-keyed unfinished-request snapshot off the engine.
+        Only valid once the worker is not running (dead/wedged/
+        drained) — the failover and requeue paths."""
+        out = []
+        for ent in self.engine.export_inflight():
+            frid = self._rid_map.get(ent["rid"])
+            if frid is not None:
+                out.append(dict(ent, rid=frid))
+        return out
+
+    # -- worker thread ----------------------------------------------------
+
+    def _start(self):
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"fleet-replica-{self.name}")
+        self._thread.start()
+
+    def _loop(self):
+        state_out = "drained"
+        try:
+            while True:
+                if self._stop.is_set():
+                    state_out = "dead"
+                    self.error = self.error or "killed"
+                    break
+                self._round += 1
+                r = self._round
+                busy = not self.engine.idle
+                # crash/wedge seams consult only when the replica has
+                # work: an unpinned fault fires deterministically
+                # "mid-decode" instead of on the first idle round
+                if busy:
+                    if faults.pull("replica_crash", r,
+                                   match={"replica": self.name}) \
+                            is not None:
+                        raise ReplicaCrash(
+                            f"injected replica_crash on {self.name} "
+                            f"(round {r})")
+                    p = faults.pull("replica_wedge", r,
+                                    match={"replica": self.name})
+                    if p is not None:
+                        self._wedge(float(p.get("seconds", 30.0)))
+                        continue
+                faults.maybe_sleep("replica_slow", r,
+                                   match={"replica": self.name})
+                if (self._drain.is_set()
+                        or (self.honor_preemption
+                            and preemption.requested())):
+                    if self.engine.state == "serving":
+                        self.engine.drain()
+                    self._state = "draining"
+                self._pump_inbox()
+                if not self.engine.idle:
+                    for res in self.engine.step():
+                        self._emit_engine(res)
+                elif self._state == "draining":
+                    break  # drained: engine empty, inbox bounced
+                else:
+                    time.sleep(self.poll_s)
+                self._publish()
+        except ReplicaCrash as e:
+            state_out = "dead"
+            self.error = str(e)
+        except Exception as e:  # noqa: BLE001 — a worker bug is a crash
+            state_out = "dead"
+            self.error = f"{type(e).__name__}: {e}"
+        self._state = state_out
+        self._publish(force=True)
+
+    def _wedge(self, seconds):
+        """No heartbeats, no progress — what a stuck process looks
+        like from outside. kill() releases it early."""
+        t_end = time.monotonic() + seconds
+        while time.monotonic() < t_end and not self._stop.is_set():
+            time.sleep(0.005)
+
+    def _pump_inbox(self):
+        while True:
+            try:
+                op = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            if op[0] == "submit":
+                _, frid, prompt, max_new, eos, prio = op
+                if frid in self._accepted:
+                    continue  # idempotent: duplicate delivery dropped
+                if frid in self._precancel:
+                    self._precancel.discard(frid)
+                    self._emit({"id": frid, "tokens": [],
+                                "status": "cancelled"})
+                    continue
+                if self._state != "serving" \
+                        or self.engine.state != "serving":
+                    # not admitting: bounce so the router re-places it
+                    self._emit({"id": frid, "tokens": [],
+                                "status": "bounced"})
+                    continue
+                erid = self.engine.submit(prompt, max_new, eos,
+                                          priority=prio)
+                self._accepted[frid] = erid
+                self._rid_map[erid] = frid
+            elif op[0] == "cancel":
+                erid = self._accepted.get(op[1])
+                if erid is not None:
+                    self.engine.cancel(erid)
+                else:
+                    self._precancel.add(op[1])
+
+    def _emit_engine(self, res):
+        """Translate an engine result (engine rid) to the fleet rid
+        and publish it."""
+        frid = self._rid_map.get(res["id"])
+        if frid is None:
+            return  # engine-local request (warmup) — not fleet-owned
+        self._emit(dict(res, id=frid))
+
+    def _emit(self, res):
+        with self._out_lock:
+            self._outbox.append(dict(res, replica=self.name))
+
+    def _publish(self, force=False):
+        now = time.monotonic()
+        if not force and now - self._last_publish < self.heartbeat_s:
+            return
+        self._last_publish = now
+        h = self.engine.health()
+        qw = self.engine.registry.get("serve_queue_wait_seconds")
+        p99 = qw.quantile(0.99) if qw is not None and qw.count else 0.0
+        snap = {"replica": self.name, "state": self._state,
+                "engine_state": h.get("state"), "ts": now,
+                "round": self._round,
+                "queued": h["queued"], "running": h["running"],
+                "free_pages": h["free_pages"],
+                "total_pages": h["total_pages"],
+                "page_occupancy": h["page_occupancy"],
+                "page_size": self.engine.page_size,
+                "queue_wait_p99_s": round(float(p99 or 0.0), 6),
+                "decode_tokens": h["decode_tokens"],
+                "compile_counts": h["compile_counts"]}
+        with self._health_lock:
+            self._health = snap

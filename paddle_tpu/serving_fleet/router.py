@@ -1,0 +1,655 @@
+"""FleetRouter — health-routed load balancing over N serving replicas.
+
+The fleet layer the ROADMAP's "millions of users" north star needs: a
+single admission point over many ServingEngine replicas that keeps
+every client request alive through replica crashes, wedges, drains
+and saturation — with the same zero-recompile discipline the engines
+themselves keep (every mechanism below is host-side bookkeeping; no
+replica ever compiles anything because of the router).
+
+Mechanisms (docs/robustness.md "Fleet serving" has the contracts):
+
+- **Placement by scrape.** Requests enter a global queue and are
+  placed by scoring each replica's last published health/metrics
+  snapshot (free KV pages, queued/running depth, queue-wait p99,
+  lifecycle state) — the same facts the round-10 ``/metrics`` +
+  ``/healthz`` endpoints expose, so a real multi-process deployment
+  scrapes HTTP instead of a lock. Stale scrapes degrade gracefully
+  (route on the previous snapshot; count ``fleet_scrape_errors``).
+- **Failover with prefix dedup.** A dead (``replica_crash``) or
+  silent (``replica_wedge``, heartbeat older than
+  ``wedge_timeout_s``) replica's unfinished requests are recovered
+  from its carcass (``export_inflight``) and continuation-resubmitted
+  elsewhere: the new prompt is ``original ‖ tokens_already_decoded``
+  and only the REMAINING budget is requested, so the client's final
+  stream is the completed prefix + the continuation — token-exact
+  under greedy decoding, never a duplicated token.
+- **Hedging.** With ``hedge_after_ms`` set, a request stuck past the
+  threshold on its primary gets a duplicate on the next-best replica;
+  the first finisher wins and the loser is cancelled (first-winner
+  dedup — the client sees exactly one result).
+- **Graceful drain / rejoin.** ``drain(name)`` flows through the
+  replica into ``ServingEngine.drain()`` (the resilience/preemption
+  seam: a process-level SIGTERM drains every replica the same way):
+  in-flight requests finish token-exactly, queued ones bounce back
+  and re-place on healthy replicas. ``rejoin(name)`` restarts the
+  worker on the SAME engine — compiled programs carry over, so a full
+  drain/rejoin cycle costs zero recompiles.
+- **Load shedding by priority.** When every serving replica is at its
+  outstanding-work limit and the global queue exceeds ``max_queue``,
+  the lowest-priority (newest-first within a priority) queued
+  requests resolve with ``status="shed"`` — predictable degradation
+  instead of unbounded queueing.
+
+The router publishes its own MetricsRegistry (catalogue in
+docs/observability.md) and serves it live via ``serve_metrics()`` —
+the router is itself a scrape target. Control flow is single-threaded
+by design: one thread drives ``step()``/``run_to_completion()``;
+replica workers run on their own daemon threads behind the transport
+seam.
+"""
+from __future__ import annotations
+
+import time
+
+from ..observability.metrics import MetricsRegistry
+from .client import ReplicaClient
+
+__all__ = ["FleetRouter"]
+
+
+class _Pending:
+    """Router-side state of one fleet request."""
+
+    __slots__ = ("rid", "prompt", "max_new", "eos", "priority",
+                 "submitted_at", "placed_at", "replica", "hedge",
+                 "delivered", "failovers", "hedged", "done")
+
+    def __init__(self, rid, prompt, max_new, eos, priority):
+        self.rid = rid
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.eos = eos
+        self.priority = int(priority)
+        self.submitted_at = time.monotonic()
+        self.placed_at = None
+        self.replica = None     # primary assignment (replica name)
+        self.hedge = None       # hedge assignment (replica name)
+        self.delivered = []     # tokens recovered from a lost replica
+        self.failovers = 0
+        self.hedged = False
+        self.done = False
+
+
+class FleetRouter:
+    """Fault-tolerant request router over serving replicas.
+
+    replicas: iterable of InprocReplica (names must be unique).
+    registry: MetricsRegistry for the fleet_* series (default: a
+        private one, mirroring ServingEngine's registry semantics).
+    max_queue: global placement-queue bound; beyond it the lowest-
+        priority queued requests are shed.
+    replica_queue_limit: max outstanding (router-placed, unfinished)
+        requests per replica — the saturation definition.
+    hedge_after_ms: duplicate a request stuck this long on its
+        primary onto a second replica (None = hedging off).
+    wedge_timeout_s: a live replica whose heartbeat is older than
+        this is declared wedged, killed, and failed over. The worker
+        can only heartbeat BETWEEN engine rounds, so this must exceed
+        the worst single dispatch/compile the replica can legally pay
+        (an unwarmed prefill bucket on real hardware is seconds) —
+        too tight a timeout turns a slow compile into a fleet-wide
+        kill cascade. Default 10s; chaos tests pin it low only
+        because their buckets are pre-warmed.
+    transport_retries / retry_jitter: ReplicaClient backoff knobs;
+        each client gets a distinct jitter seed so fleet-wide retries
+        de-synchronize (resilience.retry.backoff_schedule).
+    """
+
+    def __init__(self, replicas, *, registry=None, max_queue=64,
+                 replica_queue_limit=4, hedge_after_ms=None,
+                 wedge_timeout_s=10.0, transport_retries=3,
+                 retry_jitter=0.5):
+        self.replicas = {}
+        self._clients = {}
+        for i, rep in enumerate(replicas):
+            if rep.name in self.replicas:
+                raise ValueError(f"duplicate replica name {rep.name!r}")
+            self.replicas[rep.name] = rep
+            self._clients[rep.name] = ReplicaClient(
+                rep, retries=transport_retries, jitter=retry_jitter,
+                jitter_seed=i)
+        if not self.replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.max_queue = int(max_queue)
+        self.replica_queue_limit = int(replica_queue_limit)
+        self.hedge_after_ms = hedge_after_ms
+        self.wedge_timeout_s = float(wedge_timeout_s)
+
+        self._pending = {}          # rid -> _Pending (retired when the
+        #                             result is popped via results())
+        self._queue = []            # rids awaiting placement
+        self._done = {}             # rid -> result dict (until popped)
+        self._cancel_requested = set()
+        self._lost = set()          # failed-over, awaiting rejoin
+        self._last_scrape = {}      # name -> last good snapshot
+        self._next_rid = 0
+        self._exporter = None
+        self._closed = False
+
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        reg = self.registry
+        self._m_req = {}
+        self._m_routed = {}
+        self._m_failover = {}
+        self._m_requeued = reg.counter(
+            "fleet_requeued_total",
+            help="requests re-placed after a drain bounce")
+        self._m_hedges = reg.counter(
+            "fleet_hedges_total",
+            help="duplicate submissions issued by tail-latency hedging")
+        self._m_hedge_wins = {}
+        self._m_shed = reg.counter(
+            "fleet_shed_total",
+            help="requests rejected by priority load shedding")
+        self._m_scrape_errors = reg.counter(
+            "fleet_scrape_errors_total",
+            help="replica health scrapes that failed (stale routing)")
+        self._m_place_wait = reg.histogram(
+            "fleet_placement_wait_seconds",
+            help="submit -> placement-decision wait (the router-level "
+                 "queueing leg)")
+        self._g_queue = reg.gauge(
+            "fleet_queue_depth", help="requests awaiting placement")
+        self._g_pending = reg.gauge(
+            "fleet_pending", help="accepted, unresolved requests")
+        self._g_serving = reg.gauge(
+            "fleet_replicas_serving",
+            help="replicas currently placeable")
+
+    # -- metric series (lazy per label) -----------------------------------
+
+    def _labeled(self, cache, name, help, **labels):
+        key = tuple(sorted(labels.items()))
+        c = cache.get(key)
+        if c is None:
+            c = self.registry.counter(name, help=help, labels=labels)
+            cache[key] = c
+        return c
+
+    def _req_counter(self, status):
+        return self._labeled(
+            self._m_req, "fleet_requests_total",
+            "resolved fleet requests by terminal status", status=status)
+
+    def _routed_counter(self, replica):
+        return self._labeled(
+            self._m_routed, "fleet_routed_total",
+            "requests placed, per replica", replica=replica)
+
+    def _failover_counter(self, replica, reason):
+        return self._labeled(
+            self._m_failover, "fleet_failovers_total",
+            "in-flight requests recovered off a lost replica",
+            replica=replica, reason=reason)
+
+    def _hedge_win_counter(self, by):
+        return self._labeled(
+            self._m_hedge_wins, "fleet_hedge_wins_total",
+            "hedged requests by which leg finished first", by=by)
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=16, eos_token_id=None,
+               priority=0):
+        """Accept one request into the fleet; returns its fleet rid.
+        Placement happens at the next step()."""
+        if self._closed:
+            raise RuntimeError("FleetRouter is closed")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._pending[rid] = _Pending(rid, prompt, max_new_tokens,
+                                      eos_token_id, priority)
+        self._queue.append(rid)
+        return rid
+
+    def step(self):
+        """One control round: harvest results, scrape health, fail
+        over lost replicas, place/shed/hedge. Returns the results
+        resolved this round."""
+        if self._closed:
+            raise RuntimeError("FleetRouter is closed")
+        before = set(self._done)
+        self._collect()
+        self._scrape_all()
+        self._recover_lost()
+        self._place()
+        self._shed()
+        self._hedge()
+        self._g_queue.set(len(self._queue))
+        self._g_pending.set(
+            sum(1 for p in self._pending.values() if not p.done))
+        self._g_serving.set(len(self._serving_candidates()))
+        return [self._done[r] for r in self._done if r not in before]
+
+    def run_to_completion(self, timeout_s=120.0, poll_s=0.002):
+        """Drive step() until every accepted request resolves; returns
+        all results in rid order (cleared from the done buffer)."""
+        t_end = time.monotonic() + float(timeout_s)
+        while any(not p.done for p in self._pending.values()):
+            self.step()
+            if not any(not p.done for p in self._pending.values()):
+                break
+            if time.monotonic() > t_end:
+                stuck = sorted(r for r, p in self._pending.items()
+                               if not p.done)
+                raise RuntimeError(
+                    f"fleet did not drain within {timeout_s}s; "
+                    f"unresolved rids: {stuck[:10]}")
+            time.sleep(poll_s)
+        return self.results()
+
+    def results(self):
+        """Pop resolved results, rid order. Popping also retires the
+        router-side request state: a long-lived router stays bounded
+        by its in-flight window, not its lifetime request count (rids
+        never repeat, so a stray late result for a retired rid simply
+        finds no pending entry and is dropped — the same dedup as
+        before, without the unbounded table)."""
+        out = [self._done[r] for r in sorted(self._done)]
+        for r in self._done:
+            self._pending.pop(r, None)
+        self._done = {}
+        return out
+
+    def generate(self, prompts, max_new_tokens=16, eos_token_id=None):
+        """Convenience batch API (mirrors ServingEngine.generate):
+        submit all, drain the fleet, return token lists in submission
+        order."""
+        ids = [self.submit(p, max_new_tokens, eos_token_id)
+               for p in prompts]
+        res = {r["id"]: r for r in self.run_to_completion()}
+        return [res[i]["tokens"] for i in ids]
+
+    def drain(self, name):
+        """Gracefully drain one replica (same seam a preemption notice
+        uses): stops admitting, finishes in-flight, bounces queued
+        work back for re-placement."""
+        self.replicas[name].drain()
+
+    def rejoin(self, name):
+        """Bring a drained/failed replica back into rotation (same
+        engine — zero recompiles)."""
+        self.replicas[name].rejoin()
+        self._lost.discard(name)
+        self._last_scrape.pop(name, None)
+
+    def cancel(self, rid):
+        """Cancel a fleet request wherever it currently lives."""
+        p = self._pending.get(rid)
+        if p is None or p.done:
+            return False
+        self._cancel_requested.add(rid)
+        if rid in self._queue:
+            self._queue.remove(rid)
+            self._resolve(p, list(p.delivered), "cancelled", None)
+            return True
+        for name in (p.replica, p.hedge):
+            if name is not None and name in self._clients:
+                try:
+                    self._clients[name].cancel(rid)
+                except Exception:  # noqa: BLE001 — transport gave up
+                    pass
+        return True
+
+    def health(self):
+        """Fleet-wide snapshot: per-replica state + last scrape age,
+        queue/pending depth, lost set. What an operator (or an outer
+        LB) pages on."""
+        now = time.monotonic()
+        reps = {}
+        for name, rep in self.replicas.items():
+            snap = self._last_scrape.get(name)
+            reps[name] = {
+                "alive": rep.alive, "state": rep.state,
+                "lost": name in self._lost,
+                "scrape_age_s": (None if snap is None
+                                 else round(now - snap["ts"], 6)),
+                "queued": snap.get("queued") if snap else None,
+                "running": snap.get("running") if snap else None,
+                "free_pages": snap.get("free_pages") if snap else None,
+                "error": rep.error}
+        # list() snapshots: health() also runs on metrics-exporter
+        # HTTP threads, and the control thread may be mid-submit
+        return {"replicas": reps,
+                "queue_depth": len(self._queue),
+                "pending": sum(1 for p in list(self._pending.values())
+                               if not p.done),
+                "lost": sorted(self._lost),
+                "compile_report": self.compile_report()}
+
+    def compile_report(self):
+        """Per-replica compile counts + fleet-wide unexpected-retrace
+        total — the zero-recompile assertion's fleet form (must stay
+        frozen through crash/drain/rejoin waves)."""
+        reps = {}
+        unexpected = 0
+        for name, rep in self.replicas.items():
+            reps[name] = rep.engine.compile_counts()
+            unexpected += rep.engine.tracer.unexpected_retraces()
+        return {"replicas": reps, "unexpected_retraces": unexpected}
+
+    def serve_metrics(self, port=0, host="127.0.0.1"):
+        """Attach a live HTTP exporter to the ROUTER: /metrics is the
+        fleet registry, /healthz is health(). The router is a scrape
+        target just like its replicas."""
+        from ..observability.exporter import MetricsExporter
+        if self._exporter is not None:
+            self._exporter.close()
+        self._exporter = MetricsExporter(registry=self.registry,
+                                         port=port, host=host,
+                                         health_fn=self.health)
+        return self._exporter
+
+    def close(self):
+        """Stop every replica worker and the exporter. Engines are
+        NOT closed (the router does not own them); idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        for rep in self.replicas.values():
+            rep.kill()
+        if self._exporter is not None:
+            self._exporter.close()
+            self._exporter = None
+
+    # -- control-plane internals --------------------------------------------
+
+    def _collect(self):
+        for name in self.replicas:
+            try:
+                batch = self._clients[name].poll()
+            except Exception:  # noqa: BLE001 — transport gave up; retry
+                continue       # next round (results stay queued)
+            for res in batch:
+                self._handle(res)
+
+    def _handle(self, res):
+        rid = res["id"]
+        p = self._pending.get(rid)
+        if p is None or p.done:
+            return  # stray: hedge loser, post-rejoin flush — dedup
+        src = res.get("replica")
+        status = res["status"]
+        unsolicited_cancel = (status == "cancelled"
+                              and rid not in self._cancel_requested)
+        if status == "bounced" or unsolicited_cancel:
+            if src not in (p.replica, p.hedge):
+                # stale leg: a rejoined replica flushing its pre-crash
+                # slot, or a late bounce from a replica this rid was
+                # already failed over FROM. Its tokens were either
+                # harvested from the carcass at failover time or
+                # deliberately restarted from scratch — folding them
+                # in here could duplicate the prefix of a from-scratch
+                # resubmit already running elsewhere
+                return
+            # drain bounce: the replica gave the request back — keep
+            # the longest token prefix seen and re-place
+            toks = res.get("tokens") or []
+            if len(toks) > len(p.delivered):
+                p.delivered = list(toks)
+            if src == p.replica:
+                p.replica = None
+            if src == p.hedge:
+                p.hedge = None
+            if p.replica is None and p.hedge is None \
+                    and rid not in self._queue:
+                self._m_requeued.inc()
+                if not self._finish_from_prefix(p):
+                    self._queue.append(rid)
+            return
+        if status == "cancelled":
+            # the cancel WE asked for. Hedge losers never reach this
+            # (their rid is already done → dedup above); what remains
+            # is a client-initiated cancel of a running request, which
+            # resolves with its partial tokens
+            self._cancel_requested.discard(rid)
+            self._resolve(p, p.delivered + list(res.get("tokens") or []),
+                          "cancelled", src)
+            return
+        # terminal: ok | expired — first finisher wins
+        tokens = p.delivered + list(res.get("tokens") or [])
+        if p.hedged and p.replica is not None and p.hedge is not None:
+            loser = p.hedge if src == p.replica else p.replica
+            by = "primary" if src == p.replica else "hedge"
+            self._hedge_win_counter(by).inc()
+            self._cancel_requested.add(rid)
+            try:
+                self._clients[loser].cancel(rid)
+            except Exception:  # noqa: BLE001 — loser may already be gone
+                pass
+        self._resolve(p, tokens, status, src)
+
+    def _finish_from_prefix(self, p):
+        """A recovered prefix may already satisfy the request (eos
+        seen, or budget exhausted) — resolve without resubmitting.
+        Returns True when resolved."""
+        d = p.delivered
+        if p.eos is not None and p.eos in d:
+            self._resolve(p, d[:d.index(p.eos) + 1], "ok", None)
+            return True
+        if len(d) >= p.max_new:
+            self._resolve(p, d[:p.max_new], "ok", None)
+            return True
+        return False
+
+    def _resolve(self, p, tokens, status, replica):
+        p.done = True
+        self._cancel_requested.discard(p.rid)
+        self._req_counter(status).inc()
+        self._done[p.rid] = {
+            "id": p.rid, "tokens": [int(t) for t in tokens],
+            "status": status, "replica": replica,
+            "failovers": p.failovers, "hedged": p.hedged,
+            "age_s": round(time.monotonic() - p.submitted_at, 6)}
+
+    def _scrape_all(self):
+        for name, rep in self.replicas.items():
+            if name in self._lost:
+                continue
+            try:
+                snap = rep.scrape()
+            except Exception:  # noqa: BLE001 — scrape timeout: route stale
+                self._m_scrape_errors.inc()
+                continue
+            if snap:
+                self._last_scrape[name] = snap
+
+    def _serving_candidates(self):
+        out = []
+        for name, rep in self.replicas.items():
+            if name in self._lost or not rep.alive:
+                continue
+            snap = self._last_scrape.get(name)
+            if snap and snap.get("state") == "serving":
+                out.append((name, snap))
+        return out
+
+    def _outstanding(self):
+        """Router-side per-replica unresolved assignment counts (the
+        authoritative saturation signal — scrapes lag)."""
+        out = {name: 0 for name in self.replicas}
+        for p in self._pending.values():
+            if p.done:
+                continue
+            for name in (p.replica, p.hedge):
+                if name in out:
+                    out[name] += 1
+        return out
+
+    def _pick_replica(self, outstanding, exclude=()):
+        """Best serving replica by scraped health: free pages up,
+        queue depth / occupancy / queue-wait p99 down; capacity-capped
+        by the router's own outstanding count. Deterministic tie-break
+        on name."""
+        best, best_key = None, None
+        for name, snap in self._serving_candidates():
+            if name in exclude:
+                continue
+            if outstanding.get(name, 0) >= self.replica_queue_limit:
+                continue
+            score = (float(snap.get("free_pages", 0))
+                     - 8.0 * float(snap.get("queued", 0))
+                     - 2.0 * float(snap.get("running", 0))
+                     - 50.0 * float(snap.get("queue_wait_p99_s", 0.0))
+                     - 4.0 * outstanding.get(name, 0))
+            key = (score, name)
+            if best_key is None or score > best_key[0] \
+                    or (score == best_key[0] and name < best_key[1]):
+                best, best_key = name, key
+        return best
+
+    def _unscraped(self):
+        """Live replicas we have never heard a heartbeat from (fleet
+        boot). Placement and shedding both wait them out: an unknown
+        replica is unknown capacity, not zero capacity — and placing
+        before every snapshot has landed would skew the spread."""
+        return [name for name, rep in self.replicas.items()
+                if name not in self._lost and rep.alive
+                and name not in self._last_scrape]
+
+    def _place(self):
+        if not self._queue or self._unscraped():
+            return
+        outstanding = self._outstanding()
+        placed = []
+        # highest priority first; FIFO within a priority
+        for rid in sorted(self._queue,
+                          key=lambda r: (-self._pending[r].priority, r)):
+            p = self._pending[rid]
+            target = self._pick_replica(outstanding)
+            if target is None:
+                continue
+            prompt = p.prompt + [int(t) for t in p.delivered]
+            remaining = p.max_new - len(p.delivered)
+            try:
+                self._clients[target].submit(rid, prompt, remaining,
+                                             p.eos, p.priority)
+            except Exception:  # noqa: BLE001 — transport gave up; retry
+                continue       # next round
+            p.replica = target
+            p.placed_at = time.monotonic()
+            outstanding[target] = outstanding.get(target, 0) + 1
+            self._routed_counter(target).inc()
+            self._m_place_wait.observe(p.placed_at - p.submitted_at)
+            placed.append(rid)
+        for rid in placed:
+            self._queue.remove(rid)
+
+    def _shed(self):
+        if len(self._queue) <= self.max_queue:
+            return
+        # only shed under GENUINE saturation, never during fleet boot
+        # and never while some serving replica could still take work
+        # (e.g. a placement that lost its transport round retries next
+        # step instead of being rejected)
+        if self._unscraped() \
+                or self._pick_replica(self._outstanding()) is not None:
+            return
+        # lowest priority goes first; newest first within a priority
+        order = sorted(self._queue,
+                       key=lambda r: (self._pending[r].priority, -r))
+        while len(self._queue) > self.max_queue and order:
+            rid = order.pop(0)
+            self._queue.remove(rid)
+            p = self._pending[rid]
+            self._m_shed.inc()
+            self._resolve(p, list(p.delivered), "shed", None)
+
+    def _hedge(self):
+        if not self.hedge_after_ms:
+            return
+        now = time.monotonic()
+        outstanding = self._outstanding()
+        for rid, p in self._pending.items():
+            if p.done or p.replica is None or p.hedge is not None \
+                    or p.delivered or p.placed_at is None:
+                continue
+            if (now - p.placed_at) * 1e3 < float(self.hedge_after_ms):
+                continue
+            target = self._pick_replica(outstanding,
+                                        exclude={p.replica})
+            if target is None:
+                continue
+            try:
+                self._clients[target].submit(rid, p.prompt, p.max_new,
+                                             p.eos, p.priority)
+            except Exception:  # noqa: BLE001 — transport gave up
+                continue
+            p.hedge = target
+            p.hedged = True
+            outstanding[target] = outstanding.get(target, 0) + 1
+            self._m_hedges.inc()
+
+    def _recover_lost(self):
+        now = time.monotonic()
+        for name, rep in self.replicas.items():
+            if name in self._lost:
+                continue
+            reason = None
+            if not rep.alive and rep.state == "dead":
+                reason = "crash"
+            elif rep.alive and rep.state in ("serving", "draining"):
+                snap = self._last_scrape.get(name)
+                if snap and now - snap["ts"] > self.wedge_timeout_s:
+                    reason = "wedge"
+            elif not rep.alive and rep.state == "drained":
+                # parked cleanly; recover any straggler assignments
+                # (a submit that raced the drain into a dead inbox)
+                self._recover_assignments(name, "drain", rep)
+                continue
+            if reason is None:
+                continue
+            if rep.alive:
+                rep.kill()  # unstick the wedge; thread exits
+            self._lost.add(name)
+            self._recover_assignments(name, reason, rep)
+
+    def _recover_assignments(self, name, reason, rep):
+        """Fail over every unresolved request assigned to `name`:
+        harvest finished results first, recover partial tokens from
+        the carcass, then continuation-resubmit (completed prefix
+        deduped) or finish straight from the prefix."""
+        try:
+            for res in rep.pop_results():
+                self._handle(res)
+        except Exception:  # noqa: BLE001 — best-effort harvest
+            pass
+        try:
+            carcass = {e["rid"]: e for e in rep.export_inflight()}
+        except Exception:  # noqa: BLE001 — carcass unreadable: resubmit
+            carcass = {}   # from scratch (still correct, just slower)
+        for rid, p in list(self._pending.items()):
+            if p.done:
+                continue
+            hit = False
+            if p.replica == name:
+                p.replica = None
+                hit = True
+            if p.hedge == name:
+                p.hedge = None
+                hit = True
+            if not hit:
+                continue
+            p.failovers += 1
+            self._failover_counter(name, reason).inc()
+            ent = carcass.get(rid)
+            if ent and len(ent.get("tokens") or []) > len(p.delivered):
+                p.delivered = [int(t) for t in ent["tokens"]]
+            if p.replica is not None or p.hedge is not None:
+                continue  # the other leg is still running it
+            if rid in self._queue:
+                continue
+            if not self._finish_from_prefix(p):
+                self._queue.append(rid)

@@ -129,6 +129,15 @@ STAGES = [
     # writes the same telemetry.jsonl/metrics.json shape bench stages do
     ("telemetry_smoke", [PY, "tools/telemetry_smoke.py"], 1200,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
+    # fleet chaos drill (ISSUE 6, CPU): 3 in-process serving replicas
+    # under a seeded fault wave (replica crash/wedge/slow, flaky
+    # transport, drain/rejoin) — asserts 100% request completion with
+    # token-exact failover dedup and 0 unexpected retraces fleet-wide
+    ("fleet_chaos_smoke", [PY, "-m", "pytest",
+                           "tests/test_fleet_serving.py", "-q", "-m",
+                           "chaos", "-p", "no:cacheprovider", "-p",
+                           "no:randomly"], 1800,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
     ("bench_full", [PY, "bench.py"], 7200, {}),
     ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
      2400, {}),

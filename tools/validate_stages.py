@@ -36,6 +36,8 @@ REQUIRED_STAGES = {
     "chaos_smoke",
     # round-9 observability drill (CPU-only — ISSUE 4)
     "telemetry_smoke",
+    # fleet failover/drain/hedge/shed chaos drill (CPU-only — ISSUE 6)
+    "fleet_chaos_smoke",
 }
 
 
