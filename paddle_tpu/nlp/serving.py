@@ -76,10 +76,11 @@ class ServeRequest:
     """
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
-                 "deadline", "priority", "submitted_at", "submitted_pc")
+                 "deadline", "priority", "submitted_at", "submitted_pc",
+                 "trace", "admitted_pc")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
-                 deadline=None, priority=0):
+                 deadline=None, priority=0, trace=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -89,10 +90,15 @@ class ServeRequest:
         self.submitted_at = time.monotonic()
         # span clock (perf_counter): the queue-wait span's start
         self.submitted_pc = time.perf_counter()
+        # distributed-trace context (observability.dtrace wire form);
+        # None for untraced (non-fleet) requests — zero overhead then
+        self.trace = trace
+        self.admitted_pc = None
 
 
 class _Slot:
-    __slots__ = ("req", "pages", "out_tokens", "status", "admit_seq")
+    __slots__ = ("req", "pages", "out_tokens", "status", "admit_seq",
+                 "decode_t0")
 
     def __init__(self, req, pages, admit_seq=0):
         self.req = req
@@ -100,6 +106,8 @@ class _Slot:
         self.out_tokens = []        # generated tokens (host ints)
         self.status = "ok"          # ok | expired | cancelled | evicted
         self.admit_seq = admit_seq  # admission order (evict tie-break)
+        self.decode_t0 = None       # perf_counter at prefill end (the
+        #                             traced decode leg's start)
 
 
 def _next_pow2(n):
@@ -420,7 +428,7 @@ class ServingEngine:
     # -- public API ---------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=16, eos_token_id=None,
-               deadline_ms=None, priority=0):
+               deadline_ms=None, priority=0, trace=None):
         """Queue one request; returns its id. Admitted at the next
         step() boundary (slot + pages permitting).
 
@@ -428,7 +436,15 @@ class ServingEngine:
             (queueing + prefill + decode). Expiry is detected at host
             step boundaries; the request finishes with
             status='expired' and whatever tokens it produced.
-        priority: larger = more important (evict admission policy)."""
+        priority: larger = more important (evict admission policy).
+        trace: distributed-trace context (observability.dtrace wire
+            form, minted by a FleetRouter and propagated through the
+            replica transport). The engine then records this
+            request's queue/prefill/decode legs as child spans in the
+            process-global trace store — pure host-side dict appends
+            at the step boundaries the engine already owns, so the
+            zero-recompile contract is untouched. None (the default)
+            records nothing."""
         if self._state != "serving":
             if self._state == "closed":
                 raise RuntimeError("ServingEngine is closed")
@@ -462,8 +478,22 @@ class ServingEngine:
         self._next_rid += 1
         self._queue.append(ServeRequest(rid, prompt, max_new_tokens,
                                         eos_token_id, deadline=deadline,
-                                        priority=priority))
+                                        priority=priority, trace=trace))
         return rid
+
+    @staticmethod
+    def _dtrace_add(ctx, name, t0, t1=None, args=None, outcome=None):
+        """Record one distributed-trace child span (no-op for
+        untraced requests; never raises — tracing must not kill a
+        step)."""
+        if ctx is None:
+            return
+        try:
+            from ..observability import dtrace
+            dtrace.get_store().add_span(ctx, name, t0, t1, args=args,
+                                        outcome=outcome)
+        except Exception:  # noqa: BLE001 — accounting only
+            pass
 
     def cancel(self, rid):
         """Request cancellation of a queued or running request. Takes
@@ -877,6 +907,11 @@ class ServingEngine:
                                "status": status,
                                "age_s": age})
         self._cancel_pending.discard(req.rid)
+        if req.trace is not None and req.admitted_pc is None:
+            # never admitted (cancelled/expired/shed in the queue):
+            # the queue leg is the whole replica-side story
+            self._dtrace_add(req.trace, "queue", req.submitted_pc,
+                             outcome=status)
         self.spans.instant("finish", tid=f"req{req.rid}", cat="serve",
                            args={"status": status,
                                  "tokens": len(tokens or []),
@@ -891,6 +926,10 @@ class ServingEngine:
         finish). Pages return to the free list immediately."""
         slot = self._slots[b]
         req = slot.req
+        if req.trace is not None and slot.decode_t0 is not None:
+            self._dtrace_add(req.trace, "decode", slot.decode_t0,
+                             args={"tokens": len(slot.out_tokens)},
+                             outcome=status or slot.status)
         self._finish_request(req, status or slot.status,
                              slot.out_tokens[:req.max_new_tokens])
         self.spans.instant("release_pages", tid="sched", cat="serve",
@@ -1048,9 +1087,19 @@ class ServingEngine:
         self.spans.add(f"prefill_{bucket}", t_pre, tid=f"req{req.rid}",
                        cat="serve", args={"rid": req.rid, "slot": b,
                                           "pages": need_pages})
+        # distributed-trace legs: the queue-wait leg closed at t_pre,
+        # the prefill leg at the sync above (dtrace no-ops untraced)
+        req.admitted_pc = t_pre
+        t_post = time.perf_counter()
+        self._dtrace_add(req.trace, "queue", req.submitted_pc, t_pre,
+                         args={"slot": b})
+        self._dtrace_add(req.trace, f"prefill_{bucket}", t_pre, t_post,
+                         args={"pages": need_pages,
+                               "prompt_len": lp})
 
         self._admit_seq += 1
         self._slots[b] = _Slot(req, pages, admit_seq=self._admit_seq)
+        self._slots[b].decode_t0 = t_post
         self._slots[b].out_tokens.append(tok)
         row = np.full((self.max_pages_per_seq,), TRASH_PAGE, np.int32)
         row[:need_pages] = pages

@@ -1,20 +1,23 @@
 """Unified telemetry subsystem (metrics registry, recompile tracer,
 structured run telemetry, compiled-cost introspection, live exporter,
-spans, crash flight recorder) — docs/observability.md.
+spans, distributed tracing, SLO burn-rate accounting, crash flight
+recorder) — docs/observability.md.
 
-Layering: ``metrics``, ``telemetry``, ``exporter``, ``spans`` and
-``flightrec`` are pure stdlib (importable from the jax-free bench
-orchestrator and worker processes); ``trace`` and ``introspect``
-import jax lazily inside the wrapping calls.
+Layering: ``metrics``, ``telemetry``, ``exporter``, ``spans``,
+``dtrace``, ``slo`` and ``flightrec`` are pure stdlib (importable
+from the jax-free bench orchestrator and worker processes); ``trace``
+and ``introspect`` import jax lazily inside the wrapping calls.
 """
-from . import (exporter, flightrec, introspect, metrics,  # noqa: F401
-               spans, telemetry, trace)
+from . import (dtrace, exporter, flightrec, introspect,  # noqa: F401
+               metrics, slo, spans, telemetry, trace)
+from .dtrace import TraceStore, get_store  # noqa: F401
 from .exporter import MetricsExporter, serve_metrics  # noqa: F401
 from .flightrec import FlightRecorder  # noqa: F401
 from .introspect import (cost_report, measured_mfu,  # noqa: F401
                          resolve_peak_flops)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       default_time_buckets, get_registry)
+from .slo import SLObjective, SLOTracker  # noqa: F401
 from .spans import SpanRecorder, export_chrome  # noqa: F401
 from .telemetry import TelemetryCallback, TelemetryLogger  # noqa: F401
 from .trace import RecompileTracer, get_tracer, report_all  # noqa: F401
@@ -24,6 +27,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "TelemetryCallback", "TelemetryLogger", "RecompileTracer",
            "get_tracer", "report_all", "MetricsExporter",
            "serve_metrics", "SpanRecorder", "export_chrome",
+           "TraceStore", "get_store", "SLObjective", "SLOTracker",
            "FlightRecorder", "cost_report", "measured_mfu",
            "resolve_peak_flops", "metrics", "telemetry", "trace",
-           "introspect", "exporter", "spans", "flightrec"]
+           "introspect", "exporter", "spans", "dtrace", "slo",
+           "flightrec"]

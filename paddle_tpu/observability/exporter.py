@@ -61,17 +61,23 @@ class MetricsExporter:
         (ServingEngine.health); None serves a minimal liveness doc.
     report_fn: zero-arg callable returning extra /report sections
         merged over the defaults.
+    traces_fn: one-arg callable serving ``/traces`` (arg None = the
+        index of known traces) and ``/traces/<key>`` (arg = the key —
+        a trace id or fleet rid; return None for unknown keys -> 404).
+        None disables the endpoint (FleetRouter.serve_metrics wires
+        its trace_report here).
     host/port: bind address; port 0 = ephemeral (read .port after).
     """
 
     def __init__(self, registry=None, port=0, host="127.0.0.1",
-                 health_fn=None, report_fn=None):
+                 health_fn=None, report_fn=None, traces_fn=None):
         if registry is None:
             from .metrics import get_registry
             registry = get_registry()
         self.registry = registry
         self.health_fn = health_fn
         self.report_fn = report_fn
+        self.traces_fn = traces_fn
         self._started = time.time()
         exporter = self
 
@@ -109,11 +115,26 @@ class MetricsExporter:
                         self._send_json(exporter._health())
                     elif path == "/report":
                         self._send_json(exporter._report())
+                    elif exporter.traces_fn is not None and (
+                            path == "/traces"
+                            or path.startswith("/traces/")):
+                        key = (path[len("/traces/"):]
+                               if path.startswith("/traces/")
+                               else "") or None
+                        doc = exporter.traces_fn(key)
+                        if doc is None:
+                            self._send_json(
+                                {"error": f"unknown trace {key!r}"},
+                                code=404)
+                        else:
+                            self._send_json(doc)
                     else:
+                        endpoints = ["/metrics", "/healthz", "/report"]
+                        if exporter.traces_fn is not None:
+                            endpoints.append("/traces")
                         self._send_json(
                             {"error": f"unknown path {path!r}",
-                             "endpoints": ["/metrics", "/healthz",
-                                           "/report"]}, code=404)
+                             "endpoints": endpoints}, code=404)
                 except Exception as e:  # noqa: BLE001 — a handler bug must
                     # answer 500, not silently drop the connection
                     try:
@@ -187,8 +208,9 @@ class MetricsExporter:
 
 
 def serve_metrics(port=0, registry=None, host="127.0.0.1",
-                  health_fn=None, report_fn=None):
+                  health_fn=None, report_fn=None, traces_fn=None):
     """Start a MetricsExporter (the one-call attach the docs show);
     returns it — read ``.port`` / ``.url``, call ``.close()``."""
     return MetricsExporter(registry=registry, port=port, host=host,
-                           health_fn=health_fn, report_fn=report_fn)
+                           health_fn=health_fn, report_fn=report_fn,
+                           traces_fn=traces_fn)

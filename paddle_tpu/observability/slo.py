@@ -1,0 +1,247 @@
+"""Declarative SLOs with sliding windows and multi-window burn-rate
+alerts — the accounting layer between raw fleet metrics and "are we
+violating what we promised users".
+
+An ``SLObjective`` declares a target good-fraction over a rolling
+horizon; every request maps to a good/bad event against it:
+
+- ``latency`` objectives (TTFT p99, e2e p99): an observation is BAD
+  when it exceeds ``threshold_s``. ``target=0.99`` is exactly the
+  "p99 <= threshold" promise — at most 1% of requests may land above
+  the threshold.
+- ``availability`` objectives (goodput): the caller classifies each
+  resolved request (shed / deadline-missed / failed count against
+  served; client-initiated cancels count as neither).
+
+``SLOTracker`` keeps a per-objective sliding deque of (ts, bad)
+events and evaluates **multi-window burn rates** (the SRE-workbook
+shape): for each ``{"short_s", "long_s", "burn"}`` window pair, the
+burn rate is ``bad_fraction / error_budget`` (budget = 1 - target; a
+burn of 1.0 spends the budget exactly at the horizon's pace), and the
+window ALERTS only when BOTH the short and the long window burn
+faster than ``burn`` — the short window makes alerts clear quickly
+after recovery, the long window keeps a brief blip from paging.
+
+``evaluate()`` exports the whole state as ``fleet_slo_*`` gauges into
+the registry handed in (scrapeable next to the router's ``fleet_*``
+series) and returns the structured report; ``alerts()`` is the
+boolean rollup the router folds into its health snapshot so placement
+(or an operator) can see burn state.
+
+Stdlib-only; time base is ``time.monotonic()`` unless the caller
+passes explicit ``now`` values (tests do, for determinism).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+__all__ = ["SLObjective", "SLOTracker", "default_windows",
+           "default_fleet_slos"]
+
+
+def default_windows():
+    """Multi-window burn-rate ladder, scaled for a serving fleet with
+    a short horizon (the classic SRE pairs are 5m/1h and 30m/6h on a
+    30-day budget; these keep the same ~12x span ratio at a scale a
+    test or a short canary can exercise)."""
+    return ({"short_s": 60.0, "long_s": 720.0, "burn": 14.4},
+            {"short_s": 300.0, "long_s": 3600.0, "burn": 6.0})
+
+
+class SLObjective:
+    """One promise: at least ``target`` of events are good.
+
+    name: label on every exported series.
+    kind: ``latency`` (``threshold_s`` required — an observation above
+        it is bad) or ``availability`` (caller classifies).
+    target: required good fraction in (0, 1); error budget = 1-target.
+    threshold_s: latency cut line (latency kind only).
+    """
+
+    def __init__(self, name, kind="latency", target=0.99,
+                 threshold_s=None):
+        if kind not in ("latency", "availability"):
+            raise ValueError(f"kind {kind!r}: latency | availability")
+        if not 0.0 < float(target) < 1.0:
+            raise ValueError(f"target must be in (0,1), got {target}")
+        if kind == "latency" and threshold_s is None:
+            raise ValueError(f"latency objective {name!r} needs "
+                             "threshold_s")
+        self.name = str(name)
+        self.kind = kind
+        self.target = float(target)
+        self.threshold_s = None if threshold_s is None \
+            else float(threshold_s)
+
+    @property
+    def budget(self):
+        return 1.0 - self.target
+
+
+def default_fleet_slos():
+    """The Gemma-serving-paper decomposition as promises: time to
+    first token, end-to-end latency, and goodput."""
+    return (SLObjective("ttft", "latency", target=0.99,
+                        threshold_s=1.0),
+            SLObjective("e2e", "latency", target=0.99,
+                        threshold_s=10.0),
+            SLObjective("availability", "availability", target=0.999))
+
+
+class SLOTracker:
+    """Sliding-window good/bad accounting + burn-rate alerting for a
+    set of objectives.
+
+    objectives: iterable of SLObjective (unique names).
+    windows: burn-window pairs ({"short_s","long_s","burn"}); the
+        retention horizon is the longest long_s.
+    registry: MetricsRegistry the ``fleet_slo_*`` gauges land in
+        (None = no export; evaluate() still returns the report).
+    max_events: per-objective deque bound (oldest events evict first
+        even inside the horizon — a storm cannot grow memory).
+    """
+
+    def __init__(self, objectives=None, windows=None, registry=None,
+                 max_events=4096):
+        objectives = list(objectives if objectives is not None
+                          else default_fleet_slos())
+        names = [o.name for o in objectives]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate objective names: {names}")
+        self.objectives = {o.name: o for o in objectives}
+        self.windows = [dict(w) for w in
+                        (windows if windows is not None
+                         else default_windows())]
+        for w in self.windows:
+            w["short_s"] = float(w["short_s"])
+            w["long_s"] = float(w["long_s"])
+            w["burn"] = float(w["burn"])
+        self._horizon = max((w["long_s"] for w in self.windows),
+                            default=0.0)
+        self._events = {n: deque(maxlen=int(max_events))
+                        for n in self.objectives}
+        self._lock = threading.Lock()
+        self._registry = registry
+        self._gauges = {}
+
+    # -- recording ---------------------------------------------------------
+
+    def record_latency(self, name, seconds, now=None):
+        """Observe one latency against a latency objective (unknown
+        names are ignored so callers can record unconditionally)."""
+        obj = self.objectives.get(name)
+        if obj is None or obj.kind != "latency":
+            return
+        self._push(name, float(seconds) > obj.threshold_s, now)
+
+    def record_event(self, name, good, now=None):
+        """Observe one classified event against an availability
+        objective."""
+        obj = self.objectives.get(name)
+        if obj is None:
+            return
+        self._push(name, not bool(good), now)
+
+    def _push(self, name, bad, now):
+        ts = time.monotonic() if now is None else float(now)
+        with self._lock:
+            dq = self._events[name]
+            dq.append((ts, 1 if bad else 0))
+            # prune beyond the horizon so idle periods do not pin a
+            # storm's events forever
+            cut = ts - self._horizon
+            while dq and dq[0][0] < cut:
+                dq.popleft()
+
+    # -- evaluation --------------------------------------------------------
+
+    def _window_stats(self, dq, lo):
+        total = bad = 0
+        for ts, b in reversed(dq):
+            if ts < lo:
+                break
+            total += 1
+            bad += b
+        return total, bad
+
+    def evaluate(self, now=None):
+        """Per-objective report {sli, events, windows: [...], alert}
+        + gauge export. ``sli`` is the good fraction over the longest
+        window; a window with no events burns at 0 (no traffic spends
+        no budget). Alert = ANY window pair whose short AND long burn
+        both exceed its threshold."""
+        ts = time.monotonic() if now is None else float(now)
+        report = {}
+        with self._lock:
+            events = {n: list(dq) for n, dq in self._events.items()}
+        for name, obj in self.objectives.items():
+            dq = events[name]
+            total_h, bad_h = self._window_stats(dq, ts - self._horizon)
+            sli = 1.0 - (bad_h / total_h) if total_h else None
+            rows, alert = [], False
+            for w in self.windows:
+                burns = {}
+                for leg in ("short_s", "long_s"):
+                    total, bad = self._window_stats(dq, ts - w[leg])
+                    frac = (bad / total) if total else 0.0
+                    burns[leg] = {"events": total, "bad": bad,
+                                  "burn": frac / obj.budget}
+                firing = (burns["short_s"]["burn"] > w["burn"]
+                          and burns["long_s"]["burn"] > w["burn"])
+                alert = alert or firing
+                rows.append({"short_s": w["short_s"],
+                             "long_s": w["long_s"],
+                             "threshold": w["burn"],
+                             "short": burns["short_s"],
+                             "long": burns["long_s"],
+                             "firing": firing})
+            report[name] = {
+                "kind": obj.kind, "target": obj.target,
+                "threshold_s": obj.threshold_s,
+                "events": total_h, "bad": bad_h, "sli": sli,
+                "budget_remaining": (
+                    None if sli is None
+                    else 1.0 - (1.0 - sli) / obj.budget),
+                "windows": rows, "alert": alert}
+        self._export(report)
+        return report
+
+    def alerts(self, now=None):
+        """{objective: bool} rollup (the health-snapshot form)."""
+        return {n: r["alert"]
+                for n, r in self.evaluate(now=now).items()}
+
+    # -- gauge export ------------------------------------------------------
+
+    def _gauge(self, name, help, **labels):
+        key = (name, tuple(sorted(labels.items())))
+        g = self._gauges.get(key)
+        if g is None:
+            g = self._registry.gauge(name, help=help, labels=labels)
+            self._gauges[key] = g
+        return g
+
+    def _export(self, report):
+        if self._registry is None:
+            return
+        for name, r in report.items():
+            if r["sli"] is not None:
+                self._gauge("fleet_slo_sli",
+                            "good-event fraction over the longest "
+                            "burn window", slo=name).set(r["sli"])
+                self._gauge("fleet_slo_budget_remaining",
+                            "error-budget fraction left over the "
+                            "longest window (negative = overspent)",
+                            slo=name).set(r["budget_remaining"])
+            self._gauge("fleet_slo_alert",
+                        "1 when any multi-window burn-rate pair is "
+                        "firing", slo=name).set(1 if r["alert"] else 0)
+            for w in r["windows"]:
+                label = f"{w['short_s']:g}s/{w['long_s']:g}s"
+                self._gauge("fleet_slo_burn_rate",
+                            "short-window burn rate (bad fraction / "
+                            "error budget) per window pair",
+                            slo=name, window=label
+                            ).set(w["short"]["burn"])

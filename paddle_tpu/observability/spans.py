@@ -43,6 +43,18 @@ def _to_epoch_us(perf_t):
     return (_EPOCH_BASE + (perf_t - _PERF_BASE)) * 1e6
 
 
+def _suppressed():
+    """True inside an introspection AOT replay: span emission is
+    suppressed exactly like the tracer's counter bumps, so a replay
+    that re-executes instrumented host code can never add phantom
+    spans to a timeline (or perturb a span-count assertion)."""
+    try:
+        from .introspect import introspecting
+    except ImportError:  # standalone file-load (bench._obs_mod)
+        return False
+    return introspecting()
+
+
 class SpanRecorder:
     """Bounded ring of host spans, Chrome-trace exportable."""
 
@@ -68,6 +80,8 @@ class SpanRecorder:
     def add(self, name, t0, t1=None, tid="main", cat="host", args=None):
         """One complete span: [t0, t1] in perf_counter seconds
         (t1 None = now). Returns the event dict."""
+        if _suppressed():
+            return None
         if t1 is None:
             t1 = time.perf_counter()
         ev = {"name": name, "cat": cat, "ph": "X",
@@ -81,6 +95,8 @@ class SpanRecorder:
 
     def instant(self, name, tid="main", cat="host", args=None):
         """Zero-duration annotation (eviction, page release, skip)."""
+        if _suppressed():
+            return None
         ev = {"name": name, "cat": cat, "ph": "i", "s": "t",
               "ts": _to_epoch_us(time.perf_counter()),
               "tid": tid, "args": dict(args or {})}

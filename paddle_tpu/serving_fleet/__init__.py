@@ -15,13 +15,20 @@ whole failure model.
 - ReplicaClient:  idempotent-by-rid transport with seeded-jitter
                   retry (client.py)
 - FleetRouter:    global queue, scrape-scored placement, failover/
-                  hedging/drain/shed + its own MetricsRegistry and
-                  /metrics endpoint (router.py)
+                  hedging/drain/shed + its own MetricsRegistry,
+                  distributed tracing (one causally-linked span tree
+                  per request across router/transport/replicas, with
+                  per-hop latency attribution via trace_report), SLO
+                  burn-rate accounting (fleet_slo_* gauges), and a
+                  full /metrics+/healthz+/report+/traces endpoint
+                  (router.py)
 
 See docs/robustness.md ("Fleet serving") for the contracts and
-docs/observability.md for the fleet_* metric catalogue. Chaos suite:
-tests/test_fleet_serving.py (pytest -m chaos); campaign stage
-fleet_chaos_smoke.
+docs/observability.md for the fleet_* metric catalogue and the
+"Distributed tracing & SLOs" guide. Chaos suites:
+tests/test_fleet_serving.py + tests/test_fleet_tracing.py (pytest -m
+chaos); campaign stage fleet_chaos_smoke (metrics_diff canary-gated
+against tools/golden/fleet_chaos_metrics.json).
 """
 from .client import ReplicaClient  # noqa: F401
 from .replica import InprocReplica, ReplicaCrash  # noqa: F401

@@ -83,11 +83,17 @@ class ReplicaClient:
     # -- verbs -----------------------------------------------------------
 
     def submit(self, rid, prompt, max_new_tokens, eos_token_id=None,
-               priority=0):
-        """Deliver one request (idempotent by rid at the replica)."""
-        self._call(self.replica.enqueue,
-                   ("submit", rid, list(prompt), int(max_new_tokens),
-                    eos_token_id, int(priority)))
+               priority=0, deadline_ms=None, trace=None):
+        """Deliver one request (idempotent by rid at the replica).
+        deadline_ms (remaining wall budget) and trace (the
+        dtrace context — hop budget already decremented by the
+        caller) ride an optional trailing extras dict, so the wire
+        shape stays compatible with pre-tracing replicas."""
+        op = ["submit", rid, list(prompt), int(max_new_tokens),
+              eos_token_id, int(priority)]
+        if deadline_ms is not None or trace is not None:
+            op.append({"deadline_ms": deadline_ms, "trace": trace})
+        self._call(self.replica.enqueue, tuple(op))
 
     def cancel(self, rid):
         self._call(self.replica.enqueue, ("cancel", rid))

@@ -103,9 +103,12 @@ class InprocReplica:
 
     def enqueue(self, op):
         """Queue one command for the worker: ("submit", fleet_rid,
-        prompt, max_new_tokens, eos_token_id, priority) or
-        ("cancel", fleet_rid). Submits are idempotent by fleet rid —
-        a transport retry that double-delivers is absorbed."""
+        prompt, max_new_tokens, eos_token_id, priority[, extras]) or
+        ("cancel", fleet_rid). The optional trailing extras dict
+        carries {"deadline_ms", "trace"} — the distributed-trace
+        context hops the transport here exactly as it would a wire.
+        Submits are idempotent by fleet rid — a transport retry that
+        double-delivers is absorbed."""
         self._inbox.put(tuple(op))
 
     def pop_results(self):
@@ -260,7 +263,8 @@ class InprocReplica:
             except queue.Empty:
                 return
             if op[0] == "submit":
-                _, frid, prompt, max_new, eos, prio = op
+                _, frid, prompt, max_new, eos, prio = op[:6]
+                extras = op[6] if len(op) > 6 else {}
                 if frid in self._accepted:
                     continue  # idempotent: duplicate delivery dropped
                 if frid in self._precancel:
@@ -274,8 +278,10 @@ class InprocReplica:
                     self._emit({"id": frid, "tokens": [],
                                 "status": "bounced"})
                     continue
-                erid = self.engine.submit(prompt, max_new, eos,
-                                          priority=prio)
+                erid = self.engine.submit(
+                    prompt, max_new, eos, priority=prio,
+                    deadline_ms=extras.get("deadline_ms"),
+                    trace=extras.get("trace"))
                 self._accepted[frid] = erid
                 self._rid_map[erid] = frid
             elif op[0] == "cancel":

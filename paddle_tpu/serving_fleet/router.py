@@ -50,9 +50,12 @@ seam.
 """
 from __future__ import annotations
 
+import collections
 import time
 
+from ..observability import dtrace
 from ..observability.metrics import MetricsRegistry
+from ..observability.slo import SLOTracker
 from .client import ReplicaClient
 
 __all__ = ["FleetRouter"]
@@ -63,9 +66,11 @@ class _Pending:
 
     __slots__ = ("rid", "prompt", "max_new", "eos", "priority",
                  "submitted_at", "placed_at", "replica", "hedge",
-                 "delivered", "failovers", "hedged", "done")
+                 "delivered", "failovers", "hedged", "done",
+                 "deadline", "trace", "queue_since_pc", "leg_ctxs")
 
-    def __init__(self, rid, prompt, max_new, eos, priority):
+    def __init__(self, rid, prompt, max_new, eos, priority,
+                 deadline=None):
         self.rid = rid
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
@@ -79,6 +84,10 @@ class _Pending:
         self.failovers = 0
         self.hedged = False
         self.done = False
+        self.deadline = deadline   # abs monotonic (None = none)
+        self.trace = None          # dtrace root context
+        self.queue_since_pc = dtrace.now()  # current queue leg start
+        self.leg_ctxs = {}         # replica name -> open leg context
 
 
 class FleetRouter:
@@ -104,12 +113,29 @@ class FleetRouter:
     transport_retries / retry_jitter: ReplicaClient backoff knobs;
         each client gets a distinct jitter seed so fleet-wide retries
         de-synchronize (resilience.retry.backoff_schedule).
+    trace_store: observability.dtrace.TraceStore the request span
+        trees land in. Default the process-global store — the SAME
+        one the engines record their queue/prefill/decode legs into,
+        which is what makes the trees causally complete; pass a
+        private store only when router-side spans alone are enough.
+    attribution_tolerance: allowed unattributed fraction of a
+        request's end-to-end wall time before trace_report flags it
+        (docs/observability.md "Distributed tracing & SLOs").
+    slos: SLObjective iterable (None = the default TTFT-p99 /
+        e2e-p99 / availability trio; False disables SLO accounting).
+    slo_windows: burn-rate window pairs for the SLOTracker.
+    shed_storm_threshold / shed_storm_window_s: sheds inside the
+        window before the flight recorder dumps a shed-storm record
+        (re-arms once the window drains).
     """
 
     def __init__(self, replicas, *, registry=None, max_queue=64,
                  replica_queue_limit=4, hedge_after_ms=None,
                  wedge_timeout_s=10.0, transport_retries=3,
-                 retry_jitter=0.5):
+                 retry_jitter=0.5, trace_store=None,
+                 attribution_tolerance=0.05, slos=None,
+                 slo_windows=None, shed_storm_threshold=16,
+                 shed_storm_window_s=5.0):
         self.replicas = {}
         self._clients = {}
         for i, rep in enumerate(replicas):
@@ -137,9 +163,30 @@ class FleetRouter:
         self._exporter = None
         self._closed = False
 
+        # -- distributed tracing: one span tree per request, engines
+        # append their legs through the propagated context (dtrace)
+        self._tstore = trace_store if trace_store is not None \
+            else dtrace.get_store()
+        self.attribution_tolerance = float(attribution_tolerance)
+        self._trace_ids = collections.deque(maxlen=512)
+        self._clock_offsets = {}    # name -> estimated skew upper
+        #                             bound (heartbeat one-way delay)
+        # -- flight-recorder shed-storm window
+        self._shed_storm_threshold = int(shed_storm_threshold)
+        self._shed_storm_window_s = float(shed_storm_window_s)
+        self._shed_times = collections.deque(maxlen=4096)
+        self._shed_storm_armed = True
+
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         reg = self.registry
+        # SLO burn-rate accounting (observability.slo): evaluated once
+        # per step(), gauges land in the fleet registry, alert rollup
+        # cached for health() so placement/operators see burn state
+        self.slo = None if slos is False else SLOTracker(
+            objectives=slos, windows=slo_windows, registry=reg)
+        self._slo_state = {}
+        self._slo_eval_at = 0.0
         self._m_req = {}
         self._m_routed = {}
         self._m_failover = {}
@@ -202,28 +249,63 @@ class FleetRouter:
     # -- public API --------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=16, eos_token_id=None,
-               priority=0):
+               priority=0, deadline_ms=None):
         """Accept one request into the fleet; returns its fleet rid.
-        Placement happens at the next step()."""
+        Placement happens at the next step().
+
+        deadline_ms: wall budget from NOW for the whole fleet journey
+        (placement + every leg). The REMAINING budget rides each
+        placement, so a failover continuation inherits what is left,
+        and a request that expires while queued at the router resolves
+        status='expired' without ever placing.
+
+        Every submit mints a distributed-trace context: the request's
+        span tree (placement wait, transport, per-replica legs with
+        their queue/prefill/decode, failover/hedge annotations) lands
+        in the trace store — read it back via ``trace_report(rid)`` or
+        the ``/traces`` endpoint."""
         if self._closed:
             raise RuntimeError("FleetRouter is closed")
         rid = self._next_rid
         self._next_rid += 1
-        self._pending[rid] = _Pending(rid, prompt, max_new_tokens,
-                                      eos_token_id, priority)
+        deadline = None if deadline_ms is None \
+            else time.monotonic() + float(deadline_ms) / 1e3
+        p = _Pending(rid, prompt, max_new_tokens, eos_token_id,
+                     priority, deadline=deadline)
+        p.trace = self._tstore.new_trace(
+            name="request", proc="router", rid=rid,
+            args={"prompt_len": len(p.prompt), "max_new": p.max_new,
+                  "priority": p.priority})
+        if p.trace is not None:
+            self._trace_ids.append(p.trace["trace_id"])
+        self._pending[rid] = p
         self._queue.append(rid)
         return rid
 
     def step(self):
         """One control round: harvest results, scrape health, fail
-        over lost replicas, place/shed/hedge. Returns the results
-        resolved this round."""
+        over lost replicas, expire/place/shed/hedge, evaluate SLO
+        burn. Returns the results resolved this round. An unhandled
+        exception here is a flight-recorder trigger
+        (flight_fleet_router_exception.json) — the postmortem carries
+        the fleet registry and recent fleet events."""
         if self._closed:
             raise RuntimeError("FleetRouter is closed")
+        try:
+            return self._step_impl()
+        except Exception as e:
+            from ..observability import flightrec
+            flightrec.dump("fleet_router_exception", extra={
+                "error": f"{type(e).__name__}: {e}",
+                "fleet_registry": self._registry_snapshot()})
+            raise
+
+    def _step_impl(self):
         before = set(self._done)
         self._collect()
         self._scrape_all()
         self._recover_lost()
+        self._expire_queued()
         self._place()
         self._shed()
         self._hedge()
@@ -231,7 +313,23 @@ class FleetRouter:
         self._g_pending.set(
             sum(1 for p in self._pending.values() if not p.done))
         self._g_serving.set(len(self._serving_candidates()))
-        return [self._done[r] for r in self._done if r not in before]
+        out = [self._done[r] for r in self._done if r not in before]
+        # SLO state refreshes when something actually resolved (the
+        # events that move the windows) or on a coarse heartbeat —
+        # never per idle 2ms poll round, where the window scans would
+        # dominate the control loop
+        now = time.monotonic()
+        if self.slo is not None and (
+                out or now - self._slo_eval_at > 0.25):
+            self._slo_state = self.slo.evaluate()
+            self._slo_eval_at = now
+        return out
+
+    def _registry_snapshot(self):
+        try:
+            return self.registry.snapshot()
+        except Exception:  # noqa: BLE001 — postmortem best-effort
+            return None
 
     def run_to_completion(self, timeout_s=120.0, poll_s=0.002):
         """Drive step() until every accepted request resolves; returns
@@ -327,7 +425,24 @@ class FleetRouter:
                 "pending": sum(1 for p in list(self._pending.values())
                                if not p.done),
                 "lost": sorted(self._lost),
+                "slo": self._slo_health(),
                 "compile_report": self.compile_report()}
+
+    def _slo_health(self):
+        """Burn state for the health snapshot (cached from the last
+        step()'s evaluation — health() also runs on HTTP threads and
+        must stay cheap): per-objective alert flags + SLIs, so
+        placement or an outer LB can see budget burn without scraping
+        the gauge series."""
+        if self.slo is None:
+            return None
+        state = self._slo_state
+        return {"alerting": sorted(n for n, r in state.items()
+                                   if r.get("alert")),
+                "objectives": {n: {"alert": r.get("alert", False),
+                                   "sli": r.get("sli"),
+                                   "events": r.get("events", 0)}
+                               for n, r in state.items()}}
 
     def compile_report(self):
         """Per-replica compile counts + fleet-wide unexpected-retrace
@@ -340,16 +455,68 @@ class FleetRouter:
             unexpected += rep.engine.tracer.unexpected_retraces()
         return {"replicas": reps, "unexpected_retraces": unexpected}
 
+    def trace_report(self, rid):
+        """Per-request latency attribution: the span tree plus the
+        hop-by-hop decomposition whose coverage must reach the
+        end-to-end wall time within ``attribution_tolerance``.
+        Works while the request is live AND after it resolved (until
+        the trace evicts from the store); None for unknown rids."""
+        p = self._pending.get(rid)
+        tid = p.trace["trace_id"] if p is not None \
+            and p.trace is not None else self._tstore.find(rid)
+        if tid is None:
+            return None
+        return {"trace": self._tstore.tree(tid),
+                "attribution": self._tstore.attribution(
+                    tid, tolerance=self.attribution_tolerance)}
+
+    def _traces_endpoint(self, key):
+        """The /traces handler: index of known traces (one cheap
+        store pass — a periodic scraper must not contend the control
+        loop on the store lock; fetch /traces/<rid> for the full
+        attribution), or one trace's report by fleet rid (digits) /
+        trace id."""
+        if key is None:
+            return {"traces": self._tstore.summaries(),
+                    "tolerance": self.attribution_tolerance}
+        if str(key).isdigit():
+            return self.trace_report(int(key))
+        tree = self._tstore.tree(key)
+        if tree is None:
+            return None
+        return {"trace": tree,
+                "attribution": self._tstore.attribution(
+                    key, tolerance=self.attribution_tolerance)}
+
+    def export_timeline(self, path, extra_recorders=()):
+        """Merge every trace this router minted (bounded to the last
+        512) into ONE Perfetto timeline: a router lane plus one lane
+        per replica, per-process clock offsets reconciled from the
+        heartbeat estimates. Pass engine SpanRecorders (or the train/
+        profiler ones) as extra_recorders to overlay the round-10
+        lanes — everything shares the epoch base. Returns the path."""
+        return self._tstore.export_chrome(
+            path, trace_ids=list(self._trace_ids),
+            clock_offsets=dict(self._clock_offsets),
+            extra_recorders=extra_recorders)
+
     def serve_metrics(self, port=0, host="127.0.0.1"):
-        """Attach a live HTTP exporter to the ROUTER: /metrics is the
-        fleet registry, /healthz is health(). The router is a scrape
-        target just like its replicas."""
+        """Attach a live HTTP exporter to the ROUTER with full
+        endpoint parity with its replicas: /metrics is the fleet
+        registry (incl. the fleet_slo_* gauges), /healthz the fleet
+        health rollup, /report the fleet compile report on top of the
+        process recompile/cost reports, /traces the per-request
+        latency-attribution reports. The router is a scrape target
+        just like its replicas — same exporter, no bespoke handler."""
         from ..observability.exporter import MetricsExporter
         if self._exporter is not None:
             self._exporter.close()
-        self._exporter = MetricsExporter(registry=self.registry,
-                                         port=port, host=host,
-                                         health_fn=self.health)
+        self._exporter = MetricsExporter(
+            registry=self.registry, port=port, host=host,
+            health_fn=self.health,
+            report_fn=lambda: {"fleet_compile_report":
+                               self.compile_report()},
+            traces_fn=self._traces_endpoint)
         return self._exporter
 
     def close(self):
@@ -396,6 +563,8 @@ class FleetRouter:
                 return
             # drain bounce: the replica gave the request back — keep
             # the longest token prefix seen and re-place
+            self._end_leg(p, src, "bounced",
+                          tokens=len(res.get("tokens") or []))
             toks = res.get("tokens") or []
             if len(toks) > len(p.delivered):
                 p.delivered = list(toks)
@@ -406,6 +575,12 @@ class FleetRouter:
             if p.replica is None and p.hedge is None \
                     and rid not in self._queue:
                 self._m_requeued.inc()
+                # back at the router as of NOW — whether it re-queues
+                # or finishes straight from the prefix, the current
+                # router-resident period starts here (a stale
+                # queue_since_pc would make the resolve-time
+                # router_queue hop overlap the leg it just finished)
+                p.queue_since_pc = dtrace.now()
                 if not self._finish_from_prefix(p):
                     self._queue.append(rid)
             return
@@ -415,6 +590,7 @@ class FleetRouter:
             # is a client-initiated cancel of a running request, which
             # resolves with its partial tokens
             self._cancel_requested.discard(rid)
+            self._end_leg(p, src, "cancelled")
             self._resolve(p, p.delivered + list(res.get("tokens") or []),
                           "cancelled", src)
             return
@@ -425,10 +601,15 @@ class FleetRouter:
             by = "primary" if src == p.replica else "hedge"
             self._hedge_win_counter(by).inc()
             self._cancel_requested.add(rid)
+            # the losing leg stays in the trace tree, annotated — the
+            # postmortem sees what the hedge cost, not a missing span
+            self._end_leg(p, loser, "cancelled", hedge_loser=True)
             try:
                 self._clients[loser].cancel(rid)
             except Exception:  # noqa: BLE001 — loser may already be gone
                 pass
+        self._end_leg(p, src, status,
+                      tokens=len(res.get("tokens") or []))
         self._resolve(p, tokens, status, src)
 
     def _finish_from_prefix(self, p):
@@ -448,11 +629,69 @@ class FleetRouter:
         p.done = True
         self._cancel_requested.discard(p.rid)
         self._req_counter(status).inc()
+        age = time.monotonic() - p.submitted_at
+        # a request resolving with nothing running (shed, expired in
+        # the router queue, finished straight from a recovered prefix)
+        # spent its tail sitting at the ROUTER — record that wait as a
+        # hop, so attribution still tiles e2e instead of reporting the
+        # whole queue time as unattributed
+        if p.replica is None and p.hedge is None and not p.leg_ctxs:
+            self._tstore.add_span(p.trace, "router_queue",
+                                  p.queue_since_pc, proc="router",
+                                  args={"terminal": status})
+        # close any leg a stray path left open, then the root — the
+        # exported tree never carries a dangling open span for a
+        # resolved request
+        for name in list(p.leg_ctxs):
+            self._end_leg(p, name, status)
+        self._tstore.end_span(p.trace, outcome=status,
+                              args={"tokens": len(tokens),
+                                    "failovers": p.failovers,
+                                    "hedged": p.hedged})
+        self._record_slo(p, status, age)
         self._done[p.rid] = {
             "id": p.rid, "tokens": [int(t) for t in tokens],
             "status": status, "replica": replica,
             "failovers": p.failovers, "hedged": p.hedged,
-            "age_s": round(time.monotonic() - p.submitted_at, 6)}
+            "trace_id": None if p.trace is None
+            else p.trace["trace_id"],
+            "age_s": round(age, 6)}
+
+    def _record_slo(self, p, status, age_s):
+        """Fold one resolved request into the SLO windows: e2e
+        latency, TTFT (read off the trace tree's first prefill leg),
+        and goodput — shed + deadline-missed count against served;
+        client-initiated cancels count as neither."""
+        if self.slo is None:
+            return
+        if status == "ok":
+            self.slo.record_event("availability", good=True)
+            self.slo.record_latency("e2e", age_s)
+            ttft = self._ttft_from_trace(p)
+            if ttft is not None:
+                self.slo.record_latency("ttft", ttft)
+        elif status in ("shed", "expired", "failed"):
+            self.slo.record_event("availability", good=False)
+            # a shed/expired request's latency is not a served
+            # latency — the availability objective carries the miss
+
+    def _ttft_from_trace(self, p):
+        """submit -> first generated token, read as (end of the
+        earliest prefill span) - (root start) across every leg of the
+        trace. None when untraced or never prefilled."""
+        if p.trace is None:
+            return None
+        root_t0, first = None, None
+        for s in self._tstore.spans(p.trace["trace_id"]):
+            if s["parent"] is None:
+                root_t0 = s["t0"]
+            elif s["name"].startswith("prefill") \
+                    and s["t1"] is not None:
+                if first is None or s["t1"] < first:
+                    first = s["t1"]
+        if root_t0 is None or first is None:
+            return None
+        return max(first - root_t0, 0.0)
 
     def _scrape_all(self):
         for name, rep in self.replicas.items():
@@ -465,6 +704,16 @@ class FleetRouter:
                 continue
             if snap:
                 self._last_scrape[name] = snap
+                # per-replica clock-skew upper bound from heartbeat
+                # timestamps: receive_time - publish_ts >= |skew|, and
+                # the min over many heartbeats approaches the true
+                # one-way delay (+skew). In-process replicas share the
+                # clock, so this stays ~0; the subprocess deployment
+                # feeds it into the merged-timeline reconciliation.
+                delay = max(time.monotonic() - snap["ts"], 0.0)
+                prev = self._clock_offsets.get(name)
+                self._clock_offsets[name] = delay if prev is None \
+                    else min(prev, delay)
 
     def _serving_candidates(self):
         out = []
@@ -519,6 +768,72 @@ class FleetRouter:
                 if name not in self._lost and rep.alive
                 and name not in self._last_scrape]
 
+    def _expire_queued(self):
+        """Requests whose deadline lapsed while still queued at the
+        ROUTER resolve as expired here (placed ones expire at their
+        replica's host boundaries, as before)."""
+        now = time.monotonic()
+        for rid in list(self._queue):
+            p = self._pending[rid]
+            if p.deadline is not None and now > p.deadline:
+                self._queue.remove(rid)
+                self._resolve(p, list(p.delivered), "expired", None)
+
+    def _remaining_deadline_ms(self, p):
+        if p.deadline is None:
+            return None
+        return max((p.deadline - time.monotonic()) * 1e3, 1.0)
+
+    def _start_leg(self, p, target, hedge=False):
+        """Open the replica-leg span for an assignment and return the
+        context to propagate (failover continuations carry the
+        prefix-dedup boundary; hedge legs are marked as such)."""
+        args = {"replica": target}
+        if hedge:
+            args["hedge"] = True
+        if p.failovers:
+            args["failover_of"] = p.failovers
+        if p.delivered:
+            # the continuation leg: its prompt is original ‖ delivered
+            # and only the remaining budget is requested — the dedup
+            # boundary is THE fact a latency postmortem needs
+            args.update(prefix_dedup=True,
+                        prefix_tokens=len(p.delivered),
+                        remaining_budget=p.max_new - len(p.delivered))
+        ctx = self._tstore.start_span(p.trace, "replica_leg",
+                                      proc=target, args=args)
+        if ctx is not None:
+            p.leg_ctxs[target] = ctx
+        return ctx
+
+    def _end_leg(self, p, name, outcome, **args):
+        ctx = p.leg_ctxs.pop(name, None)
+        if ctx is not None:
+            self._tstore.end_span(ctx, outcome=outcome,
+                                  args=args or None)
+
+    def _submit_leg(self, p, target, prompt, max_new, hedge=False):
+        """Open a replica-leg span and deliver one submit through the
+        transport — trace context and REMAINING deadline ride along,
+        the transport_submit child records the retry count. Returns
+        (ok, leg_ctx); on transport failure the leg is closed
+        ``transport_failed`` and the caller retries next round."""
+        leg = self._start_leg(p, target, hedge=hedge)
+        t_send = dtrace.now()
+        client = self._clients[target]
+        retries0 = client.stats.retries
+        try:
+            client.submit(p.rid, prompt, max_new, p.eos, p.priority,
+                          deadline_ms=self._remaining_deadline_ms(p),
+                          trace=dtrace.hop(leg))
+        except Exception:  # noqa: BLE001 — transport gave up; retry
+            self._end_leg(p, target, "transport_failed")
+            return False, None
+        self._tstore.add_span(
+            leg, "transport_submit", t_send, proc="router",
+            args={"retries": client.stats.retries - retries0})
+        return True, leg
+
     def _place(self):
         if not self._queue or self._unscraped():
             return
@@ -533,13 +848,18 @@ class FleetRouter:
                 continue
             prompt = p.prompt + [int(t) for t in p.delivered]
             remaining = p.max_new - len(p.delivered)
-            try:
-                self._clients[target].submit(rid, prompt, remaining,
-                                             p.eos, p.priority)
-            except Exception:  # noqa: BLE001 — transport gave up; retry
-                continue       # next round
+            ok, leg = self._submit_leg(p, target, prompt, remaining)
+            if not ok:
+                continue       # transport gave up; retry next round
             p.replica = target
             p.placed_at = time.monotonic()
+            # the placement-wait hop closes where the leg opened, so
+            # the root's children tile the timeline gap-free
+            self._tstore.add_span(p.trace, "placement_wait",
+                                  p.queue_since_pc,
+                                  leg["t0"] if leg else dtrace.now(),
+                                  proc="router",
+                                  args={"replica": target})
             outstanding[target] = outstanding.get(target, 0) + 1
             self._routed_counter(target).inc()
             self._m_place_wait.observe(p.placed_at - p.submitted_at)
@@ -560,12 +880,44 @@ class FleetRouter:
         # lowest priority goes first; newest first within a priority
         order = sorted(self._queue,
                        key=lambda r: (self._pending[r].priority, -r))
+        shed_now = []
         while len(self._queue) > self.max_queue and order:
             rid = order.pop(0)
             self._queue.remove(rid)
             p = self._pending[rid]
             self._m_shed.inc()
             self._resolve(p, list(p.delivered), "shed", None)
+            shed_now.append(rid)
+        if shed_now:
+            self._note_shed_storm(shed_now)
+
+    def _note_shed_storm(self, shed_rids):
+        """Shed-storm flight trigger: more than shed_storm_threshold
+        sheds inside shed_storm_window_s dumps ONE flight record (with
+        the last victim's trace tree), then re-arms only after the
+        window drains — a sustained storm is one postmortem, not a
+        dump per shed."""
+        now = time.monotonic()
+        cut = now - self._shed_storm_window_s
+        while self._shed_times and self._shed_times[0] < cut:
+            self._shed_times.popleft()
+        if not self._shed_times:
+            # the window drained since the last storm: re-arm BEFORE
+            # counting the new batch, so a second storm whose first
+            # observation already meets the threshold still dumps
+            self._shed_storm_armed = True
+        self._shed_times.extend(now for _ in shed_rids)
+        count = len(self._shed_times)
+        if count >= self._shed_storm_threshold:
+            if self._shed_storm_armed:
+                self._shed_storm_armed = False
+                self._flight_dump("fleet_shed_storm", {
+                    "shed_in_window": count,
+                    "window_s": self._shed_storm_window_s,
+                    "victims": list(shed_rids),
+                    "victim_trace": self._victim_tree(shed_rids[-1])})
+        else:
+            self._shed_storm_armed = True
 
     def _hedge(self):
         if not self.hedge_after_ms:
@@ -582,10 +934,9 @@ class FleetRouter:
                                         exclude={p.replica})
             if target is None:
                 continue
-            try:
-                self._clients[target].submit(rid, p.prompt, p.max_new,
-                                             p.eos, p.priority)
-            except Exception:  # noqa: BLE001 — transport gave up
+            ok, _leg = self._submit_leg(p, target, p.prompt,
+                                        p.max_new, hedge=True)
+            if not ok:
                 continue
             p.hedge = target
             p.hedged = True
@@ -630,6 +981,7 @@ class FleetRouter:
             carcass = {e["rid"]: e for e in rep.export_inflight()}
         except Exception:  # noqa: BLE001 — carcass unreadable: resubmit
             carcass = {}   # from scratch (still correct, just slower)
+        victims = []
         for rid, p in list(self._pending.items()):
             if p.done:
                 continue
@@ -647,9 +999,45 @@ class FleetRouter:
             ent = carcass.get(rid)
             if ent and len(ent.get("tokens") or []) > len(p.delivered):
                 p.delivered = [int(t) for t in ent["tokens"]]
+            # the lost leg stays in the tree: the continuation leg
+            # that follows is causally linked to it through the shared
+            # root, and the harvested prefix length is right here
+            self._end_leg(p, name, "failover_source", reason=reason,
+                          recovered_tokens=len(p.delivered))
+            victims.append(rid)
             if p.replica is not None or p.hedge is not None:
                 continue  # the other leg is still running it
             if rid in self._queue:
                 continue
+            # router-resident again as of the recovery moment (see
+            # the bounce path: reset BEFORE the prefix-finish attempt)
+            p.queue_since_pc = dtrace.now()
             if not self._finish_from_prefix(p):
                 self._queue.append(rid)
+        if victims:
+            # "failover_reason", not "reason" — flightrec.dump owns
+            # the top-level "reason" field (the dump's trigger tag)
+            self._flight_dump("fleet_failover", {
+                "replica": name, "failover_reason": reason,
+                "victims": victims,
+                "victim_trace": self._victim_tree(victims[0])})
+
+    def _victim_tree(self, rid):
+        p = self._pending.get(rid)
+        if p is None or p.trace is None:
+            return None
+        return self._tstore.tree(p.trace["trace_id"])
+
+    def _flight_dump(self, tag, extra):
+        """Flight-recorder trigger with the fleet registry snapshot
+        and the fleet health rollup attached (never raises — a
+        postmortem write must not take the router down)."""
+        try:
+            from ..observability import flightrec
+            flightrec.note(tag, **{k: v for k, v in extra.items()
+                                   if not isinstance(v, dict)})
+            flightrec.dump(tag, extra=dict(
+                extra, fleet_registry=self._registry_snapshot(),
+                fleet_health=self.health()))
+        except Exception:  # noqa: BLE001
+            pass

@@ -118,3 +118,32 @@ def _clear_jax_caches_per_module():
         jax.clear_caches()
     import gc
     gc.collect()
+
+
+# -- fleet-stage metrics export (campaign canary gate) -----------------------
+# The fleet chaos tests (test_fleet_serving / test_fleet_tracing)
+# register each FleetRouter's registry here; at session end the merged
+# snapshot lands as metrics.json in $BENCH_TELEMETRY_DIR — the
+# artifact tools/tpu_campaign.py's fleet canary gate diffs against the
+# committed golden (tools/golden/fleet_chaos_metrics.json). A no-op
+# outside the campaign (env unset) or when no fleet test ran.
+fleet_stage_registries = []
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _fleet_stage_metrics_export():
+    yield
+    out_dir = os.environ.get("BENCH_TELEMETRY_DIR")
+    if not out_dir or not fleet_stage_registries:
+        return
+    from paddle_tpu.observability.metrics import MetricsRegistry
+    from paddle_tpu.observability.trace import report_all
+    merged = MetricsRegistry()
+    for reg in fleet_stage_registries:
+        try:
+            merged.merge(reg.snapshot())
+        except Exception:  # noqa: BLE001 — one bad registry must not
+            pass           # cost the whole stage its artifact
+    merged.dump(os.path.join(out_dir, "metrics.json"),
+                extra={"recompile_report": report_all(),
+                       "stage": "fleet_chaos"})

@@ -92,6 +92,10 @@ def _fleet(model, n=3, router_kw=None, **engine_kw):
     frozen = [e.compile_counts() for e in engines]
     reps = [InprocReplica(f"r{i}", e) for i, e in enumerate(engines)]
     router = FleetRouter(reps, **(router_kw or {}))
+    # register for the session-end metrics.json export the campaign's
+    # fleet canary gate diffs (conftest._fleet_stage_metrics_export)
+    import conftest
+    conftest.fleet_stage_registries.append(router.registry)
     return router, reps, engines, frozen
 
 

@@ -1443,6 +1443,57 @@ class TestValidateStagesFlightCheck:
         assert "unparseable flight dump" in problems[0]
 
 
+class TestValidateStagesCanaryCheck:
+    """check_canary_verdict: a _fleet_canary-marked campaign whose
+    fleet_chaos_smoke completed must carry the metrics_diff gate's
+    verdict file (ISSUE 8 — the gate must not silently never run)."""
+
+    @pytest.fixture()
+    def vs(self, tmp_path, monkeypatch):
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        monkeypatch.syspath_prepend(os.path.join(repo, "tools"))
+        monkeypatch.syspath_prepend(repo)
+        import validate_stages as mod
+        monkeypatch.setattr(mod, "OUT", str(tmp_path))
+        return mod
+
+    def _summary(self, vs, doc):
+        with open(os.path.join(vs.OUT, "summary.json"), "w") as f:
+            json.dump(doc, f)
+
+    def test_pre_gate_archives_and_unrun_stages_not_flagged(self, vs):
+        assert vs.check_canary_verdict() == ([], 0)   # no summary
+        self._summary(vs, {"fleet_chaos_smoke": {"ok": True, "rc": 0}})
+        assert vs.check_canary_verdict() == ([], 0)   # no marker
+        self._summary(vs, {"_fleet_canary": 1})
+        assert vs.check_canary_verdict() == ([], 0)   # never ran
+
+    def test_completed_stage_without_verdict_is_a_problem(self, vs):
+        self._summary(vs, {"_fleet_canary": 1,
+                           "fleet_chaos_smoke": {"ok": True, "rc": 0}})
+        problems, checked = vs.check_canary_verdict()
+        assert checked == 1 and "no verdict" in problems[0]
+
+    def test_parseable_verdict_passes_torn_or_flagless_fails(self, vs):
+        self._summary(vs, {"_fleet_canary": 1,
+                           "fleet_chaos_smoke": {"ok": True, "rc": 0}})
+        td = os.path.join(vs.OUT, "telemetry", "fleet_chaos_smoke")
+        os.makedirs(td)
+        vp = os.path.join(td, "canary_verdict.json")
+        with open(vp, "w") as f:
+            json.dump({"ok": True, "failures": []}, f)
+        assert vs.check_canary_verdict() == ([], 1)
+        with open(vp, "w") as f:
+            json.dump({"failures": []}, f)   # no ok flag
+        problems, _ = vs.check_canary_verdict()
+        assert "no 'ok' flag" in problems[0]
+        with open(vp, "w") as f:
+            f.write("{torn")
+        problems, _ = vs.check_canary_verdict()
+        assert "unparseable canary verdict" in problems[0]
+
+
 class TestGuardOutcomeAfterRollback:
     def test_storm_outlasting_rollback_keeps_skipping_one_dump(
             self, tmp_path, monkeypatch):

@@ -129,12 +129,17 @@ STAGES = [
     # writes the same telemetry.jsonl/metrics.json shape bench stages do
     ("telemetry_smoke", [PY, "tools/telemetry_smoke.py"], 1200,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
-    # fleet chaos drill (ISSUE 6, CPU): 3 in-process serving replicas
-    # under a seeded fault wave (replica crash/wedge/slow, flaky
-    # transport, drain/rejoin) — asserts 100% request completion with
-    # token-exact failover dedup and 0 unexpected retraces fleet-wide
+    # fleet chaos drill (ISSUE 6 + 8, CPU): in-process serving
+    # replicas under a seeded fault wave (replica crash/wedge/slow,
+    # flaky transport, drain/rejoin, hedging, shed storms) — asserts
+    # 100% request completion with token-exact failover dedup, one
+    # causally-linked trace tree per request with attribution within
+    # tolerance, SLO burn-rate alerting, and 0 unexpected retraces
+    # fleet-wide. The stage exports a merged fleet metrics.json that
+    # the fleet canary gate below diffs against the committed golden.
     ("fleet_chaos_smoke", [PY, "-m", "pytest",
-                           "tests/test_fleet_serving.py", "-q", "-m",
+                           "tests/test_fleet_serving.py",
+                           "tests/test_fleet_tracing.py", "-q", "-m",
                            "chaos", "-p", "no:cacheprovider", "-p",
                            "no:randomly"], 1800,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
@@ -279,6 +284,49 @@ RETRY_ONLY = {"bench_gpt13b", "bench_gpt13b_scan", "bench_gpt_b16",
               "fusion_audit_nhwc"}
 
 
+# fleet canary gate (tools/README): after fleet_chaos_smoke, its
+# merged fleet metrics.json is diffed against the committed golden
+# with regression thresholds on the rates a canary rollout pages on.
+# Thresholds are generous (the chaos wave's exact failover count is
+# timing-dependent) — the gate exists to catch a failover/shed STORM
+# or a placement-latency cliff, not single-event jitter.
+FLEET_CANARY_GOLDEN = os.path.join("tools", "golden",
+                                   "fleet_chaos_metrics.json")
+FLEET_CANARY_FAIL_ON = (
+    "fleet_failovers_total>200%",
+    "fleet_shed_total>200%",
+    "fleet_placement_wait_seconds:p99>400%",
+)
+
+
+def run_fleet_canary_gate(stage_name):
+    """Run tools/metrics_diff.py golden-vs-stage and leave the
+    verdict file tools/validate_stages.py requires
+    (telemetry/<stage>/canary_verdict.json). Returns the verdict."""
+    tele = os.path.join(OUT, "telemetry", stage_name)
+    candidate = os.path.join(tele, "metrics.json")
+    cmd = [PY, "tools/metrics_diff.py", FLEET_CANARY_GOLDEN,
+           candidate, "--quiet"]
+    for spec in FLEET_CANARY_FAIL_ON:
+        cmd += ["--fail-on", spec]
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                              text=True, timeout=120)
+        lines = [l for l in proc.stdout.strip().splitlines() if l]
+        verdict = json.loads(lines[-1]) if lines \
+            else {"ok": False, "error": "metrics_diff emitted nothing"}
+    except Exception as e:  # noqa: BLE001 — the gate must leave a
+        #                     verdict either way
+        verdict = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    verdict["gate"] = "fleet_canary"
+    verdict["golden"] = FLEET_CANARY_GOLDEN
+    verdict["fail_on"] = list(FLEET_CANARY_FAIL_ON)
+    os.makedirs(tele, exist_ok=True)
+    with open(os.path.join(tele, "canary_verdict.json"), "w") as f:
+        json.dump(verdict, f, indent=1)
+    return verdict
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--only", default=None,
@@ -299,8 +347,11 @@ def main():
     # must not read as an observability regression). _flightrec
     # likewise marks that chaos-family stages dump crash flight
     # records into their telemetry dir (round-10 introspection layer)
+    # _fleet_canary marks a campaign whose fleet_chaos_smoke stage is
+    # gated by the metrics_diff canary diff — validate_stages requires
+    # the gate's verdict file on such summaries
     summary = {"_captured_at": {"epoch": int(time.time())},
-               "_telemetry": 1, "_flightrec": 1}
+               "_telemetry": 1, "_flightrec": 1, "_fleet_canary": 1}
     stages = [s for s in STAGES if s[0] not in RETRY_ONLY]
     if only:  # run in the order the caller listed, not STAGES order
         by_name = {s[0]: s for s in STAGES}
@@ -323,6 +374,18 @@ def main():
         ok = rc == 0
         summary[name] = {"ok": ok, "rc": rc, "seconds": dt,
                          "ended_at": int(time.time()), "result": parsed}
+        if name == "fleet_chaos_smoke" and ok:
+            verdict = run_fleet_canary_gate(name)
+            gate_ok = bool(verdict.get("ok"))
+            summary[name]["canary"] = {
+                "ok": gate_ok,
+                "failures": verdict.get("failures", []),
+                "error": verdict.get("error")}
+            if not gate_ok:
+                ok = summary[name]["ok"] = False
+                print("=== fleet canary gate FAILED: "
+                      f"{verdict.get('failures') or verdict.get('error')}"
+                      " ===", flush=True)
         print(f"=== {name}: rc={rc} {dt}s "
               f"{json.dumps(parsed) if parsed else tail[-150:]!r} ===",
               flush=True)

@@ -44,9 +44,12 @@ REQUIRED_STAGES = {
 def _emits_metrics(cmd):
     """Stages built on bench.py workers or telemetry_smoke write
     telemetry.jsonl + metrics.json into campaign_out/telemetry/<stage>;
-    bare tools (decode_probe, fusion_audit, pytest suites) do not."""
+    the fleet chaos pytest stage exports its merged fleet registry the
+    same way (conftest session fixture — the canary gate's input);
+    other bare tools (decode_probe, fusion_audit) do not."""
     return any(os.path.basename(str(a)) in ("bench.py",
-                                            "telemetry_smoke.py")
+                                            "telemetry_smoke.py",
+                                            "test_fleet_serving.py")
                for a in cmd)
 
 
@@ -147,6 +150,45 @@ def check_flight_dumps():
     return problems, checked
 
 
+def check_canary_verdict():
+    """A _fleet_canary-marked campaign whose fleet_chaos_smoke stage
+    completed must have left the metrics_diff gate's verdict file
+    (telemetry/fleet_chaos_smoke/canary_verdict.json, parseable, with
+    an 'ok' flag) — a gate that silently never ran would let a
+    failover/shed regression ship as a green campaign. Returns
+    (problems, checked)."""
+    path = os.path.join(OUT, "summary.json")
+    try:
+        with open(path) as f:
+            summary = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return [], 0
+    if not summary.get("_fleet_canary"):
+        return [], 0   # pre-gate archive: nothing to hold it to
+    row = summary.get("fleet_chaos_smoke")
+    if not isinstance(row, dict) or row.get("rc") is None:
+        return [], 0   # stage never ran
+    vpath = os.path.join(OUT, "telemetry", "fleet_chaos_smoke",
+                         "canary_verdict.json")
+    # the gate runs only on a completed stage; a failed stage leaves
+    # no verdict and is already red on its own
+    if not row.get("ok") and not row.get("canary"):
+        return [], 0
+    try:
+        with open(vpath) as f:
+            verdict = json.load(f)
+    except OSError:
+        return [f"fleet_chaos_smoke: completed but the canary gate "
+                f"left no verdict at {vpath}"], 1
+    except json.JSONDecodeError as e:
+        return [f"fleet_chaos_smoke: unparseable canary verdict "
+                f"({e})"], 1
+    if "ok" not in verdict:
+        return [f"fleet_chaos_smoke: canary verdict {vpath} has no "
+                "'ok' flag"], 1
+    return [], 1
+
+
 def _child_pgids(pid):
     """Process groups of `pid`'s direct children: bench.py/decode_probe
     start their workers with start_new_session=True, so killpg on the
@@ -199,8 +241,9 @@ def main():
         return 1
     metric_problems, metrics_checked = check_completed_stage_metrics()
     flight_problems, flights_checked = check_flight_dumps()
-    metric_problems += flight_problems
-    metrics_checked += flights_checked
+    canary_problems, canary_checked = check_canary_verdict()
+    metric_problems += flight_problems + canary_problems
+    metrics_checked += flights_checked + canary_checked
     for p in metric_problems:
         print(f"  metrics: SUSPECT ({p})", flush=True)
     tmp = tempfile.mkdtemp(prefix="stage_preflight_")
