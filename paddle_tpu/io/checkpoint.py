@@ -33,6 +33,7 @@ import jax
 import numpy as np
 
 from .. import serialization
+from . import atomic
 
 __all__ = ["CheckpointManager"]
 
@@ -153,10 +154,8 @@ class CheckpointManager:
         return idx
 
     def _write_index(self):
-        tmp = self._index_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self._index, f)
-        os.replace(tmp, self._index_path())
+        atomic.atomic_replace(self._index_path(),
+                              json.dumps(self._index))
 
     def _step_dir(self, step):
         return os.path.join(self.dir, f"step_{step}")
@@ -286,21 +285,20 @@ class CheckpointManager:
             self._error = None
             raise RuntimeError("async checkpoint save failed") from err
 
-    # -- finalize marker ---------------------------------------------------
-    _MARKER = "COMPLETE"
+    # -- finalize marker (shared discipline: io/atomic.py) -----------------
+    _MARKER = atomic.MARKER_NAME
 
     def _marker_path(self, d):
         return os.path.join(d, self._MARKER)
 
     def _finalize(self, d, step):
-        """Write the COMPLETE marker and make it durable. Only a dir
-        carrying this marker is eligible for latest()/best()/restore —
-        the contract that makes every save crash-safe."""
-        path = self._marker_path(d)
-        with open(path, "w") as f:
-            json.dump({"step": step, "time": time.time()}, f)
-            f.flush()
-            os.fsync(f.fileno())
+        """Write the COMPLETE marker and make it durable (the shared
+        io.atomic discipline — the fleet journal's segment rotation
+        reuses the same helper). Only a dir carrying this marker is
+        eligible for latest()/best()/restore — the contract that makes
+        every save crash-safe."""
+        atomic.write_marker(self._marker_path(d),
+                            {"step": step, "time": time.time()})
 
     def _finalized_unlocked(self, step):
         return (os.path.exists(self._marker_path(self._step_dir(step)))

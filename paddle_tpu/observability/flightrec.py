@@ -39,6 +39,33 @@ import time
 
 __all__ = ["FlightRecorder", "get_recorder", "note", "dump"]
 
+_atomic_mod = None
+
+
+def _atomic():
+    """The shared crash-safe-write helper (io/atomic.py), resolved
+    LAZILY so this module stays stdlib-only at import: the package
+    path would pull paddle_tpu.io (numpy/jax) eagerly, and the
+    standalone file-load mode (bench lean workers, see bench._obs_mod)
+    has no package context at all — there the helper is loaded
+    straight from its file, which is fine because atomic.py is itself
+    stdlib-only by contract."""
+    global _atomic_mod
+    if _atomic_mod is None:
+        try:
+            from ..io import atomic as mod
+        except ImportError:
+            import importlib.util as ilu
+            path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                os.pardir, "io", "atomic.py")
+            spec = ilu.spec_from_file_location(
+                "_bench_obs_io_atomic", path)
+            mod = ilu.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        _atomic_mod = mod
+    return _atomic_mod
+
 
 def _finite(obj):
     if isinstance(obj, float):
@@ -102,14 +129,7 @@ class FlightRecorder:
         return self.run_dir or _default_dir()
 
     def _unique_path(self, d, reason):
-        safe = "".join(c if (c.isalnum() or c in "-_") else "_"
-                       for c in str(reason)) or "unknown"
-        path = os.path.join(d, f"flight_{safe}.json")
-        n = 2
-        while os.path.exists(path):
-            path = os.path.join(d, f"flight_{safe}_{n}.json")
-            n += 1
-        return path
+        return _atomic().unique_path(d, f"flight_{reason}")
 
     def dump(self, reason, extra=None):
         """Write the flight record for `reason`; returns the path or
@@ -137,16 +157,14 @@ class FlightRecorder:
             d = self._resolve_dir()
             os.makedirs(d, exist_ok=True)
             path = self._unique_path(d, reason)
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                try:
-                    json.dump(doc, f, indent=1, allow_nan=False)
-                except ValueError:
-                    f.seek(0)
-                    f.truncate()
-                    json.dump(_finite(doc), f, indent=1,
-                              allow_nan=False)
-            os.replace(tmp, path)
+            try:
+                text = json.dumps(doc, indent=1, allow_nan=False)
+            except ValueError:
+                text = json.dumps(_finite(doc), indent=1,
+                                  allow_nan=False)
+            # shared crash-safe write (io/atomic.py): the dump itself
+            # must never be a torn artifact for the postmortem to trip on
+            _atomic().atomic_replace(path, text)
             self.dumps.append(path)
             return path
         except Exception:  # noqa: BLE001 — see docstring

@@ -49,7 +49,23 @@ scrape_timeout      FleetRouter health scrape — the scrape raises a
 flaky_transport     ReplicaClient transport op — transient error before
                     (or, with ``after=1``, AFTER) delivery; the retry
                     wrapper + rid idempotency absorb it
+router_crash        FleetRouter control round — the router dies mid-step
+                    (recovery drill: FleetRouter.recover replays the
+                    write-ahead journal and re-adopts the replicas)
+journal_torn_write  fleet journal append — the record is written
+                    TRUNCATED and JournalCrash raises (process died
+                    mid-append); replay drops the torn tail
+journal_io_error    fleet journal append — raises JournalError with
+                    nothing written (transient disk failure; the
+                    router retries lifecycle records, rejects submits)
+journal_slow_fsync  fleet journal fsync — host sleep of ``seconds``
+                    (slow-disk drill; stalls, never corruption)
 ==================  =====================================================
+
+The journal seams pass the journal's own append (or fsync) sequence
+number as the seam step, so ``journal_torn_write@12`` tears exactly
+the 12th record this incarnation writes; ``router_crash`` steps are
+router control rounds.
 
 Fleet faults target ONE replica via payload (``replica_crash:replica=r1``
 or ``inject("replica_crash", replica="r1")``): seams pass their own

@@ -11,9 +11,19 @@ whole failure model.
 
 - InprocReplica:  one engine + worker thread behind a transport seam
                   (replica.py; a subprocess replica speaks the same
-                  verbs over a wire)
+                  verbs over a wire). The response plane is
+                  at-least-once with explicit acks: results are
+                  retained until the router durably processed them,
+                  so a router crash cannot lose a finished request
 - ReplicaClient:  idempotent-by-rid transport with seeded-jitter
                   retry (client.py)
+- Journal:        the router's write-ahead request journal
+                  (journal.py): append-only checksummed JSONL
+                  segments, atomic COMPLETE-marker rotation, torn-
+                  tail-tolerant replay, journal_* disk-fault seams —
+                  FleetRouter.recover() replays it to re-adopt a
+                  still-live fleet after a router crash/preemption
+                  with token-exact, exactly-once continuation
 - FleetRouter:    global queue, scrape-scored placement, failover/
                   hedging/drain/shed + its own MetricsRegistry,
                   distributed tracing (one causally-linked span tree
@@ -31,8 +41,10 @@ chaos); campaign stage fleet_chaos_smoke (metrics_diff canary-gated
 against tools/golden/fleet_chaos_metrics.json).
 """
 from .client import ReplicaClient  # noqa: F401
+from .journal import Journal, JournalCrash, JournalError  # noqa: F401
 from .replica import InprocReplica, ReplicaCrash  # noqa: F401
-from .router import FleetRouter  # noqa: F401
+from .router import FleetRouter, RouterCrash  # noqa: F401
 
-__all__ = ["FleetRouter", "InprocReplica", "ReplicaClient",
-           "ReplicaCrash"]
+__all__ = ["FleetRouter", "InprocReplica", "Journal", "JournalCrash",
+           "JournalError", "ReplicaClient", "ReplicaCrash",
+           "RouterCrash"]

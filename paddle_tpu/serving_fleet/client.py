@@ -99,5 +99,17 @@ class ReplicaClient:
         self._call(self.replica.enqueue, ("cancel", rid))
 
     def poll(self):
-        """Fetch finished-request dicts accumulated at the replica."""
+        """Fetch the replica's unacked finished-request dicts. Safe to
+        retry AND safe to lose the response: results are retained at
+        the replica until ack() — the half of exactly-once the request
+        plane's rid idempotency cannot give."""
         return self._call(self.replica.pop_results)
+
+    def ack(self, seqs):
+        """Retire delivered results (by ``_rseq``) at the replica.
+        Idempotent; the router calls this only once a result is
+        processed — and, when journaling, durably journaled — so a
+        crash before the ack re-surfaces the result to the recovered
+        router instead of losing it."""
+        if seqs:
+            self._call(self.replica.ack, list(seqs))

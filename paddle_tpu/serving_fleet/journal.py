@@ -1,0 +1,540 @@
+"""Write-ahead request journal — the router's durable memory.
+
+Rounds 11-12 made replicas expendable; the router was left as the
+single point of failure: the admission queue, rid ledger, delivered-
+prefix continuations and resolved-result buffer all lived in router
+memory, so a router crash lost every request the fleet had accepted.
+This module journals every request lifecycle transition the router
+owns (accepted → placed → delivered-prefix watermarks →
+resolved/shed/expired → retired) to an append-only on-disk log that a
+fresh router replays to re-adopt the fleet (``FleetRouter.recover``;
+docs/robustness.md "Router durability & recovery").
+
+Disk format — built for torn tails, not trust:
+
+- **Segments**: ``wal-<NNNNNN>.jsonl`` files; the highest-numbered
+  FINALIZED segment is active (finalized = a ``.complete`` sidecar
+  via the shared io/atomic COMPLETE-marker discipline). Appends go to
+  the active segment only.
+- **Records**: one line each — ``<len:8hex> <crc32:8hex> <payload>``
+  where payload is compact JSON. A line that is short, fails its
+  length, fails its checksum, or does not parse is a torn record:
+  replay DROPS it (counted in ``torn_tail_drops``) and resyncs at the
+  next newline, so a crash mid-append costs at most the record being
+  written, never the journal.
+- **Rotation**: when the active segment outgrows
+  ``segment_max_bytes`` (and at every recovery), the owner passes a
+  snapshot of its live state and the journal writes a NEW segment
+  (header + snapshot records) through io.atomic's write-then-rename +
+  marker path — the same discipline io/checkpoint.py finalizes
+  checkpoints with — then deletes older segments. Compaction and
+  crash-safety in one move: the new segment is readable or the old
+  one still is, never neither.
+
+Fault seams (resilience.faults; consulted ONLY in the append path,
+with the journal's own append sequence number as the seam step, so a
+chaos test pins a fault to an exact record):
+
+- ``journal_torn_write`` — the frame is written truncated
+  (``keep_bytes`` payload, default half) and ``JournalCrash`` raises:
+  the process died mid-append, tearing the tail. Everything earlier
+  is durable; replay drops the torn record.
+- ``journal_io_error``  — the append raises ``JournalError``
+  (transient disk failure); nothing is written. The router retries
+  non-admission records from a backlog; an admission (``accepted``)
+  append failure rejects the submit — durability is the admission
+  contract.
+- ``journal_slow_fsync`` — the fsync path sleeps ``seconds`` (stalls
+  surface in step latency, not corruption).
+
+Metrics (``fleet_journal_*`` in the router's registry, catalogue in
+docs/observability.md): appends, bytes, fsyncs, errors, rotations,
+replay_records, torn_tail_drops (+ the router's
+fleet_journal_recovered_requests_total).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import time
+import zlib
+
+from ..io import atomic
+from ..resilience import faults
+
+__all__ = ["Journal", "JournalCrash", "JournalError", "reconcile",
+           "replay"]
+
+_SEG_RE = re.compile(r"^wal-(\d{6})\.jsonl$")
+_FORMAT = 1
+
+
+class JournalError(RuntimeError):
+    """An append could not be made durable (injected
+    ``journal_io_error`` or a real OSError from the disk). The record
+    was NOT written; the caller decides whether to retry (lifecycle
+    records) or reject the operation (admission records)."""
+
+
+class JournalCrash(JournalError):
+    """Injected stand-in for the process dying MID-append
+    (``journal_torn_write``): a truncated frame is on disk and no
+    further writes will ever happen from this incarnation. Raised out
+    of the router's step so the chaos test can abandon the router
+    exactly where a real crash would have."""
+
+
+def _scrub(obj):
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _scrub(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_scrub(v) for v in obj]
+    return obj
+
+
+def _frame(rec):
+    """One length-prefixed, checksummed line for `rec`."""
+    try:
+        payload = json.dumps(rec, separators=(",", ":"),
+                             allow_nan=False)
+    except ValueError:
+        payload = json.dumps(_scrub(rec), separators=(",", ":"),
+                             allow_nan=False)
+    raw = payload.encode("utf-8")
+    crc = zlib.crc32(raw) & 0xFFFFFFFF
+    return b"%08x %08x " % (len(raw), crc) + raw + b"\n"
+
+
+def _parse_line(line):
+    """Record dict for one frame line, or None when torn/corrupt."""
+    if len(line) < 19 or line[8:9] != b" " or line[17:18] != b" ":
+        return None
+    try:
+        n = int(line[:8], 16)
+        crc = int(line[9:17], 16)
+    except ValueError:
+        return None
+    raw = line[18:]
+    if len(raw) != n or (zlib.crc32(raw) & 0xFFFFFFFF) != crc:
+        return None
+    try:
+        rec = json.loads(raw.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def _segments(directory):
+    """[(num, path)] ascending for every wal segment in `directory`."""
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    for name in names:
+        m = _SEG_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort()
+    return out
+
+
+def _pick_segment(directory):
+    """The segment replay trusts: the newest FINALIZED one (its head
+    — header + any rotation snapshot — was written atomically, so
+    only its appended tail can be torn). Falls back to the newest
+    unmarked segment rather than refusing to recover at all."""
+    segs = _segments(directory)
+    marked = [(n, p) for n, p in segs if atomic.has_marker(p)]
+    if marked:
+        return marked[-1]
+    return segs[-1] if segs else (None, None)
+
+
+def replay(directory):
+    """Parse the journal under `directory`.
+
+    Returns ``(records, stats)`` — the valid records of the chosen
+    segment in append order, and
+    ``{"segment", "replay_records", "torn_tail_drops", "sealed"}``.
+    Torn/corrupt lines are dropped and counted, never raised on: a
+    journal that took a crash mid-append must still replay everything
+    before the tear."""
+    num, path = _pick_segment(directory)
+    stats = {"segment": None if num is None else os.path.basename(path),
+             "replay_records": 0, "torn_tail_drops": 0, "sealed": False}
+    records = []
+    if path is None:
+        return records, stats
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return records, stats
+    for line in data.split(b"\n"):
+        if not line:
+            continue
+        rec = _parse_line(line)
+        if rec is None:
+            stats["torn_tail_drops"] += 1
+            continue
+        if rec.get("kind") == "sealed":
+            stats["sealed"] = True
+        records.append(rec)
+        stats["replay_records"] += 1
+    return records, stats
+
+
+def reconcile(records):
+    """Fold replayed records into per-rid terminal state — the pure
+    half of recovery (fuzz-tested against truncation at every byte;
+    FleetRouter._adopt reconciles this against harvested replica
+    state).
+
+    Returns ``{"requests": {rid: {...}}, "retired": set,
+    "cancelled": set, "next_rid", "sealed", "preempted"}``. A request
+    entry carries everything a continuation resubmit needs: prompt,
+    budget, eos, priority, wall-clock deadline, the journaled
+    delivered prefix (the dedup boundary), last journaled placement
+    (+ its prefix anchor and any hedge leg to orphan-cancel), failover
+    count, and — for resolved-but-unretired rids — the full result
+    for exactly-once re-delivery. Retired rids stay retired whatever
+    replays after them; a journaled cancel intent survives into the
+    ``cancelled`` set."""
+    reqs = {}
+    retired = set()
+    cancelled = set()
+    out = {"requests": reqs, "retired": retired,
+           "cancelled": cancelled, "next_rid": 0,
+           "sealed": False, "preempted": False}
+
+    def ent(rid):
+        return reqs.setdefault(int(rid), {
+            "prompt": None, "max_new": 0, "eos": None, "priority": 0,
+            "deadline_epoch": None, "submitted_epoch": None,
+            "delivered": [], "replica": None, "placed_prefix": None,
+            "hedge": None, "failovers": 0, "resolved": None})
+
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "header":
+            out["next_rid"] = max(out["next_rid"],
+                                  int(rec.get("next_rid", 0)))
+        elif kind in ("accepted", "snap_req"):
+            rid = rec.get("rid")
+            if rid is None or rec.get("prompt") is None \
+                    or int(rid) in retired:
+                continue
+            e = ent(rid)
+            e["prompt"] = [int(t) for t in rec["prompt"]]
+            e["max_new"] = int(rec.get("max_new", 0))
+            e["eos"] = rec.get("eos")
+            e["priority"] = int(rec.get("priority", 0))
+            e["deadline_epoch"] = rec.get("deadline_epoch")
+            e["submitted_epoch"] = rec.get("submitted_epoch")
+            if kind == "snap_req":
+                e["delivered"] = [int(t)
+                                  for t in rec.get("delivered") or []]
+                e["replica"] = rec.get("replica")
+                e["placed_prefix"] = rec.get("placed_prefix")
+                e["hedge"] = rec.get("hedge")
+                e["failovers"] = int(rec.get("failovers", 0))
+        elif kind == "placed":
+            if rec.get("rid") in reqs:
+                e = reqs[int(rec["rid"])]
+                e["replica"] = rec.get("replica")
+                e["placed_prefix"] = rec.get("prefix")
+        elif kind == "delivered":
+            rid = rec.get("rid")
+            if rid in reqs:
+                toks = [int(t) for t in rec.get("tokens") or []]
+                if len(toks) > len(reqs[int(rid)]["delivered"]):
+                    reqs[int(rid)]["delivered"] = toks
+        elif kind == "failover":
+            rid = rec.get("rid")
+            if rid in reqs:
+                reqs[int(rid)]["failovers"] += 1
+                reqs[int(rid)]["replica"] = None
+                reqs[int(rid)]["placed_prefix"] = None
+        elif kind in ("resolved", "snap_done"):
+            res = rec.get("result")
+            if not isinstance(res, dict) or "id" not in res:
+                continue
+            rid = int(res["id"])
+            if rid in retired:
+                # a backlog-flushed `resolved` can land AFTER the
+                # rid's `retired` record — resurrecting it here would
+                # re-deliver a result the client already took
+                continue
+            e = ent(rid)
+            e["resolved"] = res
+            e["replica"] = None
+        elif kind == "cancel":
+            if rec.get("rid") is not None:
+                cancelled.add(int(rec["rid"]))
+        elif kind == "hedged":
+            if rec.get("rid") in reqs:
+                reqs[int(rec["rid"])]["hedge"] = rec.get("replica")
+        elif kind == "retired":
+            for rid in rec.get("rids") or []:
+                retired.add(int(rid))
+                reqs.pop(int(rid), None)
+        elif kind == "sealed":
+            out["sealed"] = True
+        elif kind == "preempt":
+            out["preempted"] = True
+    if reqs:
+        out["next_rid"] = max(out["next_rid"], max(reqs) + 1)
+    if retired:
+        out["next_rid"] = max(out["next_rid"], max(retired) + 1)
+    return out
+
+
+class Journal:
+    """Append-only write-ahead log under one directory.
+
+    directory: created if missing; one active segment at a time.
+    segment_max_bytes: ``needs_rotation`` turns True past this — the
+        OWNER rotates (it holds the live-state snapshot compaction
+        needs); the journal never rotates behind its back.
+    fsync_every: fsync the active segment every N appends (1 = every
+        record, the smallest crash window; rotation and seal always
+        fsync regardless).
+    registry: MetricsRegistry for the ``fleet_journal_*`` series
+        (None = unmetered).
+    """
+
+    def __init__(self, directory, *, segment_max_bytes=1 << 20,
+                 fsync_every=1, registry=None):
+        self.dir = os.path.abspath(str(directory))
+        os.makedirs(self.dir, exist_ok=True)
+        self.segment_max_bytes = int(segment_max_bytes)
+        self.fsync_every = max(int(fsync_every), 1)
+        self._seq = 0          # append seam step (this incarnation)
+        self._fsyncs = 0
+        self._unsynced = 0
+        self._crashed = False  # torn-write seam fired: writes are dead
+        self.sealed = False
+        self._m = {}
+        if registry is not None:
+            for name, help_ in (
+                    ("appends", "journal records appended"),
+                    ("bytes", "journal bytes appended"),
+                    ("fsyncs", "journal fsync calls"),
+                    ("errors", "journal append/fsync failures"),
+                    ("rotations", "journal segment rotations"),
+                    ("replay_records", "records replayed at recovery"),
+                    ("torn_tail_drops",
+                     "torn/corrupt records dropped at replay")):
+                self._m[name] = registry.counter(
+                    f"fleet_journal_{name}_total", help=help_)
+        num, path = _pick_segment(self.dir)
+        if path is None:
+            path = self._create_segment(1, [])
+        self._active = path
+        self._f = open(path, "ab")
+        self._size = os.path.getsize(path)
+        # torn-tail repair: a segment that took a crash mid-append
+        # ends without a newline. Terminate that line NOW, or the
+        # first record this incarnation appends would concatenate
+        # onto the torn bytes and be silently unreplayable — an
+        # acked-but-unjournaled hole if the process dies again before
+        # the recovery rotate() compacts the segment.
+        if self._size:
+            with open(path, "rb") as rf:
+                rf.seek(-1, os.SEEK_END)
+                if rf.read(1) != b"\n":
+                    self._f.write(b"\n")
+                    self._f.flush()
+                    self._size += 1
+
+    # -- metrics ----------------------------------------------------------
+
+    def _inc(self, name, n=1):
+        c = self._m.get(name)
+        if c is not None and n:
+            c.inc(n)
+
+    # -- append path ------------------------------------------------------
+
+    @property
+    def active_path(self):
+        return self._active
+
+    @property
+    def needs_rotation(self):
+        return self._size >= self.segment_max_bytes
+
+    def append(self, kind, **fields):
+        """Durably append one record. Raises JournalError when the
+        disk REJECTED the append with nothing written (the injected
+        ``journal_io_error`` — transient, retryable), JournalCrash
+        when the write is in an unknowable state: the torn-write seam,
+        or a REAL write/fsync OSError. After a real failure the
+        journal is dead (fsyncgate semantics — a failed fsync leaves
+        durability unknowable, so pretending to continue would let
+        acked state diverge from disk); the owner should crash and
+        recover, which replays whatever actually landed."""
+        if self._crashed:
+            raise JournalCrash("journal is dead after a torn write")
+        self._seq += 1
+        seq = self._seq
+        rec = {"kind": str(kind), "ts": round(time.time(), 6)}
+        rec.update(fields)
+        frame = _frame(rec)
+        p = faults.pull("journal_io_error", seq)
+        if p is not None:
+            self._inc("errors")
+            raise JournalError(
+                f"EIO: injected journal_io_error (append seq {seq})")
+        p = faults.pull("journal_torn_write", seq)
+        if p is not None:
+            keep = int(p.get("keep_bytes", max(len(frame) // 2, 1)))
+            self._write(frame[:max(min(keep, len(frame) - 1), 1)],
+                        fsync=True)
+            self._crashed = True
+            raise JournalCrash(
+                f"injected journal_torn_write (append seq {seq}): "
+                f"process died mid-record")
+        self._write(frame, fsync=None)
+        self._inc("appends")
+        self._inc("bytes", len(frame))
+        return rec
+
+    def _write(self, data, fsync):
+        """fsync=None → honor the fsync_every cadence; True → force.
+        Every append is flushed THROUGH the user-space buffer (a
+        process crash must cost at most the record mid-write, not a
+        buffer of acknowledged ones); fsync_every only trades power-
+        cut durability for speed."""
+        try:
+            self._f.write(data)
+            self._f.flush()
+            self._size += len(data)
+            self._unsynced += 1
+            if fsync or (fsync is None
+                         and self._unsynced >= self.fsync_every):
+                self._fsync()
+        except JournalError:
+            raise
+        except OSError as e:
+            self._inc("errors")
+            self._crashed = True
+            raise JournalCrash(
+                f"journal write failed (journal dead): {e}") from e
+
+    def _fsync(self):
+        self._fsyncs += 1
+        faults.maybe_sleep("journal_slow_fsync", self._fsync_step())
+        try:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except OSError as e:
+            # fsyncgate: after a failed fsync the kernel may have
+            # dropped the dirty pages — durability of EVERYTHING since
+            # the last good fsync is unknowable. The only honest move
+            # is to declare the journal dead and let recovery replay
+            # what actually landed.
+            self._inc("errors")
+            self._crashed = True
+            raise JournalCrash(
+                f"journal fsync failed (journal dead): {e}") from e
+        self._unsynced = 0
+        self._inc("fsyncs")
+
+    def _fsync_step(self):
+        return self._fsyncs
+
+    def flush(self):
+        """Force the unsynced tail to disk (preemption grace windows,
+        close). No-op when everything already landed."""
+        if self._crashed:
+            return
+        if self._unsynced:
+            self._fsync()
+
+    def seal(self):
+        """Append the clean-shutdown marker and fsync — the
+        preemption contract: a SIGTERM'd router seals before exit so
+        its successor knows the journal tail is complete, not torn.
+        Later appends are still legal (results resolving inside the
+        grace window keep journaling); idempotent."""
+        if self.sealed or self._crashed:
+            return
+        self.append("sealed")
+        self.flush()
+        self.sealed = True
+
+    def close(self):
+        try:
+            self.flush()
+        except JournalError:
+            pass
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+    # -- rotation (shared io/atomic discipline) ---------------------------
+
+    def _seg_path(self, num):
+        return os.path.join(self.dir, f"wal-{num:06d}.jsonl")
+
+    def _create_segment(self, num, records, next_rid=0):
+        """Write segment `num` (header + `records`) atomically and
+        finalize it with the .complete sidecar — the checkpoint
+        COMPLETE-marker discipline, reused byte for byte: the rename
+        is the commit point, the marker is the replay-eligibility
+        claim."""
+        head = {"kind": "header", "format": _FORMAT, "segment": num,
+                "next_rid": int(next_rid), "ts": round(time.time(), 6)}
+        data = b"".join([_frame(head)] + [_frame(r) for r in records])
+        path = self._seg_path(num)
+        atomic.atomic_replace(path, data)
+        atomic.write_marker(atomic.marker_path(path),
+                            {"segment": num, "records": len(records),
+                             "time": time.time()})
+        return path
+
+    def rotate(self, snapshot_records, next_rid=0):
+        """Compact: open segment N+1 holding `snapshot_records` (the
+        owner's live unresolved/undelivered state), then drop older
+        segments. Crash-safe at every point — until the new segment's
+        marker lands, replay still picks the old one."""
+        if self._crashed:
+            return None
+        segs = _segments(self.dir)
+        num = (segs[-1][0] if segs else 0) + 1
+        try:
+            self.flush()
+        except JournalError:
+            pass
+        path = self._create_segment(num, list(snapshot_records),
+                                    next_rid=next_rid)
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        self._f = open(path, "ab")
+        self._active = path
+        self._size = os.path.getsize(path)
+        self._unsynced = 0
+        for n, old in segs:
+            if old == path:
+                continue
+            for victim in (old, atomic.marker_path(old)):
+                try:
+                    os.remove(victim)
+                except OSError:
+                    pass
+        self._inc("rotations")
+        return path
+
+    # -- replay (classmethod conveniences) --------------------------------
+
+    replay = staticmethod(replay)
+    reconcile = staticmethod(reconcile)

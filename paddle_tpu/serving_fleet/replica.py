@@ -5,7 +5,15 @@ dedicated daemon worker thread, speaking exactly the verbs a
 subprocess/remote replica would speak over a wire:
 
 - ``enqueue(op)``        — submit/cancel commands (the request plane);
-- ``pop_results()``      — finished-request dicts (the response plane);
+- ``pop_results()``      — finished-request dicts (the response
+  plane). AT-LEAST-ONCE with explicit acks: every result is retained
+  (keyed by a per-replica ``_rseq``) and re-returned by every poll
+  until ``ack()``ed, so neither a lost poll response nor a ROUTER
+  CRASH between poll and processing can lose a result — the recovered
+  router simply polls again. The router acks each result as soon as
+  it has processed it (and, when journaling, only once the resolution
+  is durable), so retention is transient in steady state;
+- ``ack(seqs)``          — drop retained results (idempotent);
 - ``scrape()``           — the last published health/metrics snapshot
   (what scraping the round-10 ``/metrics``+``/healthz`` endpoint of a
   real replica process returns);
@@ -76,6 +84,8 @@ class InprocReplica:
         self._inbox = queue.Queue()
         self._out_lock = threading.Lock()
         self._outbox = []
+        self._unacked = {}      # _rseq -> result (retained until ack)
+        self._emit_seq = 0
         self._health_lock = threading.Lock()
         self._health = {}
         self._accepted = {}     # fleet rid -> engine rid (idempotency)
@@ -112,12 +122,27 @@ class InprocReplica:
         self._inbox.put(tuple(op))
 
     def pop_results(self):
-        """Drain the outbox (fleet-rid-keyed finished dicts). Pure
-        lock swap — works even after the worker died, which is how a
-        drained replica's last results are harvested."""
+        """Every unacked result (fleet-rid-keyed dicts, ``_rseq``
+        order). Results move from the outbox into the unacked
+        retention map and are RE-returned by every poll until
+        ``ack``ed — at-least-once, so a crashed router's successor
+        re-harvests whatever the dead incarnation polled but never
+        durably processed (the router dedups by resolved rid). Pure
+        lock ops — works even after the worker died, which is how a
+        drained/crashed replica's last results are harvested."""
         with self._out_lock:
-            out, self._outbox = self._outbox, []
-        return out
+            for r in self._outbox:
+                self._unacked[r["_rseq"]] = r
+            self._outbox = []
+            return [dict(r) for r in sorted(self._unacked.values(),
+                                            key=lambda r: r["_rseq"])]
+
+    def ack(self, seqs):
+        """Drop retained results by ``_rseq`` (idempotent — a retried
+        ack that double-delivers is a no-op)."""
+        with self._out_lock:
+            for s in seqs:
+                self._unacked.pop(s, None)
 
     def scrape(self):
         """Last published health snapshot (dict copy). The
@@ -293,15 +318,25 @@ class InprocReplica:
 
     def _emit_engine(self, res):
         """Translate an engine result (engine rid) to the fleet rid
-        and publish it."""
+        and publish it. A TERMINAL result also retires the rid from
+        the idempotency ledger: the request is no longer in flight
+        here, so a later re-submit of the same rid (a recovered
+        router re-placing work it distrusts, or re-queueing after
+        cancelling a stale leg) must be accepted as a fresh run, not
+        silently dropped — the router's resolved-rid dedup absorbs
+        any duplicate result the at-least-once edge can produce."""
         frid = self._rid_map.get(res["id"])
         if frid is None:
             return  # engine-local request (warmup) — not fleet-owned
+        if res.get("status") in ("ok", "expired", "cancelled"):
+            self._accepted.pop(frid, None)
         self._emit(dict(res, id=frid))
 
     def _emit(self, res):
         with self._out_lock:
-            self._outbox.append(dict(res, replica=self.name))
+            self._emit_seq += 1
+            self._outbox.append(dict(res, replica=self.name,
+                                     _rseq=self._emit_seq))
 
     def _publish(self, force=False):
         now = time.monotonic()

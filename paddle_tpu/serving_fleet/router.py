@@ -56,9 +56,20 @@ import time
 from ..observability import dtrace
 from ..observability.metrics import MetricsRegistry
 from ..observability.slo import SLOTracker
+from ..resilience import faults, preemption
 from .client import ReplicaClient
+from .journal import Journal, JournalCrash, JournalError, reconcile, \
+    replay
 
-__all__ = ["FleetRouter"]
+__all__ = ["FleetRouter", "RouterCrash"]
+
+
+class RouterCrash(RuntimeError):
+    """Injected stand-in for the router process dying mid-control-
+    round (``router_crash`` fault kind). The chaos drill catches it,
+    abandons the router WITHOUT close() (the replicas keep running,
+    exactly like real replica processes outliving their control
+    plane), and brings up a successor via ``FleetRouter.recover``."""
 
 
 class _Pending:
@@ -67,7 +78,8 @@ class _Pending:
     __slots__ = ("rid", "prompt", "max_new", "eos", "priority",
                  "submitted_at", "placed_at", "replica", "hedge",
                  "delivered", "failovers", "hedged", "done",
-                 "deadline", "trace", "queue_since_pc", "leg_ctxs")
+                 "deadline", "trace", "queue_since_pc", "leg_ctxs",
+                 "leg_base")
 
     def __init__(self, rid, prompt, max_new, eos, priority,
                  deadline=None):
@@ -88,6 +100,12 @@ class _Pending:
         self.trace = None          # dtrace root context
         self.queue_since_pc = dtrace.now()  # current queue leg start
         self.leg_ctxs = {}         # replica name -> open leg context
+        self.leg_base = {}         # replica name -> len(delivered) the
+        #                            leg was placed with: its token
+        #                            stream is relative to THAT prefix,
+        #                            so every fold/stitch of leg tokens
+        #                            must anchor there, not at whatever
+        #                            delivered has since become
 
 
 class FleetRouter:
@@ -127,6 +145,16 @@ class FleetRouter:
     shed_storm_threshold / shed_storm_window_s: sheds inside the
         window before the flight recorder dumps a shed-storm record
         (re-arms once the window drains).
+    journal_dir: directory for the write-ahead request journal
+        (serving_fleet.journal; None = no durability). With a journal,
+        every lifecycle transition the router owns is logged before it
+        commits, submit() REJECTS (raises JournalError) when the
+        admission record cannot be made durable, a preemption notice
+        seals the journal before the drain, and a successor router
+        rebuilds the whole in-flight picture via
+        ``FleetRouter.recover(journal_dir, replicas)``.
+    journal_fsync_every / journal_segment_max_bytes: Journal knobs
+        (fsync cadence; rotation/compaction threshold).
     """
 
     def __init__(self, replicas, *, registry=None, max_queue=64,
@@ -135,7 +163,9 @@ class FleetRouter:
                  retry_jitter=0.5, trace_store=None,
                  attribution_tolerance=0.05, slos=None,
                  slo_windows=None, shed_storm_threshold=16,
-                 shed_storm_window_s=5.0):
+                 shed_storm_window_s=5.0, journal_dir=None,
+                 journal_fsync_every=1,
+                 journal_segment_max_bytes=1 << 20):
         self.replicas = {}
         self._clients = {}
         for i, rep in enumerate(replicas):
@@ -180,6 +210,25 @@ class FleetRouter:
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         reg = self.registry
+        # -- write-ahead journal (router durability): lifecycle
+        # records append BEFORE their in-memory transition commits;
+        # transient append failures park in a backlog retried every
+        # step (results whose `resolved` record is still un-durable
+        # are NOT acked at their replica, so a crash re-surfaces them)
+        self._journal = None
+        self._jbacklog = []        # (kind, fields) appends to retry
+        self._junacked_rids = set()
+        self._step_n = 0
+        self._m_recovered = None
+        if journal_dir is not None:
+            self._journal = Journal(
+                journal_dir, fsync_every=journal_fsync_every,
+                segment_max_bytes=journal_segment_max_bytes,
+                registry=reg)
+            self._m_recovered = reg.counter(
+                "fleet_journal_recovered_requests_total",
+                help="unresolved requests reinstated by router "
+                     "recovery")
         # SLO burn-rate accounting (observability.slo): evaluated once
         # per step(), gauges land in the fleet registry, alert rollup
         # cached for health() so placement/operators see burn state
@@ -263,7 +312,14 @@ class FleetRouter:
         span tree (placement wait, transport, per-replica legs with
         their queue/prefill/decode, failover/hedge annotations) lands
         in the trace store — read it back via ``trace_report(rid)`` or
-        the ``/traces`` endpoint."""
+        the ``/traces`` endpoint.
+
+        With a journal, admission is write-ahead: the ``accepted``
+        record lands durably BEFORE the rid is registered, and a disk
+        failure (``journal_io_error``) rejects the submit with
+        JournalError — the caller knows the request was never
+        accepted, instead of discovering after a crash that it was
+        never recoverable."""
         if self._closed:
             raise RuntimeError("FleetRouter is closed")
         rid = self._next_rid
@@ -272,6 +328,13 @@ class FleetRouter:
             else time.monotonic() + float(deadline_ms) / 1e3
         p = _Pending(rid, prompt, max_new_tokens, eos_token_id,
                      priority, deadline=deadline)
+        if self._journal is not None:
+            self._journal.append(
+                "accepted", rid=rid, prompt=p.prompt,
+                max_new=p.max_new, eos=p.eos, priority=p.priority,
+                deadline_epoch=None if deadline_ms is None
+                else round(time.time() + float(deadline_ms) / 1e3, 6),
+                submitted_epoch=round(time.time(), 6))
         p.trace = self._tstore.new_trace(
             name="request", proc="router", rid=rid,
             args={"prompt_len": len(p.prompt), "max_new": p.max_new,
@@ -285,8 +348,12 @@ class FleetRouter:
     def step(self):
         """One control round: harvest results, scrape health, fail
         over lost replicas, expire/place/shed/hedge, evaluate SLO
-        burn. Returns the results resolved this round. An unhandled
-        exception here is a flight-recorder trigger
+        burn. Returns the results resolved this round — a PREVIEW:
+        with a journal, exactly-once delivery across a crash holds
+        only for results consumed via results()/run_to_completion()
+        (the retire-before-handout edge); a previewed-but-unpopped
+        result is re-delivered by a successor. An unhandled exception
+        here is a flight-recorder trigger
         (flight_fleet_router_exception.json) — the postmortem carries
         the fleet registry and recent fleet events."""
         if self._closed:
@@ -301,6 +368,28 @@ class FleetRouter:
             raise
 
     def _step_impl(self):
+        self._step_n += 1
+        if faults.pull("router_crash", self._step_n) is not None:
+            raise RouterCrash(
+                f"injected router_crash (control round {self._step_n})")
+        # preemption (SIGTERM grace window): the replicas drain
+        # themselves through the same seam — the ROUTER's job is to
+        # seal the journal so its successor finds a complete, not
+        # torn, tail. Results resolving inside the grace window keep
+        # journaling after the seal; the seal is the "tail is clean
+        # as of the notice" claim
+        if self._journal is not None and not self._journal.sealed \
+                and preemption.requested():
+            try:
+                self._flush_jbacklog()
+                self._jappend("preempt")
+                self._journal.seal()
+            except JournalCrash:
+                raise
+            except JournalError:
+                pass   # transient: sealed stays False — the next
+                #        control round retries, the drain continues
+        self._flush_jbacklog()
         before = set(self._done)
         self._collect()
         self._scrape_all()
@@ -309,6 +398,9 @@ class FleetRouter:
         self._place()
         self._shed()
         self._hedge()
+        if self._journal is not None and self._journal.needs_rotation:
+            self._journal.rotate(self._snapshot_records(),
+                                 next_rid=self._next_rid)
         self._g_queue.set(len(self._queue))
         self._g_pending.set(
             sum(1 for p in self._pending.values() if not p.done))
@@ -333,7 +425,10 @@ class FleetRouter:
 
     def run_to_completion(self, timeout_s=120.0, poll_s=0.002):
         """Drive step() until every accepted request resolves; returns
-        all results in rid order (cleared from the done buffer)."""
+        all results in rid order (cleared from the done buffer). A
+        transiently-withheld pop (results() returning [] because the
+        `retired` journal record hit a disk blip) is retried until
+        the timeout — resolved results are never silently dropped."""
         t_end = time.monotonic() + float(timeout_s)
         while any(not p.done for p in self._pending.values()):
             self.step()
@@ -346,7 +441,16 @@ class FleetRouter:
                     f"fleet did not drain within {timeout_s}s; "
                     f"unresolved rids: {stuck[:10]}")
             time.sleep(poll_s)
-        return self.results()
+        out = self.results()
+        while self._done:
+            if time.monotonic() > t_end:
+                raise RuntimeError(
+                    f"journal withheld {len(self._done)} resolved "
+                    f"results past the {timeout_s}s deadline (retired "
+                    "record not durable)")
+            time.sleep(poll_s)
+            out += self.results()
+        return out
 
     def results(self):
         """Pop resolved results, rid order. Popping also retires the
@@ -354,8 +458,29 @@ class FleetRouter:
         by its in-flight window, not its lifetime request count (rids
         never repeat, so a stray late result for a retired rid simply
         finds no pending entry and is dropped — the same dedup as
-        before, without the unbounded table)."""
+        before, without the unbounded table).
+
+        With a journal, the pop is journaled (``retired``) BEFORE the
+        results are handed over: a recovered router re-delivers only
+        results the dead incarnation never handed out — exactly-once
+        across the crash, at-most-once on this edge. A transient disk
+        failure on that append WITHHOLDS the results (returns []) —
+        they stay in the done buffer and deliver on a later call once
+        the journal accepts the retirement record; handing them over
+        un-retired would re-deliver them after a crash."""
         out = [self._done[r] for r in sorted(self._done)]
+        if out and self._journal is not None:
+            self._flush_jbacklog()
+            if self._jbacklog:
+                return []   # order: `retired` must not jump parked
+                #             records for the same rids (see _jappend)
+            try:
+                self._journal.append("retired",
+                                     rids=[r["id"] for r in out])
+            except JournalCrash:
+                raise
+            except JournalError:
+                return []
         for r in self._done:
             self._pending.pop(r, None)
         self._done = {}
@@ -384,11 +509,19 @@ class FleetRouter:
         self._last_scrape.pop(name, None)
 
     def cancel(self, rid):
-        """Cancel a fleet request wherever it currently lives."""
+        """Cancel a fleet request wherever it currently lives. The
+        intent is journaled (retried from the backlog on a transient
+        disk blip), so a router crash between accepting the cancel
+        and resolving it normally resolves the request cancelled at
+        recovery instead of spending the remaining decode budget. A
+        crash INSIDE the retry window can still lose the intent —
+        the request then resolves ``ok``, indistinguishable from a
+        cancel that lost its (inherent) race with completion."""
         p = self._pending.get(rid)
         if p is None or p.done:
             return False
         self._cancel_requested.add(rid)
+        self._jappend("cancel", rid=rid)
         if rid in self._queue:
             self._queue.remove(rid)
             self._resolve(p, list(p.delivered), "cancelled", None)
@@ -527,11 +660,40 @@ class FleetRouter:
         self._closed = True
         for rep in self.replicas.values():
             rep.kill()
+        if self._journal is not None:
+            try:
+                self._flush_jbacklog()
+            except JournalError:  # incl. JournalCrash — closing anyway
+                pass
+            self._journal.close()
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None
 
     # -- control-plane internals --------------------------------------------
+
+    def _handle_batch(self, batch, ack_fn):
+        """Process harvested results and ack the ones whose handling
+        is durable. Ack = "processed AND (when journaling) the
+        `resolved` record landed": a result whose terminal record is
+        still in the retry backlog stays retained at the replica, so
+        a crash inside the durability gap re-surfaces it to the
+        successor instead of losing it. Re-polled already-processed
+        results dedup in _handle and ack here (the retry path for a
+        lost ack)."""
+        acks = []
+        for res in batch:
+            self._handle(res)
+            rseq = res.get("_rseq")
+            if rseq is not None \
+                    and res["id"] not in self._junacked_rids:
+                acks.append(rseq)
+        if not acks:
+            return
+        try:
+            ack_fn(acks)
+        except Exception:  # noqa: BLE001 — retained results simply
+            pass           # re-poll next round; _handle dedups
 
     def _collect(self):
         for name in self.replicas:
@@ -539,8 +701,7 @@ class FleetRouter:
                 batch = self._clients[name].poll()
             except Exception:  # noqa: BLE001 — transport gave up; retry
                 continue       # next round (results stay queued)
-            for res in batch:
-                self._handle(res)
+            self._handle_batch(batch, self._clients[name].ack)
 
     def _handle(self, res):
         rid = res["id"]
@@ -549,25 +710,39 @@ class FleetRouter:
             return  # stray: hedge loser, post-rejoin flush — dedup
         src = res.get("replica")
         status = res["status"]
+        if src is not None and src not in (p.replica, p.hedge):
+            # stale leg: a rejoined replica flushing its pre-crash
+            # slot, a late result from a replica this rid was already
+            # failed over FROM, or a recovery-distrusted placement.
+            # Its token stream is relative to a prefix this router no
+            # longer tracks — folding or stitching it could corrupt
+            # the client's stream (duplicate or gap the prefix of a
+            # resubmit already running elsewhere). Drop it; the live
+            # leg resolves the rid
+            return
+        # every leg's tokens are relative to the delivered prefix it
+        # was PLACED with — anchor all folds/stitches there, never at
+        # whatever delivered has since become (a continuation leg that
+        # outlives a second failover, or a hedge racing a bounced
+        # primary, would otherwise duplicate or drop the middle)
+        base = p.leg_base.get(src, len(p.delivered))
         unsolicited_cancel = (status == "cancelled"
                               and rid not in self._cancel_requested)
         if status == "bounced" or unsolicited_cancel:
-            if src not in (p.replica, p.hedge):
-                # stale leg: a rejoined replica flushing its pre-crash
-                # slot, or a late bounce from a replica this rid was
-                # already failed over FROM. Its tokens were either
-                # harvested from the carcass at failover time or
-                # deliberately restarted from scratch — folding them
-                # in here could duplicate the prefix of a from-scratch
-                # resubmit already running elsewhere
-                return
             # drain bounce: the replica gave the request back — keep
-            # the longest token prefix seen and re-place
+            # the longest ABSOLUTE token prefix seen and re-place
             self._end_leg(p, src, "bounced",
                           tokens=len(res.get("tokens") or []))
-            toks = res.get("tokens") or []
-            if len(toks) > len(p.delivered):
-                p.delivered = list(toks)
+            cand = p.delivered[:base] \
+                + [int(t) for t in res.get("tokens") or []]
+            if len(cand) > len(p.delivered):
+                p.delivered = cand
+                # delivered-prefix watermark: the dedup boundary a
+                # continuation (or a post-crash recovery) resubmits
+                # from. Losing this record to a disk fault only costs
+                # recomputation — greedy decoding regenerates the same
+                # tokens — never correctness
+                self._jappend("delivered", rid=rid, tokens=p.delivered)
             if src == p.replica:
                 p.replica = None
             if src == p.hedge:
@@ -591,11 +766,13 @@ class FleetRouter:
             # resolves with its partial tokens
             self._cancel_requested.discard(rid)
             self._end_leg(p, src, "cancelled")
-            self._resolve(p, p.delivered + list(res.get("tokens") or []),
-                          "cancelled", src)
+            self._resolve(
+                p,
+                p.delivered[:base] + list(res.get("tokens") or []),
+                "cancelled", src)
             return
         # terminal: ok | expired — first finisher wins
-        tokens = p.delivered + list(res.get("tokens") or [])
+        tokens = p.delivered[:base] + list(res.get("tokens") or [])
         if p.hedged and p.replica is not None and p.hedge is not None:
             loser = p.hedge if src == p.replica else p.replica
             by = "primary" if src == p.replica else "hedge"
@@ -626,10 +803,31 @@ class FleetRouter:
         return False
 
     def _resolve(self, p, tokens, status, replica):
+        age = time.monotonic() - p.submitted_at
+        result = {
+            "id": p.rid, "tokens": [int(t) for t in tokens],
+            "status": status, "replica": replica,
+            "failovers": p.failovers, "hedged": p.hedged,
+            "trace_id": None if p.trace is None
+            else p.trace["trace_id"],
+            "age_s": round(age, 6)}
+        # WAL: the terminal record goes first. A JournalCrash here
+        # (torn write = process death) leaves the request UNresolved
+        # in memory and on disk — the successor re-resolves it exactly
+        # once. A transient failure parks the record in the retry
+        # backlog and blocks the replica-side ack until durable; a
+        # non-empty backlog queues this record behind it (order —
+        # see _jappend).
+        if self._journal is not None \
+                and not self._jappend("resolved", result=result):
+            # gate the ack only while THIS record is still parked (a
+            # queued-behind append may have flushed on the way)
+            if any(k == "resolved" and f["result"]["id"] == p.rid
+                   for k, f in self._jbacklog):
+                self._junacked_rids.add(p.rid)
         p.done = True
         self._cancel_requested.discard(p.rid)
         self._req_counter(status).inc()
-        age = time.monotonic() - p.submitted_at
         # a request resolving with nothing running (shed, expired in
         # the router queue, finished straight from a recovered prefix)
         # spent its tail sitting at the ROUTER — record that wait as a
@@ -649,13 +847,7 @@ class FleetRouter:
                                     "failovers": p.failovers,
                                     "hedged": p.hedged})
         self._record_slo(p, status, age)
-        self._done[p.rid] = {
-            "id": p.rid, "tokens": [int(t) for t in tokens],
-            "status": status, "replica": replica,
-            "failovers": p.failovers, "hedged": p.hedged,
-            "trace_id": None if p.trace is None
-            else p.trace["trace_id"],
-            "age_s": round(age, 6)}
+        self._done[p.rid] = result
 
     def _record_slo(self, p, status, age_s):
         """Fold one resolved request into the SLO windows: e2e
@@ -829,6 +1021,7 @@ class FleetRouter:
         except Exception:  # noqa: BLE001 — transport gave up; retry
             self._end_leg(p, target, "transport_failed")
             return False, None
+        p.leg_base[target] = len(p.delivered)
         self._tstore.add_span(
             leg, "transport_submit", t_send, proc="router",
             args={"retries": client.stats.retries - retries0})
@@ -848,6 +1041,14 @@ class FleetRouter:
                 continue
             prompt = p.prompt + [int(t) for t in p.delivered]
             remaining = p.max_new - len(p.delivered)
+            # WAL: placement journals before the transport send (with
+            # the prefix length the leg is anchored to). If the send
+            # then fails (or the router dies between the two),
+            # recovery re-places onto the journaled replica — the
+            # idempotent-by-rid submit absorbs whichever half
+            # actually happened
+            self._jappend("placed", rid=rid, replica=target,
+                          prefix=len(p.delivered))
             ok, leg = self._submit_leg(p, target, prompt, remaining)
             if not ok:
                 continue       # transport gave up; retry next round
@@ -940,6 +1141,10 @@ class FleetRouter:
                 continue
             p.hedge = target
             p.hedged = True
+            # journaled so a successor can find (and cancel) a hedge
+            # leg orphaned by a router crash instead of letting it
+            # decode to a result nobody will read
+            self._jappend("hedged", rid=rid, replica=target)
             outstanding[target] = outstanding.get(target, 0) + 1
             self._m_hedges.inc()
 
@@ -973,10 +1178,10 @@ class FleetRouter:
         the carcass, then continuation-resubmit (completed prefix
         deduped) or finish straight from the prefix."""
         try:
-            for res in rep.pop_results():
-                self._handle(res)
+            harvested = rep.pop_results()
         except Exception:  # noqa: BLE001 — best-effort harvest
-            pass
+            harvested = []
+        self._handle_batch(harvested, rep.ack)
         try:
             carcass = {e["rid"]: e for e in rep.export_inflight()}
         except Exception:  # noqa: BLE001 — carcass unreadable: resubmit
@@ -996,9 +1201,20 @@ class FleetRouter:
                 continue
             p.failovers += 1
             self._failover_counter(name, reason).inc()
+            self._jappend("failover", rid=rid, replica=name,
+                          reason=reason)
             ent = carcass.get(rid)
-            if ent and len(ent.get("tokens") or []) > len(p.delivered):
-                p.delivered = [int(t) for t in ent["tokens"]]
+            if ent:
+                # carcass tokens are relative to the prefix THIS leg
+                # was placed with (a continuation's partials must
+                # extend the old prefix, never replace it)
+                base = p.leg_base.get(name, len(p.delivered))
+                cand = p.delivered[:base] \
+                    + [int(t) for t in ent.get("tokens") or []]
+                if len(cand) > len(p.delivered):
+                    p.delivered = cand
+                    self._jappend("delivered", rid=rid,
+                                  tokens=p.delivered)
             # the lost leg stays in the tree: the continuation leg
             # that follows is causally linked to it through the shared
             # root, and the harvested prefix length is right here
@@ -1041,3 +1257,280 @@ class FleetRouter:
                 fleet_health=self.health()))
         except Exception:  # noqa: BLE001
             pass
+
+    # -- write-ahead journal + crash recovery -------------------------------
+
+    def _jappend(self, kind, **fields):
+        """Append one lifecycle record; a transient failure parks the
+        record in the retry backlog (flushed at every step) and
+        returns False. While ANY record is parked, later records
+        queue behind it — reconcile() folds per-rid records in
+        journal order, so a stale `failover` flushed after a newer
+        `placed` would otherwise erase the live placement at
+        recovery. (`accepted` bypasses this: it is always its rid's
+        FIRST record, so submit() appends directly.) JournalCrash
+        propagates — the router is dead at that write, which is the
+        point of the seam."""
+        if self._journal is None:
+            return True
+        if self._jbacklog:
+            self._jbacklog.append((kind, fields))
+            self._flush_jbacklog()
+            return not self._jbacklog
+        try:
+            self._journal.append(kind, **fields)
+            return True
+        except JournalCrash:
+            raise
+        except JournalError:
+            self._jbacklog.append((kind, fields))
+            return False
+
+    def _flush_jbacklog(self):
+        """Retry parked appends; a `resolved` record going durable
+        unblocks the replica-side ack for its result."""
+        if self._journal is None or not self._jbacklog:
+            return
+        backlog, self._jbacklog = self._jbacklog, []
+        for i, (kind, fields) in enumerate(backlog):
+            try:
+                self._journal.append(kind, **fields)
+            except JournalCrash:
+                self._jbacklog = backlog[i:] + self._jbacklog
+                raise
+            except JournalError:
+                self._jbacklog.append((kind, fields))
+                continue
+            if kind == "resolved":
+                self._junacked_rids.discard(fields["result"]["id"])
+
+    def _deadline_epoch(self, p):
+        if p.deadline is None:
+            return None
+        return round(time.time() + (p.deadline - time.monotonic()), 6)
+
+    def _snapshot_records(self):
+        """The compaction payload segment rotation writes at the head
+        of a fresh segment: every unresolved request (with its
+        delivered prefix and last placement) + every resolved-but-
+        unpopped result. Retired rids are dropped — that IS the
+        compaction."""
+        now_w, now_m = time.time(), time.monotonic()
+        recs = []
+        for rid, p in sorted(self._pending.items()):
+            if p.done:
+                continue
+            recs.append({
+                "kind": "snap_req", "rid": rid, "prompt": p.prompt,
+                "max_new": p.max_new, "eos": p.eos,
+                "priority": p.priority,
+                "deadline_epoch": self._deadline_epoch(p),
+                "submitted_epoch": round(
+                    now_w - (now_m - p.submitted_at), 6),
+                "delivered": [int(t) for t in p.delivered],
+                "replica": p.replica,
+                "placed_prefix": None if p.replica is None
+                else p.leg_base.get(p.replica, len(p.delivered)),
+                "hedge": p.hedge, "failovers": p.failovers})
+        for rid in sorted(self._done):
+            recs.append({"kind": "snap_done",
+                         "result": dict(self._done[rid])})
+        return recs
+
+    @classmethod
+    def recover(cls, journal_dir, replicas, *, rejoin_parked=True,
+                **router_kw):
+        """Bring up a successor router from a dead one's journal +
+        its still-live replicas. Returns the recovered FleetRouter
+        (journaling into the same directory, compacted).
+
+        The recovery algorithm (docs/robustness.md "Router durability
+        & recovery"):
+
+        1. **Replay** the newest finalized journal segment, dropping
+           at most a torn tail, and **reconcile** the records into
+           per-rid terminal state (journal.reconcile).
+        2. **Re-adopt** the replicas: parked carcasses (drained on
+           preemption, dead after a crash) are rejoined on the SAME
+           engine — zero recompiles — and every replica's retained
+           result plane is re-polled (results the dead router fetched
+           but never durably processed come back; the ack happened
+           only after the `resolved` record was journaled, so nothing
+           is both acked and unjournaled).
+        3. **Restore** resolved-but-unretired results straight into
+           the done buffer (delivered exactly once across the crash)
+           and reinstate every unresolved request with its journaled
+           delivered prefix.
+        4. **Reconcile placement**: an unresolved rid journaled onto
+           a live serving replica is continuation-resubmitted THERE —
+           idempotent by rid, so "still running" and "the placed
+           record outran the transport" both land right; one journaled
+           onto a dead replica keeps the assignment so the normal
+           failover path harvests the carcass; the rest re-queue. The
+           continuation prompt is ``original ‖ delivered`` with the
+           remaining budget — token-exact vs an uninterrupted router,
+           zero new compiles on the re-adopted engines.
+        5. **Compact** the journal (rotation with a snapshot head) and
+           dump a ``fleet_router_recovery`` flight record.
+
+        rejoin_parked: restart drained/crashed replica workers during
+        adoption (same engine). Pass False to adopt only what is
+        already alive."""
+        records, stats = replay(journal_dir)
+        state = reconcile(records)
+        router = cls(replicas, journal_dir=journal_dir, **router_kw)
+        router._adopt(state, stats, rejoin_parked=rejoin_parked)
+        return router
+
+    def _adopt(self, state, stats, rejoin_parked=True):
+        j = self._journal
+        if j is not None:
+            j._inc("replay_records", stats["replay_records"])
+            j._inc("torn_tail_drops", stats["torn_tail_drops"])
+        self._next_rid = max(self._next_rid, int(state["next_rid"]))
+        now_m, now_w = time.monotonic(), time.time()
+        adopted = {}
+        for name, rep in self.replicas.items():
+            if rejoin_parked and not rep.alive \
+                    and rep.engine.state != "closed":
+                try:
+                    rep.rejoin()
+                except Exception:  # noqa: BLE001 — adopt what we can;
+                    pass           # the failover path owns the rest
+            adopted[name] = {"alive": rep.alive, "state": rep.state}
+        restored_done, reinstated = [], []
+        distrusted = {}   # rid -> journaled replica to pre-cancel
+        for rid, e in sorted(state["requests"].items()):
+            if e["resolved"] is not None:
+                # resolved pre-crash, never popped: re-deliver exactly
+                # once (metrics were counted by the dead incarnation —
+                # don't double-count)
+                self._done[rid] = dict(e["resolved"])
+                restored_done.append(rid)
+                continue
+            if e["prompt"] is None:
+                continue   # orphan records (torn `accepted`): nothing
+                #            to rebuild a resubmission from
+            deadline = None
+            if e["deadline_epoch"] is not None:
+                deadline = now_m + (float(e["deadline_epoch"]) - now_w)
+            p = _Pending(rid, e["prompt"], e["max_new"], e["eos"],
+                         e["priority"], deadline=deadline)
+            if e["submitted_epoch"] is not None:
+                p.submitted_at = now_m - max(
+                    now_w - float(e["submitted_epoch"]), 0.0)
+            p.delivered = [int(t) for t in e["delivered"]]
+            p.failovers = int(e["failovers"])
+            name = e["replica"] if e["replica"] in self.replicas \
+                else None
+            pp = e.get("placed_prefix")
+            if name is not None and pp is not None \
+                    and pp != len(p.delivered):
+                # distrusted placement: the journal's delivered
+                # watermark does not match the prefix the leg was
+                # placed with (a `delivered` record lost to a disk
+                # fault, or a bounce whose clearing never journals).
+                # Any result from that leg would stitch against the
+                # wrong anchor — cancel it best-effort and recompute
+                # from the prefix we CAN prove; the stale-leg guard
+                # in _handle drops whatever it still emits
+                distrusted[rid] = name
+            elif name is not None:
+                p.replica = name
+                p.leg_base[name] = len(p.delivered) if pp is None \
+                    else int(pp)
+            p.trace = self._tstore.new_trace(
+                name="request", proc="router", rid=rid,
+                args={"prompt_len": len(p.prompt),
+                      "max_new": p.max_new, "priority": p.priority,
+                      "recovered": True, "failovers": p.failovers})
+            if p.trace is not None:
+                self._trace_ids.append(p.trace["trace_id"])
+            # a journaled cancel intent survives the crash: seed the
+            # in-memory set BEFORE the harvest so the replica's
+            # 'cancelled' result (if the pre-crash cancel reached it)
+            # resolves as the solicited cancel it is, not as a bounce
+            # that would requeue the request
+            if rid in state["cancelled"]:
+                self._cancel_requested.add(rid)
+            self._pending[rid] = p
+            reinstated.append(rid)
+        if self._m_recovered is not None and reinstated:
+            self._m_recovered.inc(len(reinstated))
+        # harvest: first heartbeats + the retained result plane. A
+        # result handled here resolves/bounces through the normal
+        # paths (journaling as it goes); one for a restored-done or
+        # retired rid finds no pending entry and dedups
+        self._scrape_all()
+        self._collect()
+        for rid, name in distrusted.items():
+            p = self._pending.get(rid)
+            if p is None or p.done:
+                continue
+            try:
+                self._clients[name].cancel(rid)
+            except Exception:  # noqa: BLE001 — its results are
+                pass           # dropped by the stale-leg guard anyway
+        re_placed, requeued = [], []
+        for rid in reinstated:
+            p = self._pending.get(rid)
+            if p is None or p.done:
+                continue
+            # a hedge leg is never re-adopted (the primary is), but a
+            # crash orphaned it mid-decode — cancel it so it stops
+            # burning a slot on a result the stale-leg guard would
+            # drop anyway
+            hedge_name = state["requests"][rid].get("hedge")
+            if hedge_name in self._clients:
+                try:
+                    self._clients[hedge_name].cancel(rid)
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+            if rid in state["cancelled"]:
+                # the client cancelled this pre-crash: resolve it
+                # cancelled with what was delivered instead of
+                # spending the remaining budget on an unwanted result
+                if p.replica in self._clients:
+                    try:
+                        self._clients[p.replica].cancel(rid)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+                if rid in self._queue:
+                    self._queue.remove(rid)
+                p.replica = None
+                self._resolve(p, list(p.delivered), "cancelled", None)
+                continue
+            if rid in self._queue:
+                continue
+            if self._finish_from_prefix(p):
+                continue
+            name = p.replica
+            rep = self.replicas.get(name) if name is not None else None
+            if rep is not None and not rep.alive:
+                continue  # carcass: step()'s failover path harvests it
+            if rep is not None and rep.alive and rep.state == "serving":
+                prompt = p.prompt + [int(t) for t in p.delivered]
+                remaining = p.max_new - len(p.delivered)
+                self._jappend("placed", rid=rid, replica=name,
+                              prefix=len(p.delivered))
+                ok, _leg = self._submit_leg(p, name, prompt, remaining)
+                if ok:
+                    p.placed_at = time.monotonic()
+                    self._routed_counter(name).inc()
+                    re_placed.append(rid)
+                    continue
+            p.replica = None
+            p.queue_since_pc = dtrace.now()
+            self._queue.append(rid)
+            requeued.append(rid)
+        if j is not None:
+            j.rotate(self._snapshot_records(), next_rid=self._next_rid)
+        self._flight_dump("fleet_router_recovery", {
+            "journal_dir": None if j is None else j.dir,
+            "replay": dict(stats),
+            "restored_done": restored_done,
+            "reinstated": reinstated, "re_placed": re_placed,
+            "requeued": requeued, "retired_rids": len(state["retired"]),
+            "sealed": bool(state["sealed"]),
+            "preempted": bool(state["preempted"]),
+            "replicas_adopted": adopted})

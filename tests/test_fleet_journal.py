@@ -1,0 +1,344 @@
+"""Write-ahead journal units (paddle_tpu/serving_fleet/journal.py).
+
+Pure host-side — no models, no jax arrays — so the whole disk-fault
+surface drills in milliseconds:
+
+- record framing: length-prefix + crc32, compact JSON payload;
+- segment rotation: atomic write-then-rename + COMPLETE-marker
+  (the shared io/atomic discipline), compaction drops old segments;
+- torn-tail-tolerant replay: the FUZZ satellite truncates the journal
+  at EVERY byte offset of the final record and asserts replay never
+  crashes, never resurrects a duplicate, and drops at most the tail;
+- reconcile(): per-rid lifecycle folding (accepted → placed →
+  delivered → resolved → retired, failovers, snapshots);
+- the three disk-fault seams (journal_torn_write / journal_io_error /
+  journal_slow_fsync) and their metrics.
+"""
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from paddle_tpu.io import atomic
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving_fleet.journal import (
+    Journal, JournalCrash, JournalError, reconcile, replay)
+
+
+def _mk(tmp_path, name="j", **kw):
+    return Journal(os.path.join(tmp_path, name), **kw)
+
+
+def _lifecycle(j, rid=0, tokens=(7, 8, 9)):
+    j.append("accepted", rid=rid, prompt=[1, 2, 3], max_new=5,
+             eos=None, priority=0, deadline_epoch=None,
+             submitted_epoch=round(time.time(), 6))
+    j.append("placed", rid=rid, replica="r0")
+    j.append("delivered", rid=rid, tokens=list(tokens[:2]))
+    j.append("resolved", result={"id": rid, "tokens": list(tokens),
+                                 "status": "ok", "replica": "r0",
+                                 "failovers": 0, "hedged": False})
+
+
+class TestJournalCore:
+    def test_append_replay_roundtrip(self, tmp_path):
+        j = _mk(tmp_path)
+        _lifecycle(j, rid=0)
+        j.append("retired", rids=[0])
+        recs, stats = replay(j.dir)
+        assert stats["torn_tail_drops"] == 0
+        kinds = [r["kind"] for r in recs]
+        assert kinds == ["header", "accepted", "placed", "delivered",
+                         "resolved", "retired"]
+        st = reconcile(recs)
+        assert st["retired"] == {0}
+        assert st["requests"] == {}      # retired = compacted away
+        assert st["next_rid"] == 1
+        j.close()
+
+    def test_reconcile_lifecycle_states(self, tmp_path):
+        j = _mk(tmp_path)
+        _lifecycle(j, rid=0)                      # resolved, unretired
+        j.append("accepted", rid=1, prompt=[4, 5], max_new=3, eos=2,
+                 priority=7, deadline_epoch=123.0,
+                 submitted_epoch=100.0)
+        j.append("placed", rid=1, replica="r1", prefix=0)
+        j.append("delivered", rid=1, tokens=[9])
+        j.append("failover", rid=1, replica="r1", reason="crash")
+        j.append("accepted", rid=2, prompt=[6], max_new=4, eos=None,
+                 priority=0, deadline_epoch=None, submitted_epoch=None)
+        st = reconcile(replay(j.dir)[0])
+        assert st["requests"][0]["resolved"]["tokens"] == [7, 8, 9]
+        e1 = st["requests"][1]
+        assert e1["resolved"] is None
+        assert e1["replica"] is None         # failover cleared it
+        assert e1["placed_prefix"] is None   # ...and its anchor
+        assert e1["failovers"] == 1
+        assert e1["delivered"] == [9]
+        assert e1["priority"] == 7 and e1["eos"] == 2
+        assert e1["deadline_epoch"] == 123.0
+        e2 = st["requests"][2]
+        assert e2["replica"] is None and e2["delivered"] == []
+        assert st["next_rid"] == 3
+        j.close()
+
+    def test_resolved_after_retired_never_resurrects(self, tmp_path):
+        """A backlog-flushed `resolved` record can land AFTER the
+        rid's `retired` record in the segment — replay must not
+        resurrect the rid (its result was already handed out;
+        restoring it would deliver it twice across a crash)."""
+        j = _mk(tmp_path)
+        _lifecycle(j, rid=0)
+        j.append("retired", rids=[0])
+        j.append("resolved", result={"id": 0, "tokens": [7, 8, 9],
+                                     "status": "ok", "replica": "r0",
+                                     "failovers": 0, "hedged": False})
+        j.append("placed", rid=0, replica="r1", prefix=0)
+        st = reconcile(replay(j.dir)[0])
+        assert st["retired"] == {0}
+        assert st["requests"] == {}, \
+            "retired rids must stay retired, whatever replays later"
+        j.close()
+
+    def test_delivered_keeps_longest_prefix(self, tmp_path):
+        j = _mk(tmp_path)
+        j.append("accepted", rid=0, prompt=[1], max_new=8, eos=None,
+                 priority=0, deadline_epoch=None, submitted_epoch=None)
+        j.append("delivered", rid=0, tokens=[5, 6, 7])
+        j.append("delivered", rid=0, tokens=[5])   # stale, shorter
+        st = reconcile(replay(j.dir)[0])
+        assert st["requests"][0]["delivered"] == [5, 6, 7]
+        j.close()
+
+    def test_rotation_compacts_and_is_marked(self, tmp_path):
+        j = _mk(tmp_path)
+        _lifecycle(j, rid=0)
+        j.append("retired", rids=[0])
+        j.append("accepted", rid=1, prompt=[4], max_new=2, eos=None,
+                 priority=0, deadline_epoch=None, submitted_epoch=None)
+        snap = [{"kind": "snap_req", "rid": 1, "prompt": [4],
+                 "max_new": 2, "eos": None, "priority": 0,
+                 "deadline_epoch": None, "submitted_epoch": None,
+                 "delivered": [], "replica": None, "failovers": 0}]
+        j.rotate(snap, next_rid=2)
+        names = sorted(os.listdir(j.dir))
+        assert names == ["wal-000002.jsonl", "wal-000002.jsonl.complete"]
+        assert atomic.has_marker(j.active_path)
+        marker = json.load(open(atomic.marker_path(j.active_path)))
+        assert marker["segment"] == 2 and marker["records"] == 1
+        # appends continue into the rotated segment; replay sees
+        # snapshot + tail, old rids only via next_rid
+        j.append("placed", rid=1, replica="r1")
+        st = reconcile(replay(j.dir)[0])
+        assert sorted(st["requests"]) == [1]
+        assert st["requests"][1]["replica"] == "r1"
+        assert st["next_rid"] == 2
+        j.close()
+
+    def test_needs_rotation_threshold(self, tmp_path):
+        j = _mk(tmp_path, segment_max_bytes=256)
+        assert not j.needs_rotation
+        for i in range(8):
+            j.append("accepted", rid=i, prompt=[1] * 8, max_new=4,
+                     eos=None, priority=0, deadline_epoch=None,
+                     submitted_epoch=None)
+        assert j.needs_rotation
+        j.rotate([], next_rid=8)
+        assert not j.needs_rotation
+        j.close()
+
+    def test_seal_marks_clean_shutdown(self, tmp_path):
+        j = _mk(tmp_path, fsync_every=64)   # leave an unsynced tail
+        _lifecycle(j, rid=0)
+        assert not replay(j.dir)[1]["sealed"]
+        j.seal()
+        j.seal()   # idempotent
+        recs, stats = replay(j.dir)
+        assert stats["sealed"] and reconcile(recs)["sealed"]
+        # appends inside the grace window stay legal after the seal
+        j.append("retired", rids=[0])
+        assert reconcile(replay(j.dir)[0])["retired"] == {0}
+        j.close()
+
+    def test_replay_empty_and_missing_dir(self, tmp_path):
+        recs, stats = replay(os.path.join(tmp_path, "nope"))
+        assert recs == [] and stats["replay_records"] == 0
+        j = _mk(tmp_path)          # header only
+        recs, stats = replay(j.dir)
+        assert [r["kind"] for r in recs] == ["header"]
+        assert reconcile(recs)["requests"] == {}
+        j.close()
+
+
+class TestJournalFaultSeams:
+    def test_torn_write_tears_record_and_kills_journal(self, tmp_path):
+        reg = MetricsRegistry()
+        j = _mk(tmp_path, registry=reg)
+        with faults.scenario(("journal_torn_write", {"step": 3})):
+            _lifecycle_gen = [
+                lambda: j.append("accepted", rid=0, prompt=[1],
+                                 max_new=2, eos=None, priority=0,
+                                 deadline_epoch=None,
+                                 submitted_epoch=None),
+                lambda: j.append("placed", rid=0, replica="r0"),
+            ]
+            for fn in _lifecycle_gen:
+                fn()
+            with pytest.raises(JournalCrash):
+                j.append("delivered", rid=0, tokens=[5])
+            # the journal is dead — every later write refuses, exactly
+            # like the process that died mid-append
+            with pytest.raises(JournalCrash):
+                j.append("retired", rids=[0])
+        recs, stats = replay(j.dir)
+        assert stats["torn_tail_drops"] == 1
+        assert [r["kind"] for r in recs] == ["header", "accepted",
+                                             "placed"]
+        st = reconcile(recs)
+        assert st["requests"][0]["delivered"] == []   # torn record gone
+        j.close()
+
+    def test_reopen_over_torn_tail_repairs_newline(self, tmp_path):
+        """A successor journal opened over a torn segment must
+        terminate the torn line before appending — otherwise its
+        first record concatenates onto the torn bytes and is silently
+        unreplayable (an acked-but-unjournaled hole if the successor
+        dies again before compacting)."""
+        j = _mk(tmp_path)
+        with faults.scenario(("journal_torn_write", {"step": 2})):
+            j.append("accepted", rid=0, prompt=[1], max_new=4,
+                     eos=None, priority=0, deadline_epoch=None,
+                     submitted_epoch=None)
+            with pytest.raises(JournalCrash):
+                j.append("placed", rid=0, replica="r0")
+        j2 = Journal(j.dir)          # the successor incarnation
+        j2.append("placed", rid=0, replica="r1")
+        recs, stats = replay(j.dir)
+        assert stats["torn_tail_drops"] == 1
+        assert [r.get("replica") for r in recs
+                if r["kind"] == "placed"] == ["r1"], \
+            "the post-repair record must replay"
+        assert reconcile(recs)["requests"][0]["replica"] == "r1"
+        j2.close()
+
+    def test_io_error_raises_with_nothing_written(self, tmp_path):
+        reg = MetricsRegistry()
+        j = _mk(tmp_path, registry=reg)
+        with faults.scenario(("journal_io_error", {"step": 2})):
+            j.append("accepted", rid=0, prompt=[1], max_new=2,
+                     eos=None, priority=0, deadline_epoch=None,
+                     submitted_epoch=None)
+            with pytest.raises(JournalError):
+                j.append("placed", rid=0, replica="r0")
+            j.append("placed", rid=0, replica="r1")  # disk recovered
+        recs, _ = replay(j.dir)
+        assert [r.get("replica") for r in recs
+                if r["kind"] == "placed"] == ["r1"]
+        assert reg.get("fleet_journal_errors_total").value == 1
+        # the failed append is NOT counted — nothing was written
+        assert reg.get("fleet_journal_appends_total").value == 2
+        j.close()
+
+    def test_slow_fsync_stalls_never_corrupts(self, tmp_path):
+        j = _mk(tmp_path)
+        with faults.scenario(("journal_slow_fsync",
+                              {"seconds": 0.05})):
+            t0 = time.monotonic()
+            j.append("accepted", rid=0, prompt=[1], max_new=2,
+                     eos=None, priority=0, deadline_epoch=None,
+                     submitted_epoch=None)
+            assert time.monotonic() - t0 >= 0.05
+        recs, stats = replay(j.dir)
+        assert stats["torn_tail_drops"] == 0
+        assert recs[-1]["kind"] == "accepted"
+        j.close()
+
+    def test_metrics_catalogue(self, tmp_path):
+        reg = MetricsRegistry()
+        j = _mk(tmp_path, registry=reg)
+        _lifecycle(j, rid=0)
+        j.rotate([], next_rid=1)
+        for name in ("appends", "bytes", "fsyncs", "rotations"):
+            c = reg.get(f"fleet_journal_{name}_total")
+            assert c is not None and c.value > 0, name
+        for name in ("errors", "replay_records", "torn_tail_drops"):
+            assert reg.get(f"fleet_journal_{name}_total") is not None
+        j.close()
+
+
+class TestTornTailFuzz:
+    """Satellite: truncate the journal at EVERY byte offset of the
+    final record; recovery must never crash, never duplicate a
+    result, and drop at most the torn tail."""
+
+    def _build(self, tmp_path):
+        j = _mk(tmp_path, name="fuzz")
+        _lifecycle(j, rid=0)                       # resolved
+        j.append("accepted", rid=1, prompt=[4, 5], max_new=6,
+                 eos=None, priority=1, deadline_epoch=None,
+                 submitted_epoch=None)
+        j.append("placed", rid=1, replica="r1")
+        j.append("delivered", rid=1, tokens=[8, 9])
+        # the FINAL record: a second resolution — the fuzz tears it
+        # at every byte, which must never resurrect rid 0's result or
+        # invent a partial rid-1 result
+        j.append("resolved", result={"id": 1, "tokens": [8, 9, 10],
+                                     "status": "ok", "replica": "r1",
+                                     "failovers": 0, "hedged": False})
+        j.close()
+        return j.dir
+
+    def test_truncate_every_byte_of_final_record(self, tmp_path):
+        src = self._build(tmp_path)
+        seg = os.path.join(src, "wal-000001.jsonl")
+        data = open(seg, "rb").read()
+        # strip the final frame; keep its byte count for the sweep
+        body = data[:-1].rsplit(b"\n", 1)[0] + b"\n"
+        final_len = len(data) - len(body)
+        assert final_len > 20
+        full = reconcile(replay(src)[0])
+        assert full["requests"][1]["resolved"] is not None
+        work = os.path.join(tmp_path, "cut")
+        for cut in range(final_len + 1):
+            shutil.rmtree(work, ignore_errors=True)
+            shutil.copytree(src, work)
+            with open(os.path.join(work, "wal-000001.jsonl"),
+                      "r+b") as f:
+                f.truncate(len(body) + cut)
+            recs, stats = replay(work)       # never crashes
+            st = reconcile(recs)
+            # at most the torn tail is dropped — every earlier record
+            # survives intact
+            assert stats["torn_tail_drops"] <= 1, cut
+            assert stats["replay_records"] >= 8, cut
+            assert st["requests"][0]["resolved"]["tokens"] \
+                == [7, 8, 9], cut
+            e1 = st["requests"][1]
+            assert e1["delivered"] == [8, 9], cut
+            # the torn final record either fully survives (cut at the
+            # very end) or is fully dropped — never a partial result,
+            # never a duplicate
+            if e1["resolved"] is not None:
+                assert e1["resolved"] == \
+                    full["requests"][1]["resolved"], cut
+            else:
+                # tail dropped: the request stays unresolved with its
+                # journaled placement — recovery resubmits it
+                assert e1["replica"] == "r1", cut
+            # recovery state is a per-rid map by construction: no rid
+            # can resolve twice out of a reconcile
+            assert sorted(st["requests"]) == [0, 1], cut
+
+    def test_mid_file_garbage_resyncs_at_newline(self, tmp_path):
+        src = self._build(tmp_path)
+        seg = os.path.join(src, "wal-000001.jsonl")
+        lines = open(seg, "rb").read().split(b"\n")
+        lines[2] = lines[2][: len(lines[2]) // 2]   # corrupt ONE line
+        open(seg, "wb").write(b"\n".join(lines))
+        recs, stats = replay(src)
+        assert stats["torn_tail_drops"] == 1
+        # every other record still parses — replay resynced
+        assert stats["replay_records"] == 8

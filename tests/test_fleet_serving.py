@@ -506,7 +506,10 @@ class TestFleetChaos:
 
     def test_idempotent_submit_dedup(self, gpt_model, wave):
         """Double-delivered submit commands (the ack-lost retry case)
-        produce exactly one engine request and one result."""
+        produce exactly one engine request and one result. The result
+        plane is at-least-once: the single result is RE-returned by
+        every poll until acked (so a crashed router's successor can
+        re-harvest it), then retired for good."""
         prompts, refs = wave
         eng = _engine(gpt_model)
         _warm(eng)
@@ -523,8 +526,14 @@ class TestFleetChaos:
                 time.sleep(0.005)
             time.sleep(0.05)
             got.extend(rep.pop_results())
-            assert len(got) == 1, got
+            # ONE distinct engine result, however many times polled
+            assert len({r["_rseq"] for r in got}) == 1, got
+            assert {r["id"] for r in got} == {0}
             assert got[0]["tokens"] == refs[0]
+            # ack retires it; later polls are empty
+            rep.ack([got[0]["_rseq"]])
+            rep.ack([got[0]["_rseq"]])  # idempotent
+            assert rep.pop_results() == []
         finally:
             rep.kill()
             eng.close()

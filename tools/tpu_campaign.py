@@ -129,19 +129,39 @@ STAGES = [
     # writes the same telemetry.jsonl/metrics.json shape bench stages do
     ("telemetry_smoke", [PY, "tools/telemetry_smoke.py"], 1200,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
-    # fleet chaos drill (ISSUE 6 + 8, CPU): in-process serving
+    # fleet chaos drill (ISSUE 6 + 8 + 9, CPU): in-process serving
     # replicas under a seeded fault wave (replica crash/wedge/slow,
-    # flaky transport, drain/rejoin, hedging, shed storms) — asserts
-    # 100% request completion with token-exact failover dedup, one
-    # causally-linked trace tree per request with attribution within
-    # tolerance, SLO burn-rate alerting, and 0 unexpected retraces
-    # fleet-wide. The stage exports a merged fleet metrics.json that
-    # the fleet canary gate below diffs against the committed golden.
+    # flaky transport, drain/rejoin, hedging, shed storms, router
+    # crash + journal disk faults) — asserts 100% request completion
+    # with token-exact failover dedup, one causally-linked trace tree
+    # per request with attribution within tolerance, SLO burn-rate
+    # alerting, exactly-once delivery across router crashes, and 0
+    # unexpected retraces fleet-wide. The stage exports a merged fleet
+    # metrics.json that the fleet canary gate below diffs against the
+    # committed golden (which therefore also covers the
+    # fleet_journal_* recovery counters).
     ("fleet_chaos_smoke", [PY, "-m", "pytest",
                            "tests/test_fleet_serving.py",
-                           "tests/test_fleet_tracing.py", "-q", "-m",
+                           "tests/test_fleet_tracing.py",
+                           "tests/test_fleet_recovery.py", "-q", "-m",
                            "chaos", "-p", "no:cacheprovider", "-p",
-                           "no:randomly"], 1800,
+                           "no:randomly"], 2400,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
+    # router durability drill in isolation (ISSUE 9, CPU): seeded
+    # kill-router-mid-wave (crash seam, SIGTERM preemption, torn
+    # journal writes, transient disk errors), recover against the
+    # same live replicas, assert token-exact + exactly-once + frozen
+    # compile counts + a parseable fleet_router_recovery flight dump.
+    # DELIBERATELY duplicates the recovery slice inside
+    # fleet_chaos_smoke (~4 CPU-minutes): the chaos stage must
+    # include these tests so the canary golden covers the
+    # fleet_journal_* counters, while this stage gives the durability
+    # path its own pass/fail line + flight-dump validation
+    # (validate_stages.FLIGHT_STAGES) for fast triage.
+    ("fleet_recovery_smoke", [PY, "-m", "pytest",
+                              "tests/test_fleet_recovery.py", "-q",
+                              "-m", "chaos", "-p", "no:cacheprovider",
+                              "-p", "no:randomly"], 1800,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
     ("bench_full", [PY, "bench.py"], 7200, {}),
     ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
@@ -296,6 +316,11 @@ FLEET_CANARY_FAIL_ON = (
     "fleet_failovers_total>200%",
     "fleet_shed_total>200%",
     "fleet_placement_wait_seconds:p99>400%",
+    # router-durability counters (ISSUE 9): a journal-error or
+    # recovery STORM beyond the seeded drills' deterministic counts
+    # is a durability regression, not jitter
+    "fleet_journal_errors_total>200%",
+    "fleet_journal_recovered_requests_total>400%",
 )
 
 

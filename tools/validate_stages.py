@@ -38,6 +38,8 @@ REQUIRED_STAGES = {
     "telemetry_smoke",
     # fleet failover/drain/hedge/shed chaos drill (CPU-only — ISSUE 6)
     "fleet_chaos_smoke",
+    # router write-ahead-journal durability drill (CPU-only — ISSUE 9)
+    "fleet_recovery_smoke",
 }
 
 
@@ -49,7 +51,8 @@ def _emits_metrics(cmd):
     other bare tools (decode_probe, fusion_audit) do not."""
     return any(os.path.basename(str(a)) in ("bench.py",
                                             "telemetry_smoke.py",
-                                            "test_fleet_serving.py")
+                                            "test_fleet_serving.py",
+                                            "test_fleet_recovery.py")
                for a in cmd)
 
 
@@ -96,11 +99,13 @@ def check_completed_stage_metrics():
     return problems, checked
 
 
-# chaos-family stages: each drives at least one guard rollback, so a
-# completed run must have left parseable flight-recorder dump(s) in
-# its telemetry dir (the dumps land there because the campaign exports
-# BENCH_TELEMETRY_DIR per stage — flightrec's dump-dir fallback)
-FLIGHT_STAGES = {"chaos_smoke", "telemetry_smoke"}
+# chaos-family stages: each drives at least one flight-recorder
+# trigger (guard rollback, router crash/recovery), so a completed run
+# must have left parseable flight dump(s) in its telemetry dir (the
+# dumps land there because the campaign exports BENCH_TELEMETRY_DIR
+# per stage — flightrec's dump-dir fallback)
+FLIGHT_STAGES = {"chaos_smoke", "telemetry_smoke",
+                 "fleet_recovery_smoke"}
 
 
 def check_flight_dumps():
