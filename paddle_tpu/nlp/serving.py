@@ -351,6 +351,10 @@ class ServingEngine:
         self._trace_counts = self.tracer._counts
         self._decode_fn = self._build_decode_fn()
         self._prefill_fns = {}
+        # warm-boot bookkeeping (warmup()): which prefill buckets and
+        # whether the decode program were pre-traced at boot
+        self._warmed_buckets = set()
+        self._warmed_decode = False
         # decode-dispatch accounting: batched-decode throughput is THE
         # serving metric (wall time also pays per-request prefill,
         # which is batch-1 by construction); bench.py --serve reads
@@ -630,6 +634,93 @@ class ServingEngine:
                                    f"{max_rounds} rounds")
         return results
 
+    def _bucket_for(self, n):
+        """The pow2, whole-page prefill bucket a prompt of length `n`
+        lands in (the _admit_one formula, shared with warmup)."""
+        ps = self.page_size
+        bucket = min(max(_next_pow2(int(n)), ps), self.max_seq_len)
+        return min(-(-bucket // ps) * ps, self.max_seq_len)
+
+    def warmup(self, buckets=(), decode=True):
+        """Pre-trace the serving programs BEFORE traffic: one prefill
+        program per bucket plus the batched decode scan, driven with
+        synthetic inputs whose shapes/dtypes are exactly what real
+        admission passes — so the first real wave of those buckets
+        compiles NOTHING. The traces count once, here, in the boot
+        compile budget (`compile_counts()` shows them like any other
+        trace); this is also the fix for the first-request TTFT cliff
+        in single-replica serving (the first admission used to pay the
+        prefill compile inside a request's latency), and the warm-boot
+        contract a respawned fleet replica re-enters rotation under
+        (serving-ready, frozen counts — docs/robustness.md "Process
+        supervision").
+
+        buckets: prompt lengths OR bucket sizes — each is normalized
+            through the same pow2/whole-page formula admission uses,
+            then traced once (already-warm buckets are skipped).
+        decode: also trace the batched decode program (default True).
+
+        Writes land exclusively in the reserved trash page (the
+        synthetic page tables point every page there) and the sampling
+        RNG state is NOT advanced, so a warmed engine generates
+        token-for-token what an unwarmed one would. Requires an idle
+        engine (warmup is a boot step, not a mid-traffic one).
+        Returns the sorted list of buckets warmed by THIS call."""
+        if self._state == "closed":
+            raise RuntimeError("ServingEngine is closed")
+        if not self.idle:
+            raise RuntimeError("warmup() needs an idle engine — it is "
+                               "a boot step, not a mid-traffic one")
+        warmed = []
+        for n in sorted({self._bucket_for(n) for n in buckets}):
+            if n in self._warmed_buckets:
+                continue
+            fn = self._prefill_fn(n)
+            ids = np.full((1, n), self.pad_token_id, np.int32)
+            pages_vec = np.full((n // self.page_size,), TRASH_PAGE,
+                                np.int32)
+            _tok, new_pages, _rng = fn(
+                self._params, self._buffers, self._pages,
+                jnp.asarray(ids), jnp.int32(1), jnp.asarray(pages_vec),
+                self._rng)
+            # the pool was donated to the program — adopt the returned
+            # buffers (contents untouched outside the trash page);
+            # _rng is deliberately dropped (see docstring)
+            self._pages = new_pages
+            self._warmed_buckets.add(n)
+            warmed.append(n)
+        if decode and not self._warmed_decode:
+            b = self.max_slots
+            sched = (np.full((b, self.max_pages_per_seq), TRASH_PAGE,
+                             np.int32),
+                     np.zeros((b,), np.int32),      # seq_lens
+                     np.zeros((b,), np.int32),      # last_tokens
+                     np.zeros((b,), bool),          # active: none
+                     np.ones((b,), bool),           # done: all
+                     np.zeros((b,), np.int32),      # emitted
+                     np.ones((b,), np.int32),       # max_new
+                     np.full((b,), -1, np.int32))   # eos
+            out = self._decode_fn(self._params, self._buffers,
+                                  self._pages,
+                                  *(jnp.asarray(a) for a in sched),
+                                  self._rng)
+            self._pages = out[1]
+            self._warmed_decode = True
+        from ..observability import flightrec
+        flightrec.note("serve_warmup", buckets=warmed,
+                       decode=self._warmed_decode)
+        return warmed
+
+    @property
+    def warmed(self):
+        """True once the batched decode program has been traced — by
+        warmup() or by real traffic (a rejoined engine that already
+        served is warm: its compiled programs carried over). The
+        supervisor's boot gate reads this off the heartbeat;
+        per-bucket detail in health()."""
+        return self._warmed_decode \
+            or bool(self._trace_counts.get("decode"))
+
     def export_inflight(self):
         """Host-side snapshot of every unfinished request: in-flight
         slots with their partial tokens (queued=False) and
@@ -756,6 +847,8 @@ class ServingEngine:
              "deadline_misses": int(self._m_deadline.value),
              "evictions": int(self._m_evictions.value),
              "status_counts": dict(self.status_counts),
+             "warmed": self.warmed,
+             "warmed_buckets": sorted(self._warmed_buckets),
              "compile_counts": self.compile_counts()}
         if self._watchdog is not None:
             h["watchdog"] = dict(self._watchdog.health(),
@@ -1057,13 +1150,14 @@ class ServingEngine:
                        args={"rid": req.rid, "slot": b})
         ps = self.page_size
         lp = len(req.prompt)
-        # pow2 bucket, rounded UP to whole pages: write_prompt_kv
+        # pow2 bucket, rounded UP to whole pages (_bucket_for — ONE
+        # formula, shared with warmup so a pre-traced bucket is
+        # exactly the one admission will ask for): write_prompt_kv
         # reshapes the bucket into page blocks, and a page_size that is
         # a multiple of 8 but not a power of two (e.g. 24) would
         # otherwise leave bucket % ps != 0. Bucket count stays bounded
         # (one per pow2 size), so the no-fresh-trace property holds.
-        bucket = min(max(_next_pow2(lp), ps), self.max_seq_len)
-        bucket = min(-(-bucket // ps) * ps, self.max_seq_len)
+        bucket = self._bucket_for(lp)
         nb = bucket // ps
         pages = [self._free_pages.pop() for _ in range(need_pages)]
         # bucket tail blocks beyond the allocation write to the trash

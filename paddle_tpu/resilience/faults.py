@@ -60,6 +60,19 @@ journal_io_error    fleet journal append — raises JournalError with
                     router retries lifecycle records, rejects submits)
 journal_slow_fsync  fleet journal fsync — host sleep of ``seconds``
                     (slow-disk drill; stalls, never corruption)
+replica_exit_at_boot  ProcReplica child boot (serving_fleet/
+                    proc_child.py, BEFORE any heavy import) — the
+                    subprocess exits nonzero immediately (payload
+                    ``exit_code``, default 7). Armed via the child's
+                    own ``PADDLE_TPU_PROC_FAULTS`` env; the seam step
+                    is the INCARNATION number, so
+                    ``replica_exit_at_boot@2x99`` kills every respawn
+                    from incarnation 2 on — the crash-loop-breaker
+                    drill
+replica_slow_boot   ProcReplica child boot — host sleep of ``seconds``
+                    before the heavy import (slow-boot-past-the-gate
+                    drill; the supervisor's boot timeout kills it).
+                    Seam step = incarnation, like exit_at_boot
 ==================  =====================================================
 
 The journal seams pass the journal's own append (or fsync) sequence

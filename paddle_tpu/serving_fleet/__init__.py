@@ -15,6 +15,16 @@ whole failure model.
                   at-least-once with explicit acks: results are
                   retained until the router durably processed them,
                   so a router crash cannot lose a finished request
+- ProcReplica:    the same verbs across a REAL process boundary
+                  (proc.py + proc_child.py): one ServingEngine per OS
+                  subprocess, length-prefixed checksummed JSONL over
+                  pipes (the journal's framing), streamed partial
+                  tokens for SIGKILL-grade failover, per-incarnation
+                  result stamping, warm-boot respawn
+- FleetSupervisor: self-healing replica lifecycle (supervisor.py):
+                  OS-level crash detection, seeded-backoff respawn,
+                  health-gated warm-boot rejoin, crash-loop circuit
+                  breaker with quarantine + cooldown
 - ReplicaClient:  idempotent-by-rid transport with seeded-jitter
                   retry (client.py)
 - Journal:        the router's write-ahead request journal
@@ -42,9 +52,12 @@ against tools/golden/fleet_chaos_metrics.json).
 """
 from .client import ReplicaClient  # noqa: F401
 from .journal import Journal, JournalCrash, JournalError  # noqa: F401
+from .proc import FrameReader, ProcReplica  # noqa: F401
 from .replica import InprocReplica, ReplicaCrash  # noqa: F401
 from .router import FleetRouter, RouterCrash  # noqa: F401
+from .supervisor import FleetSupervisor  # noqa: F401
 
-__all__ = ["FleetRouter", "InprocReplica", "Journal", "JournalCrash",
-           "JournalError", "ReplicaClient", "ReplicaCrash",
+__all__ = ["FleetRouter", "FleetSupervisor", "FrameReader",
+           "InprocReplica", "Journal", "JournalCrash", "JournalError",
+           "ProcReplica", "ReplicaClient", "ReplicaCrash",
            "RouterCrash"]

@@ -217,7 +217,8 @@ def reconcile(records):
             "prompt": None, "max_new": 0, "eos": None, "priority": 0,
             "deadline_epoch": None, "submitted_epoch": None,
             "delivered": [], "replica": None, "placed_prefix": None,
-            "hedge": None, "failovers": 0, "resolved": None})
+            "placed_incarnation": None, "hedge": None, "failovers": 0,
+            "resolved": None})
 
     for rec in records:
         kind = rec.get("kind")
@@ -241,6 +242,7 @@ def reconcile(records):
                                   for t in rec.get("delivered") or []]
                 e["replica"] = rec.get("replica")
                 e["placed_prefix"] = rec.get("placed_prefix")
+                e["placed_incarnation"] = rec.get("placed_incarnation")
                 e["hedge"] = rec.get("hedge")
                 e["failovers"] = int(rec.get("failovers", 0))
         elif kind == "placed":
@@ -248,6 +250,11 @@ def reconcile(records):
                 e = reqs[int(rec["rid"])]
                 e["replica"] = rec.get("replica")
                 e["placed_prefix"] = rec.get("prefix")
+                # which incarnation of that name holds the leg — a
+                # recovered router treats a bumped incarnation as a
+                # FRESH engine (the journaled leg died with the old
+                # one), never as "still running"
+                e["placed_incarnation"] = rec.get("incarnation")
         elif kind == "delivered":
             rid = rec.get("rid")
             if rid in reqs:
@@ -260,6 +267,7 @@ def reconcile(records):
                 reqs[int(rid)]["failovers"] += 1
                 reqs[int(rid)]["replica"] = None
                 reqs[int(rid)]["placed_prefix"] = None
+                reqs[int(rid)]["placed_incarnation"] = None
         elif kind in ("resolved", "snap_done"):
             res = rec.get("result")
             if not isinstance(res, dict) or "id" not in res:

@@ -90,7 +90,14 @@ class InprocReplica:
         self._health = {}
         self._accepted = {}     # fleet rid -> engine rid (idempotency)
         self._rid_map = {}      # engine rid -> fleet rid
+        self._rid_inc = {}      # engine rid -> incarnation at accept
         self._precancel = set()  # cancel arrived before its submit
+        # incarnation: bumped on every rejoin(). Results are stamped
+        # with the incarnation their request was ACCEPTED under, so a
+        # rejoined worker flushing a pre-crash slot emits results the
+        # router's stale-incarnation guard can reject even when the
+        # rid has legitimately been re-placed onto this same name.
+        self.incarnation = 1
         self._drain = threading.Event()
         self._stop = threading.Event()
         self._round = 0
@@ -182,6 +189,7 @@ class InprocReplica:
             raise RuntimeError(f"replica {self.name} is still running")
         if self.engine.state == "closed":
             raise RuntimeError("engine is closed — cannot rejoin")
+        self.incarnation += 1
         if self.engine.state == "draining":
             self.engine.resume()
         for ent in self.engine.export_inflight():
@@ -213,6 +221,15 @@ class InprocReplica:
             if frid is not None:
                 out.append(dict(ent, rid=frid))
         return out
+
+    def compile_counts(self):
+        """Transport-shaped compile-count rollup (ProcReplica reads
+        these off the child's heartbeats; in-process the engine is
+        right here)."""
+        return self.engine.compile_counts()
+
+    def unexpected_retraces(self):
+        return self.engine.tracer.unexpected_retraces()
 
     # -- worker thread ----------------------------------------------------
 
@@ -309,6 +326,7 @@ class InprocReplica:
                     trace=extras.get("trace"))
                 self._accepted[frid] = erid
                 self._rid_map[erid] = frid
+                self._rid_inc[erid] = self.incarnation
             elif op[0] == "cancel":
                 erid = self._accepted.get(op[1])
                 if erid is not None:
@@ -330,13 +348,16 @@ class InprocReplica:
             return  # engine-local request (warmup) — not fleet-owned
         if res.get("status") in ("ok", "expired", "cancelled"):
             self._accepted.pop(frid, None)
-        self._emit(dict(res, id=frid))
+        self._emit(dict(res, id=frid),
+                   inc=self._rid_inc.get(res["id"]))
 
-    def _emit(self, res):
+    def _emit(self, res, inc=None):
         with self._out_lock:
             self._emit_seq += 1
-            self._outbox.append(dict(res, replica=self.name,
-                                     _rseq=self._emit_seq))
+            self._outbox.append(dict(
+                res, replica=self.name,
+                incarnation=self.incarnation if inc is None else inc,
+                _rseq=self._emit_seq))
 
     def _publish(self, force=False):
         now = time.monotonic()
@@ -349,6 +370,8 @@ class InprocReplica:
         snap = {"replica": self.name, "state": self._state,
                 "engine_state": h.get("state"), "ts": now,
                 "round": self._round,
+                "incarnation": self.incarnation,
+                "warmed": bool(h.get("warmed", True)),
                 "queued": h["queued"], "running": h["running"],
                 "free_pages": h["free_pages"],
                 "total_pages": h["total_pages"],

@@ -64,6 +64,17 @@ from .journal import Journal, JournalCrash, JournalError, reconcile, \
 __all__ = ["FleetRouter", "RouterCrash"]
 
 
+def labeled_counter(registry, cache, name, help, **labels):
+    """Lazy per-label-set counter creation (one shared implementation
+    for the router and the supervisor — the PR-6 dedup, kept)."""
+    key = tuple(sorted(labels.items()))
+    c = cache.get(key)
+    if c is None:
+        c = registry.counter(name, help=help, labels=labels)
+        cache[key] = c
+    return c
+
+
 class RouterCrash(RuntimeError):
     """Injected stand-in for the router process dying mid-control-
     round (``router_crash`` fault kind). The chaos drill catches it,
@@ -79,7 +90,7 @@ class _Pending:
                  "submitted_at", "placed_at", "replica", "hedge",
                  "delivered", "failovers", "hedged", "done",
                  "deadline", "trace", "queue_since_pc", "leg_ctxs",
-                 "leg_base")
+                 "leg_base", "leg_inc")
 
     def __init__(self, rid, prompt, max_new, eos, priority,
                  deadline=None):
@@ -106,6 +117,12 @@ class _Pending:
         #                            so every fold/stitch of leg tokens
         #                            must anchor there, not at whatever
         #                            delivered has since become
+        self.leg_inc = {}          # replica name -> replica INCARNATION
+        #                            the leg was placed with: a result
+        #                            stamped with any other incarnation
+        #                            of that name is a stale leg (the
+        #                            replica respawned/rejoined since)
+        #                            and is dropped in _handle
 
 
 class FleetRouter:
@@ -168,13 +185,17 @@ class FleetRouter:
                  journal_segment_max_bytes=1 << 20):
         self.replicas = {}
         self._clients = {}
-        for i, rep in enumerate(replicas):
+        self._transport_retries = int(transport_retries)
+        self._retry_jitter = float(retry_jitter)
+        # monotonic, never reused: a client seed freed by
+        # remove_replica must not be handed to a later adoption, or
+        # two replicas' retry-jitter ladders re-synchronize
+        self._next_client_seed = 0
+        for rep in replicas:
             if rep.name in self.replicas:
                 raise ValueError(f"duplicate replica name {rep.name!r}")
             self.replicas[rep.name] = rep
-            self._clients[rep.name] = ReplicaClient(
-                rep, retries=transport_retries, jitter=retry_jitter,
-                jitter_seed=i)
+            self._clients[rep.name] = self._new_client(rep)
         if not self.replicas:
             raise ValueError("FleetRouter needs at least one replica")
         self.max_queue = int(max_queue)
@@ -264,15 +285,18 @@ class FleetRouter:
             "fleet_replicas_serving",
             help="replicas currently placeable")
 
+    def _new_client(self, rep):
+        seed = self._next_client_seed
+        self._next_client_seed += 1
+        return ReplicaClient(rep, retries=self._transport_retries,
+                             jitter=self._retry_jitter,
+                             jitter_seed=seed)
+
     # -- metric series (lazy per label) -----------------------------------
 
     def _labeled(self, cache, name, help, **labels):
-        key = tuple(sorted(labels.items()))
-        c = cache.get(key)
-        if c is None:
-            c = self.registry.counter(name, help=help, labels=labels)
-            cache[key] = c
-        return c
+        return labeled_counter(self.registry, cache, name, help,
+                               **labels)
 
     def _req_counter(self, status):
         return self._labeled(
@@ -502,9 +526,53 @@ class FleetRouter:
         self.replicas[name].drain()
 
     def rejoin(self, name):
-        """Bring a drained/failed replica back into rotation (same
-        engine — zero recompiles)."""
+        """Bring a drained/failed replica back into rotation. For an
+        in-process replica this restarts the worker on the SAME engine
+        (zero recompiles); for a process replica it is a respawn — a
+        fresh incarnation that warm-boots before accepting traffic."""
         self.replicas[name].rejoin()
+        self.reinstate(name)
+
+    def reinstate(self, name):
+        """Dynamic-membership half of a rejoin: clear the lost mark
+        and the stale scrape so the next control round can route to
+        `name` again. The FleetSupervisor calls THIS after it already
+        respawned the replica and health-gated its warm boot — the
+        router must not respawn a second time."""
+        if name not in self.replicas:
+            raise KeyError(f"unknown replica {name!r}")
+        self._lost.discard(name)
+        self._last_scrape.pop(name, None)
+
+    def adopt_replica(self, rep):
+        """Dynamic membership: add a NEW replica to the live fleet
+        (placement picks it up once its first heartbeat lands). The
+        name must be new — a respawned same-name replica keeps its
+        transport object and goes through reinstate()."""
+        if rep.name in self.replicas:
+            raise ValueError(f"replica {rep.name!r} already in the "
+                             "fleet (respawns go through reinstate)")
+        self.replicas[rep.name] = rep
+        self._clients[rep.name] = self._new_client(rep)
+
+    def remove_replica(self, name):
+        """Dynamic membership: retire a replica from the fleet. Its
+        unresolved assignments fail over first (prefix-deduped, same
+        path as a crash), so nothing is lost — but the replica must
+        already be out of service (lost, dead, drained or
+        quarantined); drain it first for a graceful exit."""
+        rep = self.replicas.get(name)
+        if rep is None:
+            raise KeyError(f"unknown replica {name!r}")
+        if rep.alive and rep.state not in ("drained",) \
+                and name not in self._lost \
+                and not getattr(rep, "quarantined", False):
+            raise RuntimeError(
+                f"replica {name!r} is still in service "
+                f"(state={rep.state}); drain it first")
+        self._recover_assignments(name, "removed", rep)
+        del self.replicas[name]
+        del self._clients[name]
         self._lost.discard(name)
         self._last_scrape.pop(name, None)
 
@@ -545,6 +613,9 @@ class FleetRouter:
             reps[name] = {
                 "alive": rep.alive, "state": rep.state,
                 "lost": name in self._lost,
+                "incarnation": getattr(rep, "incarnation", None),
+                "quarantined": bool(getattr(rep, "quarantined",
+                                            False)),
                 "scrape_age_s": (None if snap is None
                                  else round(now - snap["ts"], 6)),
                 "queued": snap.get("queued") if snap else None,
@@ -584,8 +655,15 @@ class FleetRouter:
         reps = {}
         unexpected = 0
         for name, rep in self.replicas.items():
-            reps[name] = rep.engine.compile_counts()
-            unexpected += rep.engine.tracer.unexpected_retraces()
+            # transport verbs, not engine reads: a ProcReplica's
+            # engine lives in another process — its counts arrive on
+            # the heartbeat plane
+            if hasattr(rep, "compile_counts"):
+                reps[name] = rep.compile_counts()
+                unexpected += rep.unexpected_retraces()
+            else:
+                reps[name] = rep.engine.compile_counts()
+                unexpected += rep.engine.tracer.unexpected_retraces()
         return {"replicas": reps, "unexpected_retraces": unexpected}
 
     def trace_report(self, rid):
@@ -719,6 +797,17 @@ class FleetRouter:
             # the client's stream (duplicate or gap the prefix of a
             # resubmit already running elsewhere). Drop it; the live
             # leg resolves the rid
+            return
+        inc = res.get("incarnation")
+        if src is not None and inc is not None \
+                and p.leg_inc.get(src) is not None \
+                and inc != p.leg_inc[src]:
+            # stale INCARNATION: the rid was re-placed onto the same
+            # replica NAME after a respawn/rejoin, and this result was
+            # produced by the previous incarnation's engine (a flushed
+            # pre-crash slot). Same-name placement used to let it pass
+            # the src guard above; the incarnation stamp closes that —
+            # uniformly, for every status
             return
         # every leg's tokens are relative to the delivered prefix it
         # was PLACED with — anchor all folds/stitches there, never at
@@ -907,6 +996,14 @@ class FleetRouter:
                 self._clock_offsets[name] = delay if prev is None \
                     else min(prev, delay)
 
+    def _rep_incarnation(self, name):
+        """The replica's CURRENT incarnation number (bumped on every
+        rejoin/respawn); None for transports that predate the
+        contract. Stamped into placed/hedged journal records and
+        leg_inc so the stale-incarnation guard holds across
+        respawns."""
+        return getattr(self.replicas.get(name), "incarnation", None)
+
     def _serving_candidates(self):
         out = []
         for name, rep in self.replicas.items():
@@ -1022,6 +1119,7 @@ class FleetRouter:
             self._end_leg(p, target, "transport_failed")
             return False, None
         p.leg_base[target] = len(p.delivered)
+        p.leg_inc[target] = self._rep_incarnation(target)
         self._tstore.add_span(
             leg, "transport_submit", t_send, proc="router",
             args={"retries": client.stats.retries - retries0})
@@ -1048,7 +1146,8 @@ class FleetRouter:
             # idempotent-by-rid submit absorbs whichever half
             # actually happened
             self._jappend("placed", rid=rid, replica=target,
-                          prefix=len(p.delivered))
+                          prefix=len(p.delivered),
+                          incarnation=self._rep_incarnation(target))
             ok, leg = self._submit_leg(p, target, prompt, remaining)
             if not ok:
                 continue       # transport gave up; retry next round
@@ -1144,7 +1243,8 @@ class FleetRouter:
             # journaled so a successor can find (and cancel) a hedge
             # leg orphaned by a router crash instead of letting it
             # decode to a result nobody will read
-            self._jappend("hedged", rid=rid, replica=target)
+            self._jappend("hedged", rid=rid, replica=target,
+                          incarnation=self._rep_incarnation(target))
             outstanding[target] = outstanding.get(target, 0) + 1
             self._m_hedges.inc()
 
@@ -1202,7 +1302,8 @@ class FleetRouter:
             p.failovers += 1
             self._failover_counter(name, reason).inc()
             self._jappend("failover", rid=rid, replica=name,
-                          reason=reason)
+                          reason=reason,
+                          incarnation=p.leg_inc.get(name))
             ent = carcass.get(rid)
             if ent:
                 # carcass tokens are relative to the prefix THIS leg
@@ -1331,6 +1432,8 @@ class FleetRouter:
                 "replica": p.replica,
                 "placed_prefix": None if p.replica is None
                 else p.leg_base.get(p.replica, len(p.delivered)),
+                "placed_incarnation": None if p.replica is None
+                else p.leg_inc.get(p.replica),
                 "hedge": p.hedge, "failovers": p.failovers})
         for rid in sorted(self._done):
             recs.append({"kind": "snap_done",
@@ -1439,6 +1542,11 @@ class FleetRouter:
                 p.replica = name
                 p.leg_base[name] = len(p.delivered) if pp is None \
                     else int(pp)
+                # seed the incarnation the leg was journaled with, so
+                # the harvest below accepts that incarnation's retained
+                # results and rejects any other incarnation's flushes
+                if e.get("placed_incarnation") is not None:
+                    p.leg_inc[name] = int(e["placed_incarnation"])
             p.trace = self._tstore.new_trace(
                 name="request", proc="router", rid=rid,
                 args={"prompt_len": len(p.prompt),
@@ -1506,13 +1614,33 @@ class FleetRouter:
                 continue
             name = p.replica
             rep = self.replicas.get(name) if name is not None else None
+            pi = p.leg_inc.get(name) if name is not None else None
+            cur = getattr(rep, "incarnation", None)
+            if rep is not None and pi is not None \
+                    and cur is not None and pi != cur:
+                # the journaled leg's incarnation is gone — the
+                # replica respawned/rejoined between the placement and
+                # this recovery. Same name, FRESH engine: nothing
+                # there is running this rid (its carcass died with the
+                # old incarnation), so neither "still running" nor
+                # "harvest the carcass" applies. Re-place it from the
+                # provable delivered prefix like any unplaced request;
+                # the stale-incarnation guard drops whatever the old
+                # incarnation may still flush
+                p.replica = None
+                p.leg_inc.pop(name, None)
+                p.queue_since_pc = dtrace.now()
+                self._queue.append(rid)
+                requeued.append(rid)
+                continue
             if rep is not None and not rep.alive:
                 continue  # carcass: step()'s failover path harvests it
             if rep is not None and rep.alive and rep.state == "serving":
                 prompt = p.prompt + [int(t) for t in p.delivered]
                 remaining = p.max_new - len(p.delivered)
                 self._jappend("placed", rid=rid, replica=name,
-                              prefix=len(p.delivered))
+                              prefix=len(p.delivered),
+                              incarnation=cur)
                 ok, _leg = self._submit_leg(p, name, prompt, remaining)
                 if ok:
                     p.placed_at = time.monotonic()

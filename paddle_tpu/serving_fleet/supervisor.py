@@ -1,0 +1,372 @@
+"""FleetSupervisor — the self-healing control loop over replica
+lifecycle.
+
+Production TPU serving runs one engine per isolated worker process
+with an EXTERNAL supervisor replacing dead workers (the
+Gemma-on-Cloud-TPU deployment shape, PAPERS.md). The FleetRouter
+already keeps *requests* alive through a replica death (failover with
+prefix dedup); this module keeps the *fleet* alive: it watches every
+replica's OS process status and scrape heartbeats, respawns dead ones
+on a seeded exponential backoff, gates the respawn back into rotation
+on a healthy warm-boot heartbeat, and — when a replica keeps dying —
+trips a crash-loop circuit breaker instead of respawning forever.
+
+Per-replica state machine::
+
+    serving ──death──▶ backoff ──delay──▶ booting ──healthy hb──▶ serving
+       ▲                  ▲                  │ exit / gate timeout
+       │                  └──────────────────┘        (a "down")
+       └──cooldown, trial boot── quarantined ◀──N downs in window──┘
+
+- **Crash detection** is OS-level (``rep.alive`` false + state
+  ``dead`` — a SIGKILL'd subprocess, a crashed worker thread) plus an
+  optional supervisor-side heartbeat timeout for deployments where
+  the router's wedge detector is not in the loop.
+- **Backoff** delays come from ``resilience.retry.backoff_schedule``
+  with a per-replica seed derived from ``(seed, name)`` — the whole
+  respawn schedule is a pure function of the seed (chaos tests replay
+  it bit-identically; different replicas de-synchronize).
+- **Boot gate**: a respawned replica re-enters rotation
+  (``router.reinstate``) only once a fresh-incarnation heartbeat
+  reports ``state=serving`` AND ``warmed`` — the warm-boot contract:
+  the child pre-traced its prefill buckets + decode program
+  (``ServingEngine.warmup``), so traffic after the gate runs under
+  frozen compile counts. A boot that exceeds ``boot_timeout_s`` is
+  killed and counted as a failure (the slow-boot drill).
+- **Crash-loop breaker**: ``breaker_threshold`` downs inside
+  ``breaker_window_s`` quarantine the replica — no more respawns, a
+  ``fleet_crash_loop`` flight dump, ``fleet_crash_loops_total``
+  increments, and fleet health degrades HONESTLY (the replica shows
+  ``quarantined`` in supervisor and router health instead of
+  flapping). After ``breaker_cooldown_s`` the breaker half-opens: one
+  trial boot; a failure re-trips immediately, a healthy boot re-arms.
+
+``poll()`` is designed to be driven from the same control thread as
+``FleetRouter.step()`` (the router stays single-threaded by design);
+``watch()`` wraps the common loop. Metrics land in the ROUTER's
+registry by default so one ``/metrics`` scrape carries the whole
+fleet story (catalogue in docs/observability.md).
+"""
+from __future__ import annotations
+
+import collections
+import time
+import zlib
+
+from ..resilience import preemption
+from ..resilience.retry import backoff_schedule
+
+__all__ = ["FleetSupervisor"]
+
+
+class _RepState:
+    __slots__ = ("phase", "downs", "streak", "next_attempt",
+                 "boot_started", "boot_deadline", "quarantined_at",
+                 "half_open", "last_reason")
+
+    def __init__(self):
+        self.phase = "serving"
+        self.downs = collections.deque()   # monotonic death times
+        self.streak = 0                    # consecutive failed boots
+        self.next_attempt = None
+        self.boot_started = None
+        self.boot_deadline = None
+        self.quarantined_at = None
+        self.half_open = False
+        self.last_reason = None
+
+
+class FleetSupervisor:
+    """Self-healing lifecycle manager for a router's replicas.
+
+    router: the FleetRouter whose replicas to supervise (must expose
+        ``replicas`` and ``reinstate``; the supervisor never places
+        work — request-level failover stays the router's job).
+    registry: metrics destination (default: the router's registry).
+    seed: master seed; each replica's backoff schedule derives from
+        ``crc32(f"{seed}:{name}")`` so it is deterministic per
+        (seed, name) and de-synchronized across names.
+    backoff_base_s / backoff_max_s / backoff_jitter: the respawn
+        delay ladder (``resilience.retry.backoff_schedule``).
+    boot_timeout_s: spawn → healthy-heartbeat budget; past it the
+        boot is killed and counted as a failure.
+    breaker_threshold / breaker_window_s: downs inside the window
+        that trip the crash-loop breaker.
+    breaker_cooldown_s: quarantine duration before the half-open
+        trial boot.
+    heartbeat_timeout_s: optional supervisor-side wedge detection —
+        a serving replica whose last heartbeat is older than this is
+        killed and counted as a down (None = the router's wedge
+        detector owns this, the default).
+    honor_preemption: freeze respawns while a process-level
+        preemption notice is up (the fleet is draining on purpose).
+    """
+
+    def __init__(self, router, *, registry=None, seed=0,
+                 backoff_base_s=0.05, backoff_max_s=2.0,
+                 backoff_jitter=0.5, boot_timeout_s=120.0,
+                 breaker_threshold=3, breaker_window_s=30.0,
+                 breaker_cooldown_s=60.0, heartbeat_timeout_s=None,
+                 honor_preemption=True):
+        self.router = router
+        self.seed = int(seed)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.backoff_jitter = float(backoff_jitter)
+        self.boot_timeout_s = float(boot_timeout_s)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_window_s = float(breaker_window_s)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.honor_preemption = bool(honor_preemption)
+        self._st = {name: _RepState() for name in router.replicas}
+        self.registry = registry if registry is not None \
+            else router.registry
+        reg = self.registry
+        self._m_respawn = {}
+        self._m_bootfail = {}
+        self._m_loops = {}
+        self._m_boot = reg.histogram(
+            "fleet_boot_seconds",
+            help="respawn -> healthy warm-boot heartbeat (the boot "
+                 "gate's measure)")
+        self._g_quar = reg.gauge(
+            "fleet_replicas_quarantined",
+            help="replicas parked by the crash-loop breaker")
+
+    # -- metric helpers ----------------------------------------------------
+
+    def _labeled(self, cache, name, help, **labels):
+        from .router import labeled_counter
+        return labeled_counter(self.registry, cache, name, help,
+                               **labels)
+
+    def _respawn_counter(self, replica):
+        return self._labeled(
+            self._m_respawn, "fleet_respawns_total",
+            "replicas respawned and health-gated back into rotation",
+            replica=replica)
+
+    def _bootfail_counter(self, replica, reason):
+        return self._labeled(
+            self._m_bootfail, "fleet_boot_failures_total",
+            "respawn attempts that died (exit-at-boot, gate timeout, "
+            "spawn error)", replica=replica, reason=reason)
+
+    def _loop_counter(self, replica):
+        return self._labeled(
+            self._m_loops, "fleet_crash_loops_total",
+            "crash-loop breaker trips (replica quarantined)",
+            replica=replica)
+
+    # -- deterministic backoff --------------------------------------------
+
+    def _backoff_seed(self, name):
+        return zlib.crc32(f"{self.seed}:{name}".encode()) & 0xFFFFFFFF
+
+    def backoff_delays(self, name, n):
+        """The exact delays the supervisor will wait before respawn
+        attempts 1..n of `name` — a pure function of (seed, name), so
+        a chaos run's whole respawn schedule replays bit-identically
+        and two replicas never thunder in lockstep."""
+        return backoff_schedule(int(n), base_delay=self.backoff_base_s,
+                                max_delay=self.backoff_max_s,
+                                jitter=self.backoff_jitter,
+                                jitter_seed=self._backoff_seed(name))
+
+    # -- control loop ------------------------------------------------------
+
+    def poll(self, now=None):
+        """One supervision round over every replica; drive it from
+        the router's control thread (``router.step(); sup.poll()``).
+        Returns the list of (name, event) transitions this round —
+        events: down, respawn_scheduled, boot_started, boot_failed,
+        respawned, quarantined, rearmed."""
+        now = time.monotonic() if now is None else float(now)
+        events = []
+        # replicas retired from the fleet (router.remove_replica) must
+        # not haunt the quarantined gauge / health forever
+        for name in [n for n in self._st
+                     if n not in self.router.replicas]:
+            del self._st[name]
+        frozen = self.honor_preemption and preemption.requested()
+        for name, rep in list(self.router.replicas.items()):
+            st = self._st.setdefault(name, _RepState())
+            ph = st.phase
+            if ph == "serving":
+                self._poll_serving(name, rep, st, now, events)
+            elif ph == "backoff":
+                if not frozen and st.next_attempt is not None \
+                        and now >= st.next_attempt:
+                    self._attempt_boot(name, rep, st, now, events)
+            elif ph == "booting":
+                self._poll_booting(name, rep, st, now, events)
+            elif ph == "quarantined":
+                if not frozen and st.quarantined_at is not None \
+                        and now - st.quarantined_at \
+                        >= self.breaker_cooldown_s:
+                    # half-open: one trial boot; a failure re-trips
+                    # the breaker immediately
+                    st.phase = "backoff"
+                    st.half_open = True
+                    st.downs.clear()
+                    st.next_attempt = now
+                    self._set_quarantined(rep, False)
+                    events.append((name, "rearmed"))
+        self._g_quar.set(sum(1 for s in self._st.values()
+                             if s.phase == "quarantined"))
+        return events
+
+    def watch(self, until, timeout_s=60.0, poll_s=0.005):
+        """Drive ``router.step() + poll()`` until ``until()`` is
+        truthy (or raise on timeout). The common chaos-drill loop."""
+        deadline = time.monotonic() + float(timeout_s)
+        while not until():
+            self.router.step()
+            self.poll()
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"supervisor watch timed out after {timeout_s}s")
+            time.sleep(poll_s)
+
+    # -- phase handlers ----------------------------------------------------
+
+    def _poll_serving(self, name, rep, st, now, events):
+        if not rep.alive and rep.state == "dead":
+            self._down(name, rep, st, now, "crash", events)
+            return
+        if rep.state == "drained":
+            return   # operator/preemption drain — not ours to undo
+        if self.heartbeat_timeout_s is not None and rep.alive:
+            snap = self._safe_scrape(rep)
+            if snap and now - snap.get("ts", now) \
+                    > float(self.heartbeat_timeout_s):
+                rep.kill()
+                self._down(name, rep, st, now, "wedge", events)
+
+    def _attempt_boot(self, name, rep, st, now, events):
+        try:
+            rep.rejoin()   # ProcReplica.respawn / InprocReplica.rejoin
+        except Exception:  # noqa: BLE001 — a spawn error is a down
+            self._bootfail_counter(name, "spawn_error").inc()
+            self._down(name, rep, st, now, "spawn_error", events)
+            return
+        st.phase = "booting"
+        st.boot_started = now
+        st.boot_deadline = now + self.boot_timeout_s
+        events.append((name, "boot_started"))
+
+    def _poll_booting(self, name, rep, st, now, events):
+        if not rep.alive:
+            # exit-at-boot: the child died before its hello/heartbeat
+            self._bootfail_counter(name, "exit_at_boot").inc()
+            self._down(name, rep, st, now, "exit_at_boot", events)
+            return
+        snap = self._safe_scrape(rep)
+        fresh = bool(snap) and snap.get("incarnation") in (
+            None, getattr(rep, "incarnation", None))
+        if fresh and snap.get("state") == "serving" \
+                and snap.get("warmed", True):
+            # healthy warm boot: gate it back into rotation
+            self._m_boot.observe(now - st.boot_started)
+            self._respawn_counter(name).inc()
+            self.router.reinstate(name)
+            st.phase = "serving"
+            st.streak = 0
+            st.half_open = False
+            st.boot_started = st.boot_deadline = None
+            events.append((name, "respawned"))
+            return
+        if now > st.boot_deadline:
+            # slow boot past the gate: kill it, count the failure
+            rep.kill()
+            self._bootfail_counter(name, "boot_timeout").inc()
+            self._down(name, rep, st, now, "boot_timeout", events)
+
+    def _down(self, name, rep, st, now, reason, events):
+        st.last_reason = reason
+        st.streak += 1
+        st.downs.append(now)
+        cut = now - self.breaker_window_s
+        while st.downs and st.downs[0] < cut:
+            st.downs.popleft()
+        events.append((name, "down"))
+        if st.half_open or len(st.downs) >= self.breaker_threshold:
+            self._quarantine(name, rep, st, now, reason, events)
+            return
+        delay = self.backoff_delays(name, st.streak)[st.streak - 1]
+        st.phase = "backoff"
+        st.next_attempt = now + delay
+        events.append((name, "respawn_scheduled"))
+
+    def _quarantine(self, name, rep, st, now, reason, events):
+        st.phase = "quarantined"
+        st.quarantined_at = now
+        st.half_open = False
+        st.next_attempt = None
+        self._loop_counter(name).inc()
+        self._set_quarantined(rep, True)
+        events.append((name, "quarantined"))
+        self._flight_dump(name, rep, st, reason)
+
+    @staticmethod
+    def _set_quarantined(rep, flag):
+        """Mark the replica object so router health (and operators
+        reading it) see the breaker state, not an endlessly 'lost'
+        replica."""
+        try:
+            rep.quarantined = bool(flag)
+        except Exception:  # noqa: BLE001 — health cosmetics only
+            pass
+
+    def _safe_scrape(self, rep):
+        try:
+            return rep.scrape()
+        except Exception:  # noqa: BLE001 — a failed scrape is just
+            return None    # "no news"
+
+    def _flight_dump(self, name, rep, st, reason):
+        try:
+            from ..observability import flightrec
+            flightrec.note("fleet_crash_loop", replica=name,
+                           reason=reason, streak=st.streak)
+            flightrec.dump("fleet_crash_loop", extra={
+                "replica": name, "breaker_reason": reason,
+                "downs_in_window": len(st.downs),
+                "window_s": self.breaker_window_s,
+                "streak": st.streak,
+                "incarnation": getattr(rep, "incarnation", None),
+                "supervisor": self.health()})
+        except Exception:  # noqa: BLE001 — a postmortem write must
+            pass           # not take the supervisor down
+
+    # -- introspection -----------------------------------------------------
+
+    def health(self):
+        """Per-replica supervision state — what an operator pages on
+        when the fleet is degraded: who is quarantined, who is mid-
+        backoff and for how much longer, boot failure streaks."""
+        now = time.monotonic()
+        reps = {}
+        for name, st in self._st.items():
+            rep = self.router.replicas.get(name)
+            reps[name] = {
+                "phase": st.phase,
+                "alive": None if rep is None else rep.alive,
+                "incarnation": getattr(rep, "incarnation", None),
+                "streak": st.streak,
+                "downs_in_window": len(st.downs),
+                "last_reason": st.last_reason,
+                "next_attempt_in_s": None if st.next_attempt is None
+                or st.phase != "backoff"
+                else round(max(st.next_attempt - now, 0.0), 6),
+                "quarantined_for_s": None if st.quarantined_at is None
+                or st.phase != "quarantined"
+                else round(now - st.quarantined_at, 6)}
+        return {"replicas": reps,
+                "quarantined": sorted(
+                    n for n, s in self._st.items()
+                    if s.phase == "quarantined"),
+                "breaker": {"threshold": self.breaker_threshold,
+                            "window_s": self.breaker_window_s,
+                            "cooldown_s": self.breaker_cooldown_s}}

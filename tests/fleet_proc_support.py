@@ -1,0 +1,24 @@
+"""Engine builder the ProcReplica child processes use in tests.
+
+File-loaded by ``proc_child.py`` via the spec's ``builder`` path —
+NOT a test module (no ``test_`` prefix). The builder must be
+deterministic per seed: the parent computes goldens on its own
+identically-seeded engine, and the subprocess replica must generate
+token-for-token the same streams for the chaos drills' token-exact
+assertions to mean anything.
+"""
+
+
+def build_engine(seed=0, **kw):
+    """gpt-tiny ServingEngine, seeded — the fleet chaos workhorse."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+    from paddle_tpu.nlp.serving import ServingEngine
+
+    paddle.seed(seed)
+    m = GPTForCausalLM(_resolve_config("gpt-tiny"))
+    m.eval()
+    d = dict(max_slots=2, page_size=16, max_seq_len=64,
+             steps_per_dispatch=4)
+    d.update(kw)
+    return ServingEngine(m, **d)

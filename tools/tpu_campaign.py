@@ -140,13 +140,18 @@ STAGES = [
     # metrics.json that the fleet canary gate below diffs against the
     # committed golden (which therefore also covers the
     # fleet_journal_* recovery counters).
+    # (PADDLE_TPU_RUN_SLOW=1 unmasks the slow-marked real-subprocess
+    # supervisor drills so the canary golden also covers the
+    # fleet_respawns/crash_loops/boot counters.)
     ("fleet_chaos_smoke", [PY, "-m", "pytest",
                            "tests/test_fleet_serving.py",
                            "tests/test_fleet_tracing.py",
-                           "tests/test_fleet_recovery.py", "-q", "-m",
+                           "tests/test_fleet_recovery.py",
+                           "tests/test_fleet_proc.py", "-q", "-m",
                            "chaos", "-p", "no:cacheprovider", "-p",
-                           "no:randomly"], 2400,
-     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
+                           "no:randomly"], 3600,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0",
+      "PADDLE_TPU_RUN_SLOW": "1"}),
     # router durability drill in isolation (ISSUE 9, CPU): seeded
     # kill-router-mid-wave (crash seam, SIGTERM preemption, torn
     # journal writes, transient disk errors), recover against the
@@ -163,6 +168,23 @@ STAGES = [
                               "-m", "chaos", "-p", "no:cacheprovider",
                               "-p", "no:randomly"], 1800,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
+    # process-supervision drill in isolation (ISSUE 10, CPU): REAL
+    # subprocess replicas — kill -9 mid-decode → router failover +
+    # supervisor respawn + warm-boot health-gated rejoin (token-exact,
+    # zero steady-state recompiles), a persistent exit-at-boot seed
+    # tripping the crash-loop breaker (quarantine + flight dump),
+    # SIGTERM child drain, slow-boot gate kills. DELIBERATELY overlaps
+    # the proc slice inside fleet_chaos_smoke (golden/canary coverage
+    # vs fast triage — the same split fleet_recovery_smoke uses), and
+    # its own pass/fail line validates flight dumps
+    # (validate_stages.FLIGHT_STAGES).
+    ("fleet_supervisor_smoke", [PY, "-m", "pytest",
+                                "tests/test_fleet_proc.py", "-q",
+                                "-m", "chaos", "-p",
+                                "no:cacheprovider", "-p",
+                                "no:randomly"], 2400,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0",
+      "PADDLE_TPU_RUN_SLOW": "1"}),
     ("bench_full", [PY, "bench.py"], 7200, {}),
     ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
      2400, {}),
@@ -321,6 +343,12 @@ FLEET_CANARY_FAIL_ON = (
     # is a durability regression, not jitter
     "fleet_journal_errors_total>200%",
     "fleet_journal_recovered_requests_total>400%",
+    # process-supervision counters (ISSUE 10): respawns beyond the
+    # seeded drills' deterministic count = a flapping fleet; ANY
+    # crash-loop breaker trip beyond the golden's deliberate one is a
+    # self-healing regression (>0% = any increase)
+    "fleet_respawns_total>200%",
+    "fleet_crash_loops_total>0%",
 )
 
 

@@ -40,6 +40,9 @@ REQUIRED_STAGES = {
     "fleet_chaos_smoke",
     # router write-ahead-journal durability drill (CPU-only — ISSUE 9)
     "fleet_recovery_smoke",
+    # process-isolated replicas + self-healing supervisor drill
+    # (CPU-only, real subprocesses — ISSUE 10)
+    "fleet_supervisor_smoke",
 }
 
 
@@ -52,7 +55,8 @@ def _emits_metrics(cmd):
     return any(os.path.basename(str(a)) in ("bench.py",
                                             "telemetry_smoke.py",
                                             "test_fleet_serving.py",
-                                            "test_fleet_recovery.py")
+                                            "test_fleet_recovery.py",
+                                            "test_fleet_proc.py")
                for a in cmd)
 
 
@@ -105,7 +109,7 @@ def check_completed_stage_metrics():
 # dumps land there because the campaign exports BENCH_TELEMETRY_DIR
 # per stage — flightrec's dump-dir fallback)
 FLIGHT_STAGES = {"chaos_smoke", "telemetry_smoke",
-                 "fleet_recovery_smoke"}
+                 "fleet_recovery_smoke", "fleet_supervisor_smoke"}
 
 
 def check_flight_dumps():
