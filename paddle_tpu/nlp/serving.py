@@ -77,10 +77,10 @@ class ServeRequest:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "deadline", "priority", "submitted_at", "submitted_pc",
-                 "trace", "admitted_pc")
+                 "trace", "admitted_pc", "tenant", "queue_wait_s")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
-                 deadline=None, priority=0, trace=None):
+                 deadline=None, priority=0, trace=None, tenant=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -94,6 +94,10 @@ class ServeRequest:
         # None for untraced (non-fleet) requests — zero overhead then
         self.trace = trace
         self.admitted_pc = None
+        # tenancy label (observability.tenancy): None = untagged, no
+        # accounting; set at admission so finish sees the real wait
+        self.tenant = None if tenant is None else str(tenant)
+        self.queue_wait_s = None
 
 
 class _Slot:
@@ -171,7 +175,8 @@ class ServingEngine:
                  use_flash=None, temperature=0.0, top_k=0, seed=0,
                  pad_token_id=0, steps_per_dispatch=8, donate=True,
                  admission_policy="wait", watchdog_timeout=None,
-                 dispatch_retries=2, registry=None):
+                 dispatch_retries=2, registry=None,
+                 tenant_capacity=64):
         if page_size % 8:
             raise ValueError(f"page_size must be a multiple of 8 "
                              f"(Mosaic sublane tiling), got {page_size}")
@@ -325,6 +330,14 @@ class ServingEngine:
         for status in ("ok", "expired", "cancelled", "rejected",
                        "evicted"):
             self._status_counter(status)
+        # per-tenant usage attribution (observability.tenancy): a
+        # bounded space-saving sketch of tokens in/out, queue-wait and
+        # KV-page-seconds for tenant-tagged requests. Host-side dict
+        # arithmetic at the finish boundary the engine already owns —
+        # zero-recompile untouched; untagged requests skip it entirely
+        from ..observability.tenancy import TenantAccountant
+        self.tenants = TenantAccountant(capacity=tenant_capacity,
+                                        registry=reg)
         self._seen_retries = 0
         self._seen_wedges = 0
         # _sync_registry runs on the step() thread AND (via health())
@@ -432,7 +445,7 @@ class ServingEngine:
     # -- public API ---------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=16, eos_token_id=None,
-               deadline_ms=None, priority=0, trace=None):
+               deadline_ms=None, priority=0, trace=None, tenant=None):
         """Queue one request; returns its id. Admitted at the next
         step() boundary (slot + pages permitting).
 
@@ -448,7 +461,13 @@ class ServingEngine:
             process-global trace store — pure host-side dict appends
             at the step boundaries the engine already owns, so the
             zero-recompile contract is untouched. None (the default)
-            records nothing."""
+            records nothing.
+        tenant: usage-attribution label (observability.tenancy,
+            threaded from ``FleetRouter.submit`` through the replica
+            transports). Tagged requests accumulate tokens in/out,
+            queue-wait and KV-page-seconds into ``engine.tenants``
+            and stamp them on their result; None (the default) skips
+            accounting entirely."""
         if self._state != "serving":
             if self._state == "closed":
                 raise RuntimeError("ServingEngine is closed")
@@ -482,7 +501,8 @@ class ServingEngine:
         self._next_rid += 1
         self._queue.append(ServeRequest(rid, prompt, max_new_tokens,
                                         eos_token_id, deadline=deadline,
-                                        priority=priority, trace=trace))
+                                        priority=priority, trace=trace,
+                                        tenant=tenant))
         return rid
 
     @staticmethod
@@ -759,7 +779,8 @@ class ServingEngine:
             self._exporter.close()
         self._exporter = MetricsExporter(registry=self.registry,
                                          port=port, host=host,
-                                         health_fn=self.health)
+                                         health_fn=self.health,
+                                         tenants_fn=self.tenants.report)
         return self._exporter
 
     def close(self):
@@ -849,6 +870,7 @@ class ServingEngine:
              "status_counts": dict(self.status_counts),
              "warmed": self.warmed,
              "warmed_buckets": sorted(self._warmed_buckets),
+             "tenants_tracked": self.tenants.tracked,
              "compile_counts": self.compile_counts()}
         if self._watchdog is not None:
             h["watchdog"] = dict(self._watchdog.health(),
@@ -984,21 +1006,41 @@ class ServingEngine:
 
     # -- host-side scheduling ----------------------------------------------
 
-    def _finish_request(self, req, status, tokens=None):
+    def _finish_request(self, req, status, tokens=None, kv_page_s=0.0):
         """Finish a request that never reached (or is leaving) a slot.
         age_s — submit-to-finish latency — rides the result so tail
-        latency is measurable per request, not just per dispatch."""
+        latency is measurable per request, not just per dispatch;
+        tenant-tagged requests additionally carry their queue-wait and
+        KV-page-seconds (what only the engine can see) and fold into
+        the per-tenant usage sketch."""
         self._status_counter(status).inc()
         if status == "expired":
             self._m_deadline.inc()
         elif status == "evicted":
             self._m_evictions.inc()
         age = round(time.monotonic() - req.submitted_at, 6)
-        self._finished.append({"id": req.rid,
-                               "prompt": req.prompt.tolist(),
-                               "tokens": list(tokens or []),
-                               "status": status,
-                               "age_s": age})
+        qw = req.queue_wait_s
+        if qw is None:   # never admitted: the whole age was queue wait
+            qw = time.monotonic() - req.submitted_at
+        # usage facts ride EVERY result (the router folds untagged
+        # traffic under "anon", and its kv/queue numbers must be as
+        # real as a tagged tenant's); the tenant key and the
+        # engine-side sketch stay tagged-only
+        result = {"id": req.rid,
+                  "prompt": req.prompt.tolist(),
+                  "tokens": list(tokens or []),
+                  "status": status,
+                  "queue_wait_s": round(qw, 6),
+                  "kv_page_s": round(kv_page_s, 6),
+                  "age_s": age}
+        if req.tenant is not None:
+            result["tenant"] = req.tenant
+            self.tenants.account(req.tenant,
+                                 tokens_in=len(req.prompt),
+                                 tokens_out=len(tokens or []),
+                                 queue_wait_s=qw,
+                                 kv_page_s=kv_page_s, requests=1)
+        self._finished.append(result)
         self._cancel_pending.discard(req.rid)
         if req.trace is not None and req.admitted_pc is None:
             # never admitted (cancelled/expired/shed in the queue):
@@ -1023,8 +1065,15 @@ class ServingEngine:
             self._dtrace_add(req.trace, "decode", slot.decode_t0,
                              args={"tokens": len(slot.out_tokens)},
                              outcome=status or slot.status)
+        # KV-page-seconds: pages held x admission->release wall — the
+        # HBM-residency cost this request charged the pool (tenancy)
+        kv_page_s = 0.0
+        if req.admitted_pc is not None:
+            kv_page_s = len(slot.pages) * max(
+                time.perf_counter() - req.admitted_pc, 0.0)
         self._finish_request(req, status or slot.status,
-                             slot.out_tokens[:req.max_new_tokens])
+                             slot.out_tokens[:req.max_new_tokens],
+                             kv_page_s=kv_page_s)
         self.spans.instant("release_pages", tid="sched", cat="serve",
                            args={"rid": req.rid, "slot": b,
                                  "pages": len(slot.pages),
@@ -1142,7 +1191,8 @@ class ServingEngine:
             return  # back-pressure: retry next boundary
 
     def _admit_one(self, b, req, need_pages):
-        self._m_queue_wait.observe(time.monotonic() - req.submitted_at)
+        req.queue_wait_s = time.monotonic() - req.submitted_at
+        self._m_queue_wait.observe(req.queue_wait_s)
         # span: the queue-wait leg closes at admission (one lane per
         # request — Perfetto shows queue -> prefill -> finish stacked)
         self.spans.add("queue_wait", req.submitted_pc,

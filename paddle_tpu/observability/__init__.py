@@ -4,15 +4,20 @@ spans, distributed tracing, SLO burn-rate accounting, crash flight
 recorder) — docs/observability.md.
 
 Layering: ``metrics``, ``telemetry``, ``exporter``, ``spans``,
-``dtrace``, ``slo`` and ``flightrec`` are pure stdlib (importable
-from the jax-free bench orchestrator and worker processes); ``trace``
-and ``introspect`` import jax lazily inside the wrapping calls.
+``dtrace``, ``slo``, ``flightrec``, ``history``, ``tenancy`` and
+``sentinel`` are pure stdlib (importable from the jax-free bench
+orchestrator and worker processes); ``trace`` and ``introspect``
+import jax lazily inside the wrapping calls.
 """
-from . import (dtrace, exporter, flightrec, introspect,  # noqa: F401
-               metrics, slo, spans, telemetry, trace)
+from . import (dtrace, exporter, flightrec, history,  # noqa: F401
+               introspect, metrics, sentinel, slo, spans, telemetry,
+               tenancy, trace)
 from .dtrace import TraceStore, get_store  # noqa: F401
 from .exporter import MetricsExporter, serve_metrics  # noqa: F401
 from .flightrec import FlightRecorder  # noqa: F401
+from .history import HistoryStore  # noqa: F401
+from .sentinel import AnomalySentinel  # noqa: F401
+from .tenancy import SpaceSavingSketch, TenantAccountant  # noqa: F401
 from .introspect import (cost_report, measured_mfu,  # noqa: F401
                          resolve_peak_flops)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
@@ -29,6 +34,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "serve_metrics", "SpanRecorder", "export_chrome",
            "TraceStore", "get_store", "SLObjective", "SLOTracker",
            "FlightRecorder", "cost_report", "measured_mfu",
-           "resolve_peak_flops", "metrics", "telemetry", "trace",
+           "resolve_peak_flops", "HistoryStore", "AnomalySentinel",
+           "SpaceSavingSketch", "TenantAccountant",
+           "metrics", "telemetry", "trace",
            "introspect", "exporter", "spans", "dtrace", "slo",
-           "flightrec"]
+           "flightrec", "history", "sentinel", "tenancy"]

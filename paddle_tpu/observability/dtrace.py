@@ -117,11 +117,27 @@ class TraceStore:
         marked ``truncated`` and drop NEW spans — interior nodes are
         never removed, so parents outlive their children by
         construction.
+    sample: keep-fraction in [0, 1] for whole trees (default 1.0 =
+        trace everything; the process-global store reads
+        ``PADDLE_TPU_TRACE_SAMPLE``). Sampling is head-based and
+        DETERMINISTIC — a fractional accumulator keeps exactly
+        ``sample`` of new_trace calls, evenly spaced, no RNG — and
+        by WHOLE TREE: a sampled-out request records nothing anywhere
+        (``new_trace`` returns None, every hop no-ops), so whole-tree
+        tracing stays bounded at high QPS. Dropped traces are counted
+        in ``sampled_out`` AND as ``fleet_traces_sampled_out_total``
+        in the process-global registry — dropped is visible, never
+        silent.
     """
 
-    def __init__(self, max_traces=256, max_spans_per_trace=512):
+    def __init__(self, max_traces=256, max_spans_per_trace=512,
+                 sample=1.0):
         self.max_traces = int(max_traces)
         self.max_spans_per_trace = int(max_spans_per_trace)
+        self.sample = min(max(float(sample), 0.0), 1.0)
+        self._sample_acc = 0.0
+        self.sampled_out = 0
+        self._sampled_counter = None
         self._traces = OrderedDict()   # trace_id -> tree record
         self._lock = threading.Lock()
 
@@ -133,6 +149,8 @@ class TraceStore:
         context (None under introspection). Evicts the oldest WHOLE
         trace beyond max_traces."""
         if _suppressed():
+            return None
+        if self.sample < 1.0 and not self._sample_keep():
             return None
         trace_id = f"t{os.getpid():x}-{next(_id_counter)}"
         span = {"id": next(_id_counter), "parent": None,
@@ -148,6 +166,31 @@ class TraceStore:
                 #                                   an interior node
         return {"trace_id": trace_id, "span_id": span["id"],
                 "proc": proc, "hops": int(hops), "t0": span["t0"]}
+
+    def _sample_keep(self):
+        """Deterministic fractional-accumulator sampling decision.
+        Dropping increments the internal count and the
+        ``fleet_traces_sampled_out_total`` counter (lazily resolved
+        from the process-global registry; absent in standalone loads
+        — the internal count still tells the story there)."""
+        with self._lock:
+            self._sample_acc += self.sample
+            if self._sample_acc >= 1.0:
+                self._sample_acc -= 1.0
+                return True
+            self.sampled_out += 1
+        if self._sampled_counter is None:
+            try:
+                from .metrics import get_registry
+                self._sampled_counter = get_registry().counter(
+                    "fleet_traces_sampled_out_total",
+                    help="whole request trace trees dropped by the "
+                         "PADDLE_TPU_TRACE_SAMPLE head-sampling knob")
+            except ImportError:
+                self._sampled_counter = False   # standalone load
+        if self._sampled_counter:
+            self._sampled_counter.inc()
+        return False
 
     def _append(self, trace_id, span):
         rec = self._traces.get(trace_id)
@@ -469,7 +512,10 @@ _default_lock = threading.Lock()
 def get_store():
     """The process-global trace store (router mints into it, engines
     record into it; capacity via PADDLE_TPU_TRACE_CAP, default 256
-    traces)."""
+    traces; head-sampling fraction via PADDLE_TPU_TRACE_SAMPLE,
+    default 1.0 = keep everything — lower it so whole-tree tracing
+    stays bounded at high QPS; drops count in
+    ``fleet_traces_sampled_out_total``)."""
     global _default
     with _default_lock:
         if _default is None:
@@ -477,5 +523,10 @@ def get_store():
                 cap = int(os.environ.get("PADDLE_TPU_TRACE_CAP", 256))
             except ValueError:
                 cap = 256
-            _default = TraceStore(max_traces=cap)
+            try:
+                sample = float(os.environ.get(
+                    "PADDLE_TPU_TRACE_SAMPLE", 1.0))
+            except ValueError:
+                sample = 1.0
+            _default = TraceStore(max_traces=cap, sample=sample)
         return _default

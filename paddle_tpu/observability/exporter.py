@@ -66,11 +66,21 @@ class MetricsExporter:
         a trace id or fleet rid; return None for unknown keys -> 404).
         None disables the endpoint (FleetRouter.serve_metrics wires
         its trace_report here).
+    history_fn: one-arg callable serving ``/history`` — receives the
+        parsed query params ({} for a bare GET = the series index;
+        keys like series/res/window/q/op select a range/rate/quantile
+        read; return None for unknown series -> 404). None disables
+        the endpoint (FleetRouter.serve_metrics wires its
+        HistoryStore here).
+    tenants_fn: zero-arg callable serving ``/tenants`` (the
+        TenantAccountant report: top-K heavy hitters + exact totals).
+        None disables the endpoint.
     host/port: bind address; port 0 = ephemeral (read .port after).
     """
 
     def __init__(self, registry=None, port=0, host="127.0.0.1",
-                 health_fn=None, report_fn=None, traces_fn=None):
+                 health_fn=None, report_fn=None, traces_fn=None,
+                 history_fn=None, tenants_fn=None):
         if registry is None:
             from .metrics import get_registry
             registry = get_registry()
@@ -78,6 +88,8 @@ class MetricsExporter:
         self.health_fn = health_fn
         self.report_fn = report_fn
         self.traces_fn = traces_fn
+        self.history_fn = history_fn
+        self.tenants_fn = tenants_fn
         self._started = time.time()
         exporter = self
 
@@ -105,7 +117,8 @@ class MetricsExporter:
                 self._send(code, body, "application/json")
 
             def do_GET(self):  # noqa: N802 — http.server API
-                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                parts = self.path.split("?", 1)
+                path = parts[0].rstrip("/") or "/"
                 try:
                     if path == "/metrics":
                         self._send(200, exporter.registry.to_prometheus(),
@@ -128,10 +141,30 @@ class MetricsExporter:
                                 code=404)
                         else:
                             self._send_json(doc)
+                    elif exporter.history_fn is not None \
+                            and path == "/history":
+                        from urllib.parse import parse_qs
+                        params = {k: v[-1] for k, v in parse_qs(
+                            parts[1] if len(parts) > 1 else ""
+                            ).items()}
+                        doc = exporter.history_fn(params)
+                        if doc is None:
+                            self._send_json(
+                                {"error": "unknown history query "
+                                          f"{params!r}"}, code=404)
+                        else:
+                            self._send_json(doc)
+                    elif exporter.tenants_fn is not None \
+                            and path == "/tenants":
+                        self._send_json(exporter.tenants_fn())
                     else:
                         endpoints = ["/metrics", "/healthz", "/report"]
                         if exporter.traces_fn is not None:
                             endpoints.append("/traces")
+                        if exporter.history_fn is not None:
+                            endpoints.append("/history")
+                        if exporter.tenants_fn is not None:
+                            endpoints.append("/tenants")
                         self._send_json(
                             {"error": f"unknown path {path!r}",
                              "endpoints": endpoints}, code=404)
@@ -208,9 +241,11 @@ class MetricsExporter:
 
 
 def serve_metrics(port=0, registry=None, host="127.0.0.1",
-                  health_fn=None, report_fn=None, traces_fn=None):
+                  health_fn=None, report_fn=None, traces_fn=None,
+                  history_fn=None, tenants_fn=None):
     """Start a MetricsExporter (the one-call attach the docs show);
     returns it — read ``.port`` / ``.url``, call ``.close()``."""
     return MetricsExporter(registry=registry, port=port, host=host,
                            health_fn=health_fn, report_fn=report_fn,
-                           traces_fn=traces_fn)
+                           traces_fn=traces_fn, history_fn=history_fn,
+                           tenants_fn=tenants_fn)

@@ -83,16 +83,19 @@ class ReplicaClient:
     # -- verbs -----------------------------------------------------------
 
     def submit(self, rid, prompt, max_new_tokens, eos_token_id=None,
-               priority=0, deadline_ms=None, trace=None):
+               priority=0, deadline_ms=None, trace=None, tenant=None):
         """Deliver one request (idempotent by rid at the replica).
-        deadline_ms (remaining wall budget) and trace (the
-        dtrace context — hop budget already decremented by the
-        caller) ride an optional trailing extras dict, so the wire
-        shape stays compatible with pre-tracing replicas."""
+        deadline_ms (remaining wall budget), trace (the dtrace
+        context — hop budget already decremented by the caller) and
+        tenant (the usage-attribution label, observability.tenancy)
+        ride an optional trailing extras dict, so the wire shape
+        stays compatible with pre-tracing replicas."""
         op = ["submit", rid, list(prompt), int(max_new_tokens),
               eos_token_id, int(priority)]
-        if deadline_ms is not None or trace is not None:
-            op.append({"deadline_ms": deadline_ms, "trace": trace})
+        if deadline_ms is not None or trace is not None \
+                or tenant is not None:
+            op.append({"deadline_ms": deadline_ms, "trace": trace,
+                       "tenant": tenant})
         self._call(self.replica.enqueue, tuple(op))
 
     def cancel(self, rid):

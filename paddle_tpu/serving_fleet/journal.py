@@ -215,6 +215,7 @@ def reconcile(records):
     def ent(rid):
         return reqs.setdefault(int(rid), {
             "prompt": None, "max_new": 0, "eos": None, "priority": 0,
+            "tenant": None,
             "deadline_epoch": None, "submitted_epoch": None,
             "delivered": [], "replica": None, "placed_prefix": None,
             "placed_incarnation": None, "hedge": None, "failovers": 0,
@@ -235,6 +236,7 @@ def reconcile(records):
             e["max_new"] = int(rec.get("max_new", 0))
             e["eos"] = rec.get("eos")
             e["priority"] = int(rec.get("priority", 0))
+            e["tenant"] = rec.get("tenant")
             e["deadline_epoch"] = rec.get("deadline_epoch")
             e["submitted_epoch"] = rec.get("submitted_epoch")
             if kind == "snap_req":

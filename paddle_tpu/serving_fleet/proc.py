@@ -307,7 +307,8 @@ class ProcReplica:
                      "max_new": int(max_new), "eos": eos,
                      "priority": int(prio),
                      "deadline_ms": extras.get("deadline_ms"),
-                     "trace": extras.get("trace")}
+                     "trace": extras.get("trace"),
+                     "tenant": extras.get("tenant")}
             with self._out_lock:
                 self._inflight[rid] = {
                     "rid": rid, "prompt": [int(t) for t in prompt],

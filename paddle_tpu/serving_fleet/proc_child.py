@@ -127,6 +127,7 @@ class _Child:
             "page_size": self.engine.page_size,
             "queue_wait_p99_s": round(float(p99 or 0.0), 6),
             "decode_tokens": h["decode_tokens"],
+            "tenants_tracked": h.get("tenants_tracked", 0),
             "compile_counts": h["compile_counts"],
             "unexpected_retraces":
                 self.engine.tracer.unexpected_retraces(),
@@ -199,7 +200,8 @@ class _Child:
             op["prompt"], op["max_new"], op.get("eos"),
             priority=int(op.get("priority") or 0),
             deadline_ms=op.get("deadline_ms"),
-            trace=op.get("trace"))
+            trace=op.get("trace"),
+            tenant=op.get("tenant"))
         self._accepted[frid] = erid
         self._rid_map[erid] = frid
 

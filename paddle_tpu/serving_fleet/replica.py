@@ -122,9 +122,10 @@ class InprocReplica:
         """Queue one command for the worker: ("submit", fleet_rid,
         prompt, max_new_tokens, eos_token_id, priority[, extras]) or
         ("cancel", fleet_rid). The optional trailing extras dict
-        carries {"deadline_ms", "trace"} — the distributed-trace
-        context hops the transport here exactly as it would a wire.
-        Submits are idempotent by fleet rid — a transport retry that
+        carries {"deadline_ms", "trace", "tenant"} — the
+        distributed-trace context and the tenancy label hop the
+        transport here exactly as they would a wire. Submits are
+        idempotent by fleet rid — a transport retry that
         double-delivers is absorbed."""
         self._inbox.put(tuple(op))
 
@@ -323,7 +324,8 @@ class InprocReplica:
                 erid = self.engine.submit(
                     prompt, max_new, eos, priority=prio,
                     deadline_ms=extras.get("deadline_ms"),
-                    trace=extras.get("trace"))
+                    trace=extras.get("trace"),
+                    tenant=extras.get("tenant"))
                 self._accepted[frid] = erid
                 self._rid_map[erid] = frid
                 self._rid_inc[erid] = self.incarnation
@@ -379,6 +381,7 @@ class InprocReplica:
                 "page_size": self.engine.page_size,
                 "queue_wait_p99_s": round(float(p99 or 0.0), 6),
                 "decode_tokens": h["decode_tokens"],
+                "tenants_tracked": h.get("tenants_tracked", 0),
                 "compile_counts": h["compile_counts"]}
         with self._health_lock:
             self._health = snap

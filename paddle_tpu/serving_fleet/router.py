@@ -54,8 +54,11 @@ import collections
 import time
 
 from ..observability import dtrace
+from ..observability.history import HistoryStore
 from ..observability.metrics import MetricsRegistry
+from ..observability.sentinel import AnomalySentinel
 from ..observability.slo import SLOTracker
+from ..observability.tenancy import TenantAccountant
 from ..resilience import faults, preemption
 from .client import ReplicaClient
 from .journal import Journal, JournalCrash, JournalError, reconcile, \
@@ -90,15 +93,16 @@ class _Pending:
                  "submitted_at", "placed_at", "replica", "hedge",
                  "delivered", "failovers", "hedged", "done",
                  "deadline", "trace", "queue_since_pc", "leg_ctxs",
-                 "leg_base", "leg_inc")
+                 "leg_base", "leg_inc", "tenant")
 
     def __init__(self, rid, prompt, max_new, eos, priority,
-                 deadline=None):
+                 deadline=None, tenant=None):
         self.rid = rid
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
         self.eos = eos
         self.priority = int(priority)
+        self.tenant = None if tenant is None else str(tenant)
         self.submitted_at = time.monotonic()
         self.placed_at = None
         self.replica = None     # primary assignment (replica name)
@@ -172,6 +176,26 @@ class FleetRouter:
         ``FleetRouter.recover(journal_dir, replicas)``.
     journal_fsync_every / journal_segment_max_bytes: Journal knobs
         (fsync cadence; rotation/compaction threshold).
+    tenants: per-tenant usage accounting (observability.tenancy) —
+        None/True = a bounded space-saving TenantAccountant of
+        ``tenant_capacity`` heavy hitters (default ON: cardinality is
+        bounded, untagged traffic lands under "anon" so sketch totals
+        equal fleet totals EXACTLY); False disables; or pass an
+        accountant. Served at ``/tenants`` and folded into the
+        priority-shed order (heaviest tenants shed first within a
+        priority band).
+    history / history_interval_s: telemetry history plane
+        (observability.history) — True = a HistoryStore scraping THIS
+        registry every ``history_interval_s`` seconds from the
+        control loop (no extra thread); or pass a store; None = off.
+        Served at ``/history``.
+    sentinel / sentinel_kw: online anomaly detection
+        (observability.sentinel) — True = an AnomalySentinel over the
+        history plane (created if absent) watching TTFT p99, decode
+        tok/s, placement wait, journal errors and any recompile
+        delta; fires ``fleet_anomaly`` flight dumps + counters and
+        surfaces in health()["anomaly"] exactly like SLO burn alerts.
+        sentinel_kw tunes bands (z/warmup/min_consecutive/signals).
     """
 
     def __init__(self, replicas, *, registry=None, max_queue=64,
@@ -182,7 +206,10 @@ class FleetRouter:
                  slo_windows=None, shed_storm_threshold=16,
                  shed_storm_window_s=5.0, journal_dir=None,
                  journal_fsync_every=1,
-                 journal_segment_max_bytes=1 << 20):
+                 journal_segment_max_bytes=1 << 20,
+                 tenants=None, tenant_capacity=128,
+                 history=None, history_interval_s=0.25,
+                 sentinel=None, sentinel_kw=None):
         self.replicas = {}
         self._clients = {}
         self._transport_retries = int(transport_retries)
@@ -257,6 +284,33 @@ class FleetRouter:
             objectives=slos, windows=slo_windows, registry=reg)
         self._slo_state = {}
         self._slo_eval_at = 0.0
+        # -- tenancy: bounded heavy-hitter usage attribution. Untagged
+        # requests account under "anon", so the sketch's exact-totals
+        # invariant (sum over tenants == fleet counters) holds
+        # unconditionally, not only on fully-tagged traffic
+        if tenants is False:
+            self.tenants = None
+        elif tenants is None or tenants is True:
+            self.tenants = TenantAccountant(capacity=tenant_capacity,
+                                            registry=reg)
+        else:
+            self.tenants = tenants
+        # -- telemetry history plane + anomaly sentinel: both are
+        # driven from the control loop's existing heartbeat (no new
+        # threads; HistoryStore.start() exists for loop-less hosts)
+        if history is True:
+            history = HistoryStore(reg, interval_s=history_interval_s)
+        self.history = history if history else None
+        self._anomaly_state = {}
+        if sentinel is True:
+            if self.history is None:
+                self.history = HistoryStore(
+                    reg, interval_s=history_interval_s)
+            sentinel = AnomalySentinel(
+                self.history, registry=reg,
+                compile_fn=self.compile_report,
+                **(sentinel_kw or {}))
+        self.sentinel = sentinel if sentinel else None
         self._m_req = {}
         self._m_routed = {}
         self._m_failover = {}
@@ -277,6 +331,22 @@ class FleetRouter:
             "fleet_placement_wait_seconds",
             help="submit -> placement-decision wait (the router-level "
                  "queueing leg)")
+        # fleet-level token/latency series: the history plane's inputs
+        # (the sentinel's TTFT-p99 / decode-tok/s / queue-wait signals
+        # all read these back through quantile/rate-over-time)
+        self._m_tokens_in = reg.counter(
+            "fleet_tokens_in_total",
+            help="prompt tokens of resolved fleet requests")
+        self._m_tokens_out = reg.counter(
+            "fleet_tokens_out_total",
+            help="generated tokens delivered in resolved results")
+        self._m_ttft_h = reg.histogram(
+            "fleet_ttft_seconds",
+            help="submit -> first generated token, fleet level "
+                 "(trace-derived; absent for sampled-out traces)")
+        self._m_e2e_h = reg.histogram(
+            "fleet_e2e_seconds",
+            help="submit -> resolve wall time of ok requests")
         self._g_queue = reg.gauge(
             "fleet_queue_depth", help="requests awaiting placement")
         self._g_pending = reg.gauge(
@@ -322,7 +392,7 @@ class FleetRouter:
     # -- public API --------------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=16, eos_token_id=None,
-               priority=0, deadline_ms=None):
+               priority=0, deadline_ms=None, tenant=None):
         """Accept one request into the fleet; returns its fleet rid.
         Placement happens at the next step().
 
@@ -331,6 +401,14 @@ class FleetRouter:
         placement, so a failover continuation inherits what is left,
         and a request that expires while queued at the router resolves
         status='expired' without ever placing.
+
+        tenant: usage-attribution label (observability.tenancy). It
+        rides every placement down to the engine (which accounts
+        queue-wait and KV-page-seconds), the router accounts fleet
+        token totals per tenant at resolve, /tenants serves the
+        heavy-hitter rollup, and the shed order prefers shedding the
+        heaviest tenants within a priority band. None lands under
+        "anon" in the fleet rollup.
 
         Every submit mints a distributed-trace context: the request's
         span tree (placement wait, transport, per-replica legs with
@@ -351,11 +429,12 @@ class FleetRouter:
         deadline = None if deadline_ms is None \
             else time.monotonic() + float(deadline_ms) / 1e3
         p = _Pending(rid, prompt, max_new_tokens, eos_token_id,
-                     priority, deadline=deadline)
+                     priority, deadline=deadline, tenant=tenant)
         if self._journal is not None:
             self._journal.append(
                 "accepted", rid=rid, prompt=p.prompt,
                 max_new=p.max_new, eos=p.eos, priority=p.priority,
+                tenant=p.tenant,
                 deadline_epoch=None if deadline_ms is None
                 else round(time.time() + float(deadline_ms) / 1e3, 6),
                 submitted_epoch=round(time.time(), 6))
@@ -439,6 +518,15 @@ class FleetRouter:
                 out or now - self._slo_eval_at > 0.25):
             self._slo_state = self.slo.evaluate()
             self._slo_eval_at = now
+        # history scrape + anomaly evaluation ride the SAME control
+        # loop on their own cadences (maybe_* no-op between ticks) —
+        # scrape first so the sentinel reads the freshest samples
+        if self.history is not None:
+            self.history.maybe_scrape()
+        if self.sentinel is not None:
+            st = self.sentinel.maybe_evaluate()
+            if st is not None:
+                self._anomaly_state = st
         return out
 
     def _registry_snapshot(self):
@@ -630,7 +718,25 @@ class FleetRouter:
                                if not p.done),
                 "lost": sorted(self._lost),
                 "slo": self._slo_health(),
+                "anomaly": self._anomaly_health(),
+                "tenants": None if self.tenants is None else {
+                    "tracked": self.tenants.tracked},
                 "compile_report": self.compile_report()}
+
+    def _anomaly_health(self):
+        """Sentinel rollup for the health snapshot — same shape and
+        same caching discipline as the SLO rollup (health() also runs
+        on HTTP threads; the sentinel evaluates on the control loop,
+        this just reads the cached state)."""
+        if self.sentinel is None:
+            return None
+        state = self._anomaly_state
+        return {"alerting": sorted(n for n, r in state.items()
+                                   if r.get("alert")),
+                "signals": {n: {"alert": r.get("alert", False),
+                                "value": r.get("value"),
+                                "z": r.get("z")}
+                            for n, r in state.items()}}
 
     def _slo_health(self):
         """Burn state for the health snapshot (cached from the last
@@ -727,8 +833,47 @@ class FleetRouter:
             health_fn=self.health,
             report_fn=lambda: {"fleet_compile_report":
                                self.compile_report()},
-            traces_fn=self._traces_endpoint)
+            traces_fn=self._traces_endpoint,
+            history_fn=None if self.history is None
+            else self._history_endpoint,
+            tenants_fn=None if self.tenants is None
+            else self.tenants.report)
         return self._exporter
+
+    def _history_endpoint(self, params):
+        """The /history handler: bare GET = the series index; with
+        ``series=`` a range read (``res``/``t0``/``t1``/``limit``) or
+        a server-side rollup (``op=rate|quantile`` with ``window``/
+        ``q``) — tools/fleet_top.py's data plane. Unknown series ->
+        None -> 404."""
+        h = self.history
+        key = (params or {}).get("series")
+        if not key:
+            return {"series": h.index(),
+                    "interval_s": h.interval_s,
+                    "scrapes": h.scrapes,
+                    "rungs": [list(r) for r in h.rungs]}
+        if key not in h.keys():
+            return None
+        op = params.get("op", "query")
+        window = float(params.get("window", 30.0))
+        if op == "rate":
+            return {"series": key, "op": "rate", "window_s": window,
+                    "value": h.rate(key, window)}
+        if op == "quantile":
+            q = float(params.get("q", 0.99))
+            return {"series": key, "op": "quantile", "q": q,
+                    "window_s": window,
+                    "value": h.quantile_over_time(key, q, window)}
+        t0 = params.get("t0")
+        t1 = params.get("t1")
+        limit = params.get("limit")
+        return {"series": key, "res": params.get("res", "raw"),
+                "samples": h.query(
+                    key, t0=None if t0 is None else float(t0),
+                    t1=None if t1 is None else float(t1),
+                    res=params.get("res", "raw"),
+                    limit=None if limit is None else int(limit))}
 
     def close(self):
         """Stop every replica worker and the exporter. Engines are
@@ -744,6 +889,8 @@ class FleetRouter:
             except JournalError:  # incl. JournalCrash — closing anyway
                 pass
             self._journal.close()
+        if self.history is not None:
+            self.history.stop()   # no-op unless start() armed a thread
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None
@@ -858,7 +1005,7 @@ class FleetRouter:
             self._resolve(
                 p,
                 p.delivered[:base] + list(res.get("tokens") or []),
-                "cancelled", src)
+                "cancelled", src, usage=self._usage_of(res))
             return
         # terminal: ok | expired — first finisher wins
         tokens = p.delivered[:base] + list(res.get("tokens") or [])
@@ -876,7 +1023,15 @@ class FleetRouter:
                 pass
         self._end_leg(p, src, status,
                       tokens=len(res.get("tokens") or []))
-        self._resolve(p, tokens, status, src)
+        self._resolve(p, tokens, status, src, usage=self._usage_of(res))
+
+    @staticmethod
+    def _usage_of(res):
+        """Engine-side usage facts riding a replica result (what only
+        the engine can see: admission queue wait, KV-page-seconds) —
+        folded into the per-tenant sketch at resolve."""
+        return {"queue_wait_s": res.get("queue_wait_s"),
+                "kv_page_s": res.get("kv_page_s")}
 
     def _finish_from_prefix(self, p):
         """A recovered prefix may already satisfy the request (eos
@@ -891,12 +1046,13 @@ class FleetRouter:
             return True
         return False
 
-    def _resolve(self, p, tokens, status, replica):
+    def _resolve(self, p, tokens, status, replica, usage=None):
         age = time.monotonic() - p.submitted_at
         result = {
             "id": p.rid, "tokens": [int(t) for t in tokens],
             "status": status, "replica": replica,
             "failovers": p.failovers, "hedged": p.hedged,
+            "tenant": p.tenant,
             "trace_id": None if p.trace is None
             else p.trace["trace_id"],
             "age_s": round(age, 6)}
@@ -935,10 +1091,29 @@ class FleetRouter:
                               args={"tokens": len(tokens),
                                     "failovers": p.failovers,
                                     "hedged": p.hedged})
-        self._record_slo(p, status, age)
+        ttft = self._ttft_from_trace(p) if status == "ok" else None
+        self._record_slo(p, status, age, ttft)
+        # fleet-level token/latency series + per-tenant attribution —
+        # the history plane scrapes these, the sentinel bands them.
+        # Counted at the SAME commit point, so sketch totals equal the
+        # fleet counters exactly (the chaos wave's invariant)
+        self._m_tokens_in.inc(len(p.prompt))
+        self._m_tokens_out.inc(len(tokens))
+        if status == "ok":
+            self._m_e2e_h.observe(age)
+            if ttft is not None:
+                self._m_ttft_h.observe(ttft)
+        if self.tenants is not None:
+            u = usage or {}
+            self.tenants.account(
+                p.tenant if p.tenant is not None else "anon",
+                tokens_in=len(p.prompt), tokens_out=len(tokens),
+                queue_wait_s=float(u.get("queue_wait_s") or 0.0),
+                kv_page_s=float(u.get("kv_page_s") or 0.0),
+                requests=1)
         self._done[p.rid] = result
 
-    def _record_slo(self, p, status, age_s):
+    def _record_slo(self, p, status, age_s, ttft=None):
         """Fold one resolved request into the SLO windows: e2e
         latency, TTFT (read off the trace tree's first prefill leg),
         and goodput — shed + deadline-missed count against served;
@@ -948,7 +1123,6 @@ class FleetRouter:
         if status == "ok":
             self.slo.record_event("availability", good=True)
             self.slo.record_latency("e2e", age_s)
-            ttft = self._ttft_from_trace(p)
             if ttft is not None:
                 self.slo.record_latency("ttft", ttft)
         elif status in ("shed", "expired", "failed"):
@@ -1114,7 +1288,7 @@ class FleetRouter:
         try:
             client.submit(p.rid, prompt, max_new, p.eos, p.priority,
                           deadline_ms=self._remaining_deadline_ms(p),
-                          trace=dtrace.hop(leg))
+                          trace=dtrace.hop(leg), tenant=p.tenant)
         except Exception:  # noqa: BLE001 — transport gave up; retry
             self._end_leg(p, target, "transport_failed")
             return False, None
@@ -1177,9 +1351,18 @@ class FleetRouter:
         if self._unscraped() \
                 or self._pick_replica(self._outstanding()) is not None:
             return
-        # lowest priority goes first; newest first within a priority
-        order = sorted(self._queue,
-                       key=lambda r: (self._pending[r].priority, -r))
+        # lowest priority goes first; within a priority band the
+        # HEAVIEST tenants (space-saving sketch weight) go before
+        # light ones — fair degradation: saturation caused by a hot
+        # tenant lands on that tenant first — newest first as the
+        # final tie-break
+        def shed_key(r):
+            p = self._pending[r]
+            usage = 0 if self.tenants is None else self.tenants.usage(
+                p.tenant if p.tenant is not None else "anon")
+            return (p.priority, -usage, -r)
+
+        order = sorted(self._queue, key=shed_key)
         shed_now = []
         while len(self._queue) > self.max_queue and order:
             rid = order.pop(0)
@@ -1424,7 +1607,7 @@ class FleetRouter:
             recs.append({
                 "kind": "snap_req", "rid": rid, "prompt": p.prompt,
                 "max_new": p.max_new, "eos": p.eos,
-                "priority": p.priority,
+                "priority": p.priority, "tenant": p.tenant,
                 "deadline_epoch": self._deadline_epoch(p),
                 "submitted_epoch": round(
                     now_w - (now_m - p.submitted_at), 6),
@@ -1518,7 +1701,8 @@ class FleetRouter:
             if e["deadline_epoch"] is not None:
                 deadline = now_m + (float(e["deadline_epoch"]) - now_w)
             p = _Pending(rid, e["prompt"], e["max_new"], e["eos"],
-                         e["priority"], deadline=deadline)
+                         e["priority"], deadline=deadline,
+                         tenant=e.get("tenant"))
             if e["submitted_epoch"] is not None:
                 p.submitted_at = now_m - max(
                     now_w - float(e["submitted_epoch"]), 0.0)

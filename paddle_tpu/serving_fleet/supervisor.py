@@ -363,10 +363,18 @@ class FleetSupervisor:
                 "quarantined_for_s": None if st.quarantined_at is None
                 or st.phase != "quarantined"
                 else round(now - st.quarantined_at, 6)}
+        # the router's anomaly-sentinel rollup rides the supervisor
+        # health too: "who is quarantined" and "is the fleet inside
+        # its learned bands" page together — a respawn storm that
+        # coincides with a TTFT excursion is one incident, not two
+        # dashboards
+        sen = getattr(self.router, "sentinel", None)
         return {"replicas": reps,
                 "quarantined": sorted(
                     n for n, s in self._st.items()
                     if s.phase == "quarantined"),
+                "anomaly_alerting": None if sen is None
+                else sen.alerting(),
                 "breaker": {"threshold": self.breaker_threshold,
                             "window_s": self.breaker_window_s,
                             "cooldown_s": self.breaker_cooldown_s}}

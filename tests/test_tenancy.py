@@ -1,0 +1,343 @@
+"""Per-tenant usage accounting (observability/tenancy.py), the
+tenant= label threaded fleet → transport → engine, and the
+PADDLE_TPU_TRACE_SAMPLE head-sampling knob (ISSUE 11).
+
+Pinned contracts:
+
+- SpaceSavingSketch: bounded cardinality, EXACT conservation of every
+  accumulator through evictions (sum over entries == totals, always),
+  guaranteed-tracked heavy hitters, stated error bounds;
+- ServingEngine accounting: tagged requests accumulate tokens in/out,
+  queue-wait and KV-page-seconds into engine.tenants and stamp them
+  on their results; untagged requests cost nothing and stay
+  result-shape compatible;
+- fleet threading: tenant rides FleetRouter.submit through the
+  transports into the engine; the router's per-tenant token totals
+  sum EXACTLY to the fleet counters AND the resolved results;
+  token-exactness and frozen compile counts hold with accounting on;
+- /tenants endpoints (engine + router exporters) serve the report;
+- shed order: within a priority band the heaviest tenant sheds first;
+- trace sampling: deterministic keep-fraction, dropped trees counted
+  (fleet_traces_sampled_out_total), never silent, and a sampled-out
+  request still completes token-exactly.
+"""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+from paddle_tpu.nlp.serving import ServingEngine
+from paddle_tpu.observability.dtrace import TraceStore
+from paddle_tpu.observability.metrics import get_registry
+from paddle_tpu.observability.tenancy import SpaceSavingSketch, \
+    TenantAccountant
+from paddle_tpu.serving_fleet import FleetRouter, InprocReplica
+
+NEW_TOK = 10
+
+
+@pytest.fixture(scope="module")
+def gpt_model():
+    paddle.seed(0)
+    m = GPTForCausalLM(_resolve_config("gpt-tiny"))
+    m.eval()
+    return m
+
+
+def _prompts(lens, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (n,)).astype(np.int32)
+            for n in lens]
+
+
+def _engine(model, **kw):
+    d = dict(max_slots=2, page_size=16, max_seq_len=64,
+             steps_per_dispatch=4)
+    d.update(kw)
+    return ServingEngine(model, **d)
+
+
+def _warm(eng):
+    eng.generate(_prompts((5, 17), seed=7), max_new_tokens=4)
+    eng.reset_counters()
+
+
+def _fleet(model, n=2, router_kw=None, **engine_kw):
+    engines = [_engine(model, **engine_kw) for _ in range(n)]
+    for e in engines:
+        _warm(e)
+    frozen = [e.compile_counts() for e in engines]
+    reps = [InprocReplica(f"r{i}", e) for i, e in enumerate(engines)]
+    router = FleetRouter(reps, **(router_kw or {}))
+    return router, engines, frozen
+
+
+class TestSpaceSavingSketch:
+    def test_exact_below_capacity(self):
+        sk = SpaceSavingSketch(capacity=8)
+        for i in range(5):
+            sk.add(f"t{i}", i + 1, tokens_out=i + 1)
+        assert len(sk) == 5 and sk.evictions == 0
+        assert sk.error_bound == 0
+        assert sk.usage("t4") == 5 and sk.usage("t9") == 0
+        assert [r["tenant"] for r in sk.top(2)] == ["t4", "t3"]
+
+    def test_conservation_through_evictions(self):
+        """The invariant the chaos wave rides: every accumulator's
+        sum over sketch entries equals the exact total, whatever the
+        eviction history."""
+        rng = np.random.default_rng(0)
+        sk = SpaceSavingSketch(capacity=4)
+        totals = {"tokens_in": 0, "tokens_out": 0, "requests": 0}
+        for _ in range(2000):
+            t = f"t{rng.integers(0, 50)}"
+            ti, to = int(rng.integers(1, 30)), int(rng.integers(1, 30))
+            sk.add(t, ti + to, tokens_in=ti, tokens_out=to,
+                   requests=1)
+            totals["tokens_in"] += ti
+            totals["tokens_out"] += to
+            totals["requests"] += 1
+        assert len(sk) == 4 and sk.evictions > 0
+        for f, v in totals.items():
+            assert sk.totals[f] == v
+            assert sum(e[f] for e in sk._entries.values()) == v
+        assert sk.total_weight == totals["tokens_in"] \
+            + totals["tokens_out"]
+        assert sk.error_bound > 0   # honesty: overestimates are stated
+
+    def test_heavy_hitter_guaranteed_tracked(self):
+        rng = np.random.default_rng(1)
+        sk = SpaceSavingSketch(capacity=8)
+        for i in range(3000):
+            sk.add("whale", 10)          # ~55% of all weight
+            sk.add(f"minnow{rng.integers(0, 200)}",
+                   int(rng.integers(1, 9)))
+        top = sk.top(1)[0]
+        assert top["tenant"] == "whale"
+        # space-saving bound: true count >= weight - err
+        assert top["weight"] - top["err"] <= 30000 <= top["weight"]
+
+    def test_unknown_field_raises(self):
+        with pytest.raises(ValueError):
+            SpaceSavingSketch(4).add("t", 1, typo_field=3)
+
+    def test_accountant_report_and_none_tenant(self):
+        acc = TenantAccountant(capacity=4)
+        acc.account(None, tokens_out=5)          # skipped, not "None"
+        acc.account("a", tokens_in=3, tokens_out=7, queue_wait_s=0.5,
+                    kv_page_s=2.0, requests=1)
+        rep = acc.report()
+        assert rep["tracked"] == 1 and rep["exact_below_capacity"]
+        assert rep["totals"]["tokens_out"] == 7
+        assert rep["tenants"][0]["tenant"] == "a"
+        assert acc.usage("a") == 10 and acc.usage(None) == 0
+
+
+class TestEngineTenancy:
+    def test_tagged_request_accounts_and_stamps_results(self,
+                                                       gpt_model):
+        eng = _engine(gpt_model)
+        try:
+            prompts = _prompts((5, 9))
+            eng.submit(prompts[0], 6, tenant="acme")
+            eng.submit(prompts[1], 6)            # untagged rides along
+            res = {r["id"]: r for r in eng.run_to_completion()}
+            tagged, untagged = res[0], res[1]
+            assert tagged["tenant"] == "acme"
+            assert tagged["kv_page_s"] > 0
+            assert tagged["queue_wait_s"] >= 0
+            assert "tenant" not in untagged      # shape-compatible
+            rep = eng.tenants.report()
+            assert rep["tracked"] == 1
+            assert rep["totals"]["tokens_in"] == len(prompts[0])
+            assert rep["totals"]["tokens_out"] == len(tagged["tokens"])
+            assert rep["totals"]["kv_page_s"] > 0
+            assert eng.health()["tenants_tracked"] == 1
+        finally:
+            eng.close()
+
+    def test_engine_tenants_endpoint(self, gpt_model):
+        eng = _engine(gpt_model)
+        exp = eng.serve_metrics(port=0)
+        try:
+            eng.submit(_prompts((5,))[0], 4, tenant="acme")
+            eng.run_to_completion()
+            doc = json.loads(urllib.request.urlopen(
+                exp.url + "/tenants", timeout=5).read())
+            assert doc["tenants"][0]["tenant"] == "acme"
+        finally:
+            eng.close()
+
+    def test_never_admitted_finish_accounts_queue_wait(self,
+                                                       gpt_model):
+        eng = _engine(gpt_model)
+        try:
+            rid = eng.submit(_prompts((5,))[0], 4, tenant="acme")
+            assert eng.cancel(rid)
+            res = eng.step()
+            row = next(r for r in res if r["id"] == rid)
+            assert row["status"] == "cancelled"
+            assert row["kv_page_s"] == 0
+            assert eng.tenants.report()["totals"]["requests"] == 1
+        finally:
+            eng.close()
+
+
+@pytest.mark.chaos
+class TestFleetTenancy:
+    def test_tenant_totals_sum_exactly_to_fleet_totals(self,
+                                                       gpt_model):
+        """The acceptance invariant: sketch totals == fleet counters
+        == resolved-result sums, with kv-page-seconds flowing up from
+        the engines and compile counts frozen throughout."""
+        router, engines, frozen = _fleet(gpt_model)
+        try:
+            prompts = _prompts((5, 12, 17, 9, 21, 14))
+            tenants = ["a", "b", "a", "c", None, "b"]
+            rids = [router.submit(p, NEW_TOK, tenant=t)
+                    for p, t in zip(prompts, tenants)]
+            res = {r["id"]: r for r in router.run_to_completion()}
+            assert all(res[r]["status"] == "ok" for r in rids)
+            # results carry their tenant back to the client
+            assert [res[r]["tenant"] for r in rids] == tenants
+            rep = router.tenants.report()
+            by = {t["tenant"]: t for t in rep["tenants"]}
+            assert set(by) == {"a", "b", "c", "anon"}
+            out_total = sum(len(res[r]["tokens"]) for r in rids)
+            in_total = sum(len(p) for p in prompts)
+            reg = router.registry
+            assert rep["totals"]["tokens_out"] == out_total \
+                == int(reg.get("fleet_tokens_out_total").value)
+            assert rep["totals"]["tokens_in"] == in_total \
+                == int(reg.get("fleet_tokens_in_total").value)
+            assert sum(t["tokens_out"] for t in rep["tenants"]) \
+                == out_total
+            # engine-side facts flowed up through the result plane
+            assert rep["totals"]["kv_page_s"] > 0
+            assert by["a"]["requests"] == 2 and by["anon"]["requests"] == 1
+            # per-engine sketches saw only their tagged share
+            eng_out = sum(e.tenants.report()["totals"]["tokens_out"]
+                          for e in engines)
+            assert eng_out == out_total - len(res[rids[4]]["tokens"])
+            for i, e in enumerate(engines):
+                assert e.compile_counts() == frozen[i]
+            assert router.compile_report()["unexpected_retraces"] == 0
+        finally:
+            router.close()
+
+    def test_router_tenants_endpoint_and_health(self, gpt_model):
+        router, engines, frozen = _fleet(gpt_model)
+        exp = router.serve_metrics(port=0)
+        try:
+            router.generate(_prompts((5, 9)), max_new_tokens=4)
+            doc = json.loads(urllib.request.urlopen(
+                exp.url + "/tenants", timeout=5).read())
+            assert doc["totals"]["requests"] == 2
+            assert doc["tenants"][0]["tenant"] == "anon"
+            assert router.health()["tenants"] == {"tracked": 1}
+        finally:
+            router.close()
+
+    def test_shed_prefers_heaviest_tenant_within_priority(
+            self, gpt_model):
+        """Saturate a 1-slot fleet after making 'whale' the dominant
+        tenant: the overflow shed lands on whale's queued work before
+        'shrimp's at the SAME priority."""
+        router, engines, frozen = _fleet(
+            gpt_model, n=1, max_slots=1,
+            router_kw={"max_queue": 2, "replica_queue_limit": 2})
+        try:
+            # establish usage history: whale >> shrimp
+            whale_rids = [router.submit(p, NEW_TOK, tenant="whale")
+                          for p in _prompts((17, 21, 14))]
+            router.run_to_completion()
+            assert router.tenants.usage("whale") \
+                > router.tenants.usage("shrimp")
+            prompts = _prompts((5, 12, 17, 9, 21, 14))
+            tenants = ["shrimp", "whale", "shrimp", "whale",
+                       "shrimp", "whale"]
+            rids = [router.submit(p, NEW_TOK, tenant=t)
+                    for p, t in zip(prompts, tenants)]
+            res = {r["id"]: r for r in router.run_to_completion()}
+            shed = [r for r in rids if res[r]["status"] == "shed"]
+            assert len(shed) == 2
+            assert all(res[r]["tenant"] == "whale" for r in shed), \
+                "the heavy tenant must shed before the light one"
+            del whale_rids
+        finally:
+            router.close()
+
+
+class TestTraceSampling:
+    def test_deterministic_keep_fraction_and_counter(self):
+        before = get_registry().get("fleet_traces_sampled_out_total")
+        before = 0 if before is None else before.value
+        store = TraceStore(sample=0.25)
+        kept = sum(1 for _ in range(100)
+                   if store.new_trace(rid=1) is not None)
+        assert kept == 25
+        assert store.sampled_out == 75
+        after = get_registry().get("fleet_traces_sampled_out_total")
+        assert after is not None and after.value - before == 75
+        # sample=1.0 keeps everything and counts nothing
+        full = TraceStore(sample=1.0)
+        assert all(full.new_trace(rid=i) is not None
+                   for i in range(10))
+        assert full.sampled_out == 0
+
+    def test_env_knob(self, monkeypatch):
+        import paddle_tpu.observability.dtrace as dt
+        monkeypatch.setattr(dt, "_default", None)
+        monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "0.5")
+        store = dt.get_store()
+        assert store.sample == 0.5
+        monkeypatch.setattr(dt, "_default", None)
+        monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "junk")
+        assert dt.get_store().sample == 1.0
+        monkeypatch.setattr(dt, "_default", None)
+
+    @pytest.mark.chaos
+    def test_sampled_out_requests_still_token_exact(self, gpt_model):
+        """sample=0.5 through a real fleet wave: every request
+        completes with the right tokens; dropped trees are counted,
+        kept ones still export; TTFT SLO simply skips the untraced."""
+        store = TraceStore(sample=0.5)
+        router, engines, frozen = _fleet(
+            gpt_model, router_kw={"trace_store": store})
+        try:
+            eng = _engine(gpt_model)
+            prompts = _prompts((5, 12, 17, 9))
+            refs = eng.generate(prompts, max_new_tokens=NEW_TOK)
+            eng.close()
+            outs = router.generate(prompts, max_new_tokens=NEW_TOK)
+            assert outs == refs
+            assert store.sampled_out == 2   # deterministic 1-in-2
+            assert len(store.trace_ids()) == 2
+            for i, e in enumerate(engines):
+                assert e.compile_counts() == frozen[i]
+        finally:
+            router.close()
+
+
+class TestProcFrameThreading:
+    def test_submit_frame_carries_tenant(self, monkeypatch):
+        """The Proc transport's wire frame carries the tenant label
+        (no subprocess needed: capture the frame at the send seam)."""
+        from paddle_tpu.serving_fleet.proc import ProcReplica
+        rep = ProcReplica.__new__(ProcReplica)
+        rep.name = "p0"
+        import threading
+        rep._out_lock = threading.Lock()
+        rep._inflight = {}
+        sent = []
+        monkeypatch.setattr(ProcReplica, "_send",
+                            lambda self, frame: sent.append(frame))
+        rep.enqueue(("submit", 7, [1, 2, 3], 4, None, 0,
+                     {"deadline_ms": None, "trace": None,
+                      "tenant": "acme"}))
+        assert sent[0]["tenant"] == "acme"
+        rep.enqueue(("submit", 8, [1], 4, None, 0))
+        assert sent[1]["tenant"] is None

@@ -15,6 +15,19 @@ Usage:
       --fail-on 'serve_decode_token_seconds:p99>10%' \
       --fail-on 'recompile_unexpected_retraces_total:value>0%'
 
+History mode — ONE archive, any two points in time: with
+``--history <snapshot>`` (a HistoryStore save, e.g. the
+``history_smoke`` stage's ``history_snapshot.json``) the A/B
+snapshots are RECONSTRUCTED from the archive's rings at ``--at t0``
+and ``--vs t1`` instead of read from two files, so a single history
+archive supports the canary gate at any two instants:
+
+  python tools/metrics_diff.py --history history_snapshot.json \
+      --at +0 --vs -0 --fail-on 'fleet_anomaly_fired_total>0%'
+
+``--at``/``--vs`` take epoch seconds, or ``+S`` (S seconds after the
+archive's first sample) / ``-S`` (S seconds before its last).
+
 --fail-on SPEC grammar: ``name[:stat]{>|<}PCT%`` — `name` matches a
 series key exactly or every series of that metric name; `stat` is
 ``value`` (counter/gauge, the default) or ``p50``/``p99``/``mean``/
@@ -172,11 +185,45 @@ def check_fail_on(a_doc, b_doc, specs):
     return failures
 
 
+def _resolve_t(spec, first, last):
+    """--at/--vs grammar: absolute epoch seconds, or +S from the
+    archive's first sample / -S from its last."""
+    s = str(spec).strip()
+    if s.startswith("+"):
+        return first + float(s[1:])
+    if s.startswith("-"):
+        return last - float(s[1:])
+    return float(s)
+
+
+def load_history_pair(path, at, vs):
+    """(a_doc, b_doc) reconstructed from a HistoryStore snapshot at
+    two instants — the history plane's registry_snapshot_at."""
+    HistoryStore = _obs_mod("history").HistoryStore
+    store = HistoryStore.load(path)
+    first, last = store.span()
+    if first is None:
+        raise ValueError(f"{path}: empty/unreadable history snapshot")
+    t0 = _resolve_t(at, first, last)
+    t1 = _resolve_t(vs, first, last)
+    return store.registry_snapshot_at(t0), \
+        store.registry_snapshot_at(t1), t0, t1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        description="diff two metrics.json registry snapshots")
-    ap.add_argument("a", help="baseline metrics.json")
-    ap.add_argument("b", help="candidate metrics.json")
+        description="diff two metrics.json registry snapshots, or one "
+                    "history archive at two points in time")
+    ap.add_argument("a", nargs="?", help="baseline metrics.json")
+    ap.add_argument("b", nargs="?", help="candidate metrics.json")
+    ap.add_argument("--history", default=None, metavar="SNAPSHOT",
+                    help="HistoryStore snapshot to reconstruct both "
+                         "sides from (with --at/--vs)")
+    ap.add_argument("--at", default=None, metavar="T0",
+                    help="history baseline instant (epoch s, +S from "
+                         "first sample, -S from last)")
+    ap.add_argument("--vs", default=None, metavar="T1",
+                    help="history candidate instant (same grammar)")
     ap.add_argument("--fail-on", action="append", type=parse_spec,
                     default=[], metavar="name[:stat]{>|<}PCT%",
                     help="regression threshold (repeatable)")
@@ -184,10 +231,22 @@ def main(argv=None):
                     help="suppress the human-readable section")
     args = ap.parse_args(argv)
 
-    a_doc, b_doc = load_snapshot(args.a), load_snapshot(args.b)
+    if args.history is not None:
+        if args.at is None or args.vs is None:
+            ap.error("--history requires --at and --vs")
+        a_doc, b_doc, t0, t1 = load_history_pair(
+            args.history, args.at, args.vs)
+        a_name = f"{args.history}@{t0:.3f}"
+        b_name = f"{args.history}@{t1:.3f}"
+    else:
+        if not args.a or not args.b:
+            ap.error("need two snapshot paths (or --history "
+                     "--at --vs)")
+        a_doc, b_doc = load_snapshot(args.a), load_snapshot(args.b)
+        a_name, b_name = args.a, args.b
     report = diff(a_doc, b_doc)
     failures = check_fail_on(a_doc, b_doc, args.fail_on)
-    report.update({"a": args.a, "b": args.b,
+    report.update({"a": a_name, "b": b_name,
                    "fail_on": [s["spec"] for s in args.fail_on],
                    "failures": failures, "ok": not failures})
 

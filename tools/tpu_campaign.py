@@ -185,6 +185,19 @@ STAGES = [
                                 "no:randomly"], 2400,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0",
       "PADDLE_TPU_RUN_SLOW": "1"}),
+    # telemetry-history / tenancy / anomaly-sentinel drill (ISSUE 11,
+    # CPU, seeded): a clean golden wave the sentinel must stay quiet
+    # on (including a replay over the COMMITTED clean golden archive
+    # tools/golden/history_clean_wave.json — band drift that alarms
+    # on known-good history fails here), then a wave with an injected
+    # mid-wave latency regression the sentinel MUST fire on (leaving
+    # a parseable fleet_anomaly flight dump); per-tenant token totals
+    # must sum EXACTLY to fleet counters and compile counts stay
+    # frozen with accounting on. The stage's history_snapshot.json is
+    # then driven through the history gate below (metrics_diff
+    # --history --at/--vs): quiet span clean, regression span trips.
+    ("history_smoke", [PY, "tools/history_smoke.py"], 1800,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
     ("bench_full", [PY, "bench.py"], 7200, {}),
     ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
      2400, {}),
@@ -349,7 +362,69 @@ FLEET_CANARY_FAIL_ON = (
     # self-healing regression (>0% = any increase)
     "fleet_respawns_total>200%",
     "fleet_crash_loops_total>0%",
+    # anomaly-sentinel counters (ISSUE 11): any sentinel excursion
+    # beyond the golden's count is a live regression the offline gate
+    # would otherwise only see post-mortem (series skipped until the
+    # golden is regenerated with a sentinel-armed chaos suite); a
+    # sampled-out-trace storm likewise means the sampling knob is
+    # eating observability
+    "fleet_anomaly_fired_total>0%",
+    "fleet_traces_sampled_out_total>200%",
 )
+
+# history gate (ISSUE 11): ONE archive, two instants, both directions
+# proven — the clean span must show no fleet_anomaly_* increase, the
+# injected-regression span MUST trip the same spec (a gate that never
+# fires is not a gate). Uses the stage's marks.json epoch marks.
+HISTORY_GATE_FAIL_ON = ("fleet_anomaly_fired_total>0%",)
+
+
+def run_history_gate(stage_name):
+    """Drive tools/metrics_diff.py --history over the stage's
+    archive at its clean/regression marks; leave history_verdict.json
+    (required by tools/validate_stages.py on _history_gate-marked
+    summaries). ok = clean span quiet AND regression span tripped."""
+    tele = os.path.join(OUT, "telemetry", stage_name)
+    snap = os.path.join(tele, "history_snapshot.json")
+    verdict = {"gate": "history", "snapshot": snap,
+               "fail_on": list(HISTORY_GATE_FAIL_ON)}
+    try:
+        with open(os.path.join(tele, "marks.json")) as f:
+            marks = json.load(f)
+
+        def gate(t0, t1):
+            cmd = [PY, "tools/metrics_diff.py", "--history", snap,
+                   "--at", repr(float(t0)), "--vs", repr(float(t1)),
+                   "--quiet"]
+            for spec in HISTORY_GATE_FAIL_ON:
+                cmd += ["--fail-on", spec]
+            proc = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                                  text=True, timeout=120)
+            lines = [l for l in proc.stdout.strip().splitlines() if l]
+            return json.loads(lines[-1]) if lines else {"ok": False}
+
+        clean = gate(marks["t0"], marks["t_clean"])
+        regression = gate(marks["t_clean"], marks["t_end"])
+        # vacuity guard: the gated series must actually be present in
+        # the clean-span diff — a quiet verdict over snapshots that
+        # never carried fleet_anomaly_* would prove nothing
+        covered = any(k.startswith("fleet_anomaly_fired_total")
+                      for k in (clean.get("counters") or {}))
+        verdict["clean_span"] = {"ok": clean.get("ok"),
+                                 "covered": covered,
+                                 "failures": clean.get("failures")}
+        verdict["regression_span"] = {
+            "ok": regression.get("ok"),
+            "failures": regression.get("failures")}
+        verdict["ok"] = bool(clean.get("ok")) and covered \
+            and not regression.get("ok")
+    except Exception as e:  # noqa: BLE001 — the gate must leave a
+        #                     verdict either way
+        verdict.update(ok=False, error=f"{type(e).__name__}: {e}")
+    os.makedirs(tele, exist_ok=True)
+    with open(os.path.join(tele, "history_verdict.json"), "w") as f:
+        json.dump(verdict, f, indent=1)
+    return verdict
 
 
 def run_fleet_canary_gate(stage_name):
@@ -402,9 +477,12 @@ def main():
     # records into their telemetry dir (round-10 introspection layer)
     # _fleet_canary marks a campaign whose fleet_chaos_smoke stage is
     # gated by the metrics_diff canary diff — validate_stages requires
-    # the gate's verdict file on such summaries
+    # the gate's verdict file on such summaries. _history_gate
+    # likewise marks that history_smoke is gated by the two-instant
+    # history diff (run_history_gate)
     summary = {"_captured_at": {"epoch": int(time.time())},
-               "_telemetry": 1, "_flightrec": 1, "_fleet_canary": 1}
+               "_telemetry": 1, "_flightrec": 1, "_fleet_canary": 1,
+               "_history_gate": 1}
     stages = [s for s in STAGES if s[0] not in RETRY_ONLY]
     if only:  # run in the order the caller listed, not STAGES order
         by_name = {s[0]: s for s in STAGES}
@@ -439,6 +517,18 @@ def main():
                 print("=== fleet canary gate FAILED: "
                       f"{verdict.get('failures') or verdict.get('error')}"
                       " ===", flush=True)
+        if name == "history_smoke" and ok:
+            verdict = run_history_gate(name)
+            gate_ok = bool(verdict.get("ok"))
+            summary[name]["history_gate"] = {
+                "ok": gate_ok,
+                "clean_span": verdict.get("clean_span"),
+                "regression_span": verdict.get("regression_span"),
+                "error": verdict.get("error")}
+            if not gate_ok:
+                ok = summary[name]["ok"] = False
+                print("=== history gate FAILED: "
+                      f"{json.dumps(verdict)[:300]} ===", flush=True)
         print(f"=== {name}: rc={rc} {dt}s "
               f"{json.dumps(parsed) if parsed else tail[-150:]!r} ===",
               flush=True)

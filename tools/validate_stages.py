@@ -43,6 +43,9 @@ REQUIRED_STAGES = {
     # process-isolated replicas + self-healing supervisor drill
     # (CPU-only, real subprocesses — ISSUE 10)
     "fleet_supervisor_smoke",
+    # telemetry-history / tenancy / anomaly-sentinel drill + the
+    # two-instant history gate (CPU-only — ISSUE 11)
+    "history_smoke",
 }
 
 
@@ -54,6 +57,7 @@ def _emits_metrics(cmd):
     other bare tools (decode_probe, fusion_audit) do not."""
     return any(os.path.basename(str(a)) in ("bench.py",
                                             "telemetry_smoke.py",
+                                            "history_smoke.py",
                                             "test_fleet_serving.py",
                                             "test_fleet_recovery.py",
                                             "test_fleet_proc.py")
@@ -109,7 +113,8 @@ def check_completed_stage_metrics():
 # dumps land there because the campaign exports BENCH_TELEMETRY_DIR
 # per stage — flightrec's dump-dir fallback)
 FLIGHT_STAGES = {"chaos_smoke", "telemetry_smoke",
-                 "fleet_recovery_smoke", "fleet_supervisor_smoke"}
+                 "fleet_recovery_smoke", "fleet_supervisor_smoke",
+                 "history_smoke"}
 
 
 def check_flight_dumps():
@@ -198,6 +203,41 @@ def check_canary_verdict():
     return [], 1
 
 
+def check_history_verdict():
+    """A _history_gate-marked campaign whose history_smoke stage
+    completed must have left the two-instant history gate's verdict
+    (telemetry/history_smoke/history_verdict.json, parseable, with an
+    'ok' flag) — a silently-skipped gate would let a sentinel
+    regression ship as a green campaign. Returns (problems, checked)."""
+    path = os.path.join(OUT, "summary.json")
+    try:
+        with open(path) as f:
+            summary = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return [], 0
+    if not summary.get("_history_gate"):
+        return [], 0   # pre-gate archive
+    row = summary.get("history_smoke")
+    if not isinstance(row, dict) or row.get("rc") is None:
+        return [], 0   # stage never ran
+    if not row.get("ok") and not row.get("history_gate"):
+        return [], 0   # failed on its own; no verdict expected
+    vpath = os.path.join(OUT, "telemetry", "history_smoke",
+                         "history_verdict.json")
+    try:
+        with open(vpath) as f:
+            verdict = json.load(f)
+    except OSError:
+        return [f"history_smoke: completed but the history gate left "
+                f"no verdict at {vpath}"], 1
+    except json.JSONDecodeError as e:
+        return [f"history_smoke: unparseable history verdict ({e})"], 1
+    if "ok" not in verdict:
+        return [f"history_smoke: history verdict {vpath} has no "
+                "'ok' flag"], 1
+    return [], 1
+
+
 def _child_pgids(pid):
     """Process groups of `pid`'s direct children: bench.py/decode_probe
     start their workers with start_new_session=True, so killpg on the
@@ -251,8 +291,11 @@ def main():
     metric_problems, metrics_checked = check_completed_stage_metrics()
     flight_problems, flights_checked = check_flight_dumps()
     canary_problems, canary_checked = check_canary_verdict()
-    metric_problems += flight_problems + canary_problems
-    metrics_checked += flights_checked + canary_checked
+    history_problems, history_checked = check_history_verdict()
+    metric_problems += flight_problems + canary_problems \
+        + history_problems
+    metrics_checked += flights_checked + canary_checked \
+        + history_checked
     for p in metric_problems:
         print(f"  metrics: SUSPECT ({p})", flush=True)
     tmp = tempfile.mkdtemp(prefix="stage_preflight_")
