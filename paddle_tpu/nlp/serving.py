@@ -210,6 +210,10 @@ class ServingEngine:
                                                self.page_size, use_flash)
         self.temperature = float(temperature)
         self.top_k = int(top_k)
+        self.sampling_seed = int(seed)  # published in health() so the
+        #                                 fleet capture archive records
+        #                                 what replay must match for
+        #                                 token-exact goldens
         self.pad_token_id = int(pad_token_id)
         self.steps_per_dispatch = int(steps_per_dispatch)
         self.donate = bool(donate)
@@ -871,6 +875,12 @@ class ServingEngine:
              "warmed": self.warmed,
              "warmed_buckets": sorted(self._warmed_buckets),
              "tenants_tracked": self.tenants.tracked,
+             # the decode-determinism fingerprint: replayed traffic is
+             # token-exact only when these (and the weights) match —
+             # the traffic-capture plane archives them per replica
+             "sampling": {"temperature": self.temperature,
+                          "top_k": self.top_k,
+                          "seed": self.sampling_seed},
              "compile_counts": self.compile_counts()}
         if self._watchdog is not None:
             h["watchdog"] = dict(self._watchdog.health(),

@@ -4,20 +4,21 @@ spans, distributed tracing, SLO burn-rate accounting, crash flight
 recorder) — docs/observability.md.
 
 Layering: ``metrics``, ``telemetry``, ``exporter``, ``spans``,
-``dtrace``, ``slo``, ``flightrec``, ``history``, ``tenancy`` and
-``sentinel`` are pure stdlib (importable from the jax-free bench
-orchestrator and worker processes); ``trace`` and ``introspect``
-import jax lazily inside the wrapping calls.
+``dtrace``, ``slo``, ``flightrec``, ``history``, ``tenancy``,
+``trafficrec`` and ``sentinel`` are pure stdlib (importable from the
+jax-free bench orchestrator and worker processes); ``trace`` and
+``introspect`` import jax lazily inside the wrapping calls.
 """
 from . import (dtrace, exporter, flightrec, history,  # noqa: F401
                introspect, metrics, sentinel, slo, spans, telemetry,
-               tenancy, trace)
+               tenancy, trace, trafficrec)
 from .dtrace import TraceStore, get_store  # noqa: F401
 from .exporter import MetricsExporter, serve_metrics  # noqa: F401
 from .flightrec import FlightRecorder  # noqa: F401
 from .history import HistoryStore  # noqa: F401
 from .sentinel import AnomalySentinel  # noqa: F401
 from .tenancy import SpaceSavingSketch, TenantAccountant  # noqa: F401
+from .trafficrec import TrafficRecorder, load_archive  # noqa: F401
 from .introspect import (cost_report, measured_mfu,  # noqa: F401
                          resolve_peak_flops)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
@@ -36,6 +37,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "FlightRecorder", "cost_report", "measured_mfu",
            "resolve_peak_flops", "HistoryStore", "AnomalySentinel",
            "SpaceSavingSketch", "TenantAccountant",
+           "TrafficRecorder", "load_archive",
            "metrics", "telemetry", "trace",
            "introspect", "exporter", "spans", "dtrace", "slo",
-           "flightrec", "history", "sentinel", "tenancy"]
+           "flightrec", "history", "sentinel", "tenancy",
+           "trafficrec"]

@@ -144,13 +144,17 @@ class TraceStore:
     # -- recording ---------------------------------------------------------
 
     def new_trace(self, name="request", proc="router", t0=None,
-                  rid=None, hops=8, args=None):
+                  rid=None, hops=8, args=None, force=False):
         """Open a new trace with its root span; returns the root
         context (None under introspection). Evicts the oldest WHOLE
-        trace beyond max_traces."""
+        trace beyond max_traces. ``force=True`` bypasses the
+        head-sampling gate (never the introspection suppression) —
+        the traffic-capture plane keeps every ARCHIVED request's span
+        tree so an archive entry always carries its attribution,
+        whatever PADDLE_TPU_TRACE_SAMPLE says about the rest."""
         if _suppressed():
             return None
-        if self.sample < 1.0 and not self._sample_keep():
+        if not force and self.sample < 1.0 and not self._sample_keep():
             return None
         trace_id = f"t{os.getpid():x}-{next(_id_counter)}"
         span = {"id": next(_id_counter), "parent": None,

@@ -66,6 +66,11 @@ class MetricsExporter:
         a trace id or fleet rid; return None for unknown keys -> 404).
         None disables the endpoint (FleetRouter.serve_metrics wires
         its trace_report here).
+    requests_fn: one-arg callable serving ``/requests`` (arg None =
+        the recent-resolved index: rid, tenant, status, ttft/e2e,
+        archive locator — the /traces index's request-plane sibling)
+        and ``/requests/<rid>`` (one row; None -> 404). None disables
+        the endpoint.
     history_fn: one-arg callable serving ``/history`` — receives the
         parsed query params ({} for a bare GET = the series index;
         keys like series/res/window/q/op select a range/rate/quantile
@@ -80,7 +85,7 @@ class MetricsExporter:
 
     def __init__(self, registry=None, port=0, host="127.0.0.1",
                  health_fn=None, report_fn=None, traces_fn=None,
-                 history_fn=None, tenants_fn=None):
+                 history_fn=None, tenants_fn=None, requests_fn=None):
         if registry is None:
             from .metrics import get_registry
             registry = get_registry()
@@ -90,6 +95,7 @@ class MetricsExporter:
         self.traces_fn = traces_fn
         self.history_fn = history_fn
         self.tenants_fn = tenants_fn
+        self.requests_fn = requests_fn
         self._started = time.time()
         exporter = self
 
@@ -141,6 +147,19 @@ class MetricsExporter:
                                 code=404)
                         else:
                             self._send_json(doc)
+                    elif exporter.requests_fn is not None and (
+                            path == "/requests"
+                            or path.startswith("/requests/")):
+                        key = (path[len("/requests/"):]
+                               if path.startswith("/requests/")
+                               else "") or None
+                        doc = exporter.requests_fn(key)
+                        if doc is None:
+                            self._send_json(
+                                {"error": f"unknown request {key!r}"},
+                                code=404)
+                        else:
+                            self._send_json(doc)
                     elif exporter.history_fn is not None \
                             and path == "/history":
                         from urllib.parse import parse_qs
@@ -161,6 +180,8 @@ class MetricsExporter:
                         endpoints = ["/metrics", "/healthz", "/report"]
                         if exporter.traces_fn is not None:
                             endpoints.append("/traces")
+                        if exporter.requests_fn is not None:
+                            endpoints.append("/requests")
                         if exporter.history_fn is not None:
                             endpoints.append("/history")
                         if exporter.tenants_fn is not None:
@@ -242,10 +263,11 @@ class MetricsExporter:
 
 def serve_metrics(port=0, registry=None, host="127.0.0.1",
                   health_fn=None, report_fn=None, traces_fn=None,
-                  history_fn=None, tenants_fn=None):
+                  history_fn=None, tenants_fn=None, requests_fn=None):
     """Start a MetricsExporter (the one-call attach the docs show);
     returns it — read ``.port`` / ``.url``, call ``.close()``."""
     return MetricsExporter(registry=registry, port=port, host=host,
                            health_fn=health_fn, report_fn=report_fn,
                            traces_fn=traces_fn, history_fn=history_fn,
-                           tenants_fn=tenants_fn)
+                           tenants_fn=tenants_fn,
+                           requests_fn=requests_fn)

@@ -382,6 +382,7 @@ class InprocReplica:
                 "queue_wait_p99_s": round(float(p99 or 0.0), 6),
                 "decode_tokens": h["decode_tokens"],
                 "tenants_tracked": h.get("tenants_tracked", 0),
+                "sampling": h.get("sampling"),
                 "compile_counts": h["compile_counts"]}
         with self._health_lock:
             self._health = snap

@@ -51,6 +51,7 @@ seam.
 from __future__ import annotations
 
 import collections
+import os
 import time
 
 from ..observability import dtrace
@@ -59,6 +60,7 @@ from ..observability.metrics import MetricsRegistry
 from ..observability.sentinel import AnomalySentinel
 from ..observability.slo import SLOTracker
 from ..observability.tenancy import TenantAccountant
+from ..observability.trafficrec import TrafficRecorder
 from ..resilience import faults, preemption
 from .client import ReplicaClient
 from .journal import Journal, JournalCrash, JournalError, reconcile, \
@@ -93,7 +95,7 @@ class _Pending:
                  "submitted_at", "placed_at", "replica", "hedge",
                  "delivered", "failovers", "hedged", "done",
                  "deadline", "trace", "queue_since_pc", "leg_ctxs",
-                 "leg_base", "leg_inc", "tenant")
+                 "leg_base", "leg_inc", "tenant", "captured")
 
     def __init__(self, rid, prompt, max_new, eos, priority,
                  deadline=None, tenant=None):
@@ -127,6 +129,9 @@ class _Pending:
         #                            of that name is a stale leg (the
         #                            replica respawned/rejoined since)
         #                            and is dropped in _handle
+        self.captured = None       # traffic-archive locator
+        #                            ({"segment","offset"}) when this
+        #                            request was captured at admission
 
 
 class FleetRouter:
@@ -196,6 +201,21 @@ class FleetRouter:
         delta; fires ``fleet_anomaly`` flight dumps + counters and
         surfaces in health()["anomaly"] exactly like SLO burn alerts.
         sentinel_kw tunes bands (z/warmup/min_consecutive/signals).
+    capture / capture_kw: traffic capture
+        (observability.trafficrec) — a directory path creates a
+        TrafficRecorder there (capture_kw forwarded: sample,
+        segment_max_bytes, max_segments); or pass a recorder; None
+        disables. Every ADMITTED request writes an ``arrival`` record
+        at submit and a ``resolve`` record (output tokens + compact
+        per-hop attribution) at resolve; captured requests force-keep
+        their span tree whatever PADDLE_TPU_TRACE_SAMPLE says, so an
+        archive entry always carries its attribution
+        (``fleet_capture_trace_missing_total`` counts divergences).
+        ``tools/fleet_replay.py`` re-drives a fleet from the archive.
+    placement_weights: score weights for ``_pick_replica`` — dict
+        over {"free_pages", "queued", "running", "queue_wait_p99_s",
+        "outstanding"} merged over the defaults (1, 8, 2, 50, 4).
+        A replay what-if knob as much as an operator one.
     """
 
     def __init__(self, replicas, *, registry=None, max_queue=64,
@@ -209,7 +229,9 @@ class FleetRouter:
                  journal_segment_max_bytes=1 << 20,
                  tenants=None, tenant_capacity=128,
                  history=None, history_interval_s=0.25,
-                 sentinel=None, sentinel_kw=None):
+                 sentinel=None, sentinel_kw=None,
+                 capture=None, capture_kw=None,
+                 placement_weights=None):
         self.replicas = {}
         self._clients = {}
         self._transport_retries = int(transport_retries)
@@ -229,6 +251,18 @@ class FleetRouter:
         self.replica_queue_limit = int(replica_queue_limit)
         self.hedge_after_ms = hedge_after_ms
         self.wedge_timeout_s = float(wedge_timeout_s)
+        self.placement_weights = {
+            "free_pages": 1.0, "queued": 8.0, "running": 2.0,
+            "queue_wait_p99_s": 50.0, "outstanding": 4.0}
+        if placement_weights:
+            unknown = set(placement_weights) - set(
+                self.placement_weights)
+            if unknown:
+                raise ValueError(
+                    f"unknown placement weight(s) {sorted(unknown)}; "
+                    f"known: {sorted(self.placement_weights)}")
+            self.placement_weights.update(
+                {k: float(v) for k, v in placement_weights.items()})
 
         self._pending = {}          # rid -> _Pending (retired when the
         #                             result is popped via results())
@@ -311,6 +345,23 @@ class FleetRouter:
                 compile_fn=self.compile_report,
                 **(sentinel_kw or {}))
         self.sentinel = sentinel if sentinel else None
+        # -- traffic capture plane: arrival/resolve records per
+        # admitted request into a bounded rotating archive — the
+        # replay harness's (tools/fleet_replay.py) input. Best-effort
+        # by contract: a capture failure costs a record, never the
+        # serving path
+        if capture is None or capture is False:
+            self.recorder = None
+        elif isinstance(capture, (str, os.PathLike)):
+            self.recorder = TrafficRecorder(
+                capture, registry=reg, **(capture_kw or {}))
+        else:
+            self.recorder = capture
+        # recent-resolved index (the /requests endpoint): one row per
+        # resolved request with its archive locator, bounded like the
+        # trace-id ring so a scraper can find a request without
+        # scanning archives
+        self._requests_index = collections.deque(maxlen=512)
         self._m_req = {}
         self._m_routed = {}
         self._m_failover = {}
@@ -438,10 +489,20 @@ class FleetRouter:
                 deadline_epoch=None if deadline_ms is None
                 else round(time.time() + float(deadline_ms) / 1e3, 6),
                 submitted_epoch=round(time.time(), 6))
+        # traffic capture decides BEFORE the trace mints: a captured
+        # request force-keeps its span tree (whole-tree head sampling
+        # stays coherent with capture sampling — an archived request
+        # always carries its attribution)
+        if self.recorder is not None and self.recorder.admit():
+            p.captured = self.recorder.record_arrival(
+                rid, p.prompt, p.max_new, eos=p.eos,
+                priority=p.priority, tenant=p.tenant,
+                deadline_ms=deadline_ms, t_pc=p.queue_since_pc)
         p.trace = self._tstore.new_trace(
             name="request", proc="router", rid=rid,
             args={"prompt_len": len(p.prompt), "max_new": p.max_new,
-                  "priority": p.priority})
+                  "priority": p.priority},
+            force=p.captured is not None)
         if p.trace is not None:
             self._trace_ids.append(p.trace["trace_id"])
         self._pending[rid] = p
@@ -805,6 +866,32 @@ class FleetRouter:
                 "attribution": self._tstore.attribution(
                     key, tolerance=self.attribution_tolerance)}
 
+    def _requests_endpoint(self, key):
+        """The /requests handler: recent-resolved index (one cheap
+        deque copy — rid, tenant, status, ttft/e2e, archive locator;
+        the /traces index's request-plane sibling), or one row by
+        fleet rid. fleet_top and the replay tool locate a request
+        here instead of scanning archives."""
+        # the handler runs on exporter HTTP threads while the control
+        # thread appends — copying a deque mid-append can raise
+        # "mutated during iteration"; one retry makes the race benign
+        try:
+            rows = list(self._requests_index)
+        except RuntimeError:
+            rows = list(self._requests_index)
+        if key is None:
+            return {"requests": rows,
+                    "capture": None if self.recorder is None else {
+                        "dir": self.recorder.dir,
+                        "sample": self.recorder.sample}}
+        if not str(key).isdigit():
+            return None
+        rid = int(key)
+        for row in reversed(rows):
+            if row["rid"] == rid:
+                return row
+        return None
+
     def export_timeline(self, path, extra_recorders=()):
         """Merge every trace this router minted (bounded to the last
         512) into ONE Perfetto timeline: a router lane plus one lane
@@ -834,6 +921,7 @@ class FleetRouter:
             report_fn=lambda: {"fleet_compile_report":
                                self.compile_report()},
             traces_fn=self._traces_endpoint,
+            requests_fn=self._requests_endpoint,
             history_fn=None if self.history is None
             else self._history_endpoint,
             tenants_fn=None if self.tenants is None
@@ -891,6 +979,9 @@ class FleetRouter:
             self._journal.close()
         if self.history is not None:
             self.history.stop()   # no-op unless start() armed a thread
+        if self.recorder is not None:
+            self.recorder.close()  # finalize the active segment so a
+            #                        closed archive replays drop-free
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None
@@ -1093,6 +1184,7 @@ class FleetRouter:
                                     "hedged": p.hedged})
         ttft = self._ttft_from_trace(p) if status == "ok" else None
         self._record_slo(p, status, age, ttft)
+        self._note_resolved(p, result, age, ttft)
         # fleet-level token/latency series + per-tenant attribution —
         # the history plane scrapes these, the sentinel bands them.
         # Counted at the SAME commit point, so sketch totals equal the
@@ -1112,6 +1204,57 @@ class FleetRouter:
                 kv_page_s=float(u.get("kv_page_s") or 0.0),
                 requests=1)
         self._done[p.rid] = result
+
+    def _note_resolved(self, p, result, age_s, ttft):
+        """Post-resolve accounting for the capture plane: append the
+        /requests index row (always — the index is how fleet_top and
+        the replay tool locate a request without scanning archives)
+        and, for captured requests, the ``resolve`` archive record
+        with the compact per-hop attribution. A captured request that
+        resolved without a span tree or attribution is a
+        capture<->trace sampling divergence — counted, never
+        silent."""
+        hops = None
+        if p.captured is not None and p.trace is not None:
+            # attribution is O(spans) per request — paid only for
+            # CAPTURED requests (the archive is what needs it; the
+            # index row stays a one-pass cheap append)
+            try:
+                att = self._tstore.attribution(
+                    p.trace["trace_id"],
+                    tolerance=self.attribution_tolerance)
+            except Exception:  # noqa: BLE001 — accounting only
+                att = None
+            if att is not None:
+                hops = [{"name": h["name"], "proc": h["proc"],
+                         "dur_s": h["dur_s"],
+                         "outcome": h["outcome"]}
+                        for h in att["hops"]]
+        self._requests_index.append({
+            "rid": p.rid, "tenant": p.tenant,
+            "status": result["status"],
+            "ttft_s": None if ttft is None else round(ttft, 6),
+            "e2e_s": round(age_s, 6),
+            "replica": result["replica"],
+            "failovers": p.failovers, "hedged": p.hedged,
+            "trace_id": result["trace_id"],
+            "archive": None if p.captured is None
+            else dict(p.captured),
+            "ts": round(time.time(), 6)})
+        if p.captured is None or self.recorder is None:
+            return
+        if hops is None:
+            # divergence: counted via the recorder's PUBLIC surface
+            # (capture= also accepts caller-supplied recorders)
+            note = getattr(self.recorder, "note_trace_missing", None)
+            if note is not None:
+                note()
+        self.recorder.record_resolve(
+            p.rid, result["status"], result["tokens"],
+            tenant=p.tenant, replica=result["replica"],
+            failovers=p.failovers, hedged=p.hedged,
+            e2e_s=age_s, ttft_s=ttft, hops=hops,
+            trace_id=result["trace_id"])
 
     def _record_slo(self, p, status, age_s, ttft=None):
         """Fold one resolved request into the SLO windows: e2e
@@ -1159,6 +1302,14 @@ class FleetRouter:
                 continue
             if snap:
                 self._last_scrape[name] = snap
+                # the capture archive's replay-fidelity meta: each
+                # replica's sampling params (temperature/top_k/seed)
+                # ride its health plane — golden-mode replay asserts
+                # token-exactness only when these match
+                if self.recorder is not None \
+                        and snap.get("sampling") is not None:
+                    self.recorder.note_meta(**{
+                        f"sampling.{name}": snap["sampling"]})
                 # per-replica clock-skew upper bound from heartbeat
                 # timestamps: receive_time - publish_ts >= |skew|, and
                 # the min over many heartbeats approaches the true
@@ -1204,18 +1355,22 @@ class FleetRouter:
         """Best serving replica by scraped health: free pages up,
         queue depth / occupancy / queue-wait p99 down; capacity-capped
         by the router's own outstanding count. Deterministic tie-break
-        on name."""
+        on name. Weights come from ``placement_weights`` — a
+        constructor knob so a replay what-if (or a future autotuner)
+        can score alternatives without patching this method."""
+        w = self.placement_weights
         best, best_key = None, None
         for name, snap in self._serving_candidates():
             if name in exclude:
                 continue
             if outstanding.get(name, 0) >= self.replica_queue_limit:
                 continue
-            score = (float(snap.get("free_pages", 0))
-                     - 8.0 * float(snap.get("queued", 0))
-                     - 2.0 * float(snap.get("running", 0))
-                     - 50.0 * float(snap.get("queue_wait_p99_s", 0.0))
-                     - 4.0 * outstanding.get(name, 0))
+            score = (w["free_pages"] * float(snap.get("free_pages", 0))
+                     - w["queued"] * float(snap.get("queued", 0))
+                     - w["running"] * float(snap.get("running", 0))
+                     - w["queue_wait_p99_s"]
+                     * float(snap.get("queue_wait_p99_s", 0.0))
+                     - w["outstanding"] * outstanding.get(name, 0))
             key = (score, name)
             if best_key is None or score > best_key[0] \
                     or (score == best_key[0] and name < best_key[1]):
@@ -1230,6 +1385,15 @@ class FleetRouter:
         return [name for name, rep in self.replicas.items()
                 if name not in self._lost and rep.alive
                 and name not in self._last_scrape]
+
+    @property
+    def booted(self):
+        """True once every live replica's first heartbeat has landed
+        (the placement boot gate is open). A load generator that
+        starts its clock before this measures the fleet's boot
+        transient, not its serving behaviour — tools/fleet_replay.py
+        waits on this before the first scheduled arrival."""
+        return not self._unscraped()
 
     def _expire_queued(self):
         """Requests whose deadline lapsed while still queued at the

@@ -1,0 +1,175 @@
+"""tools/fleet_top.py — the fleet htop (satellite of ISSUE 12).
+
+Covers both data paths the tool ships:
+
+- ``--snapshot`` offline mode rendered against the COMMITTED history
+  archive (tools/golden/history_clean_wave.json) — the artifact every
+  history_smoke run regenerates its claims from, so the offline
+  renderer must keep reading it;
+- the live-poll path against a stub exporter serving canned
+  /healthz, /history, /tenants and /requests docs — collect_live
+  must survive partial deployments (endpoints missing) and render
+  the replica/tenant/recent-request tables.
+"""
+import importlib
+import json
+import os
+import shutil
+import sys
+
+import pytest
+
+from paddle_tpu.observability.exporter import MetricsExporter
+from paddle_tpu.observability.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+ft = importlib.import_module("fleet_top")
+
+GOLDEN_HISTORY = os.path.join(REPO, "tools", "golden",
+                              "history_clean_wave.json")
+
+HEALTH = {"queue_depth": 1, "pending": 2, "lost": [],
+          "slo": {"alerting": ["ttft"]},
+          "anomaly": {"alerting": []},
+          "replicas": {
+              "r0": {"state": "serving", "incarnation": 1,
+                     "queued": 0, "running": 1, "free_pages": 6,
+                     "scrape_age_s": 0.02, "lost": False,
+                     "quarantined": False},
+              "r1": {"state": "drained", "incarnation": 3,
+                     "queued": 0, "running": 0, "free_pages": 7,
+                     "scrape_age_s": 1.5, "lost": True,
+                     "quarantined": True}}}
+
+TENANTS = {"tracked": 2, "capacity": 8, "evictions": 0,
+           "error_bound": 0,
+           "totals": {"tokens_in": 30, "tokens_out": 64,
+                      "queue_wait_s": 0.2, "kv_page_s": 2.0,
+                      "requests": 8},
+           "tenants": [
+               {"tenant": "acme", "weight": 70, "err": 0,
+                "tokens_in": 20, "tokens_out": 50,
+                "queue_wait_s": 0.1, "kv_page_s": 1.5,
+                "requests": 5},
+               {"tenant": "anon", "weight": 24, "err": 0,
+                "tokens_in": 10, "tokens_out": 14,
+                "queue_wait_s": 0.1, "kv_page_s": 0.5,
+                "requests": 3}]}
+
+REQUESTS = {"capture": {"dir": "/tmp/cap", "sample": 1.0},
+            "requests": [
+                {"rid": 4, "tenant": "acme", "status": "ok",
+                 "ttft_s": 0.011, "e2e_s": 0.034, "replica": "r0",
+                 "failovers": 0, "hedged": False,
+                 "archive": {"segment": "cap-000001.jsonl",
+                             "offset": 1234}, "ts": 0.0},
+                {"rid": 5, "tenant": None, "status": "shed",
+                 "ttft_s": None, "e2e_s": 0.002, "replica": None,
+                 "failovers": 0, "hedged": False, "archive": None,
+                 "ts": 0.0}]}
+
+
+@pytest.fixture()
+def stub_exporter():
+    exp = MetricsExporter(
+        registry=MetricsRegistry(), port=0,
+        health_fn=lambda: HEALTH,
+        history_fn=lambda params: {"value": 2.5}
+        if params.get("series") else {"series": []},
+        tenants_fn=lambda: TENANTS,
+        requests_fn=lambda key: REQUESTS if key is None else None)
+    yield exp
+    exp.close()
+
+
+class TestOfflineSnapshot:
+    def test_committed_archive_renders(self, tmp_path):
+        """--snapshot offline mode against the COMMITTED clean-wave
+        history archive: the frame carries real history-derived
+        rates and the renderer stays total on it."""
+        shutil.copy(GOLDEN_HISTORY,
+                    tmp_path / "history_snapshot.json")
+        frame = ft.collect_snapshot(str(tmp_path))
+        assert frame["ts"] is not None
+        rates = frame["rates"]
+        # the committed clean wave really served traffic
+        assert rates["tok_s"] is not None and rates["tok_s"] > 0
+        assert rates["ttft_p99_s"] is not None
+        text = ft.render(frame)
+        assert "tok/s" in text and "fleet_top" in text
+
+    def test_main_snapshot_mode(self, tmp_path, capsys):
+        shutil.copy(GOLDEN_HISTORY,
+                    tmp_path / "history_snapshot.json")
+        with open(tmp_path / "health.json", "w") as f:
+            json.dump(HEALTH, f)
+        with open(tmp_path / "tenants.json", "w") as f:
+            json.dump(TENANTS, f)
+        with open(tmp_path / "requests.json", "w") as f:
+            json.dump(REQUESTS, f)
+        rc = ft.main(["--snapshot", str(tmp_path)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "r0" in out and "acme" in out
+        assert "cap-000001.jsonl@1234" in out
+
+    def test_snapshot_without_sidecars(self, tmp_path):
+        """health/tenants/requests sidecars are optional — a bare
+        archive still renders (post-mortem dirs are often partial)."""
+        shutil.copy(GOLDEN_HISTORY,
+                    tmp_path / "history_snapshot.json")
+        frame = ft.collect_snapshot(str(tmp_path))
+        assert frame["health"] is None
+        assert frame["requests"] is None
+        assert "fleet_top" in ft.render(frame)
+
+
+class TestLivePoll:
+    def test_collect_live_full_stack(self, stub_exporter):
+        frame = ft.collect_live(stub_exporter.url)
+        assert frame["health"]["queue_depth"] == 1
+        assert frame["tenants"]["tracked"] == 2
+        assert frame["requests"]["requests"][0]["rid"] == 4
+        # /history rollups answered by the stub
+        assert frame["rates"]["req_s"] == 2.5
+        assert frame["rates"]["ttft_p99_s"] == 2.5
+
+    def test_render_live_frame(self, stub_exporter):
+        text = ft.render(ft.collect_live(stub_exporter.url))
+        # replica table with flags (lost + quarantined -> LQ)
+        assert "r1" in text and "LQ" in text
+        assert "serving" in text and "drained" in text
+        # tenant table
+        assert "acme" in text
+        # recent-requests table with the archive locator
+        assert "RECENT REQUESTS" in text
+        assert "cap-000001.jsonl@1234" in text
+        assert "shed" in text
+        # SLO alert surfaced
+        assert "ttft" in text
+
+    def test_main_live_once(self, stub_exporter, capsys):
+        rc = ft.main(["--url", stub_exporter.url, "--once"])
+        assert rc == 0
+        assert "fleet_top" in capsys.readouterr().out
+
+    def test_live_survives_missing_endpoints(self):
+        """A router without tenancy/history/capture still renders —
+        collect_live degrades per endpoint, never dies."""
+        exp = MetricsExporter(registry=MetricsRegistry(), port=0,
+                              health_fn=lambda: HEALTH)
+        try:
+            frame = ft.collect_live(exp.url)
+            assert frame["tenants"] is None
+            assert frame["requests"] is None
+            assert frame["rates"]["req_s"] is None
+            assert "r0" in ft.render(frame)
+        finally:
+            exp.close()
+
+    def test_url_and_snapshot_mutually_exclusive(self):
+        with pytest.raises(SystemExit):
+            ft.main([])
+        with pytest.raises(SystemExit):
+            ft.main(["--url", "http://x", "--snapshot", "/tmp"])

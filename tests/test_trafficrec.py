@@ -1,0 +1,251 @@
+"""Traffic capture plane (paddle_tpu/observability/trafficrec.py).
+
+Pins the ISSUE-12 archive contracts (docs/observability.md "Traffic
+capture & replay"):
+
+- len+crc framed records through the journal's wire format; an
+  archive truncated at ANY byte offset loads its prefix — never
+  raises, never duplicates, drops at most the tail (fuzz ladder);
+- bounded rotation: segments roll at ``segment_max_bytes`` and the
+  ring keeps at most ``max_segments`` (capture can never fill a
+  disk); finalized segments carry the io/atomic ``.complete`` marker;
+- deterministic fractional-accumulator capture sampling, counted in
+  ``fleet_capture_sampled_out_total`` — dropped is visible;
+- every write is suppressed under ``introspecting()``;
+- arrival+resolve fold into replayable entries (arrival order,
+  rebased arrival offsets, meta records newest-wins).
+"""
+import json
+import os
+
+import pytest
+
+from paddle_tpu.observability import introspect
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.observability.trafficrec import (TrafficRecorder,
+                                                 load_archive)
+
+
+def _record_n(rec, n, resolve=True, start=0):
+    refs = []
+    for i in range(start, start + n):
+        refs.append(rec.record_arrival(
+            i, [1, 2, 3 + i], 8, eos=None, priority=i % 2,
+            tenant=f"t{i % 3}", deadline_ms=None))
+        if resolve:
+            rec.record_resolve(
+                i, "ok", [7, 8, 9 + i], tenant=f"t{i % 3}",
+                replica="r0", e2e_s=0.5 + i, ttft_s=0.1,
+                hops=[{"name": "replica_leg", "proc": "r0",
+                       "dur_s": 0.4, "outcome": "ok"}])
+    return refs
+
+
+class TestArchiveRoundtrip:
+    def test_capture_and_load(self, tmp_path):
+        reg = MetricsRegistry()
+        rec = TrafficRecorder(tmp_path, registry=reg)
+        refs = _record_n(rec, 5)
+        rec.note_meta(**{"sampling.r0": {"temperature": 0.0}})
+        rec.record_arrival(99, [5], 4)  # meta flushes on this write
+        rec.close()
+        assert all(r is not None for r in refs)
+        assert refs[0]["segment"] == "cap-000001.jsonl"
+        entries, meta, stats = load_archive(tmp_path)
+        assert [e["rid"] for e in entries] == [0, 1, 2, 3, 4, 99]
+        assert stats["torn_drops"] == 0
+        assert stats["unresolved"] == 1  # rid 99 never resolved
+        e0 = entries[0]
+        assert e0["prompt"] == [1, 2, 3]
+        assert e0["tokens"] == [7, 8, 9]
+        assert e0["status"] == "ok"
+        assert e0["tenant"] == "t0"
+        assert e0["hops"][0]["name"] == "replica_leg"
+        assert e0["arrival_s"] == 0.0  # rebased to first arrival
+        assert meta["sampling.r0"] == {"temperature": 0.0}
+        assert int(reg.get("fleet_capture_requests_total").value) == 6
+        assert int(reg.get("fleet_capture_errors_total").value) == 0
+
+    def test_arrival_offsets_rebase(self, tmp_path):
+        rec = TrafficRecorder(tmp_path)
+        rec.record_arrival(0, [1], 4, t_pc=100.5)
+        rec.record_arrival(1, [1], 4, t_pc=100.75)
+        rec.close()
+        entries, _, _ = load_archive(tmp_path)
+        assert entries[0]["arrival_s"] == 0.0
+        assert entries[1]["arrival_s"] == pytest.approx(0.25)
+
+    def test_meta_newest_wins(self, tmp_path):
+        rec = TrafficRecorder(tmp_path)
+        rec.note_meta(k="old")
+        rec.record_arrival(0, [1], 4)
+        rec.note_meta(k="new")
+        rec.record_arrival(1, [1], 4)
+        rec.close()
+        _, meta, _ = load_archive(tmp_path)
+        assert meta["k"] == "new"
+
+
+class TestTornTolerance:
+    def test_truncate_at_every_offset(self, tmp_path):
+        """The journal discipline: a copy truncated at ANY byte
+        offset loads without raising, never duplicates a record, and
+        loses at most the tail."""
+        rec = TrafficRecorder(tmp_path)
+        _record_n(rec, 3)
+        rec.close()
+        seg = os.path.join(tmp_path, "cap-000001.jsonl")
+        data = open(seg, "rb").read()
+        full, _, _ = load_archive(tmp_path)
+        prev_rids = None
+        for cut in range(len(data) + 1):
+            with open(seg, "wb") as f:
+                f.write(data[:cut])
+            entries, _, stats = load_archive(tmp_path)
+            rids = [e["rid"] for e in entries]
+            assert rids == sorted(set(rids))  # never duplicated
+            assert len(entries) <= len(full)
+            if prev_rids is not None:
+                # monotone: more bytes can only reveal more
+                assert set(prev_rids) <= set(rids) or cut == 0
+            prev_rids = rids
+        assert prev_rids == [e["rid"] for e in full]
+
+    def test_garbage_lines_resync(self, tmp_path):
+        rec = TrafficRecorder(tmp_path)
+        _record_n(rec, 2)
+        rec.close()
+        seg = os.path.join(tmp_path, "cap-000001.jsonl")
+        data = open(seg, "rb").read()
+        lines = data.split(b"\n")
+        lines.insert(2, b"not a frame at all")
+        with open(seg, "wb") as f:
+            f.write(b"\n".join(lines))
+        entries, _, stats = load_archive(tmp_path)
+        assert [e["rid"] for e in entries] == [0, 1]
+        assert stats["torn_drops"] == 1
+
+
+class TestRotation:
+    def test_segments_roll_and_ring_bounds(self, tmp_path):
+        reg = MetricsRegistry()
+        rec = TrafficRecorder(tmp_path, registry=reg,
+                              segment_max_bytes=512, max_segments=3)
+        _record_n(rec, 40)
+        rec.close()
+        segs = sorted(f for f in os.listdir(tmp_path)
+                      if f.startswith("cap-")
+                      and f.endswith(".jsonl"))
+        assert 1 < len(segs) <= 3  # rotated AND bounded
+        assert int(reg.get(
+            "fleet_capture_rotations_total").value) > 0
+        # finalized segments carry the io/atomic marker
+        from paddle_tpu.io import atomic
+        for seg in segs[:-1]:
+            assert atomic.has_marker(os.path.join(tmp_path, seg))
+        # the ring dropped the oldest — the survivors still load
+        entries, _, stats = load_archive(tmp_path)
+        assert stats["torn_drops"] == 0
+        rids = [e["rid"] for e in entries]
+        assert rids == sorted(rids)
+        assert rids[-1] == 39  # newest survives
+
+    def test_failed_rotation_never_raises(self, tmp_path):
+        """Best-effort contract under the worst case: the archive
+        directory vanishes mid-run, the next rotation cannot open a
+        segment — capture dies QUIETLY (errors counted, writes
+        dropped), never propagating into the submit path."""
+        import shutil
+        reg = MetricsRegistry()
+        rec = TrafficRecorder(tmp_path / "cap", registry=reg,
+                              segment_max_bytes=256)
+        assert rec.record_arrival(0, [1] * 20, 8) is not None
+        shutil.rmtree(tmp_path / "cap")
+        # keep writing until rotation trips on the missing dir, then
+        # beyond — every call must return None/record, never raise
+        for i in range(1, 30):
+            rec.record_arrival(i, [1] * 20, 8)
+        assert rec.record_arrival(99, [1], 4) is None  # capture dead
+        assert int(reg.get(
+            "fleet_capture_errors_total").value) >= 1
+        rec.close()  # idempotent on the dead recorder
+
+    def test_meta_survives_transient_write_failure(self, tmp_path,
+                                                   monkeypatch):
+        """A transient I/O failure on the meta write must not drop
+        the sampling params forever — the dirty flag clears only
+        after the write lands, so the next append retries it."""
+        rec = TrafficRecorder(tmp_path)
+        rec.note_meta(k="v")
+        real = TrafficRecorder._write_rec
+        calls = {"n": 0}
+
+        def flaky(self, rec_, fsync=False):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("transient")
+            return real(self, rec_, fsync)
+
+        monkeypatch.setattr(TrafficRecorder, "_write_rec", flaky)
+        assert rec.record_arrival(0, [1], 4) is None  # meta write hit
+        assert rec.record_arrival(1, [1], 4) is not None  # retried
+        rec.close()
+        _, meta, _ = load_archive(tmp_path)
+        assert meta == {"k": "v"}
+
+    def test_reopen_continues_numbering(self, tmp_path):
+        rec = TrafficRecorder(tmp_path)
+        _record_n(rec, 1)
+        rec.close()
+        rec2 = TrafficRecorder(tmp_path)
+        _record_n(rec2, 1, start=10)
+        rec2.close()
+        entries, _, _ = load_archive(tmp_path)
+        assert [e["rid"] for e in entries] == [0, 10]
+
+
+class TestSamplingAndSuppression:
+    def test_deterministic_fractional_sampling(self, tmp_path):
+        reg = MetricsRegistry()
+        rec = TrafficRecorder(tmp_path, registry=reg, sample=0.5)
+        kept = [rec.admit() for _ in range(10)]
+        assert kept == [False, True] * 5  # accumulator, no RNG
+        assert rec.sampled_out == 5
+        assert int(reg.get(
+            "fleet_capture_sampled_out_total").value) == 5
+        rec.close()
+
+    def test_sample_env_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_CAPTURE_SAMPLE", "0.25")
+        rec = TrafficRecorder(tmp_path)
+        assert rec.sample == 0.25
+        rec.close()
+
+    def test_suppressed_under_introspection(self, tmp_path):
+        rec = TrafficRecorder(tmp_path)
+        introspect._introspecting.on = True
+        try:
+            assert rec.admit() is False
+            assert rec.record_arrival(0, [1], 4) is None
+            assert rec.record_resolve(0, "ok", [1]) is None
+        finally:
+            introspect._introspecting.on = False
+        rec.close()
+        entries, _, stats = load_archive(tmp_path)
+        assert entries == []
+
+    def test_closed_recorder_drops(self, tmp_path):
+        rec = TrafficRecorder(tmp_path)
+        rec.close()
+        assert rec.record_arrival(0, [1], 4) is None
+        assert rec.admit() is False
+
+    def test_nonfinite_floats_stay_valid_json(self, tmp_path):
+        rec = TrafficRecorder(tmp_path)
+        rec.record_resolve(0, "ok", [1], e2e_s=float("nan"),
+                           ttft_s=float("inf"))
+        rec.close()
+        seg = os.path.join(tmp_path, "cap-000001.jsonl")
+        for line in open(seg, "rb").read().split(b"\n"):
+            if line:
+                json.loads(line[18:])  # RFC-valid payload

@@ -4,12 +4,14 @@ A terminal live view over a running FleetRouter's observability
 endpoints: one frame per interval showing the fleet headline (request
 rate, delivered tok/s, TTFT/queue-wait p99 from the history plane),
 SLO burn alerts + anomaly-sentinel excursions, the per-replica table
-(state, incarnation, queue/running, free pages, scrape age) and the
+(state, incarnation, queue/running, free pages, scrape age), the
 per-tenant heavy-hitter table (space-saving sketch: weight, tokens
-in/out, KV-page-seconds, the error bound).
+in/out, KV-page-seconds, the error bound) and the recent-resolved
+request table (rid, status, ttft/e2e, traffic-archive locator).
 
-Live mode reads ``/healthz`` + ``/history`` + ``/tenants`` off the
-router exporter (``FleetRouter.serve_metrics``):
+Live mode reads ``/healthz`` + ``/history`` + ``/tenants`` +
+``/requests`` off the router exporter
+(``FleetRouter.serve_metrics``):
 
   python tools/fleet_top.py --url http://127.0.0.1:9101
   python tools/fleet_top.py --url ... --once        # one frame, exit
@@ -60,6 +62,10 @@ def collect_live(base):
         tenants = _get(base + "/tenants")
     except Exception:  # noqa: BLE001 — tenancy may be off
         tenants = None
+    try:
+        requests = _get(base + "/requests")
+    except Exception:  # noqa: BLE001 — pre-capture routers lack it
+        requests = None
 
     def roll(series, op, **kw):
         from urllib.parse import quote
@@ -74,7 +80,7 @@ def collect_live(base):
 
     return {
         "ts": time.time(), "source": base, "health": health,
-        "tenants": tenants,
+        "tenants": tenants, "requests": requests,
         "rates": {
             "req_s": roll("fleet_requests_total{status=\"ok\"}",
                           "rate"),
@@ -110,6 +116,7 @@ def collect_snapshot(directory):
         "ts": last, "source": directory,
         "health": read_json("health.json"),
         "tenants": read_json("tenants.json"),
+        "requests": read_json("requests.json"),
         "rates": {
             "req_s": roll("fleet_requests_total{status=\"ok\"}",
                           "rate"),
@@ -177,6 +184,25 @@ def render(frame):
                 f"{row['tokens_in']:<7} {row['tokens_out']:<8}"
                 f"{_fmt(row['queue_wait_s'], nd=2):<9}"
                 f"{_fmt(row['kv_page_s'], nd=2):<9}{row['err']}")
+    rq = frame.get("requests")
+    if rq and rq.get("requests"):
+        cap = rq.get("capture") or {}
+        out.append(
+            "  RECENT REQUESTS"
+            + (f"  (capture: {cap.get('dir')}"
+               f" @ sample={cap.get('sample')})" if cap else ""))
+        out.append("  RID    TENANT        STATUS     TTFT_S   E2E_S"
+                   "    REPLICA  ARCHIVE")
+        for row in (rq.get("requests") or [])[-8:]:
+            arch = row.get("archive") or {}
+            loc = (f"{arch.get('segment')}@{arch.get('offset')}"
+                   if arch else "-")
+            out.append(
+                f"  {row['rid']:<6} {str(row.get('tenant')):<13} "
+                f"{row['status']:<10} "
+                f"{_fmt(row.get('ttft_s'), nd=3):<8} "
+                f"{_fmt(row.get('e2e_s'), nd=3):<8} "
+                f"{str(row.get('replica')):<8} {loc}")
     return "\n".join(out)
 
 

@@ -198,6 +198,20 @@ STAGES = [
     # --history --at/--vs): quiet span clean, regression span trips.
     ("history_smoke", [PY, "tools/history_smoke.py"], 1800,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
+    # traffic capture & deterministic replay drill (ISSUE 12, CPU,
+    # seeded): the committed 20-request wave
+    # (tools/golden/replay_wave.json) is captured live through a
+    # capture-armed fleet (archive complete, zero capture<->trace
+    # sampling divergences, compile counts frozen with capture on),
+    # the COMMITTED archive replays golden (token-exact per rid, zero
+    # new XLA traces), the live capture replays clean under the
+    # default verdict gates (per-hop attribution deltas within 5%),
+    # and an injected replica_slow regression MUST trip the same gate
+    # spec — both gate directions proven, vacuity-guarded. Artifacts:
+    # replay_verdict.json + replay_verdict_regression.json + the
+    # capture archive, next to the stage's metrics.json.
+    ("replay_smoke", [PY, "tools/replay_smoke.py"], 1800,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
     ("bench_full", [PY, "bench.py"], 7200, {}),
     ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
      2400, {}),
@@ -370,6 +384,14 @@ FLEET_CANARY_FAIL_ON = (
     # eating observability
     "fleet_anomaly_fired_total>0%",
     "fleet_traces_sampled_out_total>200%",
+    # traffic-capture counters (ISSUE 12): ANY capture write error is
+    # a loss of the replay corpus, and ANY capture<->trace sampling
+    # divergence means archived requests lost their attribution —
+    # both ship-stoppers, not jitter. (Series skipped by metrics_diff
+    # until the golden is regenerated with a capture-armed chaos
+    # suite — same bootstrap as the sentinel counters above.)
+    "fleet_capture_errors_total>0%",
+    "fleet_capture_trace_missing_total>0%",
 )
 
 # history gate (ISSUE 11): ONE archive, two instants, both directions

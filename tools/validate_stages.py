@@ -46,6 +46,10 @@ REQUIRED_STAGES = {
     # telemetry-history / tenancy / anomaly-sentinel drill + the
     # two-instant history gate (CPU-only — ISSUE 11)
     "history_smoke",
+    # traffic capture & deterministic replay drill: committed-wave
+    # golden replay + verdict-gate both-directions proof (CPU-only —
+    # ISSUE 12)
+    "replay_smoke",
 }
 
 
@@ -58,6 +62,7 @@ def _emits_metrics(cmd):
     return any(os.path.basename(str(a)) in ("bench.py",
                                             "telemetry_smoke.py",
                                             "history_smoke.py",
+                                            "replay_smoke.py",
                                             "test_fleet_serving.py",
                                             "test_fleet_recovery.py",
                                             "test_fleet_proc.py")
