@@ -11,6 +11,7 @@ the model class.
 """
 from __future__ import annotations
 
+import itertools
 import os
 import pickle
 
@@ -19,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..nn.layer import Layer, functional_call
+from ..observability.trace import get_tracer
 from ..tensor import Tensor
 
 __all__ = ["to_static", "save", "load", "InputSpec", "not_to_static",
@@ -49,6 +51,9 @@ def _unwrap(x):
         is_leaf=lambda t: isinstance(t, Tensor))
 
 
+_SITE_IDS = itertools.count()
+
+
 class StaticFunction:
     """Callable wrapper produced by @to_static."""
 
@@ -60,6 +65,25 @@ class StaticFunction:
         self._compiled = {}
         self._tracing = False
         self._ast_tried = False
+        # unique RecompileTracer site per wrapper: two StaticFunctions
+        # over different layers can share input signatures, and a
+        # shared site would misread the second one's first trace as an
+        # unexpected retrace
+        self._site = f"to_static_{next(_SITE_IDS)}"
+        self._tracer_sites = set()
+
+    def __del__(self):
+        # release this wrapper's sites from the process-global tracer
+        # (a site that saw an unexpected retrace is kept — forget()
+        # refuses, so churn can't launder the signal); the bare-jax
+        # caches previously died with the wrapper, the tracer's
+        # accounting must too
+        try:
+            tracer = get_tracer()
+            for site in self._tracer_sites:
+                tracer.forget(site)
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass
 
     def __call__(self, *args, **kwargs):
         if not _TO_STATIC_ENABLED[0]:
@@ -112,7 +136,16 @@ class StaticFunction:
 
             jitted = self._compiled.get(("layer", training))
             if jitted is None:
-                jitted = jax.jit(pure)
+                # through the RecompileTracer: a to_static trace is a
+                # compile the zero-recompile report must see (the
+                # train/eval split gets its own site — same shapes,
+                # different program). introspect=False: no AOT-replay
+                # double compile on a user-facing one-shot build.
+                site = (f"{self._site}_"
+                        f"{'train' if training else 'eval'}")
+                jitted = get_tracer().jit(site, pure,
+                                          introspect=False)
+                self._tracer_sites.add(site)
                 self._compiled[("layer", training)] = jitted
             from ..framework import next_rng_key
             arr_args = _unwrap(args)
@@ -123,7 +156,9 @@ class StaticFunction:
         if jitted is None:
             def pure(*a, **kw):
                 return _unwrap(self._fn(*a, **kw))
-            jitted = jax.jit(pure)
+            site = f"{self._site}_fn"
+            jitted = get_tracer().jit(site, pure, introspect=False)
+            self._tracer_sites.add(site)
             self._compiled["fn"] = jitted
         out = jitted(*_unwrap(args), **_unwrap(kwargs))
         return jax.tree_util.tree_map(Tensor, out)

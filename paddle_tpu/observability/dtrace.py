@@ -197,6 +197,10 @@ class TraceStore:
         return False
 
     def _append(self, trace_id, span):
+        # every caller already holds self._lock (start_span/end_span/
+        # annotate take it before delegating) — re-taking a plain Lock
+        # here would self-deadlock
+        # tpulint: disable-next-line=CON01
         rec = self._traces.get(trace_id)
         if rec is None:
             return False  # trace already evicted: drop, never orphan

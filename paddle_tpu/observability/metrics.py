@@ -258,8 +258,13 @@ class MetricsRegistry:
         return self._get(Histogram, name, help, labels, buckets=buckets)
 
     def get(self, name, labels=None):
-        """Existing series or None (read-side: tests, rollups)."""
-        return self._metrics.get(_series_key(name, dict(labels or {})))
+        """Existing series or None (read-side: tests, rollups). Under
+        the lock like every other reader: a lazily-registered series
+        resizing the dict mid-lookup on a scrape thread is the same
+        race snapshot() guards against."""
+        with self._lock:
+            return self._metrics.get(
+                _series_key(name, dict(labels or {})))
 
     def series(self):
         with self._lock:

@@ -25,6 +25,7 @@ under a lock per span).
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
@@ -37,6 +38,19 @@ __all__ = ["SpanRecorder", "export_chrome"]
 # an aligned timeline
 _EPOCH_BASE = time.time()
 _PERF_BASE = time.perf_counter()
+
+
+def _finite(obj):
+    """Non-finite floats -> None (RFC-valid JSON for jq/Perfetto).
+    (Duplicated across the observability modules by contract — each
+    stays standalone-loadable from bench._obs_mod.)"""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _finite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_finite(v) for v in obj]
+    return obj
 
 
 def _to_epoch_us(perf_t):
@@ -168,6 +182,13 @@ def export_chrome(path, recorders):
     os.makedirs(d, exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump(doc, f)
+        try:
+            json.dump(doc, f, allow_nan=False)
+        except ValueError:
+            # a NaN span arg (e.g. a loss annotation mid-storm) must
+            # still land as valid JSON Perfetto will open
+            f.seek(0)
+            f.truncate()
+            json.dump(_finite(doc), f, allow_nan=False)
     os.replace(tmp, path)
     return path

@@ -81,8 +81,12 @@ class TelemetryLogger:
             # a NaN loss (the storm the guard records) must still land
             # as valid JSON: normalize via a tolerant round-trip, then
             # null out the non-finite leaves
-            rec = _finite(json.loads(
-                json.dumps(rec, default=_json_default)))
+            # the inner dumps MUST keep allow_nan: it is the tolerant
+            # normalization round-trip whose output _finite() then
+            # nulls — the emitted line below carries allow_nan=False
+            # tpulint: disable-next-line=OBS01
+            raw = json.dumps(rec, default=_json_default)
+            rec = _finite(json.loads(raw))
             line = json.dumps(rec, allow_nan=False) + "\n"
         self._f.write(line)
         self._bytes += len(line)

@@ -87,7 +87,7 @@ class RecompileTracer:
             _all_tracers.append(self)
 
     # -- wrapping ----------------------------------------------------------
-    def jit(self, site, fn, **jit_kwargs):
+    def jit(self, site, fn, introspect=True, **jit_kwargs):
         """jax.jit(fn) with trace accounting at `site`. The inner bump
         runs exactly when jax traces (compiles); the outer wrapper
         stays host-side and records the event + signature only on a
@@ -98,7 +98,9 @@ class RecompileTracer:
         host-side note check ``introspecting()``, so the replay can
         never masquerade as a recompile (nested sites included —
         train_step re-traced inside train_step_multi's replay stays
-        silent too)."""
+        silent too). ``introspect=False`` keeps the accounting but
+        skips the AOT replay — for user-facing one-shot compiles
+        (to_static) where doubling the compile buys nothing."""
         import jax
         try:
             from .introspect import introspecting
@@ -124,7 +126,8 @@ class RecompileTracer:
             if counts.get(site, 0) != before:
                 wall = time.perf_counter() - t0
                 tracer._note(site, args, kw, wall)
-                tracer._introspect(site, jfn, args, kw, wall)
+                if introspect:
+                    tracer._introspect(site, jfn, args, kw, wall)
             return out
 
         call.site = site
@@ -184,6 +187,21 @@ class RecompileTracer:
         """Bump `site` from inside a hand-rolled traced body (legacy
         callers); no signature/event is recorded."""
         self._counts[site] = self._counts.get(site, 0) + 1
+
+    def forget(self, site):
+        """Drop a site's accounting. For dynamically-minted sites
+        (to_static wrappers releasing theirs on GC) so a
+        wrapper-churning process doesn't grow the tracer — and its
+        report — without bound. A site that recorded an UNEXPECTED
+        retrace is kept: that signal must survive the wrapper that
+        produced it, or churn could launder a real recompile out of
+        the report. Returns True when the site was dropped."""
+        if self._unexpected.get(site):
+            return False
+        self._counts.pop(site, None)
+        self._sigs.pop(site, None)
+        self._unexpected.pop(site, None)
+        return True
 
     # -- queries -----------------------------------------------------------
     def counts(self):

@@ -230,6 +230,9 @@ class ProcReplica:
         os.makedirs(inc_dir, exist_ok=True)
         env = dict(os.environ)
         env.update(self._env_extra)
+        # spawn-env plumbing, not a telemetry emission: the spec is a
+        # finite-by-construction dict the child round-trips verbatim
+        # tpulint: disable-next-line=OBS01
         env["PADDLE_TPU_PROC_SPEC"] = json.dumps(self.spec)
         env["PADDLE_TPU_FLIGHT_DIR"] = inc_dir
         env.pop("PADDLE_TPU_FAULTS", None)   # the parent's chaos wave

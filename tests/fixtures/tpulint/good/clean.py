@@ -1,0 +1,14 @@
+"""The gate-proof twin of ``bad/violations.py``: every contract the
+seeded file breaks, honored — tpulint over this tree must exit 0."""
+import os
+
+
+def traced(tracer, fn):
+    # routed through a RecompileTracer site: TRC01-clean
+    return tracer.jit("fixture_site", fn)
+
+
+def durable_write(doc):
+    from paddle_tpu.io import atomic
+    golden = os.path.join("tools", "golden", "wave.json")
+    return atomic.atomic_replace(golden, doc)

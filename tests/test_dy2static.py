@@ -384,3 +384,57 @@ def test_while_with_augassign():
 
     np.testing.assert_allclose(
         np.asarray(f(_x([1.5, 0.5])).numpy()), 8.0, rtol=1e-6)
+
+
+# ---------- tracer accounting (ISSUE 13: to_static through the tracer)
+
+def test_to_static_compiles_land_in_tracer_accounting():
+    """A to_static trace is a compile the zero-recompile report must
+    see: per-wrapper train/eval sites, one trace each, and a repeat
+    call (cached program) must not bump anything."""
+    from paddle_tpu.observability.trace import get_tracer
+    paddle.seed(0)
+    net = _Plain()
+    st = paddle.jit.to_static(net)
+    x = _x(np.ones((2, 4)))
+    st(x); st(x)
+    net.eval()
+    st(x)
+    tracer = get_tracer()
+    prefix = st.forward._site   # to_static(Layer) returns the layer;
+    #                             the StaticFunction is its forward
+    sites = {s: n for s, n in tracer.counts().items()
+             if s.startswith(prefix)}
+    assert sorted(s.rsplit("_", 1)[1] for s in sites) \
+        == ["eval", "train"], sites
+    assert all(n == 1 for n in sites.values()), sites
+    assert tracer.report()["unexpected_retraces"] == 0
+
+
+def test_to_static_wrapper_gc_releases_tracer_sites():
+    """Dynamically-minted sites die with their wrapper (a
+    wrapper-churning process must not grow the tracer without
+    bound) — but a site that saw an unexpected retrace is KEPT, so
+    churn can't launder the signal out of the report."""
+    import gc
+    from paddle_tpu.observability.trace import get_tracer
+    paddle.seed(0)
+    net = _Plain()
+    st = paddle.jit.to_static(net)
+    st(_x(np.ones((2, 4))))
+    site_prefix = st.forward._site
+    tracer = get_tracer()
+    assert any(s.startswith(site_prefix) for s in tracer.counts())
+    del st, net   # to_static(Layer) returned `net` itself
+    gc.collect()
+    assert not any(s.startswith(site_prefix)
+                   for s in tracer.counts())
+    # forget() refuses when the site carries a retrace signal
+    tracer._counts["phantom_site"] = 2
+    tracer._unexpected["phantom_site"] = 1
+    try:
+        assert tracer.forget("phantom_site") is False
+        assert "phantom_site" in tracer.counts()
+    finally:
+        tracer._unexpected.pop("phantom_site", None)
+        tracer.forget("phantom_site")

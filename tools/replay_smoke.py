@@ -134,12 +134,17 @@ def main(argv=None):
         if ok:
             # wave_spec = the GENERATED schedule (the drift guard's
             # reference); entries = the CAPTURED archive (measured
-            # arrival offsets + resolved tokens — golden replay input)
-            with open(GOLDEN, "w") as f:
-                json.dump({"format": 1,
-                           "seed": WAVE_SEED, "n": WAVE_N,
-                           "wave_spec": _spec(wave),
-                           "entries": entries}, f, indent=1)
+            # arrival offsets + resolved tokens — golden replay input).
+            # Through io/atomic: a ctrl-C mid-regen must cost this
+            # regen, never the committed golden every future campaign
+            # replays against.
+            from paddle_tpu.io import atomic
+            atomic.atomic_replace(
+                GOLDEN,
+                json.dumps({"format": 1,
+                            "seed": WAVE_SEED, "n": WAVE_N,
+                            "wave_spec": _spec(wave),
+                            "entries": entries}, indent=1) + "\n")
         print(json.dumps({"ok": ok, "wrote_golden": GOLDEN if ok
                           else None, "checks": checks}))
         return 0 if ok else 1

@@ -116,6 +116,15 @@ def _driver_bench_active(max_age_s=45 * 60):
 
 STAGES = [
     ("probe", [PY, "bench.py", "--worker", "probe"], 600, {}),
+    # static invariant sweep (ISSUE 13, CPU, seconds): tools/tpulint
+    # over paddle_tpu/ + tools/ + bench.py — trace-safety, durability,
+    # concurrency, telemetry-JSON and doc-catalogue contracts checked
+    # BEFORE any chaos stage burns minutes discovering the same bug at
+    # runtime. Zero tunnel window; the stage's lint_report.json lands
+    # in its telemetry dir (the CLI honors BENCH_TELEMETRY_DIR) where
+    # validate_stages requires non_baselined == 0.
+    ("staticcheck", [PY, "-m", "tools.tpulint", "--json"], 600,
+     {"JAX_PLATFORMS": "cpu"}),
     # resilience chaos drill (ISSUE 3): fault-injection suite with a
     # fixed seed, forced onto CPU — it validates the build's failure
     # handling (guard/rollback, preemption resume, serving

@@ -1,0 +1,835 @@
+"""tpulint rules — the repo's hard-won contracts as AST checkers.
+
+Each rule distills a class of bug this repo has actually shipped and
+then chased through chaos drills (docs/static_analysis.md maps every
+rule to the CHANGES.md incident that motivated it):
+
+- TRC01 untraced-jit       raw jax.jit/pjit outside the RecompileTracer
+- TRC02 retrace-risk       host impurity / Python branches in traced bodies
+- DUR01 raw-durable-write  journal/checkpoint/flight/golden writes
+                           bypassing io/atomic
+- CON01 lock-discipline    guarded registry/store state touched outside
+                           the owning lock
+- OBS01 json-validity      telemetry json.dump(s) without the
+                           non-finite-safe (allow_nan=False) discipline
+- DOC01 catalogue-drift    emitted fleet_* metrics / PADDLE_TPU_* knobs
+                           vs the committed doc tables, both directions
+
+All stdlib. Checkers must stay SYNTACTIC and conservative: a rule that
+cries wolf gets disabled; a miss is caught by the chaos drills the way
+it always was. Suppress intentional sites inline
+(``# tpulint: disable=RULE`` with a reason in the same comment) or
+grandfather them in ``baseline.json`` with a justification.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import glob
+import os
+import re
+
+from .core import Finding
+
+__all__ = ["RULES", "active_rules"]
+
+
+class Rule:
+    def __init__(self, id, name, doc, fn, project_level=False):
+        self.id = id
+        self.name = name
+        self.doc = doc
+        self._fn = fn
+        self.project_level = project_level
+
+    def check(self, ctx):
+        return self._fn(ctx)
+
+    def check_project(self, ctxs, root):
+        return self._fn(ctxs, root)
+
+
+RULES = {}
+
+
+def _register(id, name, doc, project_level=False):
+    def deco(fn):
+        RULES[id] = Rule(id, name, doc, fn, project_level)
+        return fn
+    return deco
+
+
+def active_rules(ids=None):
+    if not ids:
+        return list(RULES.values())
+    unknown = [i for i in ids if i not in RULES]
+    if unknown:
+        raise KeyError(f"unknown rule(s): {unknown}; "
+                       f"known: {sorted(RULES)}")
+    return [RULES[i] for i in ids]
+
+
+# -- shared AST helpers -----------------------------------------------------
+
+def _dotted(node):
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Imports:
+    """Per-file import facts: what names mean 'jax' / 'jax.jit'."""
+
+    def __init__(self, tree):
+        self.jax_aliases = set()        # names bound to the jax module
+        self.jit_names = set()          # names bound to jax.jit / pjit
+        self.pjit_mod_aliases = set()   # names bound to the pjit module
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax" or a.name.startswith("jax."):
+                        self.jax_aliases.add(a.asname
+                                             or a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name in ("jit", "pjit"):
+                            self.jit_names.add(a.asname or a.name)
+                if node.module.startswith("jax.experimental"):
+                    for a in node.names:
+                        if a.name == "pjit":
+                            # `from jax.experimental.pjit import pjit`
+                            # binds the FUNCTION; `from jax.experimental
+                            # import pjit` binds the MODULE — treat the
+                            # name as both (call-form disambiguates)
+                            self.jit_names.add(a.asname or a.name)
+                            self.pjit_mod_aliases.add(a.asname
+                                                      or a.name)
+
+    @classmethod
+    def of(cls, ctx):
+        imp = ctx.cache.get("imports")
+        if imp is None:
+            imp = ctx.cache["imports"] = cls(ctx.tree)
+        return imp
+
+    def raw_jit_symbol(self, node):
+        """'jax.jit' / 'pjit' when `node` is a raw jit/pjit reference
+        (NOT a tracer's .jit method), else None."""
+        if isinstance(node, ast.Name):
+            return node.id if node.id in self.jit_names else None
+        d = _dotted(node)
+        if not d:
+            return None
+        root, leaf = d.split(".")[0], d.split(".")[-1]
+        if leaf in ("jit", "pjit") and root in self.jax_aliases:
+            return d
+        if leaf == "pjit" and root in self.pjit_mod_aliases:
+            return d
+        return None
+
+
+def _call_mode_arg(call):
+    """The `mode` argument of an open() call, if a string constant."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+# -- TRC01: untraced jit ----------------------------------------------------
+
+_TRC01_EXEMPT = {
+    # the tracer IS the sanctioned jax.jit site
+    "paddle_tpu/observability/trace.py",
+}
+
+
+@_register(
+    "TRC01", "untraced-jit",
+    "jax.jit/pjit not routed through a RecompileTracer site — the "
+    "compile is invisible to zero-recompile accounting "
+    "(report_all(), the serving compile-count freeze, the sentinel's "
+    "delta signal). Route through tracer.jit(site, fn); probes that "
+    "measure compiles themselves belong in the baseline.")
+def _trc01(ctx):
+    if ctx.path in _TRC01_EXEMPT:
+        return []
+    imports = _Imports.of(ctx)
+    out = []
+
+    def hit(node, expr):
+        sym = imports.raw_jit_symbol(expr)
+        if sym:
+            out.append(ctx.finding(
+                "TRC01", node, sym,
+                f"raw {sym} call bypasses the RecompileTracer — route "
+                f"through tracer.jit(site, fn) so the compile lands in "
+                f"zero-recompile accounting"))
+            return True
+        return False
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            hit(node, node.func)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    # @partial(jax.jit, ...): the Call walk above sees
+                    # partial(), not jax.jit — check its first arg
+                    d = _dotted(dec.func) or ""
+                    if d.split(".")[-1] == "partial" and dec.args:
+                        hit(dec, dec.args[0])
+                else:
+                    hit(dec, dec)
+    return out
+
+
+# -- TRC02: retrace risk ----------------------------------------------------
+
+_TRC02_IMPURE = {
+    "time.time", "time.time_ns", "time.perf_counter",
+    "time.perf_counter_ns", "time.monotonic", "time.monotonic_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.now", "os.urandom", "uuid.uuid1", "uuid.uuid4",
+    "random.random", "random.randint", "random.uniform",
+    "random.choice", "random.shuffle", "random.sample", "os.getenv",
+}
+_TRC02_IMPURE_PREFIXES = ("np.random.", "numpy.random.", "os.environ")
+_TRACED_WRAPPER_LEAVES = {"scan", "while_loop", "fori_loop", "cond"}
+
+
+def _traced_bodies(ctx, imports):
+    """FunctionDef/Lambda nodes whose bodies run under a jax trace:
+    jit-decorated defs, fns passed to jax.jit / tracer.jit(site, fn),
+    and bodies handed to lax.scan / while_loop / fori_loop / cond.
+    Name references resolve in the CALL's enclosing scope (innermost
+    def whose body defines that name), so a scan body called `step`
+    can never alias an unrelated method named `step` elsewhere in the
+    file."""
+    traced_nodes = set()
+    parents = ctx.parents()
+
+    def _find_def(scope, name):
+        # DIRECT children only — lexical scoping, so a class method
+        # named like a scan body elsewhere can never alias it
+        for n in getattr(scope, "body", ()):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name == name:
+                return n
+        return None
+
+    def note_arg(arg, at):
+        if isinstance(arg, (ast.Lambda, ast.FunctionDef)):
+            traced_nodes.add(id(arg))
+            return
+        if not isinstance(arg, ast.Name):
+            return
+        node = at
+        while id(node) in parents:
+            node = parents[id(node)]
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Module)):
+                hit = _find_def(node, arg.id)
+                if hit is not None:
+                    traced_nodes.add(id(hit))
+                    return
+                if isinstance(node, ast.Module):
+                    return
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                expr = dec
+                if isinstance(dec, ast.Call):
+                    d = _dotted(dec.func) or ""
+                    expr = dec.args[0] if (
+                        d.split(".")[-1] == "partial" and dec.args) \
+                        else dec.func
+                if imports.raw_jit_symbol(expr):
+                    traced_nodes.add(id(node))
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func) or ""
+        leaf = d.split(".")[-1] if d else ""
+        root = d.split(".")[0] if d else ""
+        if imports.raw_jit_symbol(node.func) and node.args:
+            note_arg(node.args[0], node)
+        elif leaf == "jit" and not imports.raw_jit_symbol(node.func) \
+                and len(node.args) >= 2:
+            # tracer.jit(site, fn) — the fn is still a traced body
+            note_arg(node.args[1], node)
+        elif leaf in _TRACED_WRAPPER_LEAVES and root and (
+                root in imports.jax_aliases or root == "lax"):
+            # scan/while_loop(cond_fn, body_fn)/fori_loop(lo, hi, body)
+            # /cond(pred, true_fn, false_fn): every callable positional
+            # arg is a traced body
+            for a in node.args:
+                note_arg(a, node)
+    return traced_nodes
+
+
+def _param_names(fn):
+    names = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.Lambda)):
+        a = fn.args
+        for arg in (list(a.posonlyargs) + list(a.args)
+                    + list(a.kwonlyargs)):
+            names.add(arg.arg)
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+    return names
+
+
+@_register(
+    "TRC02", "retrace-risk",
+    "host impurity (wall clocks, RNG, environment reads) or a Python "
+    "if/while on a traced value inside a jitted/scanned body — the "
+    "impurity freezes at trace time or forces data-dependent "
+    "retracing; use lax.cond/jnp.where and pass host values as args.")
+def _trc02(ctx):
+    imports = _Imports.of(ctx)
+    traced = _traced_bodies(ctx, imports)
+    if not traced:
+        return []
+    out = []
+    analyzed = set()   # a body nested inside a traced body is reached
+    #                    both via visit()'s recursion and the traced
+    #                    set — analyze once or findings double-count
+
+    def analyze(fn):
+        if id(fn) in analyzed:
+            return
+        analyzed.add(id(fn))
+        tainted = set(_param_names(fn))
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+
+        def _static_roots(e):
+            """Subtrees whose VALUE is static under trace even when
+            rooted at a tainted name: `.ndim/.shape/.dtype/.size`
+            attribute reads and `len(x)` — trace-time Python ints the
+            bucket-drift bug can't ride on."""
+            roots = set()
+            for n in ast.walk(e):
+                if isinstance(n, ast.Attribute) and n.attr in (
+                        "ndim", "shape", "dtype", "size"):
+                    roots.update(id(c) for c in ast.walk(n))
+                elif isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Name) \
+                        and n.func.id in ("len", "isinstance", "type"):
+                    roots.update(id(c) for c in ast.walk(n))
+            return roots
+
+        def expr_tainted(e, ignore_static=False):
+            skip = _static_roots(e) if ignore_static else ()
+            return any(isinstance(n, ast.Name) and n.id in tainted
+                       and id(n) not in skip
+                       for n in ast.walk(e))
+
+        def test_on_traced(test):
+            """True only for a COMPARISON or arithmetic on a tainted
+            VALUE (`if x > 0`, `while n < k`) — the bucket-drift bug.
+            Bare truthiness (`if labels:`, `if not labels:`),
+            `is None`, and static-metadata reads (`x.ndim == 3`,
+            `len(xs) > 1`) are trace-time pytree/shape tests, legal
+            under trace."""
+            for n in ast.walk(test):
+                if isinstance(n, ast.Compare):
+                    none_cmp = all(
+                        isinstance(op, (ast.Is, ast.IsNot))
+                        for op in n.ops)
+                    if not none_cmp and (
+                            expr_tainted(n.left, ignore_static=True)
+                            or any(expr_tainted(c, ignore_static=True)
+                                   for c in n.comparators)):
+                        return True
+                elif isinstance(n, ast.UnaryOp) \
+                        and not isinstance(n.op, ast.Not) \
+                        and expr_tainted(n, ignore_static=True):
+                    return True
+                elif isinstance(n, ast.BinOp) \
+                        and expr_tainted(n, ignore_static=True):
+                    return True
+            return False
+
+        def visit(node):
+            # a nested def/lambda inherits the traced context
+            # (closures over tracers trace too) but gets its own
+            # params — and must go through analyze() exactly once,
+            # whether reached here or via the traced set
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                analyze(node)
+                return
+            if isinstance(node, ast.Assign):
+                if expr_tainted(node.value):
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d and (d in _TRC02_IMPURE or any(
+                        d.startswith(p)
+                        for p in _TRC02_IMPURE_PREFIXES)):
+                    out.append(ctx.finding(
+                        "TRC02", node, d,
+                        f"{d}() inside a traced body executes at "
+                        f"TRACE time only — its value freezes into "
+                        f"the compiled program (pass it in as an "
+                        f"argument instead)"))
+            if isinstance(node, ast.Subscript):
+                d = _dotted(node.value)
+                if d == "os.environ":
+                    out.append(ctx.finding(
+                        "TRC02", node, "os.environ",
+                        "os.environ read inside a traced body freezes "
+                        "at trace time"))
+            if isinstance(node, (ast.If, ast.While)):
+                if test_on_traced(node.test):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    out.append(ctx.finding(
+                        "TRC02", node, f"{kind}-on-traced",
+                        f"Python `{kind}` on a traced value — this "
+                        f"either fails to trace or silently retraces "
+                        f"per branch; use lax.cond/lax.select/"
+                        f"jnp.where"))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in body:
+            visit(stmt)
+
+    for node in ast.walk(ctx.tree):
+        if id(node) in traced:
+            analyze(node)
+    return out
+
+
+# -- DUR01: raw durable writes ----------------------------------------------
+
+_DUR01_DURABLE_FILES = {
+    "paddle_tpu/io/checkpoint.py",
+    "paddle_tpu/serving_fleet/journal.py",
+    "paddle_tpu/observability/flightrec.py",
+    "paddle_tpu/observability/history.py",
+    "paddle_tpu/observability/trafficrec.py",
+}
+_DUR01_EXEMPT = {
+    # io/atomic.py IS the write-then-rename discipline
+    "paddle_tpu/io/atomic.py",
+}
+_DUR01_TOKENS = ("journal", "wal-", "ckpt", "checkpoint", "flight_",
+                 "golden", ".complete")
+
+
+@_register(
+    "DUR01", "raw-durable-write",
+    "write-mode open()/os.rename/os.replace on a durable artifact "
+    "path (journal/checkpoint/flight/golden) outside io/atomic — a "
+    "crash mid-write leaves a torn file no reader tolerates; route "
+    "through io.atomic.atomic_replace/write_marker/unique_path.")
+def _dur01(ctx):
+    if ctx.path in _DUR01_EXEMPT:
+        return []
+    durable_file = ctx.path in _DUR01_DURABLE_FILES
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        sym = None
+        if isinstance(node.func, ast.Name) and node.func.id == "open":
+            mode = _call_mode_arg(node)
+            if mode and ("w" in mode or "x" in mode):
+                sym = f'open(mode="{mode}")'
+        elif d in ("os.rename", "os.replace"):
+            sym = d
+        if sym is None:
+            continue
+        if durable_file:
+            out.append(ctx.finding(
+                "DUR01", node, sym,
+                f"{sym} inside a durable-artifact module bypasses "
+                f"io/atomic's write-then-rename + marker discipline"))
+            continue
+        seg = ctx.segment(node).lower()
+        if any(t in seg for t in _DUR01_TOKENS):
+            out.append(ctx.finding(
+                "DUR01", node, sym,
+                f"{sym} on what looks like a durable artifact path — "
+                f"route through io/atomic so a crash can't tear it"))
+    return out
+
+
+# -- CON01: lock discipline -------------------------------------------------
+
+# scoped to the classes the exporter's HTTP threads actually read
+# concurrently with dispatch (the ISSUE 13 contract); widen the set as
+# new shared-state stores grow scrape-side readers
+_CON01_FILES = {
+    "paddle_tpu/observability/metrics.py",
+    "paddle_tpu/observability/dtrace.py",
+}
+
+
+def _con01_class_findings(ctx, cls):
+    init = next((n for n in cls.body
+                 if isinstance(n, ast.FunctionDef)
+                 and n.name == "__init__"), None)
+    if init is None:
+        return []
+    lock_attr = None
+    containers = set()
+    for node in ast.walk(init):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not (isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            continue
+        v = node.value
+        d = _dotted(getattr(v, "func", None)) or ""
+        leaf = d.split(".")[-1]
+        if leaf in ("Lock", "RLock") and "lock" in t.attr.lower():
+            lock_attr = lock_attr or t.attr
+        elif t.attr.startswith("_") and (
+                isinstance(v, (ast.Dict, ast.List, ast.Set))
+                or leaf in ("dict", "list", "set", "OrderedDict",
+                            "deque", "defaultdict", "Counter")):
+            containers.add(t.attr)
+    if not lock_attr or not containers:
+        return []
+
+    locked_attrs = set()
+    unlocked_sites = []   # (node, attr, method_name)
+
+    def scan(node, in_lock, method):
+        if isinstance(node, ast.With):
+            # exact match on `self.<lock_attr>`: a substring test
+            # would count `with global_lock:` / `with other._lock:`
+            # as holding THIS object's lock and miss the torn-scrape
+            # race the rule exists to catch
+            holds = any(_dotted(item.context_expr)
+                        == f"self.{lock_attr}"
+                        for item in node.items)
+            for item in node.items:
+                scan(item, in_lock, method)
+            for child in node.body:
+                scan(child, in_lock or holds, method)
+            return
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" \
+                and node.attr in containers:
+            if in_lock:
+                locked_attrs.add(node.attr)
+            else:
+                unlocked_sites.append((node, node.attr, method))
+        for child in ast.iter_child_nodes(node):
+            scan(child, in_lock, method)
+
+    for meth in cls.body:
+        if isinstance(meth, ast.FunctionDef) and meth.name != "__init__":
+            for stmt in meth.body:
+                scan(stmt, False, meth.name)
+
+    out = []
+    for node, attr, method in unlocked_sites:
+        if attr not in locked_attrs:
+            continue   # never lock-guarded anywhere: not this rule's
+            #            contract (single-thread state)
+        out.append(ctx.finding(
+            "CON01", node, f"self.{attr}",
+            f"{cls.name}.{method} touches self.{attr} outside `with "
+            f"self.{lock_attr}` — an exporter scrape thread can see "
+            f"it mid-mutation (torn dict resize / inconsistent "
+            f"snapshot)"))
+    return out
+
+
+@_register(
+    "CON01", "lock-discipline",
+    "state of a lock-owning class (MetricsRegistry, TraceStore) read "
+    "or mutated outside the owning lock's `with` scope — the exporter "
+    "HTTP threads scrape these concurrently with dispatch.")
+def _con01(ctx):
+    if ctx.path not in _CON01_FILES:
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef):
+            out.extend(_con01_class_findings(ctx, node))
+    return out
+
+
+# -- OBS01: telemetry JSON validity -----------------------------------------
+
+_OBS01_SCOPES = ("paddle_tpu/observability/", "paddle_tpu/serving_fleet/")
+
+
+@_register(
+    "OBS01", "json-validity",
+    "json.dump(s) without allow_nan=False on a telemetry path — a NaN "
+    "gauge (a storm's train_loss) would emit a bare NaN token that "
+    "jq/JS consumers reject; use the try/allow_nan=False + _finite() "
+    "fallback discipline every exporter in the repo follows.")
+def _obs01(ctx):
+    if not ctx.path.startswith(_OBS01_SCOPES):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d not in ("json.dump", "json.dumps"):
+            continue
+        ok = any(kw.arg == "allow_nan"
+                 and isinstance(kw.value, ast.Constant)
+                 and kw.value.value is False
+                 for kw in node.keywords)
+        if not ok:
+            out.append(ctx.finding(
+                "OBS01", node, d,
+                f"{d} without allow_nan=False on a telemetry path — "
+                f"non-finite floats would emit invalid JSON; use the "
+                f"allow_nan=False + _finite() fallback discipline"))
+    return out
+
+
+# -- DOC01: catalogue drift -------------------------------------------------
+
+_KNOB_RE = re.compile(r"PADDLE_TPU_[A-Z][A-Z0-9_]*")
+_DOC_METRIC_FILE = "docs/observability.md"
+_DOC_KNOB_FILES = ("README.md", "tools/README.md")
+# a call creates a metric series when its callee name carries one of
+# these markers — covers registry.counter(...), the shared
+# labeled_counter() helper, and per-class wrappers like slo's
+# self._gauge(...)
+_EMIT_MARKERS = ("counter", "gauge", "histogram", "metric", "labeled")
+_METRIC_NAME_RE = re.compile(r"fleet_[a-z0-9_]+\Z")
+
+
+def _is_emit_call(node):
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    leaf = (d or "").split(".")[-1].lower()
+    return any(m in leaf for m in _EMIT_MARKERS)
+
+
+def _resolve_fstring(ctx, call, joined):
+    """Resolve the repo's for-loop metric-name idiom:
+    ``for name, h in (("a", ...), ("b", ...)): reg.counter(
+    f"fleet_x_{name}_total")`` -> concrete names. Unresolvable parts
+    become '*' (a wildcard pattern)."""
+    parents = ctx.parents()
+
+    def enclosing_for_binding(name):
+        node = call
+        while id(node) in parents:
+            node = parents[id(node)]
+            if not isinstance(node, ast.For):
+                continue
+            t, it = node.target, node.iter
+            if not isinstance(it, (ast.Tuple, ast.List)):
+                continue
+            if isinstance(t, ast.Name) and t.id == name:
+                vals = [e.value for e in it.elts
+                        if isinstance(e, ast.Constant)]
+                if len(vals) == len(it.elts):
+                    return vals
+            if isinstance(t, ast.Tuple):
+                for i, el in enumerate(t.elts):
+                    if isinstance(el, ast.Name) and el.id == name:
+                        vals = []
+                        for row in it.elts:
+                            if isinstance(row, (ast.Tuple, ast.List)) \
+                                    and i < len(row.elts) \
+                                    and isinstance(row.elts[i],
+                                                   ast.Constant):
+                                vals.append(row.elts[i].value)
+                            else:
+                                return None
+                        return vals
+        return None
+
+    results = [""]
+    exact = True
+    for part in joined.values:
+        if isinstance(part, ast.Constant):
+            results = [r + str(part.value) for r in results]
+        elif isinstance(part, ast.FormattedValue) \
+                and isinstance(part.value, ast.Name):
+            vals = enclosing_for_binding(part.value.id)
+            if vals:
+                results = [r + str(v) for r in results for v in vals]
+            else:
+                results = [r + "*" for r in results]
+                exact = False
+        else:
+            results = [r + "*" for r in results]
+            exact = False
+    return results, exact
+
+
+def _expand_braces(token):
+    m = re.search(r"\{([^{}]*)\}", token)
+    if not m:
+        return [token]
+    out = []
+    for alt in m.group(1).split(","):
+        out.extend(_expand_braces(token[:m.start()] + alt.strip()
+                                  + token[m.end():]))
+    return out
+
+
+def _doc_metric_rows(root):
+    """fleet_* names (with line numbers) from docs/observability.md's
+    '## Metric catalogue' table, brace lists and comma cells
+    expanded."""
+    path = os.path.join(root, _DOC_METRIC_FILE)
+    rows = {}
+    try:
+        lines = open(path, encoding="utf-8").read().splitlines()
+    except OSError:
+        return rows, False
+    in_section = False
+    for i, line in enumerate(lines, start=1):
+        if line.startswith("## "):
+            in_section = line.strip() == "## Metric catalogue"
+            continue
+        if not in_section or not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1] if "|" in line[1:] else ""
+        for tok in re.findall(r"`([^`]+)`", first_cell):
+            for name in _expand_braces(tok.strip()):
+                if re.fullmatch(r"fleet_[a-z0-9_]+", name):
+                    rows.setdefault(name, i)
+    return rows, True
+
+
+def _doc_knob_mentions(root):
+    """PADDLE_TPU_* tokens across the committed doc set, with one
+    (file, line) locator each."""
+    out = {}
+    files = [os.path.join(root, f) for f in _DOC_KNOB_FILES]
+    files += sorted(glob.glob(os.path.join(root, "docs", "*.md")))
+    for path in files:
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            lines = open(path, encoding="utf-8").read().splitlines()
+        except OSError:
+            continue
+        for i, line in enumerate(lines, start=1):
+            for knob in _KNOB_RE.findall(line):
+                out.setdefault(knob, (rel, i))
+    return out
+
+
+@_register(
+    "DOC01", "catalogue-drift",
+    "emitted fleet_* metrics and PADDLE_TPU_* env knobs must match "
+    "the committed doc tables (docs/observability.md catalogue + env "
+    "knob table), BOTH directions: an undocumented emission is "
+    "invisible to operators; a documented ghost misleads them.",
+    project_level=True)
+def _doc01(ctxs, root):
+    out = []
+    code_metrics = {}     # literal name -> (ctx, node)
+    code_patterns = {}    # wildcard pattern -> (ctx, node)
+    code_knobs = {}       # knob -> (ctx, lineno)
+    code_strings = set()  # every fleet_* string constant anywhere —
+    #                       the generous "still alive in code" set the
+    #                       docs->code direction checks against
+    for ctx in ctxs:
+        for node in ast.walk(ctx.tree):
+            if _is_emit_call(node):
+                for arg in list(node.args) + [
+                        kw.value for kw in node.keywords
+                        if kw.arg in (None, "name")]:
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str) \
+                            and _METRIC_NAME_RE.fullmatch(arg.value):
+                        code_metrics.setdefault(arg.value, (ctx, node))
+                    elif isinstance(arg, ast.JoinedStr):
+                        names, exact = _resolve_fstring(ctx, node, arg)
+                        for n in names:
+                            if not n.startswith("fleet_"):
+                                continue
+                            if exact:
+                                code_metrics.setdefault(n, (ctx, node))
+                            else:
+                                code_patterns.setdefault(n, (ctx, node))
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                for knob in _KNOB_RE.findall(node.value):
+                    code_knobs.setdefault(
+                        knob, (ctx, getattr(node, "lineno", 1)))
+                code_strings.update(
+                    re.findall(r"fleet_[a-z0-9_]+", node.value))
+            elif isinstance(node, ast.JoinedStr):
+                for part in node.values:
+                    if isinstance(part, ast.Constant):
+                        for knob in _KNOB_RE.findall(str(part.value)):
+                            code_knobs.setdefault(
+                                knob,
+                                (ctx, getattr(node, "lineno", 1)))
+
+    doc_metrics, have_doc = _doc_metric_rows(root)
+    if have_doc:
+        for name, (ctx, node) in sorted(code_metrics.items()):
+            if name not in doc_metrics:
+                out.append(ctx.finding(
+                    "DOC01", node, name,
+                    f"emitted metric `{name}` has no row in "
+                    f"{_DOC_METRIC_FILE}'s metric catalogue"))
+        for pat, (ctx, node) in sorted(code_patterns.items()):
+            if not any(fnmatch.fnmatchcase(n, pat)
+                       for n in doc_metrics):
+                out.append(ctx.finding(
+                    "DOC01", node, pat,
+                    f"no catalogue row in {_DOC_METRIC_FILE} matches "
+                    f"emitted metric pattern `{pat}`"))
+        for name, line in sorted(doc_metrics.items()):
+            if name in code_metrics or name in code_strings:
+                continue
+            if any(fnmatch.fnmatchcase(name, p)
+                   for p in code_patterns):
+                continue
+            out.append(Finding(
+                "DOC01", _DOC_METRIC_FILE, line, 0,
+                "metric-catalogue", name,
+                f"catalogue row `{name}` appears nowhere in the "
+                f"scanned code — stale doc row (or a lost emission)"))
+
+    doc_knobs = _doc_knob_mentions(root)
+    for knob, (ctx, lineno) in sorted(code_knobs.items()):
+        if knob not in doc_knobs:
+            out.append(Finding(
+                "DOC01", ctx.path, lineno, 0, "env-knobs", knob,
+                f"env knob {knob} is read in code but documented "
+                f"nowhere (docs/*.md, README.md, tools/README.md) — "
+                f"add it to docs/observability.md's knob table"))
+    for knob, (rel, line) in sorted(doc_knobs.items()):
+        if knob not in code_knobs:
+            out.append(Finding(
+                "DOC01", rel, line, 0, "env-knobs", knob,
+                f"doc mention of {knob} matches no string in the "
+                f"scanned code — stale knob (or a renamed one)"))
+    return out

@@ -29,6 +29,9 @@ _INSTANT_S = 3.0  # a real stage spends longer than this just importing
 # not surface as tunnel_watch silently skipping "unknown" stages
 REQUIRED_STAGES = {
     "probe", "bench_full", "bench_gpt13b_scan_cce",
+    # static invariant sweep — tpulint over the shipping source
+    # (CPU-only, runs before chaos_smoke — ISSUE 13)
+    "staticcheck",
     # round-7 serving + llama rungs
     "bench_serve_gpt", "bench_serve_llama", "bench_serve_flashk",
     "bench_llama", "decode_probe_paged",
@@ -243,6 +246,41 @@ def check_history_verdict():
     return [], 1
 
 
+def check_lint_report():
+    """A completed staticcheck stage must have left a parseable
+    lint_report.json with non_baselined == 0 in its telemetry dir —
+    a lint stage that 'passed' without a report (or with unreported
+    new findings) would let a contract violation ship as a green
+    campaign. Returns (problems, checked)."""
+    path = os.path.join(OUT, "summary.json")
+    try:
+        with open(path) as f:
+            summary = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return [], 0
+    row = summary.get("staticcheck")
+    if not isinstance(row, dict) or not row.get("ok"):
+        return [], 0   # never ran, or already red on its own
+    rpath = os.path.join(OUT, "telemetry", "staticcheck",
+                         "lint_report.json")
+    try:
+        with open(rpath) as f:
+            report = json.load(f)
+    except OSError:
+        return [f"staticcheck: completed but left no lint report at "
+                f"{rpath}"], 1
+    except json.JSONDecodeError as e:
+        return [f"staticcheck: unparseable lint_report.json ({e})"], 1
+    nb = report.get("non_baselined")
+    if not isinstance(nb, int):
+        return [f"staticcheck: lint report {rpath} has no "
+                "'non_baselined' count"], 1
+    if nb != 0:
+        return [f"staticcheck: {nb} non-baselined finding(s) in a "
+                f"stage marked ok — the gate was bypassed"], 1
+    return [], 1
+
+
 def _child_pgids(pid):
     """Process groups of `pid`'s direct children: bench.py/decode_probe
     start their workers with start_new_session=True, so killpg on the
@@ -297,10 +335,11 @@ def main():
     flight_problems, flights_checked = check_flight_dumps()
     canary_problems, canary_checked = check_canary_verdict()
     history_problems, history_checked = check_history_verdict()
+    lint_problems, lint_checked = check_lint_report()
     metric_problems += flight_problems + canary_problems \
-        + history_problems
+        + history_problems + lint_problems
     metrics_checked += flights_checked + canary_checked \
-        + history_checked
+        + history_checked + lint_checked
     for p in metric_problems:
         print(f"  metrics: SUSPECT ({p})", flush=True)
     tmp = tempfile.mkdtemp(prefix="stage_preflight_")
