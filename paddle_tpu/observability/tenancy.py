@@ -172,6 +172,15 @@ class TenantAccountant:
             return 0 if tenant is None \
                 else self.sketch.usage(str(tenant))
 
+    def heaviest(self, k):
+        """The k heaviest tenant names by sketch weight (descending,
+        name-tiebroken) — the brownout ladder's clamp set: level L
+        clamps exactly ``heaviest(L)``."""
+        if int(k) < 1:
+            return []
+        with self._lock:
+            return [r["tenant"] for r in self.sketch.top(int(k))]
+
     @property
     def tracked(self):
         with self._lock:

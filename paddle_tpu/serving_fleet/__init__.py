@@ -24,7 +24,14 @@ whole failure model.
 - FleetSupervisor: self-healing replica lifecycle (supervisor.py):
                   OS-level crash detection, seeded-backoff respawn,
                   health-gated warm-boot rejoin, crash-loop circuit
-                  breaker with quarantine + cooldown
+                  breaker with quarantine + cooldown, `retiring`
+                  exemption for autoscaler-owned scale-ins
+- FleetAutoscaler: SLO-driven elastic capacity (autoscaler.py):
+                  scale out on multi-window burn alerts / standing
+                  overload, scale in on recovered budget + idle trend
+                  with hysteresis + cooldowns, warm-boot-gated
+                  adoption, drain->remove retirement, every decision
+                  journaled + flight-dumped (fleet_autoscale_*)
 - ReplicaClient:  idempotent-by-rid transport with seeded-jitter
                   retry (client.py)
 - Journal:        the router's write-ahead request journal
@@ -50,6 +57,7 @@ tests/test_fleet_serving.py + tests/test_fleet_tracing.py (pytest -m
 chaos); campaign stage fleet_chaos_smoke (metrics_diff canary-gated
 against tools/golden/fleet_chaos_metrics.json).
 """
+from .autoscaler import FleetAutoscaler  # noqa: F401
 from .client import ReplicaClient  # noqa: F401
 from .journal import Journal, JournalCrash, JournalError  # noqa: F401
 from .proc import FrameReader, ProcReplica  # noqa: F401
@@ -57,7 +65,7 @@ from .replica import InprocReplica, ReplicaCrash  # noqa: F401
 from .router import FleetRouter, RouterCrash  # noqa: F401
 from .supervisor import FleetSupervisor  # noqa: F401
 
-__all__ = ["FleetRouter", "FleetSupervisor", "FrameReader",
-           "InprocReplica", "Journal", "JournalCrash", "JournalError",
-           "ProcReplica", "ReplicaClient", "ReplicaCrash",
-           "RouterCrash"]
+__all__ = ["FleetAutoscaler", "FleetRouter", "FleetSupervisor",
+           "FrameReader", "InprocReplica", "Journal", "JournalCrash",
+           "JournalError", "ProcReplica", "ReplicaClient",
+           "ReplicaCrash", "RouterCrash"]

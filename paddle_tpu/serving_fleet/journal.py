@@ -196,7 +196,8 @@ def reconcile(records):
     state).
 
     Returns ``{"requests": {rid: {...}}, "retired": set,
-    "cancelled": set, "next_rid", "sealed", "preempted"}``. A request
+    "cancelled": set, "next_rid", "sealed", "preempted",
+    "autoscale": [scale_out/scale_in/brownout records]}``. A request
     entry carries everything a continuation resubmit needs: prompt,
     budget, eos, priority, wall-clock deadline, the journaled
     delivered prefix (the dedup boundary), last journaled placement
@@ -208,9 +209,11 @@ def reconcile(records):
     reqs = {}
     retired = set()
     cancelled = set()
+    autoscale = []
     out = {"requests": reqs, "retired": retired,
            "cancelled": cancelled, "next_rid": 0,
-           "sealed": False, "preempted": False}
+           "sealed": False, "preempted": False,
+           "autoscale": autoscale}
 
     def ent(rid):
         return reqs.setdefault(int(rid), {
@@ -297,6 +300,21 @@ def reconcile(records):
             out["sealed"] = True
         elif kind == "preempt":
             out["preempted"] = True
+        elif kind in ("scale_out", "scale_in", "brownout"):
+            # autoscale/overload decision records: kept verbatim so a
+            # successor (and its autoscaler) can see the scale event
+            # the dead router was mid-way through. A per-rid brownout
+            # record additionally clamps the reinstated budget — the
+            # degraded promise survives the crash (the request must
+            # not resurrect with its full pre-brownout budget).
+            rid = rec.get("rid")
+            if kind == "brownout" and rid is not None \
+                    and int(rid) in reqs \
+                    and rec.get("max_new") is not None:
+                e = reqs[int(rid)]
+                e["max_new"] = min(int(e["max_new"]),
+                                   int(rec["max_new"]))
+            autoscale.append(dict(rec))
     if reqs:
         out["next_rid"] = max(out["next_rid"], max(reqs) + 1)
     if retired:

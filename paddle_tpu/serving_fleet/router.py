@@ -216,6 +216,25 @@ class FleetRouter:
         over {"free_pages", "queued", "running", "queue_wait_p99_s",
         "outstanding"} merged over the defaults (1, 8, 2, 50, 4).
         A replay what-if knob as much as an operator one.
+    overload_target_ms / overload_interval_s: the adaptive overload
+        control layer (CoDel-style queue-delay admission,
+        docs/robustness.md "Elastic autoscaling & overload control").
+        When the head-of-line placement sojourn stays above the
+        target for a full interval WITH nothing placeable, the router
+        enters ``degraded``: queued requests whose sojourn already
+        exceeds the target shed fail-fast (tenant-fair order — the
+        static ``max_queue`` stays only as a hard backstop), and the
+        brownout ladder below starts climbing. None disables (static
+        max_queue only).
+    brownout_max_new / brownout_levels / brownout_step_s: tenant-fair
+        brownout — while degraded the level climbs one rung every
+        ``brownout_step_s`` up to ``brownout_levels`` and decays the
+        same way after recovery; at level L the L HEAVIEST tenants
+        (space-saving sketch weight) have their decode budgets
+        clamped to ``brownout_max_new`` at placement. Degradation
+        lands on whoever is causing the load first, is journaled
+        (``brownout`` records) and honestly visible in
+        ``health()["overload"]``.
     """
 
     def __init__(self, replicas, *, registry=None, max_queue=64,
@@ -231,7 +250,10 @@ class FleetRouter:
                  history=None, history_interval_s=0.25,
                  sentinel=None, sentinel_kw=None,
                  capture=None, capture_kw=None,
-                 placement_weights=None):
+                 placement_weights=None,
+                 overload_target_ms=2000.0, overload_interval_s=1.0,
+                 brownout_max_new=4, brownout_levels=3,
+                 brownout_step_s=2.0):
         self.replicas = {}
         self._clients = {}
         self._transport_retries = int(transport_retries)
@@ -288,6 +310,32 @@ class FleetRouter:
         self._shed_storm_window_s = float(shed_storm_window_s)
         self._shed_times = collections.deque(maxlen=4096)
         self._shed_storm_armed = True
+        # -- adaptive overload control (CoDel-style sojourn admission
+        # + tenant-fair brownout). All host-side bookkeeping driven
+        # from the control loop; the FleetAutoscaler reads `degraded`
+        # as one of its scale-out signals
+        self._overload_target_s = None if overload_target_ms is None \
+            else float(overload_target_ms) / 1e3
+        self._overload_interval_s = float(overload_interval_s)
+        self._overload_since = None   # head sojourn first over target
+        self._degraded = False
+        self._degraded_at = None
+        self._brownout_max_new = int(brownout_max_new)
+        self._brownout_levels = int(brownout_levels)
+        self._brownout_step_s = float(brownout_step_s)
+        self._brownout_level = 0
+        self._brownout_changed = 0.0
+        self._brownout_set = set()   # tenants clamped at this level
+        # the FleetAutoscaler attaches itself here (serving_fleet/
+        # autoscaler.py); health() folds its cached rollup in
+        self.autoscaler = None
+        # bounded log of scale/brownout decision records: carried
+        # through snapshot compaction so "why is the fleet this
+        # size" survives any number of crash/recover cycles, not
+        # just until the next rotate(). recover() seeds it (and
+        # recovered_autoscale) from the dead incarnation's journal
+        self._scale_log = collections.deque(maxlen=64)
+        self.recovered_autoscale = []
 
         self.registry = registry if registry is not None \
             else MetricsRegistry()
@@ -405,6 +453,20 @@ class FleetRouter:
         self._g_serving = reg.gauge(
             "fleet_replicas_serving",
             help="replicas currently placeable")
+        self._g_degraded = reg.gauge(
+            "fleet_degraded",
+            help="1 while the overload controller sees a standing "
+                 "placement queue (sojourn over target for a full "
+                 "interval with nothing placeable)")
+        self._g_blevel = reg.gauge(
+            "fleet_brownout_level",
+            help="current brownout rung (0 = none; level L clamps "
+                 "the L heaviest tenants' decode budgets)")
+        self._m_bclamp = {}
+        self._m_osheds = reg.counter(
+            "fleet_overload_sheds_total",
+            help="queued requests shed by the sojourn-based overload "
+                 "controller (also counted in fleet_shed_total)")
 
     def _new_client(self, rep):
         seed = self._next_client_seed
@@ -439,6 +501,12 @@ class FleetRouter:
         return self._labeled(
             self._m_hedge_wins, "fleet_hedge_wins_total",
             "hedged requests by which leg finished first", by=by)
+
+    def _bclamp_counter(self, tenant):
+        return self._labeled(
+            self._m_bclamp, "fleet_brownout_clamped_total",
+            "requests whose decode budget was clamped by the "
+            "brownout ladder, per tenant", tenant=tenant)
 
     # -- public API --------------------------------------------------------
 
@@ -560,6 +628,7 @@ class FleetRouter:
         self._recover_lost()
         self._expire_queued()
         self._place()
+        self._overload_control()
         self._shed()
         self._hedge()
         if self._journal is not None and self._journal.needs_rotation:
@@ -704,12 +773,49 @@ class FleetRouter:
         self.replicas[rep.name] = rep
         self._clients[rep.name] = self._new_client(rep)
 
+    def retire(self, name):
+        """Begin a graceful scale-in of `name` (the autoscaler's
+        drain half). Before the drain, any HEDGE leg parked on the
+        victim is cancelled and folded closed: a duplicate leg whose
+        primary still runs elsewhere must not keep decoding on a
+        draining replica — it would burn a slot for tokens the
+        stale-leg guard (or the first-finisher dedup) was always
+        going to discard, delaying the drain by a full decode. The
+        replica is removable (``remove_replica``) once drained and
+        its assignments have resolved."""
+        rep = self.replicas.get(name)
+        if rep is None:
+            raise KeyError(f"unknown replica {name!r}")
+        self._cancel_stray_hedges(name)
+        rep.drain()
+
+    def _cancel_stray_hedges(self, name):
+        """Cancel hedge legs parked on `name` whose primary leg still
+        runs elsewhere, and close them in the trace tree WITHOUT a
+        failover (nothing needs recovering — the primary owns the
+        request). The engine resolves the cancel with partial tokens;
+        p.hedge is cleared NOW so _handle's stale-leg guard drops that
+        flush instead of folding it."""
+        for rid, p in list(self._pending.items()):
+            if p.done or p.hedge != name or p.replica is None:
+                continue
+            try:
+                self._clients[name].cancel(rid)
+            except Exception:  # noqa: BLE001 — replica may be gone
+                pass
+            self._end_leg(p, name, "cancelled", scale_in=True)
+            p.hedge = None
+            p.leg_base.pop(name, None)
+            p.leg_inc.pop(name, None)
+
     def remove_replica(self, name):
-        """Dynamic membership: retire a replica from the fleet. Its
-        unresolved assignments fail over first (prefix-deduped, same
-        path as a crash), so nothing is lost — but the replica must
-        already be out of service (lost, dead, drained or
-        quarantined); drain it first for a graceful exit."""
+        """Dynamic membership: retire a replica from the fleet. Any
+        in-flight hedge leg whose primary still runs is cancelled
+        (never failed over — the primary owns it), then unresolved
+        assignments fail over (prefix-deduped, same path as a crash),
+        so nothing is lost — but the replica must already be out of
+        service (lost, dead, drained or quarantined); drain it first
+        (``retire``) for a graceful exit."""
         rep = self.replicas.get(name)
         if rep is None:
             raise KeyError(f"unknown replica {name!r}")
@@ -719,11 +825,22 @@ class FleetRouter:
             raise RuntimeError(
                 f"replica {name!r} is still in service "
                 f"(state={rep.state}); drain it first")
+        self._cancel_stray_hedges(name)
         self._recover_assignments(name, "removed", rep)
         del self.replicas[name]
         del self._clients[name]
         self._lost.discard(name)
         self._last_scrape.pop(name, None)
+
+    def journal_event(self, kind, **fields):
+        """Journal one control-plane decision record (``scale_out`` /
+        ``scale_in`` — the FleetAutoscaler's write path into the same
+        WAL the request lifecycle uses). Rides the ordered backlog
+        like any lifecycle record; a journal-less router no-ops.
+        Returns False while the record is parked (transient disk
+        fault), True once durable."""
+        self._scale_log.append(dict(fields, kind=str(kind)))
+        return self._jappend(str(kind), **fields)
 
     def cancel(self, rid):
         """Cancel a fleet request wherever it currently lives. The
@@ -773,6 +890,7 @@ class FleetRouter:
                 "error": rep.error}
         # list() snapshots: health() also runs on metrics-exporter
         # HTTP threads, and the control thread may be mid-submit
+        asc = self.autoscaler
         return {"replicas": reps,
                 "queue_depth": len(self._queue),
                 "pending": sum(1 for p in list(self._pending.values())
@@ -780,6 +898,11 @@ class FleetRouter:
                 "lost": sorted(self._lost),
                 "slo": self._slo_health(),
                 "anomaly": self._anomaly_health(),
+                "overload": self._overload_health(),
+                # the autoscaler's cached rollup (updated on its
+                # poll(); health() also runs on HTTP threads, so this
+                # must stay a cheap dict read)
+                "autoscale": None if asc is None else asc.snapshot(),
                 "tenants": None if self.tenants is None else {
                     "tracked": self.tenants.tracked},
                 "compile_report": self.compile_report()}
@@ -1475,6 +1598,10 @@ class FleetRouter:
             target = self._pick_replica(outstanding)
             if target is None:
                 continue
+            # brownout: clamp a browned-out tenant's decode budget at
+            # the placement boundary (journals BEFORE the placed
+            # record, so recovery reconciles the clamped budget)
+            self._maybe_brownout_clamp(p)
             prompt = p.prompt + [int(t) for t in p.delivered]
             remaining = p.max_new - len(p.delivered)
             # WAL: placement journals before the transport send (with
@@ -1505,6 +1632,167 @@ class FleetRouter:
         for rid in placed:
             self._queue.remove(rid)
 
+    def _shed_key(self, r):
+        """Degradation order shared by every shed path: lowest
+        priority goes first; within a priority band the HEAVIEST
+        tenants (space-saving sketch weight) go before light ones —
+        fair degradation: saturation caused by a hot tenant lands on
+        that tenant first — newest first as the final tie-break."""
+        p = self._pending[r]
+        usage = 0 if self.tenants is None else self.tenants.usage(
+            p.tenant if p.tenant is not None else "anon")
+        return (p.priority, -usage, -r)
+
+    # -- adaptive overload control (sojourn admission + brownout) ----------
+
+    @property
+    def degraded(self):
+        """True while the overload controller sees a standing
+        placement queue — one of the autoscaler's scale-out signals
+        and the honest health()["overload"] flag."""
+        return self._degraded
+
+    @property
+    def slo_alerting(self):
+        """Objectives whose multi-window burn-rate pairs are firing
+        (cached from the last step()'s evaluation — cheap enough for
+        the autoscaler to read every poll)."""
+        return sorted(n for n, r in self._slo_state.items()
+                      if r.get("alert"))
+
+    def _overload_control(self):
+        """CoDel-style queue-delay admission: the static ``max_queue``
+        bound sheds on LENGTH, which says nothing about how long
+        clients are actually waiting. This controller watches the
+        head-of-line placement sojourn instead — when it stays above
+        ``overload_target_ms`` for a full ``overload_interval_s``
+        while NOTHING is placeable (genuine saturation, never fleet
+        boot), the router enters ``degraded``: queued requests whose
+        sojourn already exceeds the target resolve ``shed`` fail-fast
+        (they could not be served inside the target anyway — better
+        an honest early rejection than a guaranteed SLO breach),
+        worst-first in the tenant-fair shed order, while younger
+        requests stay queued for the capacity the autoscaler is
+        bringing up. The brownout ladder rides the same state."""
+        t = self._overload_target_s
+        if t is None:
+            return
+        now = time.monotonic()
+        standing = False
+        if self._queue and not self._unscraped():
+            head = min(self._queue,
+                       key=lambda r: (-self._pending[r].priority, r))
+            sojourn = now - self._pending[head].submitted_at
+            standing = sojourn > t \
+                and self._pick_replica(self._outstanding()) is None
+        if standing:
+            if self._overload_since is None:
+                self._overload_since = now
+            if not self._degraded \
+                    and now - self._overload_since \
+                    >= self._overload_interval_s:
+                self._set_degraded(True, now)
+        else:
+            self._overload_since = None
+            if self._degraded:
+                self._set_degraded(False, now)
+        if self._degraded:
+            victims = sorted(
+                (r for r in self._queue
+                 if now - self._pending[r].submitted_at > t),
+                key=self._shed_key)
+            shed_now = []
+            for rid in victims:
+                self._queue.remove(rid)
+                p = self._pending[rid]
+                self._m_shed.inc()
+                self._m_osheds.inc()
+                self._resolve(p, list(p.delivered), "shed", None)
+                shed_now.append(rid)
+            if shed_now:
+                self._note_shed_storm(shed_now)
+        self._brownout_tick(now)
+
+    def _set_degraded(self, flag, now):
+        self._degraded = bool(flag)
+        self._degraded_at = now if flag else None
+        self._g_degraded.set(1 if flag else 0)
+
+    def _brownout_tick(self, now):
+        """One rung per ``brownout_step_s`` while degraded (capped at
+        ``brownout_levels``), one rung back down per step after
+        recovery — hysteresis, never a cliff. Level L clamps the L
+        heaviest tenants; the set refreshes every tick because sketch
+        weights move with the traffic."""
+        lvl = self._brownout_level
+        if self._degraded:
+            if lvl < self._brownout_levels and (
+                    lvl == 0 or now - self._brownout_changed
+                    >= self._brownout_step_s):
+                self._set_brownout(lvl + 1, now)
+        elif lvl > 0 and now - self._brownout_changed \
+                >= self._brownout_step_s:
+            self._set_brownout(lvl - 1, now)
+        if self._brownout_level and self.tenants is not None:
+            self._brownout_set = set(
+                self.tenants.heaviest(self._brownout_level))
+
+    def _set_brownout(self, level, now):
+        escalating = level > self._brownout_level
+        self._brownout_level = int(level)
+        self._brownout_changed = now
+        self._g_blevel.set(level)
+        self._brownout_set = set() if level == 0 \
+            or self.tenants is None \
+            else set(self.tenants.heaviest(level))
+        # every brownout decision is journaled; escalations also
+        # flight-dump (a sustained storm is <= brownout_levels dumps)
+        self._scale_log.append({"kind": "brownout",
+                                "level": self._brownout_level,
+                                "tenants": sorted(self._brownout_set)})
+        self._jappend("brownout", level=self._brownout_level,
+                      tenants=sorted(self._brownout_set))
+        if escalating:
+            self._flight_dump("fleet_brownout", {
+                "level": self._brownout_level,
+                "clamped_tenants": sorted(self._brownout_set),
+                "degraded_for_s": None if self._degraded_at is None
+                else round(now - self._degraded_at, 6)})
+
+    def _maybe_brownout_clamp(self, p):
+        """Placement-time budget clamp for browned-out tenants: the
+        request still serves, just shorter — graceful degradation
+        while capacity catches up. Journaled per rid (recovery honors
+        the clamp: reconcile folds it into max_new)."""
+        if not self._brownout_level or not self._brownout_set:
+            return
+        tname = p.tenant if p.tenant is not None else "anon"
+        if tname not in self._brownout_set:
+            return
+        cap = len(p.delivered) + self._brownout_max_new
+        if p.max_new <= cap:
+            return
+        p.max_new = cap
+        self._bclamp_counter(tname).inc()
+        self._jappend("brownout", rid=p.rid, tenant=tname,
+                      level=self._brownout_level, max_new=cap)
+
+    def _overload_health(self):
+        """Overload-controller rollup for the health snapshot —
+        ``degraded`` is an honest, externally visible state, not a
+        silent shed counter."""
+        if self._overload_target_s is None:
+            return {"degraded": False, "brownout_level": 0,
+                    "clamped_tenants": [], "target_s": None,
+                    "degraded_for_s": None}
+        now = time.monotonic()
+        return {"degraded": self._degraded,
+                "brownout_level": self._brownout_level,
+                "clamped_tenants": sorted(self._brownout_set),
+                "target_s": self._overload_target_s,
+                "degraded_for_s": None if self._degraded_at is None
+                else round(now - self._degraded_at, 6)}
+
     def _shed(self):
         if len(self._queue) <= self.max_queue:
             return
@@ -1515,18 +1803,7 @@ class FleetRouter:
         if self._unscraped() \
                 or self._pick_replica(self._outstanding()) is not None:
             return
-        # lowest priority goes first; within a priority band the
-        # HEAVIEST tenants (space-saving sketch weight) go before
-        # light ones — fair degradation: saturation caused by a hot
-        # tenant lands on that tenant first — newest first as the
-        # final tie-break
-        def shed_key(r):
-            p = self._pending[r]
-            usage = 0 if self.tenants is None else self.tenants.usage(
-                p.tenant if p.tenant is not None else "anon")
-            return (p.priority, -usage, -r)
-
-        order = sorted(self._queue, key=shed_key)
+        order = sorted(self._queue, key=self._shed_key)
         shed_now = []
         while len(self._queue) > self.max_queue and order:
             rid = order.pop(0)
@@ -1785,6 +2062,9 @@ class FleetRouter:
         for rid in sorted(self._done):
             recs.append({"kind": "snap_done",
                          "result": dict(self._done[rid])})
+        # the scale/brownout story rides compaction (bounded): a
+        # successor can always answer "why is the fleet this size"
+        recs.extend(dict(r) for r in self._scale_log)
         return recs
 
     @classmethod
@@ -1838,6 +2118,12 @@ class FleetRouter:
             j._inc("replay_records", stats["replay_records"])
             j._inc("torn_tail_drops", stats["torn_tail_drops"])
         self._next_rid = max(self._next_rid, int(state["next_rid"]))
+        # the dead incarnation's scale/brownout decisions: surfaced
+        # to the successor's operator/autoscaler and re-carried
+        # through this recovery's own compaction below
+        self.recovered_autoscale = [dict(r) for r in
+                                    state.get("autoscale") or []]
+        self._scale_log.extend(self.recovered_autoscale)
         now_m, now_w = time.monotonic(), time.time()
         adopted = {}
         for name, rep in self.replicas.items():
@@ -2009,4 +2295,5 @@ class FleetRouter:
             "requeued": requeued, "retired_rids": len(state["retired"]),
             "sealed": bool(state["sealed"]),
             "preempted": bool(state["preempted"]),
+            "autoscale_records": len(self.recovered_autoscale),
             "replicas_adopted": adopted})

@@ -18,6 +18,17 @@ Per-replica state machine::
        │                  └──────────────────┘        (a "down")
        └──cooldown, trial boot── quarantined ◀──N downs in window──┘
 
+    any state ──mark_retiring()──▶ retiring (terminal here; purged
+                                   once the router removes the name)
+
+``retiring`` is the autoscaler ownership handoff: a replica the
+FleetAutoscaler is scaling in (draining toward
+``router.remove_replica``) is EXPECTED to stop heartbeating and then
+die — the supervisor must not read that as a crash and resurrect it
+(nor spend a quarantine half-open trial on it). Exactly one owner
+wins: once marked, the supervisor never kills, respawns or trial-boots
+the name again; the state purges when the name leaves the router.
+
 - **Crash detection** is OS-level (``rep.alive`` false + state
   ``dead`` — a SIGKILL'd subprocess, a crashed worker thread) plus an
   optional supervisor-side heartbeat timeout for deployments where
@@ -201,6 +212,11 @@ class FleetSupervisor:
                     self._attempt_boot(name, rep, st, now, events)
             elif ph == "booting":
                 self._poll_booting(name, rep, st, now, events)
+            elif ph == "retiring":
+                # autoscaler-owned: an expected death — no hb-timeout
+                # kill, no respawn, no half-open trial. Purged above
+                # once remove_replica drops the name from the router.
+                continue
             elif ph == "quarantined":
                 if not frozen and st.quarantined_at is not None \
                         and now - st.quarantined_at \
@@ -216,6 +232,31 @@ class FleetSupervisor:
         self._g_quar.set(sum(1 for s in self._st.values()
                              if s.phase == "quarantined"))
         return events
+
+    def mark_retiring(self, name):
+        """Hand ownership of `name` to the autoscaler's scale-in path:
+        from now on its drain, silence and death are EXPECTED — the
+        supervisor will not kill it on a heartbeat timeout, respawn it
+        on death, or spend a quarantine half-open trial on it
+        (exactly-one-owner: ``watch()`` must never resurrect a replica
+        mid-retirement). Idempotent; the state purges once the router
+        drops the name (``remove_replica``). Returns the previous
+        phase."""
+        st = self._st.setdefault(str(name), _RepState())
+        prev = st.phase
+        st.phase = "retiring"
+        st.next_attempt = None
+        st.boot_started = st.boot_deadline = None
+        st.half_open = False
+        if prev == "quarantined":
+            # leaving quarantine for retirement: clear the breaker
+            # cosmetics so health shows 'retiring', not a phantom
+            # quarantine on a name that is about to disappear
+            rep = self.router.replicas.get(name)
+            if rep is not None:
+                self._set_quarantined(rep, False)
+        st.quarantined_at = None
+        return prev
 
     def watch(self, until, timeout_s=60.0, poll_s=0.005):
         """Drive ``router.step() + poll()`` until ``until()`` is
@@ -373,6 +414,9 @@ class FleetSupervisor:
                 "quarantined": sorted(
                     n for n, s in self._st.items()
                     if s.phase == "quarantined"),
+                "retiring": sorted(
+                    n for n, s in self._st.items()
+                    if s.phase == "retiring"),
                 "anomaly_alerting": None if sen is None
                 else sen.alerting(),
                 "breaker": {"threshold": self.breaker_threshold,

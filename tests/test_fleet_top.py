@@ -40,7 +40,16 @@ HEALTH = {"queue_depth": 1, "pending": 2, "lost": [],
               "r1": {"state": "drained", "incarnation": 3,
                      "queued": 0, "running": 0, "free_pages": 7,
                      "scrape_age_s": 1.5, "lost": True,
-                     "quarantined": True}}}
+                     "quarantined": True}},
+          "overload": {"degraded": True, "brownout_level": 1,
+                       "clamped_tenants": ["acme"], "target_s": 2.0,
+                       "degraded_for_s": 1.2},
+          "autoscale": {"state": "retiring", "replicas": 2,
+                        "min": 1, "max": 4, "booting": "as1",
+                        "retiring": "r1",
+                        "last_decision": {"event": "scale_in_started",
+                                          "replica": "r1", "t": 12.0},
+                        "events": 3}}
 
 TENANTS = {"tracked": 2, "capacity": 8, "evictions": 0,
            "error_bound": 0,
@@ -148,6 +157,20 @@ class TestLivePoll:
         assert "shed" in text
         # SLO alert surfaced
         assert "ttft" in text
+
+    def test_render_autoscaler_panel(self, stub_exporter):
+        """The AUTOSCALER panel (ISSUE 15 satellite): controller
+        state + bounds, degraded/brownout with the clamp set, last
+        decision, and per-replica roles incl. the booting newcomer
+        and the retiring victim."""
+        text = ft.render(ft.collect_live(stub_exporter.url))
+        assert "AUTOSCALER" in text
+        assert "state=retiring" in text and "[1..4]" in text
+        assert "degraded=yes" in text and "brownout=L1" in text
+        assert "clamped=acme" in text
+        assert "last: scale_in_started" in text
+        assert "r1=retiring" in text and "as1=booting" in text
+        assert "r0=serving" in text
 
     def test_main_live_once(self, stub_exporter, capsys):
         rc = ft.main(["--url", stub_exporter.url, "--once"])

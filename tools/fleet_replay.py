@@ -17,11 +17,18 @@ original:
   at 50 req/s — the "what if this traffic came faster" drills;
 - **what-if knob overrides** (``--knob k=v``, repeatable): router
   knobs (``hedge_after_ms``, ``max_queue``, ``replica_queue_limit``,
-  ``placement.<weight>``) and engine knobs (``steps_per_dispatch``,
-  ``page_size`` — the prefill-bucket-ladder granularity —
-  ``max_slots``, ``max_seq_len``, ``temperature``, ``top_k``,
-  ``seed``) — score a knob setting against recorded traffic without
-  touching production;
+  ``placement.<weight>``, the overload/brownout controller's
+  ``overload_target_ms``/``brownout_*``) and engine knobs
+  (``steps_per_dispatch``, ``page_size`` — the prefill-bucket-ladder
+  granularity — ``max_slots``, ``max_seq_len``, ``temperature``,
+  ``top_k``, ``seed``) — score a knob setting against recorded
+  traffic without touching production. ``autoscale.<param>`` knobs
+  (``autoscale.max_replicas=3 autoscale.scale_out_cooldown_s=0.5``
+  ...) additionally arm a FleetAutoscaler over the replay fleet, so
+  an autoscaling POLICY is scorable offline against a recorded
+  archive — the verdict grows an ``autoscale`` section (decision
+  events, flap count, final fleet size) and spawned replicas join
+  the zero-new-traces math with their adoption-time frozen counts;
 - **golden mode** (``--golden``): asserts token-exact outputs per
   original rid (valid when seeds/params match — greedy decoding and
   the same weights make replay bit-deterministic) and ZERO new XLA
@@ -76,10 +83,21 @@ DEFAULT_GATES = {
 }
 
 ROUTER_KNOBS = {"hedge_after_ms", "max_queue", "replica_queue_limit",
-                "wedge_timeout_s"}
+                "wedge_timeout_s", "overload_target_ms",
+                "overload_interval_s", "brownout_max_new",
+                "brownout_levels", "brownout_step_s"}
 ENGINE_KNOBS = {"steps_per_dispatch", "page_size", "max_slots",
                 "max_seq_len", "temperature", "top_k", "seed",
                 "num_pages"}
+# --knob autoscale.<param>: arms a FleetAutoscaler over the replay
+# fleet (spawn_fn builds extra warmed replicas up to max_replicas) so
+# an autoscale POLICY is scorable against a recorded archive — the
+# verdict grows an "autoscale" section (events, flaps, final size)
+AUTOSCALE_KNOBS = {"min_replicas", "max_replicas",
+                   "scale_out_cooldown_s", "scale_in_cooldown_s",
+                   "recovery_hold_s", "budget_floor", "scale_in_util",
+                   "boot_timeout_s", "retire_timeout_s",
+                   "flap_window_s"}
 
 
 # -- wave sources ----------------------------------------------------------
@@ -145,9 +163,12 @@ def load_wave(path):
 
 
 def parse_knobs(pairs):
-    """--knob k=v pairs -> (router_kw, engine_kw, placement_weights).
-    Unknown knobs fail loudly — a typo'd what-if is not a what-if."""
+    """--knob k=v pairs -> (router_kw, engine_kw, placement_weights,
+    autoscale_kw). Unknown knobs fail loudly — a typo'd what-if is
+    not a what-if. Any ``autoscale.<param>`` knob arms an autoscaler
+    over the replay fleet (autoscale_kw is None when absent)."""
     router_kw, engine_kw, weights = {}, {}, {}
+    autoscale_kw = None
     for pair in pairs or ():
         if "=" not in pair:
             raise ValueError(f"--knob {pair!r}: expected k=v")
@@ -159,6 +180,15 @@ def parse_knobs(pairs):
             val = v
         if k.startswith("placement."):
             weights[k[len("placement."):]] = float(val)
+        elif k.startswith("autoscale."):
+            param = k[len("autoscale."):]
+            if param not in AUTOSCALE_KNOBS:
+                raise ValueError(
+                    f"unknown knob {k!r}; autoscale params: "
+                    f"{sorted(AUTOSCALE_KNOBS)}")
+            if autoscale_kw is None:
+                autoscale_kw = {}
+            autoscale_kw[param] = val
         elif k in ROUTER_KNOBS:
             router_kw[k] = val
         elif k in ENGINE_KNOBS:
@@ -166,21 +196,31 @@ def parse_knobs(pairs):
         else:
             raise ValueError(
                 f"unknown knob {k!r}; router: {sorted(ROUTER_KNOBS)}, "
-                f"engine: {sorted(ENGINE_KNOBS)}, plus placement.<w>")
-    return router_kw, engine_kw, weights
+                f"engine: {sorted(ENGINE_KNOBS)}, plus placement.<w> "
+                "and autoscale.<param>")
+    return router_kw, engine_kw, weights, autoscale_kw
 
 
 def build_fleet(entries, *, model="gpt-tiny", replicas=2,
                 model_seed=0, engine_kw=None, router_kw=None,
-                placement_weights=None, capture_dir=None, warm=True):
+                placement_weights=None, capture_dir=None, warm=True,
+                autoscale_kw=None):
     """A fresh in-process fleet sized for a replay: engines warmed on
     every prefill bucket the wave can land in (plus the decode scan),
     compile counts frozen AFTER the warmup. Returns
-    (router, engines, frozen_counts)."""
+    (router, engines, frozen_counts).
+
+    autoscale_kw (a dict, possibly empty) arms a FleetAutoscaler over
+    the fleet: ``spawn_fn`` builds additional warmed replicas named
+    ``as<N>`` (appended to ``engines`` so callers can close them),
+    the autoscaler attaches as ``router.autoscaler`` and ``replay``
+    drives its ``poll()`` — the what-if path for scoring an
+    autoscale policy against recorded traffic."""
     import paddle_tpu as paddle
     from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
     from paddle_tpu.nlp.serving import ServingEngine
-    from paddle_tpu.serving_fleet import FleetRouter, InprocReplica
+    from paddle_tpu.serving_fleet import FleetAutoscaler, \
+        FleetRouter, InprocReplica
 
     paddle.seed(int(model_seed))
     mdl = GPTForCausalLM(_resolve_config(model))
@@ -191,11 +231,16 @@ def build_fleet(entries, *, model="gpt-tiny", replicas=2,
     engines = []
     warm_lens = sorted({len(e["prompt"]) for e in entries}) if warm \
         else []
-    for _ in range(int(replicas)):
+
+    def _engine():
         eng = ServingEngine(mdl, **ekw)
         if warm_lens:
             eng.warmup(buckets=warm_lens, decode=True)
         engines.append(eng)
+        return eng
+
+    for _ in range(int(replicas)):
+        _engine()
     frozen = [e.compile_counts() for e in engines]
     reps = [InprocReplica(f"r{i}", e) for i, e in enumerate(engines)]
     rkw = dict(history=True, history_interval_s=0.05)
@@ -205,6 +250,21 @@ def build_fleet(entries, *, model="gpt-tiny", replicas=2,
     if capture_dir is not None:
         rkw["capture"] = capture_dir
     router = FleetRouter(reps, **rkw)
+    if autoscale_kw is not None:
+        # pre-build + warm the spare engines NOW, before the replay
+        # clock starts: spawn_fn inside asc.poll() runs on the
+        # control thread, and paying multi-second XLA warmups there
+        # mid-burst would freeze router.step() and charge the scored
+        # policy for the harness's own spawn stall. The pool is sized
+        # from the policy's max_replicas when given (else one spare);
+        # an exhausted pool falls back to a lazy build.
+        mr = autoscale_kw.get("max_replicas")
+        pool_n = max(int(mr) - int(replicas), 0) if mr is not None \
+            else 1
+        pool = [_engine() for _ in range(pool_n)]
+        FleetAutoscaler(router, lambda i: InprocReplica(
+            f"as{i}", pool.pop(0) if pool else _engine()),
+            **autoscale_kw)
     return router, engines, frozen
 
 
@@ -247,6 +307,7 @@ def replay(router, entries, *, mode="recorded", time_scale=1.0,
     t0 = time.monotonic()
     t_end = t0 + float(timeout_s)
     nxt = 0
+    autoscaler = getattr(router, "autoscaler", None)
     while True:
         now = time.monotonic() - t0
         while nxt < len(order) and offs[order[nxt]] <= now:
@@ -260,6 +321,8 @@ def replay(router, entries, *, mode="recorded", time_scale=1.0,
             rid_map[rid] = e["rid"]
             nxt += 1
         router.step()
+        if autoscaler is not None:
+            autoscaler.poll()
         for r in router.results():
             results[rid_map.get(r["id"], r["id"])] = r
         if nxt >= len(order) and len(results) >= len(entries):
@@ -466,13 +529,15 @@ def run_replay(entries, *, out_dir, mode="recorded", time_scale=1.0,
     from paddle_tpu.observability.trafficrec import load_archive
     from paddle_tpu.observability.trace import report_all
 
-    router_kw, engine_kw, weights = parse_knobs(knob_pairs)
+    router_kw, engine_kw, weights, autoscale_kw = \
+        parse_knobs(knob_pairs)
     cap_dir = os.path.join(out_dir, "replay_archive")
     router, engines, frozen = build_fleet(
         entries, model=model, replicas=replicas,
         model_seed=model_seed, engine_kw=engine_kw,
         router_kw=router_kw, placement_weights=weights,
-        capture_dir=cap_dir)
+        capture_dir=cap_dir, autoscale_kw=autoscale_kw)
+    autoscale_facts = None
     try:
         if faults_arm is not None:
             faults_arm()
@@ -480,7 +545,30 @@ def run_replay(entries, *, out_dir, mode="recorded", time_scale=1.0,
             router, entries, mode=mode, time_scale=time_scale,
             rate=rate, timeout_s=timeout_s)
         hist = history_quantiles(router)
-        counts = [e.compile_counts() for e in engines]
+        asc = getattr(router, "autoscaler", None)
+        base_n = len(frozen)
+        compare = list(engines[:base_n])
+        if asc is not None:
+            # spawned replicas joined with their compile counts
+            # frozen at adoption — fold them into the zero-new-traces
+            # math (engines spawned but never adopted have no frozen
+            # baseline and stay out of the comparison)
+            spawn_frozen = {id(rep.engine): fz
+                            for rep, fz in asc.spawned
+                            if fz is not None
+                            and hasattr(rep, "engine")}
+            for e in engines[base_n:]:
+                fz = spawn_frozen.get(id(e))
+                if fz is not None:
+                    compare.append(e)
+                    frozen = frozen + [fz]
+            autoscale_facts = {
+                "events": asc.health()["decisions"],
+                "flaps": int(router.registry.get(
+                    "fleet_autoscale_flaps_total").value),
+                "replicas_final": len(router.replicas),
+                "state": asc.state}
+        counts = [e.compile_counts() for e in compare]
         new_traces = sum(
             sum(c.values()) for c in counts) - sum(
             sum(c.values()) for c in frozen)
@@ -506,6 +594,7 @@ def run_replay(entries, *, out_dir, mode="recorded", time_scale=1.0,
         knobs={"pairs": list(knob_pairs or ()),
                "replicas": replicas}, history=hist)
     verdict["wall_s"] = round(wall_s, 3)
+    verdict["autoscale"] = autoscale_facts
     report_all()  # keep the tracer rollup warm for post-hoc reads
     return verdict, replay_entries
 

@@ -5,9 +5,12 @@ endpoints: one frame per interval showing the fleet headline (request
 rate, delivered tok/s, TTFT/queue-wait p99 from the history plane),
 SLO burn alerts + anomaly-sentinel excursions, the per-replica table
 (state, incarnation, queue/running, free pages, scrape age), the
-per-tenant heavy-hitter table (space-saving sketch: weight, tokens
-in/out, KV-page-seconds, the error bound) and the recent-resolved
-request table (rid, status, ttft/e2e, traffic-archive locator).
+AUTOSCALER panel (controller state + size bounds, degraded/brownout
+level with the clamped tenants, last decision + reason, per-replica
+role incl. booting/retiring members), the per-tenant heavy-hitter
+table (space-saving sketch: weight, tokens in/out, KV-page-seconds,
+the error bound) and the recent-resolved request table (rid, status,
+ttft/e2e, traffic-archive locator).
 
 Live mode reads ``/healthz`` + ``/history`` + ``/tenants`` +
 ``/requests`` off the router exporter
@@ -167,6 +170,40 @@ def render(frame):
                     f"{_fmt(row.get('free_pages')):<7} "
                     f"{_fmt(row.get('scrape_age_s'), 's'):<11} "
                     f"{flags}")
+    if h:
+        asc = h.get("autoscale")
+        ov = h.get("overload") or {}
+        if asc or ov.get("degraded") or ov.get("brownout_level"):
+            bits = []
+            if asc:
+                bits.append(
+                    f"state={asc.get('state')} "
+                    f"size={asc.get('replicas')} "
+                    f"[{asc.get('min')}..{asc.get('max')}]")
+            bits.append(
+                f"degraded={'yes' if ov.get('degraded') else 'no'} "
+                f"brownout=L{ov.get('brownout_level') or 0}")
+            if ov.get("clamped_tenants"):
+                bits.append(
+                    f"clamped={','.join(ov['clamped_tenants'])}")
+            out.append("  AUTOSCALER  " + "  ".join(bits))
+            last = (asc or {}).get("last_decision")
+            if last:
+                detail = " ".join(
+                    f"{k}={v}" for k, v in sorted(last.items())
+                    if k not in ("event", "t") and v is not None)
+                out.append(f"    last: {last.get('event')} "
+                           f"{detail}".rstrip())
+            reps = h.get("replicas") or {}
+            if asc and reps:
+                roles = []
+                for name in sorted(reps):
+                    role = "retiring" if name == asc.get("retiring") \
+                        else str(reps[name].get("state"))
+                    roles.append(f"{name}={role}")
+                if asc.get("booting"):
+                    roles.append(f"{asc['booting']}=booting")
+                out.append("    ROLE  " + " ".join(roles))
     t = frame.get("tenants")
     if t:
         out.append(

@@ -156,9 +156,10 @@ STAGES = [
                            "tests/test_fleet_serving.py",
                            "tests/test_fleet_tracing.py",
                            "tests/test_fleet_recovery.py",
-                           "tests/test_fleet_proc.py", "-q", "-m",
-                           "chaos", "-p", "no:cacheprovider", "-p",
-                           "no:randomly"], 3600,
+                           "tests/test_fleet_proc.py",
+                           "tests/test_fleet_autoscale.py", "-q",
+                           "-m", "chaos", "-p", "no:cacheprovider",
+                           "-p", "no:randomly"], 3600,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0",
       "PADDLE_TPU_RUN_SLOW": "1"}),
     # router durability drill in isolation (ISSUE 9, CPU): seeded
@@ -220,6 +221,18 @@ STAGES = [
     # replay_verdict.json + replay_verdict_regression.json + the
     # capture archive, next to the stage's metrics.json.
     ("replay_smoke", [PY, "tools/replay_smoke.py"], 1800,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
+    # elastic autoscaling drill (ISSUE 15, CPU, seeded): a one-replica
+    # fleet under a pinned-slow burst — multi-window TTFT burn fires →
+    # scale-out through the warm-boot gate (adopted replica takes
+    # traffic with zero new steady-state traces), recovery + budget
+    # refill + idle hold → scale-in (hedge-safe drain → remove).
+    # Asserts no lost rid (exactly-once), ok results token-exact vs
+    # an uninterrupted golden, bounded SLO breach, zero flaps, frozen
+    # compile counts, scale_out/scale_in journal records reconcile,
+    # and parseable fleet_scale_out/in flight dumps
+    # (validate_stages.FLIGHT_STAGES).
+    ("autoscale_smoke", [PY, "tools/autoscale_smoke.py"], 1800,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
     ("bench_full", [PY, "bench.py"], 7200, {}),
     ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
@@ -401,6 +414,14 @@ FLEET_CANARY_FAIL_ON = (
     # suite — same bootstrap as the sentinel counters above.)
     "fleet_capture_errors_total>0%",
     "fleet_capture_trace_missing_total>0%",
+    # elastic-autoscaling counter (ISSUE 15): ANY controller flap
+    # (opposite-direction decisions inside flap_window_s) beyond the
+    # golden is an oscillating policy — the "never flaps" contract
+    # made enforceable. (Overload sheds are NOT gated separately:
+    # they count into fleet_shed_total, whose storm gate above
+    # already covers them, and their exact count is timing-sensitive
+    # on a loaded CI box.)
+    "fleet_autoscale_flaps_total>0%",
 )
 
 # history gate (ISSUE 11): ONE archive, two instants, both directions

@@ -53,6 +53,10 @@ REQUIRED_STAGES = {
     # golden replay + verdict-gate both-directions proof (CPU-only —
     # ISSUE 12)
     "replay_smoke",
+    # elastic autoscaling drill: burst → scale-out → recovery →
+    # scale-in with no lost rid + bounded SLO breach (CPU-only —
+    # ISSUE 15)
+    "autoscale_smoke",
 }
 
 
@@ -66,6 +70,7 @@ def _emits_metrics(cmd):
                                             "telemetry_smoke.py",
                                             "history_smoke.py",
                                             "replay_smoke.py",
+                                            "autoscale_smoke.py",
                                             "test_fleet_serving.py",
                                             "test_fleet_recovery.py",
                                             "test_fleet_proc.py")
@@ -122,7 +127,7 @@ def check_completed_stage_metrics():
 # per stage — flightrec's dump-dir fallback)
 FLIGHT_STAGES = {"chaos_smoke", "telemetry_smoke",
                  "fleet_recovery_smoke", "fleet_supervisor_smoke",
-                 "history_smoke"}
+                 "history_smoke", "autoscale_smoke"}
 
 
 def check_flight_dumps():
