@@ -35,12 +35,15 @@ crosses a jit boundary.
 """
 from __future__ import annotations
 
+import hashlib
 import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["PagedLayerCache", "alloc_pages", "quantize_rows",
+__all__ = ["PagedLayerCache", "PrefixIndex", "alloc_pages",
+           "prefix_fingerprints", "quantize_rows",
            "write_token_kv", "write_prompt_kv", "paged_attention_ref",
            "paged_update_and_attend", "paged_layer_forward",
            "TRASH_PAGE"]
@@ -281,3 +284,250 @@ def paged_update_and_attend(q, k, v, cache: PagedLayerCache, groups=1,
                                   lens, k_scale=k_scale, v_scale=v_scale)
     out = out.reshape(b, 1, h, d)
     return out, (k_pages, v_pages, k_scale, v_scale)
+
+
+# -- COW prefix caching (host side) -----------------------------------------
+#
+# A request whose prompt shares a page-aligned prefix with an earlier
+# prompt can reuse that prompt's already-written pages instead of
+# recomputing prefill for them. The sharing unit is the FULL page:
+# fingerprints are a rolling blake2b chain over page-sized token
+# blocks, so a boundary fingerprint commits to the entire token prefix
+# before it (two prompts with the same boundary-j fingerprint share
+# tokens [0, j*page_size) with cryptographic certainty, and the chain
+# is process-independent — the fleet router recomputes the same values
+# from heartbeat-advertised page sizes).
+#
+# COW discipline is structural, not trapped: boundaries stop at
+# (len-1)//page_size, so the final prompt position ALWAYS lands in the
+# request's private tail (the sampled first token needs a live
+# forward), and decode writes land at positions >= len — page index
+# len//ps >= any shared boundary — i.e. never on a shared page. The
+# "copy" in copy-on-write is the short tail prefill re-materializing
+# the partial page privately.
+
+
+def prefix_fingerprints(prompt, page_size):
+    """Rolling per-page-boundary fingerprints of a prompt.
+
+    Returns [fp_1, .., fp_j] hex digests where fp_j commits to tokens
+    [0, j*page_size). Boundaries are capped at (len-1)//page_size so
+    the final prompt position always stays in the private tail (its
+    forward pass samples the first token — see module note above)."""
+    arr = np.ascontiguousarray(np.asarray(prompt, np.int64))
+    nb = max((arr.shape[0] - 1) // page_size, 0) if arr.shape[0] else 0
+    h = hashlib.blake2b(digest_size=12)
+    h.update(b"ps%d" % page_size)
+    out = []
+    for j in range(nb):
+        h.update(arr[j * page_size:(j + 1) * page_size].tobytes())
+        out.append(h.hexdigest())
+    return out
+
+
+class _PrefixEntry:
+    __slots__ = ("fp", "pages", "kv", "hits", "last_used")
+
+    def __init__(self, fp, pages, kv, now):
+        self.fp = fp
+        self.pages = tuple(pages)   # page ids, boundary order
+        self.kv = kv                # [(k, v)] per layer: padded dense
+        #                             [1, max_seq_len, Hkv, D] device
+        #                             buffers (shared across nested
+        #                             boundaries; rows past a boundary
+        #                             are overwritten/masked by the
+        #                             tail program)
+        self.hits = 0
+        self.last_used = now
+
+
+class PrefixIndex:
+    """Host-side refcounted index of immutable shared prefix pages.
+
+    One entry per registered page boundary (nested boundaries of the
+    same prompt are separate entries sharing page ids and K/V views).
+    Two refcounts per owned page: ``owners`` (how many entries cover
+    it) and ``rc`` (how many live slots map it). A page returns to the
+    engine's free list only when BOTH reach zero — slots release rc on
+    finish, entries release owners on LRU eviction, and eviction skips
+    any entry with a page still pinned by a live slot (shared pages
+    evict LRU only at refcount 0).
+
+    Entries also pin a dense padded copy of the prefix K/V rows (per
+    layer, [1, max_seq_len, Hkv, D], built once at registration): the
+    tail-prefill program needs the prefix as a dense static-cache
+    buffer so the tail's keys/queries attend it exactly as a full
+    prefill would, and keeping it device-resident makes a hit
+    admission a pure dispatch — zero per-hit transfers. The index
+    itself stays engine-agnostic host bookkeeping: the buffers are
+    opaque objects it never touches."""
+
+    def __init__(self, page_size, min_pages=1, max_entries=512):
+        self.page_size = int(page_size)
+        self.min_pages = max(int(min_pages), 1)
+        self.max_entries = int(max_entries)
+        self._entries = {}      # fp -> _PrefixEntry
+        self._owners = {}       # page -> entry count
+        self._rc = {}           # page -> live slot count
+        self._clock = 0         # monotonic LRU clock (no wall time)
+        # counters (plain monotonic ints; the engine surfaces them
+        # through health() and the fleet router folds them into the
+        # fleet_prefix_* registry series off heartbeats)
+        self.hits = 0
+        self.misses = 0
+        self.hit_pages = 0
+        self.total_pages = 0    # shareable prompt pages seen (denom)
+        self.cow_copies = 0     # private tail pages re-materialized
+        self.evictions = 0
+        self.adopted_pages = 0  # pages ever adopted (monotonic; the
+        #                         fleet_prefix_shared_pages_total feed
+        #                         — shared_pages is the level, this
+        #                         the counter)
+
+    # -- introspection ----------------------------------------------------
+
+    @property
+    def entries(self):
+        return len(self._entries)
+
+    @property
+    def owned_pages(self):
+        """Pages currently owned by the index (not on the free list)."""
+        return set(self._owners)
+
+    @property
+    def owned_page_count(self):
+        return len(self._owners)
+
+    def pinned(self, page):
+        return self._rc.get(page, 0) > 0
+
+    def fingerprint_set(self):
+        """All registered boundary fingerprints (heartbeat inventory)."""
+        return set(self._entries)
+
+    def covers(self, fps):
+        """True when every boundary in the chain is already
+        registered (an insert would be a no-op)."""
+        return all(fp in self._entries for fp in fps)
+
+    def top_fingerprints(self, n=5):
+        """[(fp, pages, hits)] hottest entries, for health()."""
+        rows = sorted(self._entries.values(),
+                      key=lambda e: (-e.hits, -e.last_used))
+        return [(e.fp, len(e.pages), e.hits) for e in rows[:n]]
+
+    def stats(self):
+        return {"entries": len(self._entries),
+                "shared_pages": len(self._owners),
+                "hits": self.hits, "misses": self.misses,
+                "hit_pages": self.hit_pages,
+                "total_pages": self.total_pages,
+                "cow_copies": self.cow_copies,
+                "evictions": self.evictions,
+                "adopted_pages": self.adopted_pages}
+
+    # -- lookup / refcounting ---------------------------------------------
+
+    def match(self, fps):
+        """Longest registered boundary of a fingerprint chain:
+        (entry, npages) or None. A boundary hit implies every shorter
+        boundary matches too (rolling chain), so scanning from the
+        longest suffices; respects min_pages."""
+        for j in range(len(fps), self.min_pages - 1, -1):
+            e = self._entries.get(fps[j - 1])
+            if e is not None:
+                return e, j
+        return None
+
+    def acquire(self, entry):
+        """Pin an entry's pages for a live slot; returns the page ids
+        in boundary order."""
+        self._clock += 1
+        entry.hits += 1
+        entry.last_used = self._clock
+        for p in entry.pages:
+            self._rc[p] = self._rc.get(p, 0) + 1
+        return list(entry.pages)
+
+    def release(self, pages):
+        """Drop a finished slot's pin on shared pages. Pages stay owned
+        by their entries (reuse is the point) — only eviction frees."""
+        for p in pages:
+            n = self._rc.get(p, 0) - 1
+            if n > 0:
+                self._rc[p] = n
+            else:
+                self._rc.pop(p, None)
+
+    # -- registration / eviction ------------------------------------------
+
+    def insert(self, fps, pages, kv, *, pin=True):
+        """Register boundaries [min_pages .. len(fps)] of a prompt.
+
+        ``pages`` are the donor slot's prompt pages (>= len(fps) of
+        them); ``kv`` is the padded dense K/V sidecar ([(k, v)] per
+        layer, [1, max_seq_len, Hkv, D]) — one object, shared by
+        every nested boundary entry (rows past a boundary are
+        overwritten/masked by the tail program, so no per-boundary
+        slices exist). Pages newly adopted by the index get rc pinned
+        for the donor slot when ``pin`` (the slot is still running on
+        them; its release drops the pin). Returns (adopted, freed):
+        the set of pages the index now owns among
+        ``pages[:len(fps)]``, and pages released by capacity eviction
+        that the caller MUST return to its free list."""
+        adopted, freed = set(), []
+        self._clock += 1
+        for j in range(self.min_pages, len(fps) + 1):
+            fp = fps[j - 1]
+            if fp in self._entries:
+                self._entries[fp].last_used = self._clock
+                continue
+            if len(self._entries) >= self.max_entries and \
+                    not self._evict_entries(1, freed):
+                break               # full and nothing evictable
+            self._entries[fp] = _PrefixEntry(fp, pages[:j], kv,
+                                             self._clock)
+            for p in pages[:j]:
+                if p not in self._owners:
+                    adopted.add(p)
+                self._owners[p] = self._owners.get(p, 0) + 1
+        self.adopted_pages += len(adopted)
+        if pin:
+            for p in adopted:
+                self._rc[p] = self._rc.get(p, 0) + 1
+        return adopted, freed
+
+    def evict(self, need_pages):
+        """Free at least ``need_pages`` pages by LRU entry eviction
+        (entries whose pages are all slot-unpinned). Returns the list
+        of freed page ids (may be shorter than asked)."""
+        freed = []
+        while len(freed) < need_pages:
+            got = self._evict_entries(1, freed)
+            if not got:
+                break
+        return freed
+
+    def _evict_entries(self, n, freed=None):
+        """Evict up to n LRU entries with no slot-pinned page; append
+        fully-released pages to ``freed``. Returns entries evicted."""
+        done = 0
+        for e in sorted(self._entries.values(),
+                        key=lambda e: e.last_used):
+            if done >= n:
+                break
+            if any(self._rc.get(p, 0) for p in e.pages):
+                continue
+            del self._entries[e.fp]
+            self.evictions += 1
+            done += 1
+            for p in e.pages:
+                left = self._owners.get(p, 0) - 1
+                if left > 0:
+                    self._owners[p] = left
+                else:
+                    self._owners.pop(p, None)
+                    if freed is not None:
+                        freed.append(p)
+        return done

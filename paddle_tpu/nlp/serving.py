@@ -48,6 +48,7 @@ token-exact prefix dedup, hedging, graceful drain/rejoin via
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 
@@ -60,8 +61,8 @@ from ..observability.metrics import MetricsRegistry
 from ..resilience import faults
 from ..resilience.retry import call_with_retries
 from ..tensor import Tensor
-from .paged_cache import PagedLayerCache, alloc_pages, write_prompt_kv, \
-    TRASH_PAGE
+from .paged_cache import PagedLayerCache, PrefixIndex, alloc_pages, \
+    prefix_fingerprints, write_prompt_kv, TRASH_PAGE
 
 __all__ = ["ServingEngine", "ServeRequest"]
 
@@ -77,7 +78,8 @@ class ServeRequest:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id",
                  "deadline", "priority", "submitted_at", "submitted_pc",
-                 "trace", "admitted_pc", "tenant", "queue_wait_s")
+                 "trace", "admitted_pc", "tenant", "queue_wait_s",
+                 "prefix_fps")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id,
                  deadline=None, priority=0, trace=None, tenant=None):
@@ -98,11 +100,15 @@ class ServeRequest:
         # accounting; set at admission so finish sees the real wait
         self.tenant = None if tenant is None else str(tenant)
         self.queue_wait_s = None
+        # rolling per-page-boundary fingerprint chain (COW prefix
+        # caching) — computed once at submit when the cache is on
+        self.prefix_fps = None
 
 
 class _Slot:
     __slots__ = ("req", "pages", "out_tokens", "status", "admit_seq",
-                 "decode_t0")
+                 "decode_t0", "shared", "prefix_hit_pages",
+                 "prefix_pages")
 
     def __init__(self, req, pages, admit_seq=0):
         self.req = req
@@ -112,6 +118,10 @@ class _Slot:
         self.admit_seq = admit_seq  # admission order (evict tie-break)
         self.decode_t0 = None       # perf_counter at prefill end (the
         #                             traced decode leg's start)
+        self.shared = frozenset()   # pages owned by the prefix index
+        #                             (release, don't free, on finish)
+        self.prefix_hit_pages = 0   # prompt pages served from cache
+        self.prefix_pages = 0       # shareable prompt pages (denom)
 
 
 def _next_pow2(n):
@@ -168,6 +178,20 @@ class ServingEngine:
         persistent compilation cache on jax 0.4.x (reloading donated
         executables aborts — R6_NOTES.md); bench.py does this
         automatically for PADDLE_TPU_BENCH_CACHE.
+    prefix_cache: copy-on-write prefix-page sharing (PrefixIndex):
+        prompts sharing a page-aligned prefix with an earlier prompt
+        map the already-written pages into their page table and run a
+        short bucketed TAIL prefill only. Hits can change TTFT, never
+        tokens (docs/performance.md round 19). Default ON; None reads
+        PADDLE_TPU_PREFIX_CACHE (0/false/off disables — the kill
+        switch). Hit admission additionally requires the tail bucket
+        pre-traced by warmup() — a cold engine serves every request
+        through the full-prefill path, so zero-recompile and token
+        goldens hold unconditionally.
+    min_prefix_pages: shortest prefix (in whole pages) worth sharing;
+        None reads PADDLE_TPU_PREFIX_MIN_PAGES (default 1).
+    prefix_max_entries: bound on registered fingerprint boundaries
+        (LRU-evicted beyond it).
     """
 
     def __init__(self, model, *, max_slots=8, page_size=16,
@@ -176,7 +200,8 @@ class ServingEngine:
                  pad_token_id=0, steps_per_dispatch=8, donate=True,
                  admission_policy="wait", watchdog_timeout=None,
                  dispatch_retries=2, registry=None,
-                 tenant_capacity=64):
+                 tenant_capacity=64, prefix_cache=None,
+                 min_prefix_pages=None, prefix_max_entries=512):
         if page_size % 8:
             raise ValueError(f"page_size must be a multiple of 8 "
                              f"(Mosaic sublane tiling), got {page_size}")
@@ -217,6 +242,16 @@ class ServingEngine:
         self.pad_token_id = int(pad_token_id)
         self.steps_per_dispatch = int(steps_per_dispatch)
         self.donate = bool(donate)
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "PADDLE_TPU_PREFIX_CACHE", "1").lower() \
+                not in ("0", "false", "off")
+        if min_prefix_pages is None:
+            min_prefix_pages = int(os.environ.get(
+                "PADDLE_TPU_PREFIX_MIN_PAGES", "1"))
+        self.prefix = PrefixIndex(
+            self.page_size, min_pages=min_prefix_pages,
+            max_entries=prefix_max_entries) if prefix_cache else None
 
         self._params, self._buffers = model.raw_state()
         self._pages = [alloc_pages(self.num_pages, self.page_size,
@@ -330,6 +365,10 @@ class ServingEngine:
             "serve_queue_depth", help="requests awaiting admission"))
         self._g_running = own(reg.gauge(
             "serve_running", help="requests occupying a slot"))
+        self._g_prefix_occ = own(reg.gauge(
+            "prefix_cache_occupancy",
+            help="fraction of usable KV pages owned by the shared "
+                 "prefix index (0 when the cache is off/empty)"))
         self._m_req = {}            # status -> serve_requests_total
         for status in ("ok", "expired", "cancelled", "rejected",
                        "evicted"):
@@ -368,9 +407,14 @@ class ServingEngine:
         self._trace_counts = self.tracer._counts
         self._decode_fn = self._build_decode_fn()
         self._prefill_fns = {}
+        self._tail_prefill_fns = {}
         # warm-boot bookkeeping (warmup()): which prefill buckets and
-        # whether the decode program were pre-traced at boot
+        # whether the decode program were pre-traced at boot. Tail
+        # buckets gate the prefix-cache HIT path: a hit admission only
+        # happens when its tail program is already traced, so caching
+        # can never introduce a mid-traffic compile
         self._warmed_buckets = set()
+        self._warmed_tail_buckets = set()
         self._warmed_decode = False
         # decode-dispatch accounting: batched-decode throughput is THE
         # serving metric (wall time also pays per-request prefill,
@@ -404,6 +448,9 @@ class ServingEngine:
         self._g_queue_depth.set(len(self._queue))
         self._g_running.set(
             sum(1 for s in self._slots if s is not None))
+        if self.prefix is not None:
+            self._g_prefix_occ.set(
+                round(self.prefix.owned_page_count / usable, 6))
 
     def _sync_registry(self):
         """Fold the monotonic retry/watchdog sources into registry
@@ -503,10 +550,17 @@ class ServingEngine:
             else time.monotonic() + float(deadline_ms) / 1e3
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(ServeRequest(rid, prompt, max_new_tokens,
-                                        eos_token_id, deadline=deadline,
-                                        priority=priority, trace=trace,
-                                        tenant=tenant))
+        req = ServeRequest(rid, prompt, max_new_tokens,
+                           eos_token_id, deadline=deadline,
+                           priority=priority, trace=trace,
+                           tenant=tenant)
+        if self.prefix is not None:
+            # rolling page-boundary fingerprints, once per request —
+            # a failover continuation re-submitted here re-fingerprints
+            # naturally (hit = cheap re-admission, miss = normal
+            # continuation prefill)
+            req.prefix_fps = prefix_fingerprints(prompt, self.page_size)
+        self._queue.append(req)
         return rid
 
     @staticmethod
@@ -696,14 +750,15 @@ class ServingEngine:
             raise RuntimeError("warmup() needs an idle engine — it is "
                                "a boot step, not a mid-traffic one")
         warmed = []
-        for n in sorted({self._bucket_for(n) for n in buckets}):
+        norm = sorted({self._bucket_for(n) for n in buckets})
+        for n in norm:
             if n in self._warmed_buckets:
                 continue
             fn = self._prefill_fn(n)
             ids = np.full((1, n), self.pad_token_id, np.int32)
             pages_vec = np.full((n // self.page_size,), TRASH_PAGE,
                                 np.int32)
-            _tok, new_pages, _rng = fn(
+            _tok, new_pages, _kv, _rng = fn(
                 self._params, self._buffers, self._pages,
                 jnp.asarray(ids), jnp.int32(1), jnp.asarray(pages_vec),
                 self._rng)
@@ -713,6 +768,53 @@ class ServingEngine:
             self._pages = new_pages
             self._warmed_buckets.add(n)
             warmed.append(n)
+        if self.prefix is not None and norm:
+            # tail-prefill ladder: a prefix HIT on a prompt of bucket n
+            # runs a tail of 1..n tokens, whose bucket is one of the
+            # pow2/whole-page values below n — trace them all now so a
+            # hit never compiles mid-traffic (the hit path is gated on
+            # exactly this set)
+            tails = set()
+            for n in norm:
+                tails.update(self._bucket_for(t)
+                             for t in range(1, n + 1))
+            pre = self.max_seq_len
+            zero = jnp.zeros((1, pre, self.kv_heads, self.head_dim),
+                             jnp.float32)
+            kpre = [zero] * self.num_layers
+            vpre = [zero] * self.num_layers
+            for t in sorted(tails):
+                if t in self._warmed_tail_buckets:
+                    continue
+                fn = self._tail_prefill_fn(t)
+                ids = np.full((1, t), self.pad_token_id, np.int32)
+                pages_vec = np.full((t // self.page_size,), TRASH_PAGE,
+                                    np.int32)
+                _tok, new_pages, _kv, _rng = fn(
+                    self._params, self._buffers, self._pages, kpre,
+                    vpre, jnp.asarray(ids), jnp.int32(0), jnp.int32(1),
+                    jnp.asarray(pages_vec), self._rng)
+                self._pages = new_pages
+                self._warmed_tail_buckets.add(t)
+            # eager-op ladder for the REGISTRATION path: jnp.pad at
+            # full prefill (bucket -> max_seq_len sidecar) and the
+            # extension splice at a hit are eager XLA ops whose
+            # executables key on shapes only (splice starts are
+            # dynamic operands) — run every shape combo the warmed
+            # buckets can produce so a registering wave never pays a
+            # backend compile mid-traffic
+            for n in norm:
+                if n < pre:
+                    jnp.pad(zero[:, :n],
+                            ((0, 0), (0, pre - n), (0, 0), (0, 0)))
+            for t in sorted(self._warmed_tail_buckets):
+                src = zero[:, :t]
+                for w in sorted({min(t, pre - jj * self.page_size)
+                                 for jj in range(1, pre //
+                                                 self.page_size)}):
+                    jax.lax.dynamic_update_slice(
+                        zero, src if w == t else src[:, :w],
+                        (0, 0, 0, 0))
         if decode and not self._warmed_decode:
             b = self.max_slots
             sched = (np.full((b, self.max_pages_per_seq), TRASH_PAGE,
@@ -732,6 +834,7 @@ class ServingEngine:
             self._warmed_decode = True
         from ..observability import flightrec
         flightrec.note("serve_warmup", buckets=warmed,
+                       tail_buckets=sorted(self._warmed_tail_buckets),
                        decode=self._warmed_decode)
         return warmed
 
@@ -813,6 +916,11 @@ class ServingEngine:
                 # live ones are cancelled with their partial tokens
                 self._finish_slot(
                     b, None if self._done[b] else "cancelled")
+        if self.prefix is not None:
+            # every slot is gone, so nothing is pinned: a full evict
+            # returns the index-owned pages and keeps the close()
+            # contract (ALL pages back on the free list)
+            self._free_pages.extend(self.prefix.evict(self.num_pages))
         self._state = "closed"
         if self._watchdog is not None:
             self._watchdog.stop()
@@ -882,6 +990,17 @@ class ServingEngine:
                           "top_k": self.top_k,
                           "seed": self.sampling_seed},
              "compile_counts": self.compile_counts()}
+        if self.prefix is not None:
+            st = self.prefix.stats()
+            st["occupancy"] = self._g_prefix_occ.value
+            st["min_pages"] = self.prefix.min_pages
+            st["page_size"] = self.page_size
+            st["top"] = [{"fp": f, "pages": p, "hits": n}
+                         for f, p, n in self.prefix.top_fingerprints()]
+            # the full boundary inventory: the fleet router harvests
+            # this off heartbeats for prefix-affinity placement
+            st["fingerprints"] = sorted(self.prefix.fingerprint_set())
+            h["prefix_cache"] = st
         if self._watchdog is not None:
             h["watchdog"] = dict(self._watchdog.health(),
                                  wedge_count=int(self._m_wedges.value))
@@ -998,25 +1117,97 @@ class ServingEngine:
             def arr(x):
                 return x._value if isinstance(x, Tensor) else x
 
-            new_pages = []
+            new_pages, dense_kv = [], []
             for (k, v, ks, vs), layer in zip(pages, caches):
+                kd, vd = arr(layer[0]), arr(layer[1])
                 new_pages.append(write_prompt_kv(
-                    k, v, ks, vs, arr(layer[0]), arr(layer[1]),
-                    pages_vec))
+                    k, v, ks, vs, kd, vd, pages_vec))
+                # the dense prompt K/V ride back out so the prefix
+                # index can pin host-side f32 copies of shareable
+                # pages — device buffers, no extra compute
+                dense_kv.append((kd, vd))
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], true_len - 1, keepdims=False)
             rng, sub = jax.random.split(rng)
             tok = self._sample(last[None, :], sub)[0]
-            return tok, new_pages, rng
+            return tok, new_pages, dense_kv, rng
 
         fn = self._counting(f"prefill_{bucket}", prefill,
                             donate_argnums=(2,))
         self._prefill_fns[bucket] = fn
         return fn
 
+    def _tail_prefill_fn(self, tb):
+        """The prefix-cache HIT program for tail bucket ``tb``: the
+        matched prefix arrives as dense host-pinned f32 K/V buffers
+        (padded to max_seq_len so the program is shape-stable across
+        hits), the tail tokens run the models' static-cache multi-token
+        forward at cache_index=cached_len — positions, RoPE and the
+        causal mask all line up with what a full prefill computes for
+        those rows — and only the tail K/V is written into (private)
+        pages. One program per tail bucket, zero recompiles after
+        warmup; donation matches the full-prefill contract."""
+        fn = self._tail_prefill_fns.get(tb)
+        if fn is not None:
+            return fn
+
+        def tail_prefill(params, buffers, pages, kpre, vpre, ids,
+                         cached_len, true_tail, pages_vec, rng):
+            def arr(x):
+                return x._value if isinstance(x, Tensor) else x
+
+            caches = []
+            for kp, vp in zip(kpre, vpre):
+                pad = jnp.zeros(kp.shape[:1] + (tb,) + kp.shape[2:],
+                                kp.dtype)
+                caches.append((Tensor(jnp.concatenate([kp, pad], 1)),
+                               Tensor(jnp.concatenate([vp, pad], 1))))
+            out = functional_call(self.model, params, buffers,
+                                  Tensor(ids), use_cache=False,
+                                  cache=caches,
+                                  cache_index=Tensor(cached_len))
+            logits_t, new_caches = out
+            logits = arr(logits_t)
+            new_pages, tail_kv = [], []
+            z0 = jnp.int32(0)
+            for (k, v, ks, vs), layer in zip(pages, new_caches):
+                kb, vb = arr(layer[0]), arr(layer[1])
+                kt = jax.lax.dynamic_slice(
+                    kb, (z0, cached_len, z0, z0),
+                    (1, tb) + kb.shape[2:])
+                vt = jax.lax.dynamic_slice(
+                    vb, (z0, cached_len, z0, z0),
+                    (1, tb) + vb.shape[2:])
+                new_pages.append(write_prompt_kv(k, v, ks, vs, kt, vt,
+                                                 pages_vec))
+                tail_kv.append((kt, vt))
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], true_tail - 1, keepdims=False)
+            rng, sub = jax.random.split(rng)
+            tok = self._sample(last[None, :], sub)[0]
+            return tok, new_pages, tail_kv, rng
+
+        fn = self._counting(f"tail_prefill_{tb}", tail_prefill,
+                            donate_argnums=(2,))
+        self._tail_prefill_fns[tb] = fn
+        return fn
+
+    def _prefix_dense(self, entry, j):
+        """A matched entry's padded [1, max_seq_len, Hkv, D] dense
+        prefix K/V, ready for the tail program. Zero per-hit work:
+        the index keeps the padded DEVICE buffers (built once at
+        registration), and rows beyond the matched boundary are
+        irrelevant by construction — the tail program overwrites
+        [cached, cached+tb) with the tail's own K/V and causally
+        masks everything past that, so the same buffers serve every
+        nested boundary of the entry."""
+        del j  # every boundary reads the same padded buffers
+        return ([k for k, _ in entry.kv], [v for _, v in entry.kv])
+
     # -- host-side scheduling ----------------------------------------------
 
-    def _finish_request(self, req, status, tokens=None, kv_page_s=0.0):
+    def _finish_request(self, req, status, tokens=None, kv_page_s=0.0,
+                        prefix_hit_pages=0, prefix_pages=0):
         """Finish a request that never reached (or is leaving) a slot.
         age_s — submit-to-finish latency — rides the result so tail
         latency is measurable per request, not just per dispatch;
@@ -1042,6 +1233,8 @@ class ServingEngine:
                   "status": status,
                   "queue_wait_s": round(qw, 6),
                   "kv_page_s": round(kv_page_s, 6),
+                  "prefix_hit_pages": int(prefix_hit_pages),
+                  "prefix_pages": int(prefix_pages),
                   "age_s": age}
         if req.tenant is not None:
             result["tenant"] = req.tenant
@@ -1049,7 +1242,9 @@ class ServingEngine:
                                  tokens_in=len(req.prompt),
                                  tokens_out=len(tokens or []),
                                  queue_wait_s=qw,
-                                 kv_page_s=kv_page_s, requests=1)
+                                 kv_page_s=kv_page_s, requests=1,
+                                 prefix_hit_pages=int(prefix_hit_pages),
+                                 prefix_pages=int(prefix_pages))
         self._finished.append(result)
         self._cancel_pending.discard(req.rid)
         if req.trace is not None and req.admitted_pc is None:
@@ -1083,12 +1278,23 @@ class ServingEngine:
                 time.perf_counter() - req.admitted_pc, 0.0)
         self._finish_request(req, status or slot.status,
                              slot.out_tokens[:req.max_new_tokens],
-                             kv_page_s=kv_page_s)
+                             kv_page_s=kv_page_s,
+                             prefix_hit_pages=slot.prefix_hit_pages,
+                             prefix_pages=slot.prefix_pages)
         self.spans.instant("release_pages", tid="sched", cat="serve",
                            args={"rid": req.rid, "slot": b,
                                  "pages": len(slot.pages),
+                                 "shared": len(slot.shared),
                                  "status": status or slot.status})
-        self._free_pages.extend(slot.pages)
+        if slot.shared:
+            # refcount-aware release: index-owned pages stay resident
+            # for the next hit (they free only through LRU eviction at
+            # refcount 0); only the private pages return to the pool
+            self.prefix.release(slot.shared)
+            self._free_pages.extend(p for p in slot.pages
+                                    if p not in slot.shared)
+        else:
+            self._free_pages.extend(slot.pages)
         self._slots[b] = None
         self._active[b] = False
         self._done[b] = True
@@ -1176,6 +1382,21 @@ class ServingEngine:
                            // self.page_size)
             have = 0 if exhausted else len(self._free_pages)
             short_pages = have < need_pages
+            if short_pages and not exhausted \
+                    and self.prefix is not None:
+                # reclaim BEFORE the admission policy bites: idle
+                # shared prefixes (refcount 0) are cache, not load —
+                # LRU-evict them instead of rejecting/preempting work.
+                # Under INJECTED exhaustion the free list must keep
+                # reading as empty, so no reclaim then.
+                freed = self.prefix.evict(need_pages - have)
+                if freed:
+                    self._free_pages.extend(freed)
+                    self.spans.instant(
+                        "prefix_evict", tid="sched", cat="serve",
+                        args={"pages": len(freed)})
+                    have = len(self._free_pages)
+                    short_pages = have < need_pages
             if free_slot is not None and not short_pages:
                 self._queue.popleft()
                 self._admit_one(free_slot, req, need_pages)
@@ -1200,6 +1421,51 @@ class ServingEngine:
                 continue  # re-check the head against freed capacity
             return  # back-pressure: retry next boundary
 
+    def _prefix_lookup(self, req):
+        """(entry, matched_pages) when the HIT path should run, else
+        None — and fold the hit/miss accounting. A hit additionally
+        requires its tail bucket pre-traced (warmup): caching must
+        never introduce a mid-traffic compile, so a cold engine takes
+        the full-prefill path unconditionally. An engine that never
+        armed ANY tail bucket keeps the cache fully dormant (no
+        accounting, no page retention): it could never serve a hit,
+        so retained pages would only shrink the pool."""
+        if self.prefix is None or not self._warmed_tail_buckets:
+            return None
+        fps = req.prefix_fps
+        if fps is None:  # e.g. cache enabled after submit — recompute
+            fps = prefix_fingerprints(req.prompt, self.page_size)
+            req.prefix_fps = fps
+        self.prefix.total_pages += len(fps)
+        m = self.prefix.match(fps)
+        if m is not None:
+            tail = len(req.prompt) - m[1] * self.page_size
+            if self._bucket_for(tail) in self._warmed_tail_buckets:
+                self.prefix.hits += 1
+                self.prefix.hit_pages += m[1]
+                return m
+        if len(fps) >= self.prefix.min_pages:
+            self.prefix.misses += 1
+        return None
+
+    def _prefix_register(self, req, pages, kv_host_fn):
+        """Register a prompt's boundary fingerprints after its pages
+        were written (miss path: all of them; hit path: the extension
+        beyond the matched boundary). kv_host_fn materializes the host
+        f32 dense K/V lazily — only paid when something new registers.
+        Returns the set of slot pages the index now owns. Dormant
+        (never-armed) engines register nothing — see _prefix_lookup."""
+        if self.prefix is None or not self._warmed_tail_buckets:
+            return frozenset()
+        fps = req.prefix_fps or []
+        if len(fps) < self.prefix.min_pages or self.prefix.covers(fps):
+            return frozenset()
+        adopted, freed = self.prefix.insert(fps, pages, kv_host_fn(),
+                                            pin=True)
+        if freed:
+            self._free_pages.extend(freed)
+        return adopted
+
     def _admit_one(self, b, req, need_pages):
         req.queue_wait_s = time.monotonic() - req.submitted_at
         self._m_queue_wait.observe(req.queue_wait_s)
@@ -1208,6 +1474,42 @@ class ServingEngine:
         self.spans.add("queue_wait", req.submitted_pc,
                        tid=f"req{req.rid}", cat="serve",
                        args={"rid": req.rid, "slot": b})
+        hit = self._prefix_lookup(req)
+        if hit is not None:
+            tok, pages, shared, t_post = self._prefill_hit(
+                b, req, need_pages, hit)
+        else:
+            tok, pages, shared, t_post = self._prefill_full(
+                b, req, need_pages)
+
+        self._admit_seq += 1
+        slot = _Slot(req, pages, admit_seq=self._admit_seq)
+        slot.shared = frozenset(shared)
+        slot.prefix_hit_pages = 0 if hit is None else hit[1]
+        slot.prefix_pages = len(req.prefix_fps or [])
+        self._slots[b] = slot
+        self._slots[b].decode_t0 = t_post
+        self._slots[b].out_tokens.append(tok)
+        row = np.full((self.max_pages_per_seq,), TRASH_PAGE, np.int32)
+        row[:need_pages] = pages
+        self._page_table[b] = row
+        self._seq_lens[b] = len(req.prompt)
+        self._last_tokens[b] = tok
+        self._emitted[b] = 1
+        self._max_new[b] = req.max_new_tokens
+        self._eos[b] = -1 if req.eos_token_id is None \
+            else int(req.eos_token_id)
+        self._active[b] = True
+        self._done[b] = bool(req.max_new_tokens <= 1
+                             or (req.eos_token_id is not None
+                                 and tok == req.eos_token_id))
+        self._dev_sched = None  # host state diverged from device
+
+    def _prefill_full(self, b, req, need_pages):
+        """The miss path: full bucketed prefill (the pre-prefix-cache
+        admission body, unchanged), plus prefix registration of the
+        freshly written prompt pages. Returns (first token, pages,
+        index-owned pages, prefill-end perf_counter)."""
         ps = self.page_size
         lp = len(req.prompt)
         # pow2 bucket, rounded UP to whole pages (_bucket_for — ONE
@@ -1230,7 +1532,7 @@ class ServingEngine:
         fn = self._prefill_fn(bucket)
         t_pre = time.perf_counter()
         with self._watch(f"prefill_{bucket}"):
-            tok, new_pages, self._rng = fn(
+            tok, new_pages, dense_kv, self._rng = fn(
                 self._params, self._buffers, self._pages,
                 jnp.asarray(ids), jnp.int32(lp), jnp.asarray(pages_vec),
                 self._rng)
@@ -1250,25 +1552,101 @@ class ServingEngine:
         self._dtrace_add(req.trace, f"prefill_{bucket}", t_pre, t_post,
                          args={"pages": need_pages,
                                "prompt_len": lp})
+        def kv_dense():
+            # padded [1, max_seq_len, Hkv, D] DEVICE buffers for the
+            # index: no host round-trip, and jnp.pad on a fixed shape
+            # set compiles once per bucket then replays — admission
+            # never stalls on eager transfers
+            pre = self.max_seq_len
+            out = []
+            for k, v in dense_kv:
+                pad = pre - k.shape[1]
+                if pad > 0:
+                    k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                    v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                out.append((k, v))
+            return out
 
-        self._admit_seq += 1
-        self._slots[b] = _Slot(req, pages, admit_seq=self._admit_seq)
-        self._slots[b].decode_t0 = t_post
-        self._slots[b].out_tokens.append(tok)
-        row = np.full((self.max_pages_per_seq,), TRASH_PAGE, np.int32)
-        row[:need_pages] = pages
-        self._page_table[b] = row
-        self._seq_lens[b] = lp
-        self._last_tokens[b] = tok
-        self._emitted[b] = 1
-        self._max_new[b] = req.max_new_tokens
-        self._eos[b] = -1 if req.eos_token_id is None \
-            else int(req.eos_token_id)
-        self._active[b] = True
-        self._done[b] = bool(req.max_new_tokens <= 1
-                             or (req.eos_token_id is not None
-                                 and tok == req.eos_token_id))
-        self._dev_sched = None  # host state diverged from device
+        shared = self._prefix_register(req, pages, kv_dense)
+        return tok, pages, shared, t_post
+
+    def _prefill_hit(self, b, req, need_pages, hit):
+        """The prefix-cache HIT path: map the matched entry's shared
+        pages into this slot (COW — they are never written again),
+        allocate private pages for the tail + decode, and run the
+        short tail-prefill program. The sampling RNG splits exactly
+        once, like a full prefill, so the token stream is the OFF
+        path's stream whenever logits agree. Returns like
+        _prefill_full."""
+        entry, j = hit
+        ps = self.page_size
+        lp = len(req.prompt)
+        cached = j * ps
+        tail = lp - cached      # >= 1: boundaries stop before the end
+        tb = self._bucket_for(tail)
+        nbt = tb // ps
+        priv = [self._free_pages.pop()
+                for _ in range(need_pages - j)]
+        shared_pages = self.prefix.acquire(entry)
+        pages = shared_pages + priv
+        pages_vec = np.full((nbt,), TRASH_PAGE, np.int32)
+        pages_vec[:min(len(priv), nbt)] = priv[:nbt]
+        ids = np.full((1, tb), self.pad_token_id, np.int32)
+        ids[0, :tail] = req.prompt[cached:]
+        kpre, vpre = self._prefix_dense(entry, j)
+
+        fn = self._tail_prefill_fn(tb)
+        t_pre = time.perf_counter()
+        with self._watch(f"tail_prefill_{tb}"):
+            tok, new_pages, tail_kv, self._rng = fn(
+                self._params, self._buffers, self._pages, kpre, vpre,
+                jnp.asarray(ids), jnp.int32(cached), jnp.int32(tail),
+                jnp.asarray(pages_vec), self._rng)
+        self._pages = new_pages
+        tok = int(tok)  # host sync: the first token exists NOW
+        self._m_ttft.observe(time.monotonic() - req.submitted_at)
+        self.spans.add(f"tail_prefill_{tb}", t_pre,
+                       tid=f"req{req.rid}", cat="serve",
+                       args={"rid": req.rid, "slot": b,
+                             "pages": need_pages, "cached_pages": j})
+        req.admitted_pc = t_pre
+        t_post = time.perf_counter()
+        self._dtrace_add(req.trace, "queue", req.submitted_pc, t_pre,
+                         args={"slot": b})
+        self._dtrace_add(req.trace, f"tail_prefill_{tb}", t_pre,
+                         t_post, args={"pages": need_pages,
+                                       "prompt_len": lp,
+                                       "cached_pages": j})
+        # COW accounting: the partial-page tail re-materialized
+        # privately instead of writing the shared pages
+        self.prefix.cow_copies += min(-(-tail // ps), len(priv))
+        shared = set(shared_pages)
+        jm = len(req.prefix_fps or [])
+        if jm > j:
+            # extension-on-hit: this prompt proves longer boundaries —
+            # splice the entry's prefix K/V with the tail rows just
+            # computed and register them (prefix view + tail copy).
+            # The splice width is the whole (clipped) tail bucket, not
+            # the exact extension: every newly proven boundary sits at
+            # <= cached + tail <= cached + width, and rows past the
+            # deepest boundary are past-boundary garbage the tail
+            # program overwrites/masks on any future hit. Bucketed
+            # widths keep the eager-op shape set identical to the
+            # ladder warmup() pre-compiled — no mid-traffic compile.
+            width = min(tb, self.max_seq_len - cached)
+
+            def kv_dense():
+                return [(jax.lax.dynamic_update_slice(
+                            ek, kt if width == tb else kt[:, :width],
+                            (0, cached, 0, 0)),
+                         jax.lax.dynamic_update_slice(
+                            ev, vt if width == tb else vt[:, :width],
+                            (0, cached, 0, 0)))
+                        for (ek, ev), (kt, vt)
+                        in zip(entry.kv, tail_kv)]
+
+            shared |= self._prefix_register(req, pages, kv_dense)
+        return tok, pages, shared, t_post
 
     def _watch(self, op):
         """Watchdog heartbeat around one dispatch (nullcontext when no

@@ -41,7 +41,8 @@ __all__ = ["SpaceSavingSketch", "TenantAccountant", "USAGE_FIELDS"]
 
 #: the accumulators every entry (and the exact-totals row) carries
 USAGE_FIELDS = ("tokens_in", "tokens_out", "queue_wait_s",
-                "kv_page_s", "requests")
+                "kv_page_s", "requests", "prefix_hit_pages",
+                "prefix_pages")
 
 
 class SpaceSavingSketch:
@@ -147,7 +148,8 @@ class TenantAccountant:
                      "stay exact)")
 
     def account(self, tenant, *, tokens_in=0, tokens_out=0,
-                queue_wait_s=0.0, kv_page_s=0.0, requests=0):
+                queue_wait_s=0.0, kv_page_s=0.0, requests=0,
+                prefix_hit_pages=0, prefix_pages=0):
         """Fold one request's usage for ``tenant`` (None is skipped —
         untagged traffic costs nothing here; the ROUTER maps untagged
         to 'anon' so fleet sums stay exact regardless)."""
@@ -160,7 +162,9 @@ class TenantAccountant:
                             tokens_out=int(tokens_out),
                             queue_wait_s=float(queue_wait_s),
                             kv_page_s=float(kv_page_s),
-                            requests=int(requests))
+                            requests=int(requests),
+                            prefix_hit_pages=int(prefix_hit_pages),
+                            prefix_pages=int(prefix_pages))
             if self._m_evict is not None \
                     and self.sketch.evictions > ev0:
                 self._m_evict.inc(self.sketch.evictions - ev0)

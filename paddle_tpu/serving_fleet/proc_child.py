@@ -129,6 +129,7 @@ class _Child:
             "decode_tokens": h["decode_tokens"],
             "tenants_tracked": h.get("tenants_tracked", 0),
             "sampling": h.get("sampling"),
+            "prefix_cache": h.get("prefix_cache"),
             "compile_counts": h["compile_counts"],
             "unexpected_retraces":
                 self.engine.tracer.unexpected_retraces(),

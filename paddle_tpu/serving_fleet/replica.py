@@ -383,6 +383,7 @@ class InprocReplica:
                 "decode_tokens": h["decode_tokens"],
                 "tenants_tracked": h.get("tenants_tracked", 0),
                 "sampling": h.get("sampling"),
+                "prefix_cache": h.get("prefix_cache"),
                 "compile_counts": h["compile_counts"]}
         with self._health_lock:
             self._health = snap

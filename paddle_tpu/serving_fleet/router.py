@@ -95,7 +95,8 @@ class _Pending:
                  "submitted_at", "placed_at", "replica", "hedge",
                  "delivered", "failovers", "hedged", "done",
                  "deadline", "trace", "queue_since_pc", "leg_ctxs",
-                 "leg_base", "leg_inc", "tenant", "captured")
+                 "leg_base", "leg_inc", "tenant", "captured",
+                 "prefix_fps")
 
     def __init__(self, rid, prompt, max_new, eos, priority,
                  deadline=None, tenant=None):
@@ -132,6 +133,8 @@ class _Pending:
         self.captured = None       # traffic-archive locator
         #                            ({"segment","offset"}) when this
         #                            request was captured at admission
+        self.prefix_fps = None     # page_size -> prompt prefix
+        #                            fingerprints (affinity memo)
 
 
 class FleetRouter:
@@ -214,8 +217,13 @@ class FleetRouter:
         ``tools/fleet_replay.py`` re-drives a fleet from the archive.
     placement_weights: score weights for ``_pick_replica`` — dict
         over {"free_pages", "queued", "running", "queue_wait_p99_s",
-        "outstanding"} merged over the defaults (1, 8, 2, 50, 4).
-        A replay what-if knob as much as an operator one.
+        "outstanding", "prefix_affinity"} merged over the defaults
+        (1, 8, 2, 50, 4, 0). A replay what-if knob as much as an
+        operator one. ``prefix_affinity`` scores each candidate by
+        the number of leading prompt pages already resident in its
+        prefix cache (fingerprints advertised on heartbeats) — the
+        default 0 preserves pre-affinity placement exactly; replay
+        scores alternatives via ``--knob placement.prefix_affinity``.
     overload_target_ms / overload_interval_s: the adaptive overload
         control layer (CoDel-style queue-delay admission,
         docs/robustness.md "Elastic autoscaling & overload control").
@@ -275,7 +283,8 @@ class FleetRouter:
         self.wedge_timeout_s = float(wedge_timeout_s)
         self.placement_weights = {
             "free_pages": 1.0, "queued": 8.0, "running": 2.0,
-            "queue_wait_p99_s": 50.0, "outstanding": 4.0}
+            "queue_wait_p99_s": 50.0, "outstanding": 4.0,
+            "prefix_affinity": 0.0}
         if placement_weights:
             unknown = set(placement_weights) - set(
                 self.placement_weights)
@@ -467,6 +476,37 @@ class FleetRouter:
             "fleet_overload_sheds_total",
             help="queued requests shed by the sojourn-based overload "
                  "controller (also counted in fleet_shed_total)")
+        # -- prefix-cache plane: fleet rollups folded from replica
+        # heartbeats (engine-monotonic stats, delta-folded per scrape
+        # so a respawned replica's reset never decrements), plus the
+        # per-replica fingerprint inventories the affinity term in
+        # _pick_replica scores against. Registered at 0 up front —
+        # a cold fleet exports the whole catalogue.
+        self._m_prefix = {
+            "hits": reg.counter(
+                "fleet_prefix_hits_total",
+                help="prefix-cache hit admissions across the fleet "
+                     "(folded from replica heartbeats)"),
+            "misses": reg.counter(
+                "fleet_prefix_misses_total",
+                help="admissions with a shareable prefix that missed "
+                     "every replica prefix cache they landed on"),
+            "adopted_pages": reg.counter(
+                "fleet_prefix_shared_pages_total",
+                help="prompt KV pages adopted into replica prefix "
+                     "caches (shareable immutable pages published)"),
+            "cow_copies": reg.counter(
+                "fleet_prefix_cow_copies_total",
+                help="private tail pages materialized at hit "
+                     "admissions (the copy-on-write copies)"),
+            "evictions": reg.counter(
+                "fleet_prefix_evictions_total",
+                help="prefix-cache entries evicted (LRU under page "
+                     "pressure or index capacity)")}
+        self._prefix_seen = {}   # name -> last folded stat values
+        self._fpsets = {}        # name -> (fingerprint set, page_size)
+        self._m_pfx_hitp = {}
+        self._m_pfx_pages = {}
 
     def _new_client(self, rep):
         seed = self._next_client_seed
@@ -1245,7 +1285,9 @@ class FleetRouter:
         the engine can see: admission queue wait, KV-page-seconds) —
         folded into the per-tenant sketch at resolve."""
         return {"queue_wait_s": res.get("queue_wait_s"),
-                "kv_page_s": res.get("kv_page_s")}
+                "kv_page_s": res.get("kv_page_s"),
+                "prefix_hit_pages": res.get("prefix_hit_pages"),
+                "prefix_pages": res.get("prefix_pages")}
 
     def _finish_from_prefix(self, p):
         """A recovered prefix may already satisfy the request (eos
@@ -1318,14 +1360,29 @@ class FleetRouter:
             self._m_e2e_h.observe(age)
             if ttft is not None:
                 self._m_ttft_h.observe(ttft)
+        u = usage or {}
+        php = int(u.get("prefix_hit_pages") or 0)
+        ppg = int(u.get("prefix_pages") or 0)
         if self.tenants is not None:
-            u = usage or {}
             self.tenants.account(
                 p.tenant if p.tenant is not None else "anon",
                 tokens_in=len(p.prompt), tokens_out=len(tokens),
                 queue_wait_s=float(u.get("queue_wait_s") or 0.0),
                 kv_page_s=float(u.get("kv_page_s") or 0.0),
-                requests=1)
+                requests=1, prefix_hit_pages=php, prefix_pages=ppg)
+        # per-tenant hit-rate series for the history plane / fleet_top
+        # (pages, not requests: the rate that predicts TTFT savings)
+        if ppg:
+            tname = p.tenant if p.tenant is not None else "anon"
+            self._labeled(
+                self._m_pfx_pages, "fleet_prefix_pages_total",
+                "shareable prompt pages of resolved requests, "
+                "per tenant", tenant=tname).inc(ppg)
+            if php:
+                self._labeled(
+                    self._m_pfx_hitp, "fleet_prefix_hit_pages_total",
+                    "prompt pages served from a replica prefix cache, "
+                    "per tenant", tenant=tname).inc(php)
         self._done[p.rid] = result
 
     def _note_resolved(self, p, result, age_s, ttft):
@@ -1443,6 +1500,30 @@ class FleetRouter:
                 prev = self._clock_offsets.get(name)
                 self._clock_offsets[name] = delay if prev is None \
                     else min(prev, delay)
+                self._fold_prefix(name, snap)
+
+    def _fold_prefix(self, name, snap):
+        """Harvest one heartbeat's prefix-cache section: refresh the
+        fingerprint inventory the affinity term scores against, and
+        delta-fold the engine-monotonic stats into the fleet
+        counters. A value that went BACKWARDS means the engine
+        restarted (stats reset with the incarnation) — fold the new
+        absolute value, never a negative delta."""
+        pc = snap.get("prefix_cache")
+        if not pc:
+            self._fpsets.pop(name, None)
+            self._prefix_seen.pop(name, None)
+            return
+        self._fpsets[name] = (frozenset(pc.get("fingerprints") or ()),
+                              int(snap.get("page_size") or 0))
+        seen = self._prefix_seen.setdefault(name, {})
+        for stat, ctr in self._m_prefix.items():
+            v = int(pc.get(stat) or 0)
+            last = seen.get(stat, 0)
+            d = v - last if v >= last else v
+            seen[stat] = v
+            if d > 0:
+                ctr.inc(d)
 
     def _rep_incarnation(self, name):
         """The replica's CURRENT incarnation number (bumped on every
@@ -1474,14 +1555,48 @@ class FleetRouter:
                     out[name] += 1
         return out
 
-    def _pick_replica(self, outstanding, exclude=()):
+    def _affinity_fps(self, p, page_size):
+        """Prefix fingerprints of a pending request's ORIGINAL prompt
+        at a replica's page size, memoised on the pending (placement
+        retries every control round; replicas may run different page
+        sizes, so the cache is keyed by page size)."""
+        if p.prefix_fps is None:
+            p.prefix_fps = {}
+        fps = p.prefix_fps.get(page_size)
+        if fps is None:
+            from ..nlp.paged_cache import prefix_fingerprints
+            fps = prefix_fingerprints(p.prompt, page_size)
+            p.prefix_fps[page_size] = fps
+        return fps
+
+    def _affinity_pages(self, p, name):
+        """Leading prompt pages of `p` already resident in replica
+        `name`'s prefix cache (per its last advertised fingerprint
+        inventory) — the prefix-affinity score term."""
+        fpset, ps = self._fpsets.get(name, (None, 0))
+        if not fpset or not ps:
+            return 0
+        matched = 0
+        for fp in self._affinity_fps(p, ps):
+            if fp not in fpset:
+                break
+            matched += 1
+        return matched
+
+    def _pick_replica(self, outstanding, exclude=(), pending=None):
         """Best serving replica by scraped health: free pages up,
         queue depth / occupancy / queue-wait p99 down; capacity-capped
         by the router's own outstanding count. Deterministic tie-break
         on name. Weights come from ``placement_weights`` — a
         constructor knob so a replay what-if (or a future autotuner)
-        can score alternatives without patching this method."""
+        can score alternatives without patching this method. With a
+        nonzero ``prefix_affinity`` weight and a concrete `pending`,
+        candidates already holding the request's prefix pages score
+        higher (weight 0 — the default — skips the term entirely, so
+        capacity probes and affinity-off fleets place exactly as
+        before)."""
         w = self.placement_weights
+        aff_w = w["prefix_affinity"]
         best, best_key = None, None
         for name, snap in self._serving_candidates():
             if name in exclude:
@@ -1494,6 +1609,8 @@ class FleetRouter:
                      - w["queue_wait_p99_s"]
                      * float(snap.get("queue_wait_p99_s", 0.0))
                      - w["outstanding"] * outstanding.get(name, 0))
+            if aff_w and pending is not None:
+                score += aff_w * self._affinity_pages(pending, name)
             key = (score, name)
             if best_key is None or score > best_key[0] \
                     or (score == best_key[0] and name < best_key[1]):
@@ -1595,7 +1712,7 @@ class FleetRouter:
         for rid in sorted(self._queue,
                           key=lambda r: (-self._pending[r].priority, r)):
             p = self._pending[rid]
-            target = self._pick_replica(outstanding)
+            target = self._pick_replica(outstanding, pending=p)
             if target is None:
                 continue
             # brownout: clamp a browned-out tenant's decode budget at
@@ -1604,6 +1721,13 @@ class FleetRouter:
             self._maybe_brownout_clamp(p)
             prompt = p.prompt + [int(t) for t in p.delivered]
             remaining = p.max_new - len(p.delivered)
+            # the placement's affinity context rides the journal: the
+            # full-prefix fingerprint at the TARGET's page size, so a
+            # recovered router (and any postmortem) can re-score what
+            # affinity saw. None when the target never advertised a
+            # prefix cache (or the prompt spans < 2 pages).
+            _, ps = self._fpsets.get(target, (None, 0))
+            fps = self._affinity_fps(p, ps) if ps else []
             # WAL: placement journals before the transport send (with
             # the prefix length the leg is anchored to). If the send
             # then fails (or the router dies between the two),
@@ -1612,7 +1736,8 @@ class FleetRouter:
             # actually happened
             self._jappend("placed", rid=rid, replica=target,
                           prefix=len(p.delivered),
-                          incarnation=self._rep_incarnation(target))
+                          incarnation=self._rep_incarnation(target),
+                          fingerprint=fps[-1] if fps else None)
             ok, leg = self._submit_leg(p, target, prompt, remaining)
             if not ok:
                 continue       # transport gave up; retry next round
@@ -1855,7 +1980,8 @@ class FleetRouter:
             if (now - p.placed_at) * 1e3 < float(self.hedge_after_ms):
                 continue
             target = self._pick_replica(outstanding,
-                                        exclude={p.replica})
+                                        exclude={p.replica},
+                                        pending=p)
             if target is None:
                 continue
             ok, _leg = self._submit_leg(p, target, p.prompt,

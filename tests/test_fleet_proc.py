@@ -413,7 +413,9 @@ class TestWarmup:
         assert eng.warmed and eng.health()["warmed"]
         assert eng.health()["warmed_buckets"] == [16, 32]
         frozen = eng.compile_counts()
-        assert frozen == {"prefill_16": 1, "prefill_32": 1, "decode": 1}
+        assert frozen == {"prefill_16": 1, "prefill_32": 1,
+                          "tail_prefill_16": 1,
+                          "tail_prefill_32": 1, "decode": 1}
         # the first REAL wave: token-exact parity with the unwarmed
         # golden AND zero new traces (the TTFT cliff is gone — no
         # compile inside any request's latency)
@@ -628,6 +630,8 @@ class TestProcReplicaSmoke:
             assert snap["warmed"] and snap["incarnation"] == 1
             frozen = rep.compile_counts()
             assert frozen == {"prefill_16": 1, "prefill_32": 1,
+                              "tail_prefill_16": 1,
+                              "tail_prefill_32": 1,
                               "decode": 1}, \
                 "warm boot must pre-trace exactly the spec'd programs"
             rep.enqueue(("submit", 0, list(prompts[0]), NEW_TOK,
@@ -743,7 +747,8 @@ class TestProcFleetChaos:
             assert snap["warmed"] and snap["incarnation"] == 2
             frozen = victim.compile_counts()
             assert frozen == {"prefill_16": 1, "prefill_32": 1,
-                              "decode": 1}
+                              "tail_prefill_16": 1,
+                              "tail_prefill_32": 1, "decode": 1}
             # wave 3: the respawned replica takes real traffic with
             # ZERO steady-state recompiles after its warm boot
             rids3 = [router.submit(p, NEW_TOK) for p in prompts]

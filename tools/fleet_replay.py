@@ -21,8 +21,15 @@ original:
   ``overload_target_ms``/``brownout_*``) and engine knobs
   (``steps_per_dispatch``, ``page_size`` — the prefill-bucket-ladder
   granularity — ``max_slots``, ``max_seq_len``, ``temperature``,
-  ``top_k``, ``seed``) — score a knob setting against recorded
-  traffic without touching production. ``autoscale.<param>`` knobs
+  ``top_k``, ``seed``, ``prefix_cache``, ``min_prefix_pages``) —
+  score a knob setting against recorded traffic without touching
+  production. ``placement.prefix_affinity`` scores prefix-affinity
+  routing offline; the verdict's ``prefix_stats`` section reports
+  what the replay fleet's caches did (hit rate, pages shared, TTFT
+  ratios), and ``--report-prefix-stats`` scans the archive's
+  recorded prompts WITHOUT replaying — the expected page-level hit
+  rate per page-size/min-prefix knob, the measure-before-build
+  number. ``autoscale.<param>`` knobs
   (``autoscale.max_replicas=3 autoscale.scale_out_cooldown_s=0.5``
   ...) additionally arm a FleetAutoscaler over the replay fleet, so
   an autoscaling POLICY is scorable offline against a recorded
@@ -88,7 +95,7 @@ ROUTER_KNOBS = {"hedge_after_ms", "max_queue", "replica_queue_limit",
                 "brownout_levels", "brownout_step_s"}
 ENGINE_KNOBS = {"steps_per_dispatch", "page_size", "max_slots",
                 "max_seq_len", "temperature", "top_k", "seed",
-                "num_pages"}
+                "num_pages", "prefix_cache", "min_prefix_pages"}
 # --knob autoscale.<param>: arms a FleetAutoscaler over the replay
 # fleet (spawn_fn builds extra warmed replicas up to max_replicas) so
 # an autoscale POLICY is scorable against a recorded archive — the
@@ -157,6 +164,55 @@ def load_wave(path):
     return (doc.get("entries") or [], doc.get("meta") or {},
             {"segments": 0, "records": len(doc.get("entries") or []),
              "torn_drops": 0, "unresolved": 0})
+
+
+# -- prefix-cache what-if scan ---------------------------------------------
+
+
+def prefix_stats(entries, *, page_sizes=(8, 16, 32), min_pages=1):
+    """Expected page-level prefix-cache hit rate of a recorded wave,
+    per page-size knob — the measure-BEFORE-build number (r19).
+
+    Replays the archive's prompts in arrival order against an ideal
+    single-replica index: a request's leading pages hit when an
+    earlier request already published the same fingerprint chain.
+    This is the upper bound a real fleet approaches as affinity
+    routing concentrates each fingerprint on one replica; no engine
+    (or jax) is involved — pure host-side hashing."""
+    from paddle_tpu.nlp.paged_cache import prefix_fingerprints
+    order = sorted(range(len(entries)),
+                   key=lambda i: (float(entries[i].get("arrival_s")
+                                        or 0.0), i))
+    mp = max(int(min_pages), 1)
+    out = {}
+    for ps in page_sizes:
+        seen = set()
+        pages = hit_pages = reqs = reqs_shareable = reqs_hit = 0
+        for i in order:
+            fps = prefix_fingerprints(
+                entries[i].get("prompt") or [], int(ps))
+            reqs += 1
+            pages += len(fps)
+            if len(fps) >= mp:
+                reqs_shareable += 1
+            matched = 0
+            for fp in fps:
+                if fp not in seen:
+                    break
+                matched += 1
+            if matched >= mp:
+                hit_pages += matched
+                reqs_hit += 1
+            seen.update(fps)
+        out[str(int(ps))] = {
+            "page_size": int(ps), "min_prefix_pages": mp,
+            "requests": reqs, "shareable_requests": reqs_shareable,
+            "expected_hit_requests": reqs_hit,
+            "shareable_pages": pages,
+            "expected_hit_pages": hit_pages,
+            "expected_page_hit_rate": None if not pages
+            else round(hit_pages / pages, 4)}
+    return out
 
 
 # -- fleet construction ----------------------------------------------------
@@ -577,6 +633,27 @@ def run_replay(entries, *, out_dir, mode="recorded", time_scale=1.0,
             "new_traces": new_traces,
             "unexpected_retraces":
                 router.compile_report()["unexpected_retraces"]}
+        # live prefix-cache facts, harvested before teardown: what
+        # the replay fleet's caches actually did with this traffic
+        # (vs prefix_stats' ideal scan) — the verdict's prefix_stats
+        # section folds in the TTFT ratios so one JSON answers "did
+        # the knob pay?"
+        prefix_live = {"engines": 0, "hits": 0, "misses": 0,
+                       "hit_pages": 0, "total_pages": 0,
+                       "shared_pages": 0, "cow_copies": 0,
+                       "evictions": 0}
+        for e in engines:
+            pc = e.health().get("prefix_cache")
+            if not pc:
+                continue
+            prefix_live["engines"] += 1
+            for k in ("hits", "misses", "hit_pages", "total_pages",
+                      "shared_pages", "cow_copies", "evictions"):
+                prefix_live[k] += int(pc.get(k) or 0)
+        prefix_live["page_hit_rate"] = None \
+            if not prefix_live["total_pages"] else round(
+                prefix_live["hit_pages"]
+                / prefix_live["total_pages"], 4)
     finally:
         router.close()
         for e in engines:
@@ -595,6 +672,12 @@ def run_replay(entries, *, out_dir, mode="recorded", time_scale=1.0,
                "replicas": replicas}, history=hist)
     verdict["wall_s"] = round(wall_s, 3)
     verdict["autoscale"] = autoscale_facts
+    verdict["prefix_stats"] = None if not prefix_live["engines"] \
+        else dict(prefix_live,
+                  ttft_p50_ratio=verdict["slo"]["ratios"]
+                  .get("ttft_p50_s"),
+                  ttft_p99_ratio=verdict["slo"]["ratios"]
+                  .get("ttft_p99_s"))
     report_all()  # keep the tracer rollup warm for post-hoc reads
     return verdict, replay_entries
 
@@ -625,6 +708,12 @@ def main(argv=None):
                     metavar="K=V", help="what-if override (repeat)")
     ap.add_argument("--golden", action="store_true",
                     help="assert token-exact + zero new traces")
+    ap.add_argument("--report-prefix-stats", action="store_true",
+                    help="scan the wave's recorded prompts and "
+                         "report expected page-level prefix-cache "
+                         "hit rates (no replay; honors --knob "
+                         "page_size/min_prefix_pages, else sweeps "
+                         "page sizes 8/16/32)")
     ap.add_argument("--replicas", type=int, default=2)
     ap.add_argument("--model", default="gpt-tiny")
     ap.add_argument("--model-seed", type=int, default=0)
@@ -653,6 +742,16 @@ def main(argv=None):
                        "entries": entries}, f, indent=1)
         print(json.dumps({"ok": True, "wrote_wave": args.write_wave,
                           "entries": len(entries)}))
+        return 0
+    if args.report_prefix_stats:
+        _rkw, ekw, _w, _a = parse_knobs(args.knob)
+        pss = [int(ekw["page_size"])] if "page_size" in ekw \
+            else [8, 16, 32]
+        mp = int(ekw.get("min_prefix_pages") or 1)
+        print(json.dumps({
+            "ok": True, "entries": len(entries),
+            "prefix_stats": prefix_stats(entries, page_sizes=pss,
+                                         min_pages=mp)}))
         return 0
 
     out_dir = os.environ.get("BENCH_TELEMETRY_DIR") or os.path.join(

@@ -157,7 +157,8 @@ STAGES = [
                            "tests/test_fleet_tracing.py",
                            "tests/test_fleet_recovery.py",
                            "tests/test_fleet_proc.py",
-                           "tests/test_fleet_autoscale.py", "-q",
+                           "tests/test_fleet_autoscale.py",
+                           "tests/test_prefix_cache.py", "-q",
                            "-m", "chaos", "-p", "no:cacheprovider",
                            "-p", "no:randomly"], 3600,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0",
@@ -233,6 +234,16 @@ STAGES = [
     # and parseable fleet_scale_out/in flight dumps
     # (validate_stages.FLIGHT_STAGES).
     ("autoscale_smoke", [PY, "tools/autoscale_smoke.py"], 1800,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
+    # copy-on-write prefix-cache drill (ISSUE 16, CPU, seeded): a
+    # shared-prefix wave through a cache-ON engine vs a cache-OFF
+    # control — ON streams token-exact vs OFF across two waves (the
+    # hard invariant), cumulative page hit rate >= 0.5, ON TTFT p50
+    # strictly below OFF (hits run the short tail-prefill ladder, not
+    # the full bucket), compile counts frozen with caching ON (zero
+    # unexpected retraces), and every page back on the free list
+    # after close (shared-page refcounts conserve).
+    ("prefix_cache_smoke", [PY, "tools/prefix_cache_smoke.py"], 1800,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
     ("bench_full", [PY, "bench.py"], 7200, {}),
     ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
@@ -422,6 +433,13 @@ FLEET_CANARY_FAIL_ON = (
     # already covers them, and their exact count is timing-sensitive
     # on a loaded CI box.)
     "fleet_autoscale_flaps_total>0%",
+    # prefix-cache counter (ISSUE 16): the chaos suite's prefix drill
+    # produces a deterministic hit count — hits falling >50% below
+    # the golden means shared prompts stopped matching (fingerprint
+    # or admission regression) while everything else still passes
+    # token-exactness. (Series skipped by metrics_diff until the
+    # golden is regenerated with the prefix drill in the suite.)
+    "fleet_prefix_hits_total<50%",
 )
 
 # history gate (ISSUE 11): ONE archive, two instants, both directions

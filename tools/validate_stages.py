@@ -57,6 +57,10 @@ REQUIRED_STAGES = {
     # scale-in with no lost rid + bounded SLO breach (CPU-only —
     # ISSUE 15)
     "autoscale_smoke",
+    # copy-on-write prefix-cache drill: shared-prefix wave token-exact
+    # ON vs OFF, hit rate over floor, ON TTFT p50 strictly better,
+    # zero new traces (CPU-only — ISSUE 16)
+    "prefix_cache_smoke",
 }
 
 
@@ -71,6 +75,7 @@ def _emits_metrics(cmd):
                                             "history_smoke.py",
                                             "replay_smoke.py",
                                             "autoscale_smoke.py",
+                                            "prefix_cache_smoke.py",
                                             "test_fleet_serving.py",
                                             "test_fleet_recovery.py",
                                             "test_fleet_proc.py")
