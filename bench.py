@@ -692,7 +692,14 @@ def worker_serve(args, on_tpu):
                             max_seq_len=max_seq, cache_dtype=dtype,
                             use_flash=use_flash,
                             steps_per_dispatch=spd, donate=donate,
-                            registry=rung_reg)
+                            registry=rung_reg,
+                            spec_decode=bool(args.spec))
+        if args.spec:
+            # the verify program only arms through warmup() (the
+            # zero-recompile gate) — the wave-as-warmup below never
+            # traces it, so an unwarmed --spec rung would silently
+            # measure plain decode
+            eng.warmup(buckets=sorted(set(prompt_lens)), decode=True)
         def wave(n):
             prompts = [rng.integers(0, vocab,
                                     (prompt_lens[i % len(prompt_lens)],))
@@ -738,6 +745,13 @@ def worker_serve(args, on_tpu):
                "ttft_ms": _hist_ms(rung_reg.get("serve_ttft_seconds")),
                "queue_wait_ms": _hist_ms(
                    rung_reg.get("serve_queue_wait_seconds"))}
+        if args.spec:
+            sp = eng.health().get("spec") or {}
+            row["spec"] = {"k": sp.get("k"),
+                           "draft": sp.get("draft"),
+                           "proposed": sp.get("proposed"),
+                           "accepted": sp.get("accepted"),
+                           "acceptance_rate": sp.get("acceptance_rate")}
         rows.append(row)
         try:
             _emit("serve_rung", model=kind, **row)
@@ -1492,6 +1506,11 @@ def main():
     ap.add_argument("--cache-dtype", default=None,
                     help="decode/serve KV cache dtype (bfloat16 halves "
                          "decode HBM traffic; serve also takes int8)")
+    ap.add_argument("--spec", action="store_true",
+                    help="--serve: arm speculative decoding on every "
+                         "rung (ngram draft, PADDLE_TPU_SPEC_K "
+                         "tokens/dispatch); rows gain the acceptance "
+                         "stats and stay token-exact vs plain rungs")
     ap.add_argument("--serve-model", choices=("gpt", "llama"),
                     default="gpt",
                     help="serve: which zoo model the ladder decodes "
@@ -1612,6 +1631,9 @@ def main():
     if args.flash_only and workloads != ["serve"]:
         ap.error("--flash-only applies to the serving ladder only "
                  "(use --serve)")
+    if args.spec and workloads != ["serve"]:
+        ap.error("--spec applies to the serving ladder only "
+                 "(use --serve)")
     if args.flash_only and args.no_flash:
         ap.error("--flash-only and --no-flash select disjoint rungs")
     if args.serve_dtype and workloads != ["decode"]:
@@ -1680,6 +1702,8 @@ def main():
             passthrough.append("--no-flash")
         if args.flash_only:
             passthrough.append("--flash-only")
+        if args.spec:
+            passthrough.append("--spec")
         if args.recompute:
             passthrough.append("--recompute")
         if args.s2d:

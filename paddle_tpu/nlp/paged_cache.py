@@ -32,6 +32,16 @@ receive one as their layer cache route through
 NOT a pytree — nlp/serving.py constructs it inside its jitted programs
 from raw array arguments and unpacks the returned arrays, so it never
 crosses a jit boundary.
+
+Rewind contract (speculative decoding, round 20): rows past a slot's
+committed length (`seq_lens`) are garbage by definition — attention
+masks keys at index >= positions+1, and any later write at those
+positions overwrites in place. So rejecting speculative KV writes
+needs NO device-side cleanup: the host simply declines to advance
+`seq_lens` past the accepted count (the same contract that makes the
+prefix cache's private-tail pages safe to re-prefill after failover).
+A spec verify dispatch writes K+1 rows per slot into already-owned
+pages; committing j of them is one host-side integer add.
 """
 from __future__ import annotations
 

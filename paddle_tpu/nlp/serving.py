@@ -108,7 +108,7 @@ class ServeRequest:
 class _Slot:
     __slots__ = ("req", "pages", "out_tokens", "status", "admit_seq",
                  "decode_t0", "shared", "prefix_hit_pages",
-                 "prefix_pages")
+                 "prefix_pages", "spec_proposed", "spec_accepted")
 
     def __init__(self, req, pages, admit_seq=0):
         self.req = req
@@ -122,6 +122,8 @@ class _Slot:
         #                             (release, don't free, on finish)
         self.prefix_hit_pages = 0   # prompt pages served from cache
         self.prefix_pages = 0       # shareable prompt pages (denom)
+        self.spec_proposed = 0      # draft tokens dispatched to verify
+        self.spec_accepted = 0      # draft tokens the target confirmed
 
 
 def _next_pow2(n):
@@ -192,6 +194,22 @@ class ServingEngine:
         None reads PADDLE_TPU_PREFIX_MIN_PAGES (default 1).
     prefix_max_entries: bound on registered fingerprint boundaries
         (LRU-evicted beyond it).
+    spec_decode: speculative decoding (draft-propose / one-dispatch-
+        verify): a proposer guesses spec_k tokens per live slot and the
+        flagship verifies all spec_k+1 positions in ONE folded batched
+        dispatch through the paged cache, applying its own per-position
+        seeded sampler — accepted tokens are bit-identical to what
+        non-speculative decode would have produced (greedy AND top-k;
+        docs/performance.md round 20). Default OFF; None reads
+        PADDLE_TPU_SPEC_DECODE (the kill switch — 1/true/on arms it).
+        An armed engine additionally requires warmup() to pre-trace the
+        verify program before any speculative dispatch runs, so a
+        never-warmed engine is byte-identical to a spec-off one.
+    spec_k: draft tokens proposed per slot per dispatch; None reads
+        PADDLE_TPU_SPEC_K (default 4).
+    spec_draft: 'ngram' (zero-weight prompt-lookup proposer — no second
+        model) or a tiny GPT/Llama draft model instance sharing the
+        tokenizer; None reads PADDLE_TPU_SPEC_DRAFT (default 'ngram').
     """
 
     def __init__(self, model, *, max_slots=8, page_size=16,
@@ -201,7 +219,8 @@ class ServingEngine:
                  admission_policy="wait", watchdog_timeout=None,
                  dispatch_retries=2, registry=None,
                  tenant_capacity=64, prefix_cache=None,
-                 min_prefix_pages=None, prefix_max_entries=512):
+                 min_prefix_pages=None, prefix_max_entries=512,
+                 spec_decode=None, spec_k=None, spec_draft=None):
         if page_size % 8:
             raise ValueError(f"page_size must be a multiple of 8 "
                              f"(Mosaic sublane tiling), got {page_size}")
@@ -252,6 +271,18 @@ class ServingEngine:
         self.prefix = PrefixIndex(
             self.page_size, min_pages=min_prefix_pages,
             max_entries=prefix_max_entries) if prefix_cache else None
+        if spec_decode is None:
+            spec_decode = os.environ.get(
+                "PADDLE_TPU_SPEC_DECODE", "0").lower() \
+                in ("1", "true", "on")
+        if spec_k is None:
+            spec_k = int(os.environ.get("PADDLE_TPU_SPEC_K", "4"))
+        self.spec_k = int(spec_k)
+        if self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        if spec_draft is None:
+            spec_draft = os.environ.get("PADDLE_TPU_SPEC_DRAFT", "ngram")
+        self.spec_draft = spec_draft
 
         self._params, self._buffers = model.raw_state()
         self._pages = [alloc_pages(self.num_pages, self.page_size,
@@ -270,6 +301,20 @@ class ServingEngine:
         self._done = np.ones((b,), bool)
         self._active = np.zeros((b,), bool)
         self._rng = jax.random.PRNGKey(seed)
+        # prime the eager split executable NOW (result discarded, RNG
+        # state untouched): the per-admission split below must never
+        # pay its one-time process-wide compile inside a request's
+        # TTFT — the replay latency baselines treat admission as
+        # microseconds of host work
+        jax.random.split(self._rng)
+        # per-slot sampling key base: one fresh split per ADMISSION,
+        # folded with the token's emitted index inside the programs
+        # (key = fold_in(base, index)). Token streams are therefore a
+        # pure function of (request, admission order, index) — not of
+        # how decode work is scheduled into dispatches — which is what
+        # lets speculative verify reproduce non-speculative sampling
+        # bit-for-bit at any acceptance pattern.
+        self._key_base = np.zeros((b, 2), np.uint32)
 
         # device-resident mirror of the scheduling arrays: refreshed
         # from host only when admission/eviction mutates them, so a
@@ -416,6 +461,31 @@ class ServingEngine:
         self._warmed_buckets = set()
         self._warmed_tail_buckets = set()
         self._warmed_decode = False
+        # speculative decoding: proposer + folded verify program.
+        # Dispatch routing is gated on _warmed_spec (set by warmup()),
+        # mirroring the prefix-cache tail-bucket gate: an armed-but-
+        # never-warmed engine takes the plain decode path for every
+        # dispatch, so speculation can never introduce a mid-traffic
+        # compile and a never-warmed engine is byte-identical to a
+        # spec-off one
+        self._spec = None
+        self._spec_verify_fn = None
+        self._warmed_spec = False
+        if spec_decode:
+            from .speculative import make_proposer
+            self._spec = make_proposer(self, self.spec_draft)
+            self._spec_verify_fn = self._build_spec_verify_fn()
+            self._m_spec_proposed = own(reg.counter(
+                "serve_spec_proposed_total",
+                help="draft tokens dispatched to speculative verify"))
+            self._m_spec_accepted = own(reg.counter(
+                "serve_spec_accepted_total",
+                help="draft tokens the target model confirmed "
+                     "(committed bit-identical to plain decode)"))
+            self._m_spec_dispatches = own(reg.counter(
+                "serve_spec_dispatches_total",
+                help="folded verify dispatches (each commits >= 1 "
+                     "token per live slot)"))
         # decode-dispatch accounting: batched-decode throughput is THE
         # serving metric (wall time also pays per-request prefill,
         # which is batch-1 by construction); bench.py --serve reads
@@ -627,7 +697,10 @@ class ServingEngine:
         if self._state == "serving":
             self._admit()
         if self._active.any() and not (self._done | ~self._active).all():
-            self._dispatch_decode()
+            if self._spec is not None and self._warmed_spec:
+                self._dispatch_spec()
+            else:
+                self._dispatch_decode()
         self._evict()
         self._sync_registry()
         out, self._finished = self._finished, []
@@ -758,13 +831,14 @@ class ServingEngine:
             ids = np.full((1, n), self.pad_token_id, np.int32)
             pages_vec = np.full((n // self.page_size,), TRASH_PAGE,
                                 np.int32)
-            _tok, new_pages, _kv, _rng = fn(
+            _tok, new_pages, _kv = fn(
                 self._params, self._buffers, self._pages,
                 jnp.asarray(ids), jnp.int32(1), jnp.asarray(pages_vec),
                 self._rng)
             # the pool was donated to the program — adopt the returned
-            # buffers (contents untouched outside the trash page);
-            # _rng is deliberately dropped (see docstring)
+            # buffers (contents untouched outside the trash page); the
+            # RNG rode along as a synthetic key only — host state is
+            # NOT advanced (see docstring)
             self._pages = new_pages
             self._warmed_buckets.add(n)
             warmed.append(n)
@@ -790,7 +864,7 @@ class ServingEngine:
                 ids = np.full((1, t), self.pad_token_id, np.int32)
                 pages_vec = np.full((t // self.page_size,), TRASH_PAGE,
                                     np.int32)
-                _tok, new_pages, _kv, _rng = fn(
+                _tok, new_pages, _kv = fn(
                     self._params, self._buffers, self._pages, kpre,
                     vpre, jnp.asarray(ids), jnp.int32(0), jnp.int32(1),
                     jnp.asarray(pages_vec), self._rng)
@@ -825,17 +899,39 @@ class ServingEngine:
                      np.ones((b,), bool),           # done: all
                      np.zeros((b,), np.int32),      # emitted
                      np.ones((b,), np.int32),       # max_new
-                     np.full((b,), -1, np.int32))   # eos
+                     np.full((b,), -1, np.int32),   # eos
+                     np.zeros((b, 2), np.uint32))   # key_base
             out = self._decode_fn(self._params, self._buffers,
                                   self._pages,
-                                  *(jnp.asarray(a) for a in sched),
-                                  self._rng)
+                                  *(jnp.asarray(a) for a in sched))
             self._pages = out[1]
             self._warmed_decode = True
+        if self._spec is not None and decode:
+            # speculative programs: the folded verify (all-trash table,
+            # inactive slots — writes land in the trash page) plus the
+            # proposer's own programs (draft prefill per warmed bucket
+            # + the propose scan for a model draft; nothing for ngram).
+            # _warmed_spec is the arming gate: until it flips, every
+            # dispatch takes the plain decode path
+            if not self._warmed_spec:
+                b = self.max_slots
+                _true, new_pages = self._spec_verify_fn(
+                    self._params, self._buffers, self._pages,
+                    jnp.asarray(np.full((b, self.max_pages_per_seq),
+                                        TRASH_PAGE, np.int32)),
+                    jnp.asarray(np.zeros((b,), np.int32)),
+                    jnp.asarray(np.zeros((b,), np.int32)),
+                    jnp.asarray(np.zeros((b, self.spec_k), np.int32)),
+                    jnp.asarray(np.zeros((b, 2), np.uint32)),
+                    jnp.asarray(np.zeros((b,), np.int32)))
+                self._pages = new_pages
+                self._warmed_spec = True
+            self._spec.warmup(self, norm)
         from ..observability import flightrec
         flightrec.note("serve_warmup", buckets=warmed,
                        tail_buckets=sorted(self._warmed_tail_buckets),
-                       decode=self._warmed_decode)
+                       decode=self._warmed_decode,
+                       spec=self._warmed_spec)
         return warmed
 
     @property
@@ -1001,6 +1097,20 @@ class ServingEngine:
             # this off heartbeats for prefix-affinity placement
             st["fingerprints"] = sorted(self.prefix.fingerprint_set())
             h["prefix_cache"] = st
+        if self._spec is not None:
+            # the fleet router delta-folds proposed/accepted/dispatches
+            # off heartbeats into fleet_spec_* (acceptance canary)
+            prop = int(self._m_spec_proposed.value)
+            acc = int(self._m_spec_accepted.value)
+            h["spec"] = {"k": self.spec_k,
+                         "draft": self._spec.kind,
+                         "armed": self._warmed_spec,
+                         "proposed": prop,
+                         "accepted": acc,
+                         "dispatches":
+                             int(self._m_spec_dispatches.value),
+                         "acceptance_rate":
+                             round(acc / prop, 6) if prop else None}
         if self._watchdog is not None:
             h["watchdog"] = dict(self._watchdog.health(),
                                  wedge_count=int(self._m_wedges.value))
@@ -1019,6 +1129,28 @@ class ServingEngine:
             return jnp.take_along_axis(
                 cand, pick[..., None], axis=-1)[..., 0].astype(jnp.int32)
         return jax.random.categorical(key, logits).astype(jnp.int32)
+
+    def _sample_rows(self, logits, keys):
+        """Batched sampling with ONE key per row: logits [N, V],
+        keys [N, 2]. Every row's draw depends only on its own (key,
+        logits) — `vmap` of the single-row sampler — so a row sampled
+        inside a width-N batch is bit-identical to the same row
+        sampled inside a width-M batch. That row independence is what
+        makes speculative verify (which folds K+1 positions into the
+        batch dim) reproduce the plain decode scan's tokens exactly;
+        a single-key `categorical` over the whole batch would draw
+        batch-shape-dependent noise and break it."""
+        logits = logits.astype(jnp.float32)
+        if self.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / self.temperature
+        if self.top_k:
+            vals, cand = jax.lax.top_k(logits, self.top_k)
+            pick = jax.vmap(jax.random.categorical)(keys, vals)
+            return jnp.take_along_axis(
+                cand, pick[:, None], axis=-1)[:, 0].astype(jnp.int32)
+        return jax.vmap(jax.random.categorical)(keys,
+                                                logits).astype(jnp.int32)
 
     # -- compiled programs --------------------------------------------------
 
@@ -1073,29 +1205,79 @@ class ServingEngine:
 
         def decode(params, buffers, pages, page_table, seq_lens,
                    last_tokens, active, done, emitted, max_new, eos,
-                   rng):
+                   key_base):
             def step(carry, _):
-                (pages, seq_lens, last, done, emitted, rng) = carry
+                (pages, seq_lens, last, done, emitted) = carry
                 live = active & ~done
                 logits, pages = self._model_token_step(
                     params, buffers, last, pages, page_table, seq_lens)
-                rng, sub = jax.random.split(rng)
-                nxt = self._sample(logits, sub)
+                # token index e = emitted-so-far keys the draw:
+                # fold_in(base, e) — the stream is a function of the
+                # request and index, never of dispatch scheduling
+                keys = jax.vmap(jax.random.fold_in)(key_base, emitted)
+                nxt = self._sample_rows(logits, keys)
                 nxt = jnp.where(live, nxt, jnp.int32(pad))
                 emitted = emitted + live.astype(jnp.int32)
                 stop = (emitted >= max_new) | ((eos >= 0) & (nxt == eos))
                 done = done | (live & stop)
                 seq_lens = seq_lens + live.astype(jnp.int32)
                 last = jnp.where(live, nxt, last)
-                return (pages, seq_lens, last, done, emitted, rng), nxt
+                return (pages, seq_lens, last, done, emitted), nxt
 
-            carry = (pages, seq_lens, last_tokens, done, emitted, rng)
+            carry = (pages, seq_lens, last_tokens, done, emitted)
             carry, toks = jax.lax.scan(step, carry, None, length=steps)
-            pages, seq_lens, last, done, emitted, rng = carry
-            return (toks, pages, seq_lens, last, done, emitted, rng)
+            pages, seq_lens, last, done, emitted = carry
+            return (toks, pages, seq_lens, last, done, emitted)
 
         # donate the page pool (arg 2): decode updates it in place
         return self._counting("decode", decode, donate_argnums=(2,))
+
+    def _build_spec_verify_fn(self):
+        """The speculative-verify program: ONE batched dispatch scores
+        all spec_k+1 candidate positions of every slot by FOLDING them
+        into the batch dimension — lane (b, j) = row b*(K+1)+j carries
+        slot b's candidate token at position seq_lens[b]+j, with the
+        slot's page-table row repeated across its lanes. Within each
+        layer the paged cache writes every lane's K/V row first (one
+        scatter, distinct (page, row) targets because positions are
+        consecutive) and then attends with lens = position+1, so lane
+        (b, j) sees exactly the context plain decode would have at that
+        position. _model_token_step is the SAME function the decode
+        scan calls, per-row computations are batch-width invariant, and
+        each position samples with fold_in(key_base, emitted+j) — the
+        identical key plain decode would use — so the returned tokens
+        are bit-identical to non-speculative decode wherever the draft
+        context matches (the host commits exactly that prefix + one
+        correction, r19-tail-style: rows written past the commit point
+        are masked by lens and overwritten by the next dispatch).
+
+        Lanes whose position would exceed max_seq_len have their WHOLE
+        table row redirected to the trash page (never a clamp into a
+        real page): the table keeps the plain-decode width so attention
+        reduction shapes — and therefore bitwise numerics — are
+        untouched, and the host never commits such positions (submit()
+        bounds prompt+max_new by max_seq_len)."""
+        k1 = self.spec_k + 1
+        b = self.max_slots
+
+        def verify(params, buffers, pages, page_table, seq_lens,
+                   last_tokens, drafts, key_base, emitted):
+            toks_f = jnp.concatenate(
+                [last_tokens[:, None], drafts], axis=1).reshape(-1)
+            offs = jnp.arange(k1, dtype=jnp.int32)
+            pos_f = (seq_lens[:, None] + offs[None, :]).reshape(-1)
+            pt_f = jnp.repeat(page_table, k1, axis=0)
+            pt_f = jnp.where((pos_f >= self.max_seq_len)[:, None],
+                             jnp.int32(TRASH_PAGE), pt_f)
+            logits, pages = self._model_token_step(
+                params, buffers, toks_f, pages, pt_f, pos_f)
+            idx_f = (emitted[:, None] + offs[None, :]).reshape(-1)
+            keys = jax.vmap(jax.random.fold_in)(
+                jnp.repeat(key_base, k1, axis=0), idx_f)
+            true = self._sample_rows(logits, keys)
+            return true.reshape(b, k1), pages
+
+        return self._counting("spec_verify", verify, donate_argnums=(2,))
 
     def _prefill_fn(self, bucket):
         fn = self._prefill_fns.get(bucket)
@@ -1103,7 +1285,7 @@ class ServingEngine:
             return fn
 
         def prefill(params, buffers, pages, ids, true_len, pages_vec,
-                    rng):
+                    key):
             s_b = ids.shape[1]
             mask = (jnp.arange(s_b)[None, :]
                     < true_len).astype(jnp.int32)
@@ -1128,9 +1310,8 @@ class ServingEngine:
                 dense_kv.append((kd, vd))
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], true_len - 1, keepdims=False)
-            rng, sub = jax.random.split(rng)
-            tok = self._sample(last[None, :], sub)[0]
-            return tok, new_pages, dense_kv, rng
+            tok = self._sample(last[None, :], key)[0]
+            return tok, new_pages, dense_kv
 
         fn = self._counting(f"prefill_{bucket}", prefill,
                             donate_argnums=(2,))
@@ -1152,7 +1333,7 @@ class ServingEngine:
             return fn
 
         def tail_prefill(params, buffers, pages, kpre, vpre, ids,
-                         cached_len, true_tail, pages_vec, rng):
+                         cached_len, true_tail, pages_vec, key):
             def arr(x):
                 return x._value if isinstance(x, Tensor) else x
 
@@ -1183,9 +1364,8 @@ class ServingEngine:
                 tail_kv.append((kt, vt))
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], true_tail - 1, keepdims=False)
-            rng, sub = jax.random.split(rng)
-            tok = self._sample(last[None, :], sub)[0]
-            return tok, new_pages, tail_kv, rng
+            tok = self._sample(last[None, :], key)[0]
+            return tok, new_pages, tail_kv
 
         fn = self._counting(f"tail_prefill_{tb}", tail_prefill,
                             donate_argnums=(2,))
@@ -1207,7 +1387,8 @@ class ServingEngine:
     # -- host-side scheduling ----------------------------------------------
 
     def _finish_request(self, req, status, tokens=None, kv_page_s=0.0,
-                        prefix_hit_pages=0, prefix_pages=0):
+                        prefix_hit_pages=0, prefix_pages=0,
+                        spec_proposed=0, spec_accepted=0):
         """Finish a request that never reached (or is leaving) a slot.
         age_s — submit-to-finish latency — rides the result so tail
         latency is measurable per request, not just per dispatch;
@@ -1235,6 +1416,8 @@ class ServingEngine:
                   "kv_page_s": round(kv_page_s, 6),
                   "prefix_hit_pages": int(prefix_hit_pages),
                   "prefix_pages": int(prefix_pages),
+                  "spec_proposed": int(spec_proposed),
+                  "spec_accepted": int(spec_accepted),
                   "age_s": age}
         if req.tenant is not None:
             result["tenant"] = req.tenant
@@ -1244,7 +1427,9 @@ class ServingEngine:
                                  queue_wait_s=qw,
                                  kv_page_s=kv_page_s, requests=1,
                                  prefix_hit_pages=int(prefix_hit_pages),
-                                 prefix_pages=int(prefix_pages))
+                                 prefix_pages=int(prefix_pages),
+                                 spec_proposed=int(spec_proposed),
+                                 spec_accepted=int(spec_accepted))
         self._finished.append(result)
         self._cancel_pending.discard(req.rid)
         if req.trace is not None and req.admitted_pc is None:
@@ -1280,7 +1465,9 @@ class ServingEngine:
                              slot.out_tokens[:req.max_new_tokens],
                              kv_page_s=kv_page_s,
                              prefix_hit_pages=slot.prefix_hit_pages,
-                             prefix_pages=slot.prefix_pages)
+                             prefix_pages=slot.prefix_pages,
+                             spec_proposed=slot.spec_proposed,
+                             spec_accepted=slot.spec_accepted)
         self.spans.instant("release_pages", tid="sched", cat="serve",
                            args={"rid": req.rid, "slot": b,
                                  "pages": len(slot.pages),
@@ -1474,13 +1661,22 @@ class ServingEngine:
         self.spans.add("queue_wait", req.submitted_pc,
                        tid=f"req{req.rid}", cat="serve",
                        args={"rid": req.rid, "slot": b})
+        # ONE host-side split per admission: `sub` seeds this request's
+        # whole token stream (prefill samples with it directly; decode/
+        # verify fold it with each token's emitted index). The split
+        # order — admission order — is the only thing the stream
+        # depends on, so replay and failover reproduce it exactly.
+        self._rng, sub = jax.random.split(self._rng)
         hit = self._prefix_lookup(req)
         if hit is not None:
             tok, pages, shared, t_post = self._prefill_hit(
-                b, req, need_pages, hit)
+                b, req, need_pages, hit, sub)
         else:
             tok, pages, shared, t_post = self._prefill_full(
-                b, req, need_pages)
+                b, req, need_pages, sub)
+        self._key_base[b] = np.asarray(sub)
+        if self._spec is not None and self._warmed_spec:
+            self._spec.on_admit(self, b, req)
 
         self._admit_seq += 1
         slot = _Slot(req, pages, admit_seq=self._admit_seq)
@@ -1505,7 +1701,7 @@ class ServingEngine:
                                  and tok == req.eos_token_id))
         self._dev_sched = None  # host state diverged from device
 
-    def _prefill_full(self, b, req, need_pages):
+    def _prefill_full(self, b, req, need_pages, key):
         """The miss path: full bucketed prefill (the pre-prefix-cache
         admission body, unchanged), plus prefix registration of the
         freshly written prompt pages. Returns (first token, pages,
@@ -1532,10 +1728,10 @@ class ServingEngine:
         fn = self._prefill_fn(bucket)
         t_pre = time.perf_counter()
         with self._watch(f"prefill_{bucket}"):
-            tok, new_pages, dense_kv, self._rng = fn(
+            tok, new_pages, dense_kv = fn(
                 self._params, self._buffers, self._pages,
                 jnp.asarray(ids), jnp.int32(lp), jnp.asarray(pages_vec),
-                self._rng)
+                key)
         self._pages = new_pages
         tok = int(tok)  # host sync: the first token exists NOW
         self._m_ttft.observe(time.monotonic() - req.submitted_at)
@@ -1570,13 +1766,13 @@ class ServingEngine:
         shared = self._prefix_register(req, pages, kv_dense)
         return tok, pages, shared, t_post
 
-    def _prefill_hit(self, b, req, need_pages, hit):
+    def _prefill_hit(self, b, req, need_pages, hit, key):
         """The prefix-cache HIT path: map the matched entry's shared
         pages into this slot (COW — they are never written again),
         allocate private pages for the tail + decode, and run the
-        short tail-prefill program. The sampling RNG splits exactly
-        once, like a full prefill, so the token stream is the OFF
-        path's stream whenever logits agree. Returns like
+        short tail-prefill program. The admission key seeds the first
+        token exactly like a full prefill, so the token stream is the
+        OFF path's stream whenever logits agree. Returns like
         _prefill_full."""
         entry, j = hit
         ps = self.page_size
@@ -1598,10 +1794,10 @@ class ServingEngine:
         fn = self._tail_prefill_fn(tb)
         t_pre = time.perf_counter()
         with self._watch(f"tail_prefill_{tb}"):
-            tok, new_pages, tail_kv, self._rng = fn(
+            tok, new_pages, tail_kv = fn(
                 self._params, self._buffers, self._pages, kpre, vpre,
                 jnp.asarray(ids), jnp.int32(cached), jnp.int32(tail),
-                jnp.asarray(pages_vec), self._rng)
+                jnp.asarray(pages_vec), key)
         self._pages = new_pages
         tok = int(tok)  # host sync: the first token exists NOW
         self._m_ttft.observe(time.monotonic() - req.submitted_at)
@@ -1664,8 +1860,8 @@ class ServingEngine:
                 jnp.asarray(a) for a in
                 (self._page_table, self._seq_lens, self._last_tokens,
                  self._active, self._done, self._emitted,
-                 self._max_new, self._eos))
-        (pt_d, sl_d, lt_d, ac_d, dn_d, em_d, mn_d, eos_d) = \
+                 self._max_new, self._eos, self._key_base))
+        (pt_d, sl_d, lt_d, ac_d, dn_d, em_d, mn_d, eos_d, kb_d) = \
             self._dev_sched
 
         def dispatch():
@@ -1674,23 +1870,22 @@ class ServingEngine:
             faults.maybe_raise("dispatch_error", self._rounds)
             return self._decode_fn(
                 self._params, self._buffers, self._pages,
-                pt_d, sl_d, lt_d, ac_d, dn_d, em_d, mn_d, eos_d,
-                self._rng)
+                pt_d, sl_d, lt_d, ac_d, dn_d, em_d, mn_d, eos_d, kb_d)
 
         from ..resilience.retry import retryable_for
         with self._watch("decode"):
             # slow-step seam sits inside the watchdog window: a wedged
             # dispatch and an injected stall look identical to health()
             faults.maybe_sleep("slow_step", self._rounds)
-            (toks, pages, seq_lens, last, done, emitted,
-             self._rng) = call_with_retries(
+            (toks, pages, seq_lens, last, done,
+             emitted) = call_with_retries(
                 dispatch, retries=self.dispatch_retries,
                 retryable=retryable_for(self.donate),
                 stats=self.retry_stats)
         self._pages = pages
         # decode only advances these four; the rest stay device-valid
         self._dev_sched = (pt_d, seq_lens, last, ac_d, done, emitted,
-                           mn_d, eos_d)
+                           mn_d, eos_d, kb_d)
         toks = np.asarray(toks)                     # [steps, B]
         # np.array (copy): np.asarray of a jax array is a read-only
         # view, and eviction writes these in place
@@ -1733,3 +1928,108 @@ class ServingEngine:
                 # live steps are the first n of the scan (done is
                 # monotonic within a dispatch)
                 slot.out_tokens.extend(int(t) for t in toks[:n, b])
+
+    def _dispatch_spec(self):
+        """One speculative decode round: the proposer drafts spec_k
+        tokens per slot, the folded verify program scores all spec_k+1
+        positions in ONE dispatch, and the host commits the longest
+        draft prefix the target's own sampler reproduced plus exactly
+        one correction (or the bonus token after a full accept) —
+        every live slot advances >= 1 token per dispatch, and every
+        committed token is bit-identical to plain decode's.
+
+        The rewind is host-side bookkeeping, the r19 tail contract:
+        seq_lens advances only over the committed tokens, so KV rows
+        written past the commit point are masked by the attention
+        length and overwritten by the next dispatch (whose verify span
+        seq_lens..seq_lens+spec_k covers them) — page contents never
+        roll back on device."""
+        K = self.spec_k
+        emitted_before = self._emitted.copy()
+        t0 = time.perf_counter()
+        # proposer cost — ngram host lookup or the draft model's own
+        # dispatch — counts inside the decode window: acceptance gains
+        # must beat it for tok/s to move
+        drafts = self._spec.propose(self)           # [B, K] np.int32
+        sched = tuple(jnp.asarray(a) for a in
+                      (self._page_table, self._seq_lens,
+                       self._last_tokens, drafts, self._key_base,
+                       self._emitted))
+
+        def dispatch():
+            faults.maybe_raise("dispatch_error", self._rounds)
+            return self._spec_verify_fn(
+                self._params, self._buffers, self._pages, *sched)
+
+        from ..resilience.retry import retryable_for
+        with self._watch("spec_verify"):
+            faults.maybe_sleep("slow_step", self._rounds)
+            true, pages = call_with_retries(
+                dispatch, retries=self.dispatch_retries,
+                retryable=retryable_for(self.donate),
+                stats=self.retry_stats)
+        self._pages = pages
+        true = np.asarray(true)                     # [B, K+1]; syncs
+        proposed = accepted = committed = 0
+        for b in range(self.max_slots):
+            slot = self._slots[b]
+            if slot is None or not self._active[b] or self._done[b]:
+                continue
+            e = int(emitted_before[b])
+            mx = int(self._max_new[b])
+            eos = int(self._eos[b])
+            com = acc = 0
+            done = False
+            for j in range(K + 1):
+                # position j attends rows 0..seq_lens+j-1: the prompt
+                # plus drafts 0..j-1 — valid exactly while every
+                # earlier draft matched, which is when this loop is
+                # still running (j == K is the bonus token, reached
+                # only after a full accept)
+                t = int(true[b, j])
+                slot.out_tokens.append(t)
+                com += 1
+                hit = j < K and t == int(drafts[b, j])
+                acc += int(hit)
+                if (e + com >= mx) or (eos >= 0 and t == eos):
+                    done = True
+                    break
+                if j < K and not hit:
+                    break               # correction committed; rewind
+            self._seq_lens[b] += com
+            self._emitted[b] = e + com
+            self._last_tokens[b] = slot.out_tokens[-1]
+            if done:
+                self._done[b] = True
+            slot.spec_proposed += K
+            slot.spec_accepted += acc
+            proposed += K
+            accepted += acc
+            committed += com
+        self._dev_sched = None  # host state diverged from device
+        self.last_dispatch_s = time.perf_counter() - t0
+        live = int(sum(1 for s in self._slots if s is not None))
+        self.spans.add("spec_verify", t0, t0 + self.last_dispatch_s,
+                       tid="decode", cat="serve",
+                       args={"round": self._rounds, "tokens": committed,
+                             "proposed": proposed, "accepted": accepted,
+                             "live_slots": live})
+        from ..observability import flightrec
+        flightrec.note("serve_spec_dispatch", round=self._rounds,
+                       tokens=committed, proposed=proposed,
+                       accepted=accepted, live_slots=live,
+                       wall_s=round(self.last_dispatch_s, 6))
+        self.decode_seconds += self.last_dispatch_s
+        self.decode_tokens += committed
+        self.decode_dispatches += 1
+        self._m_dispatch.observe(self.last_dispatch_s)
+        self._m_decode_dispatches.inc()
+        self._m_spec_dispatches.inc()
+        if proposed:
+            self._m_spec_proposed.inc(proposed)
+        if accepted:
+            self._m_spec_accepted.inc(accepted)
+        if committed:
+            self._m_tok.observe(self.last_dispatch_s / committed,
+                                count=committed)
+            self._m_decode_tokens.inc(committed)

@@ -1,0 +1,349 @@
+"""Speculative decoding proposers for the paged serving engine.
+
+The engine's speculative loop (nlp/serving.py `_dispatch_spec`) is
+propose -> one folded verify dispatch -> host commit/rewind. THIS
+module is the propose half: a proposer drafts ``spec_k`` candidate
+tokens per slot each round; the target model then scores all K+1
+positions in ONE batched dispatch and commits exactly the prefix its
+own per-position seeded sampler reproduces. The contract that makes
+any proposer safe to plug in:
+
+- **draft quality is a latency knob, never a correctness one** — a
+  proposer that emits garbage costs acceptance (and therefore tok/s),
+  but every committed token still comes out of the TARGET's sampler
+  with the TARGET's per-(request, index) key, bit-identical to plain
+  decode;
+- **propose() is called between dispatches** and may not mutate any
+  target-engine state (page table, seq lens, RNG) — the engine owns
+  the commit; a proposer owns only its private state;
+- **zero-recompile holds**: any program a proposer compiles is traced
+  inside ``warmup()`` through the engine's counting jit, so the
+  post-warmup frozen-counts assertion covers draft programs too.
+
+Two proposers ship:
+
+``NgramProposer`` (default, ``spec_draft="ngram"``) — zero-weight
+prompt-lookup speculation: the longest recent-suffix n-gram of each
+slot's (prompt + generated) stream is matched against its own earlier
+occurrences and the K tokens that followed the most recent match are
+proposed (vLLM's "prompt lookup" / ngram speculation). Needs no
+second model, no device state, no warmup work — pure host numpy —
+and wins exactly on the repetitive/extractive traffic where drafting
+pays at all.
+
+``DraftModelProposer`` (``spec_draft="gpt-tiny"`` etc. or a model
+instance) — a small GPT/Llama sharing the target's tokenizer drafts
+autoregressively through its OWN paged KV pool (fixed identity page
+table — one private lane of pages per slot, so no allocator and no
+interaction with the target's free list). The draft never rewinds:
+its state is DERIVED from the target's each round by a uniform
+(K+1)-step scan — step 0 re-ingests token index L-1 (idempotent
+rewrite of a row the draft already holds), step 1 is forced to the
+target's last committed token (index L), steps 2..K consume the
+draft's own proposals — so after any accept/reject pattern the rows
+a future round attends are exactly the committed stream's.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import functional_call
+from ..tensor import Tensor
+from .paged_cache import PagedLayerCache, alloc_pages, \
+    write_prompt_kv, TRASH_PAGE
+
+__all__ = ["NgramProposer", "DraftModelProposer", "make_proposer"]
+
+
+def _ngram_propose(ctx, k, pad, nmin=1, nmax=3):
+    """Prompt-lookup drafts for one stream: match the longest suffix
+    n-gram (nmax down to nmin) at its MOST RECENT earlier occurrence
+    and propose the tokens that followed it. A match near the end of
+    the context SELF-EXTENDS — drafted tokens join the working
+    context and the lookup repeats — so a tight cycle drafts all k
+    tokens instead of padding after one period. ``pad`` fills only
+    when no n-gram recurs at all. Pure host work, O(n * len^2) worst
+    case — fine at serving prompt lengths."""
+    work = list(ctx)
+    out = []
+    while len(out) < k:
+        got = None
+        n_ctx = len(work)
+        for n in range(min(nmax, n_ctx - 1), nmin - 1, -1):
+            suf = work[n_ctx - n:]
+            for s in range(n_ctx - n - 1, -1, -1):
+                if work[s:s + n] == suf:
+                    got = work[s + n:s + n + (k - len(out))]
+                    break
+            if got:
+                break
+        if not got:
+            break
+        out.extend(got)
+        work.extend(got)
+    out.extend([pad] * (k - len(out)))
+    return out[:k]
+
+
+class NgramProposer:
+    """Zero-weight prompt-lookup proposer (see module doc)."""
+
+    kind = "ngram"
+
+    def __init__(self, engine, nmin=1, nmax=3):
+        self.nmin = int(nmin)
+        self.nmax = int(nmax)
+        del engine  # stateless: everything is read at propose time
+
+    def warmup(self, engine, buckets):
+        """Nothing to trace — host numpy only."""
+
+    def on_admit(self, engine, b, req):
+        """No per-admission state."""
+
+    def propose(self, engine):
+        """[max_slots, spec_k] int32 drafts; dead slots get pad rows
+        (their verify lanes are ignored by the commit loop)."""
+        k = engine.spec_k
+        pad = engine.pad_token_id
+        drafts = np.full((engine.max_slots, k), pad, np.int32)
+        for b in range(engine.max_slots):
+            slot = engine._slots[b]
+            if slot is None or not engine._active[b] \
+                    or engine._done[b]:
+                continue
+            ctx = list(slot.req.prompt) + list(slot.out_tokens)
+            drafts[b] = _ngram_propose(ctx, k, pad,
+                                       self.nmin, self.nmax)
+        return drafts
+
+
+class DraftModelProposer:
+    """Small-model proposer over a private paged KV pool (module doc).
+
+    The draft pool mirrors the target's page geometry but with a FIXED
+    identity page table: slot ``b`` owns pages
+    ``[1 + b*pps, 1 + (b+1)*pps)`` (page 0 is the draft's own trash
+    page), so admission/eviction never touches a draft allocator.
+    Rows the propose scan would write past ``max_seq_len`` are
+    redirected to the trash page with the position clamped — those
+    proposals are junk, which only costs acceptance near the length
+    cap (the verify program independently trash-guards its side).
+    """
+
+    kind = "draft"
+
+    def __init__(self, engine, model):
+        model.eval()
+        self.model = model
+        cfg = model.config
+        if cfg.vocab_size != engine.cfg.vocab_size:
+            raise ValueError(
+                f"draft model vocab_size={cfg.vocab_size} != target "
+                f"vocab_size={engine.cfg.vocab_size}: speculative "
+                "drafts must share the tokenizer")
+        self.kv_heads = (getattr(cfg, "num_key_value_heads", 0)
+                         or cfg.num_attention_heads)
+        self.num_layers = cfg.num_hidden_layers
+        self.head_dim = cfg.head_dim
+        self._params, self._buffers = model.raw_state()
+        b = engine.max_slots
+        ps = engine.page_size
+        pps = engine.max_pages_per_seq
+        # draft pool: f32 regardless of the target's cache dtype (the
+        # draft is tiny; its numerics never reach committed tokens)
+        self._pages = [alloc_pages(1 + b * pps, ps, self.kv_heads,
+                                   self.head_dim, "float32")
+                       for _ in range(self.num_layers)]
+        self._table = np.arange(b * pps, dtype=np.int32) \
+            .reshape(b, pps) + 1
+        self._prefill_fns = {}
+        self._warmed_buckets = set()
+        self._propose_fn = None
+
+    # -- compiled programs (traced via the ENGINE's counting jit, so
+    # draft traces land in the same compile budget / frozen-counts
+    # assertion as every serving program) --------------------------
+
+    def _layer_caches(self, pages, page_table, positions):
+        return [PagedLayerCache(k, v, page_table, positions,
+                                k_scale=ks, v_scale=vs,
+                                use_flash=False)
+                for (k, v, ks, vs) in pages]
+
+    def _token_step(self, params, buffers, pages, tokens, page_table,
+                    positions):
+        caches = self._layer_caches(pages, page_table, positions)
+        out = functional_call(
+            self.model, params, buffers,
+            Tensor(tokens[:, None]), use_cache=False, cache=caches,
+            cache_index=Tensor(positions))
+        logits_t, new_caches = out
+        logits = logits_t._value if isinstance(logits_t, Tensor) \
+            else logits_t
+        from .serving import ServingEngine
+        return (logits[:, -1].astype(jnp.float32),
+                ServingEngine._unwrap_pages(new_caches))
+
+    def _prefill_fn(self, engine, bucket):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+
+        def dprefill(params, buffers, pages, ids, true_len, pages_vec):
+            s_b = ids.shape[1]
+            mask = (jnp.arange(s_b)[None, :]
+                    < true_len).astype(jnp.int32)
+            out = functional_call(self.model, params, buffers,
+                                  Tensor(ids),
+                                  attention_mask=Tensor(mask),
+                                  use_cache=True)
+            _logits, caches = out
+
+            def arr(x):
+                return x._value if isinstance(x, Tensor) else x
+
+            new_pages = []
+            for (k, v, ks, vs), layer in zip(pages, caches):
+                new_pages.append(write_prompt_kv(
+                    k, v, ks, vs, arr(layer[0]), arr(layer[1]),
+                    pages_vec))
+            return new_pages
+
+        fn = engine._counting(f"draft_prefill_{bucket}", dprefill,
+                              donate_argnums=(2,))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def _build_propose_fn(self, engine):
+        k1 = engine.spec_k + 1
+        max_len = engine.max_seq_len
+
+        def propose(params, buffers, pages, page_table, lens, last0,
+                    next_tok):
+            # one-behind protocol: lens = L-1 (L = the target's
+            # committed length), so step i writes draft row L-1+i.
+            # step 0 input = token index L-1 (idempotent rewrite),
+            # step 1 FORCED to the target's last token (index L),
+            # steps 2..K consume the previous step's proposal.
+            def step(carry, i):
+                pages, prev = carry
+                tok = jnp.where(i == 0, last0,
+                                jnp.where(i == 1, next_tok, prev))
+                pos = lens + i
+                pt = jnp.where((pos >= max_len)[:, None],
+                               jnp.int32(TRASH_PAGE), page_table)
+                pos_c = jnp.minimum(pos, max_len - 1)
+                logits, pages = self._token_step(
+                    params, buffers, pages, tok, pt, pos_c)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (pages, nxt), nxt
+
+            (pages, _), props = jax.lax.scan(
+                step, (pages, last0), jnp.arange(k1, dtype=jnp.int32))
+            # props[i] is the proposal emitted by step i; step 0's is
+            # a throwaway (its true successor is already known: the
+            # forced next_tok) -> drafts = props[1:], [K, B] -> [B, K]
+            return props[1:].T, pages
+
+        return engine._counting("draft_propose", propose,
+                                donate_argnums=(2,))
+
+    # -- proposer interface ----------------------------------------
+
+    def warmup(self, engine, buckets):
+        """Trace the draft prefill per (normalized) bucket plus the
+        propose scan — called from the engine's warmup() after the
+        target programs, writes landing in the draft's trash page."""
+        for n in buckets:
+            if n in self._warmed_buckets:
+                continue
+            fn = self._prefill_fn(engine, n)
+            ids = np.full((1, n), engine.pad_token_id, np.int32)
+            pages_vec = np.full((n // engine.page_size,), TRASH_PAGE,
+                                np.int32)
+            self._pages = fn(self._params, self._buffers, self._pages,
+                            jnp.asarray(ids), jnp.int32(1),
+                            jnp.asarray(pages_vec))
+            self._warmed_buckets.add(n)
+        if self._propose_fn is None:
+            b = engine.max_slots
+            self._propose_fn = self._build_propose_fn(engine)
+            _drafts, new_pages = self._propose_fn(
+                self._params, self._buffers, self._pages,
+                jnp.asarray(np.full_like(self._table, TRASH_PAGE)),
+                jnp.asarray(np.zeros((b,), np.int32)),
+                jnp.asarray(np.zeros((b,), np.int32)),
+                jnp.asarray(np.zeros((b,), np.int32)))
+            self._pages = new_pages
+
+    def on_admit(self, engine, b, req):
+        """Ingest the freshly admitted prompt into slot ``b``'s draft
+        lane. An unwarmed bucket is skipped (never a mid-traffic
+        compile): the lane then holds stale rows and this slot's
+        proposals are junk until re-admission — acceptance cost only.
+        """
+        bucket = engine._bucket_for(len(req.prompt))
+        if bucket not in self._warmed_buckets:
+            return
+        ps = engine.page_size
+        nb = bucket // ps
+        pages_vec = np.full((nb,), TRASH_PAGE, np.int32)
+        pages_vec[:nb] = self._table[b, :nb]
+        ids = np.full((1, bucket), engine.pad_token_id, np.int32)
+        ids[0, :len(req.prompt)] = req.prompt
+        fn = self._prefill_fn(engine, bucket)
+        self._pages = fn(self._params, self._buffers, self._pages,
+                         jnp.asarray(ids),
+                         jnp.int32(len(req.prompt)),
+                         jnp.asarray(pages_vec))
+
+    def propose(self, engine):
+        if self._propose_fn is None:     # never warmed: junk drafts
+            return np.full((engine.max_slots, engine.spec_k),
+                           engine.pad_token_id, np.int32)
+        b = engine.max_slots
+        lens = np.maximum(engine._seq_lens - 1, 0).astype(np.int32)
+        last0 = np.zeros((b,), np.int32)
+        for i in range(b):
+            slot = engine._slots[i]
+            if slot is None or not engine._active[i] \
+                    or engine._done[i]:
+                continue
+            # token index L-1: the last prompt token until the second
+            # generated token exists, then the second-to-last output
+            last0[i] = slot.req.prompt[-1] \
+                if len(slot.out_tokens) <= 1 else slot.out_tokens[-2]
+        drafts, new_pages = self._propose_fn(
+            self._params, self._buffers, self._pages,
+            jnp.asarray(self._table), jnp.asarray(lens),
+            jnp.asarray(last0),
+            jnp.asarray(engine._last_tokens.astype(np.int32)))
+        self._pages = new_pages
+        return np.asarray(drafts).astype(np.int32)
+
+
+def make_proposer(engine, draft):
+    """Resolve the engine's ``spec_draft`` knob: "ngram" (default) ->
+    NgramProposer; a tiny-config name ("gpt-tiny", "llama-tiny", any
+    name the GPT/Llama config resolvers know) -> a freshly seeded
+    DraftModelProposer; a model INSTANCE -> DraftModelProposer over
+    it (the way to hand in actually trained draft weights)."""
+    if draft is None or draft == "ngram":
+        return NgramProposer(engine)
+    if not isinstance(draft, str):
+        return DraftModelProposer(engine, draft)
+    name = draft.lower()
+    if name.startswith("gpt"):
+        from .gpt import GPTForCausalLM, _resolve_config
+        model = GPTForCausalLM(_resolve_config(name))
+    elif name.startswith("llama"):
+        from .llama import LlamaForCausalLM, _resolve_config
+        model = LlamaForCausalLM(_resolve_config(name))
+    else:
+        raise ValueError(
+            f"spec_draft {draft!r}: expected 'ngram', a gpt*/llama* "
+            "config name, or a model instance")
+    return DraftModelProposer(engine, model)

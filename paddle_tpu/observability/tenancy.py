@@ -42,7 +42,7 @@ __all__ = ["SpaceSavingSketch", "TenantAccountant", "USAGE_FIELDS"]
 #: the accumulators every entry (and the exact-totals row) carries
 USAGE_FIELDS = ("tokens_in", "tokens_out", "queue_wait_s",
                 "kv_page_s", "requests", "prefix_hit_pages",
-                "prefix_pages")
+                "prefix_pages", "spec_proposed", "spec_accepted")
 
 
 class SpaceSavingSketch:
@@ -149,7 +149,8 @@ class TenantAccountant:
 
     def account(self, tenant, *, tokens_in=0, tokens_out=0,
                 queue_wait_s=0.0, kv_page_s=0.0, requests=0,
-                prefix_hit_pages=0, prefix_pages=0):
+                prefix_hit_pages=0, prefix_pages=0,
+                spec_proposed=0, spec_accepted=0):
         """Fold one request's usage for ``tenant`` (None is skipped —
         untagged traffic costs nothing here; the ROUTER maps untagged
         to 'anon' so fleet sums stay exact regardless)."""
@@ -164,7 +165,9 @@ class TenantAccountant:
                             kv_page_s=float(kv_page_s),
                             requests=int(requests),
                             prefix_hit_pages=int(prefix_hit_pages),
-                            prefix_pages=int(prefix_pages))
+                            prefix_pages=int(prefix_pages),
+                            spec_proposed=int(spec_proposed),
+                            spec_accepted=int(spec_accepted))
             if self._m_evict is not None \
                     and self.sketch.evictions > ev0:
                 self._m_evict.inc(self.sketch.evictions - ev0)

@@ -130,6 +130,7 @@ class _Child:
             "tenants_tracked": h.get("tenants_tracked", 0),
             "sampling": h.get("sampling"),
             "prefix_cache": h.get("prefix_cache"),
+            "spec": h.get("spec"),
             "compile_counts": h["compile_counts"],
             "unexpected_retraces":
                 self.engine.tracer.unexpected_retraces(),

@@ -384,6 +384,7 @@ class InprocReplica:
                 "tenants_tracked": h.get("tenants_tracked", 0),
                 "sampling": h.get("sampling"),
                 "prefix_cache": h.get("prefix_cache"),
+                "spec": h.get("spec"),
                 "compile_counts": h["compile_counts"]}
         with self._health_lock:
             self._health = snap

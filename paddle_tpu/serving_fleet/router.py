@@ -507,6 +507,25 @@ class FleetRouter:
         self._fpsets = {}        # name -> (fingerprint set, page_size)
         self._m_pfx_hitp = {}
         self._m_pfx_pages = {}
+        # speculative-decoding acceptance telemetry: same heartbeat
+        # delta-fold discipline as the prefix counters (registered at
+        # 0 so a cold fleet exports the catalogue; a replica restart
+        # folds the new absolute value, never a negative delta)
+        self._m_spec = {
+            "proposed": reg.counter(
+                "fleet_spec_proposed_total",
+                help="draft tokens dispatched to speculative verify "
+                     "across the fleet (folded from heartbeats)"),
+            "accepted": reg.counter(
+                "fleet_spec_accepted_total",
+                help="draft tokens the target models confirmed — "
+                     "committed bit-identical to plain decode"),
+            "dispatches": reg.counter(
+                "fleet_spec_dispatches_total",
+                help="folded verify dispatches across the fleet")}
+        self._spec_seen = {}     # name -> last folded spec stats
+        self._m_spec_drafted = {}
+        self._m_spec_acc = {}
 
     def _new_client(self, rep):
         seed = self._next_client_seed
@@ -1287,7 +1306,9 @@ class FleetRouter:
         return {"queue_wait_s": res.get("queue_wait_s"),
                 "kv_page_s": res.get("kv_page_s"),
                 "prefix_hit_pages": res.get("prefix_hit_pages"),
-                "prefix_pages": res.get("prefix_pages")}
+                "prefix_pages": res.get("prefix_pages"),
+                "spec_proposed": res.get("spec_proposed"),
+                "spec_accepted": res.get("spec_accepted")}
 
     def _finish_from_prefix(self, p):
         """A recovered prefix may already satisfy the request (eos
@@ -1363,13 +1384,16 @@ class FleetRouter:
         u = usage or {}
         php = int(u.get("prefix_hit_pages") or 0)
         ppg = int(u.get("prefix_pages") or 0)
+        spp = int(u.get("spec_proposed") or 0)
+        spa = int(u.get("spec_accepted") or 0)
         if self.tenants is not None:
             self.tenants.account(
                 p.tenant if p.tenant is not None else "anon",
                 tokens_in=len(p.prompt), tokens_out=len(tokens),
                 queue_wait_s=float(u.get("queue_wait_s") or 0.0),
                 kv_page_s=float(u.get("kv_page_s") or 0.0),
-                requests=1, prefix_hit_pages=php, prefix_pages=ppg)
+                requests=1, prefix_hit_pages=php, prefix_pages=ppg,
+                spec_proposed=spp, spec_accepted=spa)
         # per-tenant hit-rate series for the history plane / fleet_top
         # (pages, not requests: the rate that predicts TTFT savings)
         if ppg:
@@ -1383,6 +1407,21 @@ class FleetRouter:
                     self._m_pfx_hitp, "fleet_prefix_hit_pages_total",
                     "prompt pages served from a replica prefix cache, "
                     "per tenant", tenant=tname).inc(php)
+        # per-tenant acceptance-rate series (fleet_top's SPEC_ACC):
+        # drafted vs accepted tokens, the ratio that predicts decode
+        # tok/s gains per tenant
+        if spp:
+            tname = p.tenant if p.tenant is not None else "anon"
+            self._labeled(
+                self._m_spec_drafted, "fleet_spec_draft_tokens_total",
+                "draft tokens speculated for resolved requests, "
+                "per tenant", tenant=tname).inc(spp)
+            if spa:
+                self._labeled(
+                    self._m_spec_acc,
+                    "fleet_spec_accepted_tokens_total",
+                    "accepted draft tokens of resolved requests, "
+                    "per tenant", tenant=tname).inc(spa)
         self._done[p.rid] = result
 
     def _note_resolved(self, p, result, age_s, ttft):
@@ -1501,6 +1540,25 @@ class FleetRouter:
                 self._clock_offsets[name] = delay if prev is None \
                     else min(prev, delay)
                 self._fold_prefix(name, snap)
+                self._fold_spec(name, snap)
+
+    def _fold_spec(self, name, snap):
+        """Harvest one heartbeat's speculative-decoding section into
+        the fleet_spec_* counters — the same restart-tolerant
+        delta-fold as _fold_prefix (a backwards value means the engine
+        restarted: fold the new absolute, never a negative delta)."""
+        sp = snap.get("spec")
+        if not sp:
+            self._spec_seen.pop(name, None)
+            return
+        seen = self._spec_seen.setdefault(name, {})
+        for stat, ctr in self._m_spec.items():
+            v = int(sp.get(stat) or 0)
+            last = seen.get(stat, 0)
+            d = v - last if v >= last else v
+            seen[stat] = v
+            if d > 0:
+                ctr.inc(d)
 
     def _fold_prefix(self, name, snap):
         """Harvest one heartbeat's prefix-cache section: refresh the

@@ -215,6 +215,60 @@ def prefix_stats(entries, *, page_sizes=(8, 16, 32), min_pages=1):
     return out
 
 
+# -- speculative-decoding what-if scan -------------------------------------
+
+
+def spec_stats(entries, *, k_values=(2, 4, 8), nmin=1, nmax=3):
+    """Expected speculative-decoding acceptance of a recorded wave,
+    per ``spec.k`` knob — the measure-BEFORE-build number (r20).
+
+    Replays each archived request's RECORDED token stream through the
+    ngram proposer's exact matching rule (prompt-lookup over prompt +
+    generated-so-far): at every speculative round the proposer drafts
+    K tokens and the recorded stream itself adjudicates how many land
+    — the target model never runs, so this is pure host work, and
+    because accepted tokens are bit-identical to plain decode the
+    recorded stream IS what verify would have sampled. Reports per-K
+    acceptance rate and expected committed tokens per verify dispatch
+    (>= 1 + acceptance * K intuition, measured exactly)."""
+    from paddle_tpu.nlp.speculative import _ngram_propose
+    out = {}
+    for k in k_values:
+        k = int(k)
+        rounds = proposed = accepted = committed = streams = 0
+        for e in entries:
+            toks = [int(t) for t in (e.get("tokens") or [])]
+            if len(toks) < 2:
+                continue
+            streams += 1
+            ctx = [int(t) for t in (e.get("prompt") or [])] + toks[:1]
+            i = 1                     # first token rides prefill
+            while i < len(toks):
+                drafts = _ngram_propose(ctx, k, -1, nmin, nmax)
+                rounds += 1
+                proposed += k
+                com = 0
+                for j in range(k + 1):
+                    t = toks[i]
+                    ctx.append(t)
+                    com += 1
+                    i += 1
+                    hit = j < k and drafts[j] == t
+                    if hit:
+                        accepted += 1
+                    if i >= len(toks) or not hit:
+                        break
+                committed += com
+        out[str(k)] = {
+            "k": k, "streams": streams, "rounds": rounds,
+            "proposed": proposed, "accepted": accepted,
+            "acceptance_rate": None if not proposed
+            else round(accepted / proposed, 4),
+            "tokens_per_dispatch": None if not rounds
+            else round(committed / rounds, 4)}
+    return out
+
+
 # -- fleet construction ----------------------------------------------------
 
 
@@ -245,6 +299,23 @@ def parse_knobs(pairs):
             if autoscale_kw is None:
                 autoscale_kw = {}
             autoscale_kw[param] = val
+        elif k.startswith("spec."):
+            # speculative-decoding knobs: spec.k / spec.draft imply
+            # arming (a what-if on K with speculation off would be
+            # vacuous); spec.decode=false is the explicit OFF lever
+            param = k[len("spec."):]
+            if param == "k":
+                engine_kw["spec_k"] = int(val)
+                engine_kw.setdefault("spec_decode", True)
+            elif param == "draft":
+                engine_kw["spec_draft"] = str(val)
+                engine_kw.setdefault("spec_decode", True)
+            elif param == "decode":
+                engine_kw["spec_decode"] = bool(val)
+            else:
+                raise ValueError(
+                    f"unknown knob {k!r}; spec params: k, draft, "
+                    "decode")
         elif k in ROUTER_KNOBS:
             router_kw[k] = val
         elif k in ENGINE_KNOBS:
@@ -654,6 +725,21 @@ def run_replay(entries, *, out_dir, mode="recorded", time_scale=1.0,
             if not prefix_live["total_pages"] else round(
                 prefix_live["hit_pages"]
                 / prefix_live["total_pages"], 4)
+        # live speculative-decoding facts (engines armed via --knob
+        # spec.*): what the draft/verify loop actually accepted on
+        # this traffic, vs spec_stats' offline scan
+        spec_live = {"engines": 0, "proposed": 0, "accepted": 0,
+                     "dispatches": 0}
+        for e in engines:
+            sp = e.health().get("spec")
+            if not sp:
+                continue
+            spec_live["engines"] += 1
+            for k in ("proposed", "accepted", "dispatches"):
+                spec_live[k] += int(sp.get(k) or 0)
+        spec_live["acceptance_rate"] = None \
+            if not spec_live["proposed"] else round(
+                spec_live["accepted"] / spec_live["proposed"], 4)
     finally:
         router.close()
         for e in engines:
@@ -678,6 +764,12 @@ def run_replay(entries, *, out_dir, mode="recorded", time_scale=1.0,
                   .get("ttft_p50_s"),
                   ttft_p99_ratio=verdict["slo"]["ratios"]
                   .get("ttft_p99_s"))
+    verdict["spec_stats"] = None if not spec_live["engines"] \
+        else dict(spec_live,
+                  e2e_p50_ratio=verdict["slo"]["ratios"]
+                  .get("e2e_p50_s"),
+                  e2e_p99_ratio=verdict["slo"]["ratios"]
+                  .get("e2e_p99_s"))
     report_all()  # keep the tracer rollup warm for post-hoc reads
     return verdict, replay_entries
 
@@ -714,6 +806,12 @@ def main(argv=None):
                          "hit rates (no replay; honors --knob "
                          "page_size/min_prefix_pages, else sweeps "
                          "page sizes 8/16/32)")
+    ap.add_argument("--report-spec-stats", action="store_true",
+                    help="replay the wave's recorded token streams "
+                         "through the ngram proposer and report "
+                         "expected speculative acceptance rate / "
+                         "tokens-per-dispatch (no replay; honors "
+                         "--knob spec.k, else sweeps K 2/4/8)")
     ap.add_argument("--replicas", type=int, default=2)
     ap.add_argument("--model", default="gpt-tiny")
     ap.add_argument("--model-seed", type=int, default=0)
@@ -752,6 +850,13 @@ def main(argv=None):
             "ok": True, "entries": len(entries),
             "prefix_stats": prefix_stats(entries, page_sizes=pss,
                                          min_pages=mp)}))
+        return 0
+    if args.report_spec_stats:
+        _rkw, ekw, _w, _a = parse_knobs(args.knob)
+        ks = [int(ekw["spec_k"])] if "spec_k" in ekw else [2, 4, 8]
+        print(json.dumps({
+            "ok": True, "entries": len(entries),
+            "spec_stats": spec_stats(entries, k_values=ks)}))
         return 0
 
     out_dir = os.environ.get("BENCH_TELEMETRY_DIR") or os.path.join(

@@ -214,19 +214,24 @@ def render(frame):
             f"out={t['totals']['tokens_out']} "
             f"kv_page_s={_fmt(t['totals']['kv_page_s'], nd=1)}")
         out.append("  TENANT        WEIGHT  TOK_IN  TOK_OUT "
-                   "QWAIT_S  KV_PG_S  PFX_HIT  ERR")
+                   "QWAIT_S  KV_PG_S  PFX_HIT  SPEC_ACC  ERR")
         for row in (t.get("tenants") or [])[:16]:
             # page-level prefix hit rate (r19): the share of this
             # tenant's shareable prompt pages served from cache
             ppg = row.get("prefix_pages") or 0
             pfx = "-" if not ppg else \
                 f"{100.0 * (row.get('prefix_hit_pages') or 0) / ppg:.0f}%"
+            # speculative acceptance rate (r20): the share of this
+            # tenant's draft tokens the target model confirmed
+            spp = row.get("spec_proposed") or 0
+            spc = "-" if not spp else \
+                f"{100.0 * (row.get('spec_accepted') or 0) / spp:.0f}%"
             out.append(
                 f"  {row['tenant']:<13} {row['weight']:<7} "
                 f"{row['tokens_in']:<7} {row['tokens_out']:<8}"
                 f"{_fmt(row['queue_wait_s'], nd=2):<9}"
                 f"{_fmt(row['kv_page_s'], nd=2):<9}"
-                f"{pfx:<9}{row['err']}")
+                f"{pfx:<9}{spc:<10}{row['err']}")
     rq = frame.get("requests")
     if rq and rq.get("requests"):
         cap = rq.get("capture") or {}

@@ -158,7 +158,8 @@ STAGES = [
                            "tests/test_fleet_recovery.py",
                            "tests/test_fleet_proc.py",
                            "tests/test_fleet_autoscale.py",
-                           "tests/test_prefix_cache.py", "-q",
+                           "tests/test_prefix_cache.py",
+                           "tests/test_spec_decode.py", "-q",
                            "-m", "chaos", "-p", "no:cacheprovider",
                            "-p", "no:randomly"], 3600,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0",
@@ -244,6 +245,17 @@ STAGES = [
     # unexpected retraces), and every page back on the free list
     # after close (shared-page refcounts conserve).
     ("prefix_cache_smoke", [PY, "tools/prefix_cache_smoke.py"], 1800,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
+    # speculative-decoding drill (ISSUE 20, CPU, seeded): a long-decode
+    # wave through a spec-ON engine (K=8, ngram prompt-lookup draft)
+    # vs a spec-OFF control at steps_per_dispatch=1 — ON streams
+    # token-exact vs OFF (the hard invariant: speculation may change
+    # latency, never tokens), cumulative draft acceptance >= 0.5,
+    # ON decode tok/s strictly above OFF (an accepting dispatch
+    # commits up to K+1 tokens against one folded-batch verify),
+    # compile counts frozen with speculation ON (the verify scan is
+    # pre-traced by warmup), zero unexpected retraces.
+    ("spec_smoke", [PY, "tools/spec_smoke.py"], 1800,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
     ("bench_full", [PY, "bench.py"], 7200, {}),
     ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
@@ -440,6 +452,13 @@ FLEET_CANARY_FAIL_ON = (
     # token-exactness. (Series skipped by metrics_diff until the
     # golden is regenerated with the prefix drill in the suite.)
     "fleet_prefix_hits_total<50%",
+    # speculative-decoding counter (ISSUE 20): the chaos suite's spec
+    # drill produces a deterministic accepted-draft count — acceptance
+    # falling >50% below the golden means the flagship stopped
+    # confirming drafts (proposer or verify regression) while
+    # token-exactness still passes (speculation never changes tokens,
+    # so only the acceptance counter can reveal a dead proposer).
+    "fleet_spec_accepted_total<50%",
 )
 
 # history gate (ISSUE 11): ONE archive, two instants, both directions

@@ -61,6 +61,10 @@ REQUIRED_STAGES = {
     # ON vs OFF, hit rate over floor, ON TTFT p50 strictly better,
     # zero new traces (CPU-only — ISSUE 16)
     "prefix_cache_smoke",
+    # speculative-decoding drill: long-decode wave token-exact ON vs
+    # OFF, acceptance over floor, ON decode tok/s strictly above OFF,
+    # zero new traces (CPU-only — ISSUE 20)
+    "spec_smoke",
 }
 
 
@@ -76,6 +80,7 @@ def _emits_metrics(cmd):
                                             "replay_smoke.py",
                                             "autoscale_smoke.py",
                                             "prefix_cache_smoke.py",
+                                            "spec_smoke.py",
                                             "test_fleet_serving.py",
                                             "test_fleet_recovery.py",
                                             "test_fleet_proc.py")
