@@ -31,7 +31,7 @@ import json
 import os
 
 __all__ = ["MARKER_NAME", "atomic_replace", "fsync_dir", "marker_path",
-           "unique_path", "write_marker"]
+           "publish_dir", "unique_path", "write_marker"]
 
 #: canonical marker filename for directory-shaped artifacts
 #: (checkpoint step dirs); file-shaped artifacts (journal segments)
@@ -100,6 +100,34 @@ def write_marker(path, meta=None, fsync=True):
 
 def has_marker(target):
     return os.path.exists(marker_path(target))
+
+
+def publish_dir(staging, final, fsync=True):
+    """Atomically publish a fully-staged DIRECTORY artifact: fsync
+    every regular file in `staging` (a crash after the rename must not
+    reveal torn payload bytes under the final name), rename it onto
+    `final`, fsync the parent, then write the COMPLETE marker strictly
+    last. A crash at ANY point leaves either no `final` entry or an
+    unmarked one — consumers that require the marker (has_marker) can
+    never load a half-written artifact. `final` must not already
+    exist (callers stage into a sibling and pick fresh names; this is
+    the never-clobber rule for directory artifacts). Returns `final`.
+    """
+    if fsync:
+        for base, _dirs, files in os.walk(staging):
+            for name in files:
+                fd = os.open(os.path.join(base, name), os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+            fsync_dir(base)
+    os.rename(staging, final)
+    parent = os.path.dirname(os.path.abspath(final))
+    if fsync:
+        fsync_dir(parent)
+    write_marker(marker_path(final), {"published": True}, fsync=fsync)
+    return final
 
 
 def unique_path(directory, stem, ext=".json"):

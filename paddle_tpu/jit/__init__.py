@@ -220,8 +220,11 @@ def save(layer, path, input_spec=None, **configs):
         *shape_args)
     blob = exp.serialize()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path + ".stablehlo", "wb") as f:
-        f.write(blob)
+    # atomic tmp-rename (io.atomic): a crash mid-export must leave the
+    # previous artifact or none — never a torn .stablehlo a later
+    # jit.load would feed to the deserializer
+    from ..io.atomic import atomic_replace
+    atomic_replace(path + ".stablehlo", blob)
     from ..serialization import save as _save
     _save({"params": {k: Tensor(v) for k, v in params.items()},
            "buffers": {k: Tensor(v) for k, v in buffers.items()},

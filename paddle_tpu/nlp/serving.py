@@ -450,6 +450,19 @@ class ServingEngine:
         self.spans = SpanRecorder(name="serving")
         self._exporter = None
         self._trace_counts = self.tracer._counts
+        # AOT export surface: every compiled serving program's RAW
+        # (pre-tracer) body + jit kwargs, recorded by _counting as the
+        # program is built. jit.serving_artifact lowers these through
+        # jax.export so a respawned replica can boot from serialized
+        # StableHLO instead of re-tracing Python (docs/robustness.md
+        # "Artifact boot").
+        self._aot_programs = {}
+        # how THIS engine became serving-ready: "traced" (warmup) or
+        # "aot" (artifact load). serving_artifact.warm_boot stamps
+        # mode/boot_s/artifact; heartbeats carry it to fleet_top's
+        # BOOT column.
+        self.boot_info = {"mode": "traced", "boot_s": None,
+                          "artifact": None}
         self._decode_fn = self._build_decode_fn()
         self._prefill_fns = {}
         self._tail_prefill_fns = {}
@@ -827,19 +840,11 @@ class ServingEngine:
         for n in norm:
             if n in self._warmed_buckets:
                 continue
-            fn = self._prefill_fn(n)
-            ids = np.full((1, n), self.pad_token_id, np.int32)
-            pages_vec = np.full((n // self.page_size,), TRASH_PAGE,
-                                np.int32)
-            _tok, new_pages, _kv = fn(
-                self._params, self._buffers, self._pages,
-                jnp.asarray(ids), jnp.int32(1), jnp.asarray(pages_vec),
-                self._rng)
-            # the pool was donated to the program — adopt the returned
-            # buffers (contents untouched outside the trash page); the
-            # RNG rode along as a synthetic key only — host state is
-            # NOT advanced (see docstring)
-            self._pages = new_pages
+            # the pool is donated to the program and the returned
+            # buffers adopted (contents untouched outside the trash
+            # page); the RNG rides along as a synthetic key only —
+            # host state is NOT advanced (see docstring)
+            self._prime(f"prefill_{n}", self._prefill_fn(n))
             self._warmed_buckets.add(n)
             warmed.append(n)
         if self.prefix is not None and norm:
@@ -852,44 +857,43 @@ class ServingEngine:
             for n in norm:
                 tails.update(self._bucket_for(t)
                              for t in range(1, n + 1))
-            pre = self.max_seq_len
-            zero = jnp.zeros((1, pre, self.kv_heads, self.head_dim),
-                             jnp.float32)
-            kpre = [zero] * self.num_layers
-            vpre = [zero] * self.num_layers
             for t in sorted(tails):
                 if t in self._warmed_tail_buckets:
                     continue
-                fn = self._tail_prefill_fn(t)
-                ids = np.full((1, t), self.pad_token_id, np.int32)
-                pages_vec = np.full((t // self.page_size,), TRASH_PAGE,
-                                    np.int32)
-                _tok, new_pages, _kv = fn(
-                    self._params, self._buffers, self._pages, kpre,
-                    vpre, jnp.asarray(ids), jnp.int32(0), jnp.int32(1),
-                    jnp.asarray(pages_vec), self._rng)
-                self._pages = new_pages
+                self._prime(f"tail_prefill_{t}",
+                            self._tail_prefill_fn(t))
                 self._warmed_tail_buckets.add(t)
-            # eager-op ladder for the REGISTRATION path: jnp.pad at
-            # full prefill (bucket -> max_seq_len sidecar) and the
-            # extension splice at a hit are eager XLA ops whose
-            # executables key on shapes only (splice starts are
-            # dynamic operands) — run every shape combo the warmed
-            # buckets can produce so a registering wave never pays a
-            # backend compile mid-traffic
-            for n in norm:
-                if n < pre:
-                    jnp.pad(zero[:, :n],
-                            ((0, 0), (0, pre - n), (0, 0), (0, 0)))
-            for t in sorted(self._warmed_tail_buckets):
-                src = zero[:, :t]
-                for w in sorted({min(t, pre - jj * self.page_size)
-                                 for jj in range(1, pre //
-                                                 self.page_size)}):
-                    jax.lax.dynamic_update_slice(
-                        zero, src if w == t else src[:, :w],
-                        (0, 0, 0, 0))
+            self._warm_eager_ladder(norm)
         if decode and not self._warmed_decode:
+            self._prime("decode", self._decode_fn)
+            self._warmed_decode = True
+        if self._spec is not None and decode:
+            # speculative programs: the folded verify (all-trash table,
+            # inactive slots — writes land in the trash page) plus the
+            # proposer's own programs (draft prefill per warmed bucket
+            # + the propose scan for a model draft; nothing for ngram).
+            # _warmed_spec is the arming gate: until it flips, every
+            # dispatch takes the plain decode path
+            if not self._warmed_spec:
+                self._prime("spec_verify", self._spec_verify_fn)
+                self._warmed_spec = True
+            self._spec.warmup(self, norm)
+        from ..observability import flightrec
+        flightrec.note("serve_warmup", buckets=warmed,
+                       tail_buckets=sorted(self._warmed_tail_buckets),
+                       decode=self._warmed_decode,
+                       spec=self._warmed_spec)
+        return warmed
+
+    def _warm_args(self, name):
+        """Synthetic boot-time arguments for serving program `name` —
+        shapes and dtypes exactly what real dispatch passes, page
+        tables pointing every write at the reserved trash page, the
+        RNG riding along as a value only (host state not advanced).
+        ONE builder shared by warmup() (tracing boot) and
+        jit.serving_artifact (AOT export signatures + load-time
+        priming), so the two boot paths can never drift apart."""
+        if name == "decode":
             b = self.max_slots
             sched = (np.full((b, self.max_pages_per_seq), TRASH_PAGE,
                              np.int32),
@@ -901,22 +905,11 @@ class ServingEngine:
                      np.ones((b,), np.int32),       # max_new
                      np.full((b,), -1, np.int32),   # eos
                      np.zeros((b, 2), np.uint32))   # key_base
-            out = self._decode_fn(self._params, self._buffers,
-                                  self._pages,
-                                  *(jnp.asarray(a) for a in sched))
-            self._pages = out[1]
-            self._warmed_decode = True
-        if self._spec is not None and decode:
-            # speculative programs: the folded verify (all-trash table,
-            # inactive slots — writes land in the trash page) plus the
-            # proposer's own programs (draft prefill per warmed bucket
-            # + the propose scan for a model draft; nothing for ngram).
-            # _warmed_spec is the arming gate: until it flips, every
-            # dispatch takes the plain decode path
-            if not self._warmed_spec:
-                b = self.max_slots
-                _true, new_pages = self._spec_verify_fn(
-                    self._params, self._buffers, self._pages,
+            return (self._params, self._buffers, self._pages,
+                    *(jnp.asarray(a) for a in sched))
+        if name == "spec_verify":
+            b = self.max_slots
+            return (self._params, self._buffers, self._pages,
                     jnp.asarray(np.full((b, self.max_pages_per_seq),
                                         TRASH_PAGE, np.int32)),
                     jnp.asarray(np.zeros((b,), np.int32)),
@@ -924,15 +917,79 @@ class ServingEngine:
                     jnp.asarray(np.zeros((b, self.spec_k), np.int32)),
                     jnp.asarray(np.zeros((b, 2), np.uint32)),
                     jnp.asarray(np.zeros((b,), np.int32)))
-                self._pages = new_pages
-                self._warmed_spec = True
-            self._spec.warmup(self, norm)
-        from ..observability import flightrec
-        flightrec.note("serve_warmup", buckets=warmed,
-                       tail_buckets=sorted(self._warmed_tail_buckets),
-                       decode=self._warmed_decode,
-                       spec=self._warmed_spec)
-        return warmed
+        if name.startswith("tail_prefill_"):
+            t = int(name.rsplit("_", 1)[1])
+            pre = self.max_seq_len
+            zero = jnp.zeros((1, pre, self.kv_heads, self.head_dim),
+                             jnp.float32)
+            ids = np.full((1, t), self.pad_token_id, np.int32)
+            pages_vec = np.full((t // self.page_size,), TRASH_PAGE,
+                                np.int32)
+            return (self._params, self._buffers, self._pages,
+                    [zero] * self.num_layers, [zero] * self.num_layers,
+                    jnp.asarray(ids), jnp.int32(0), jnp.int32(1),
+                    jnp.asarray(pages_vec), self._rng)
+        if name.startswith("prefill_"):
+            n = int(name.rsplit("_", 1)[1])
+            ids = np.full((1, n), self.pad_token_id, np.int32)
+            pages_vec = np.full((n // self.page_size,), TRASH_PAGE,
+                                np.int32)
+            return (self._params, self._buffers, self._pages,
+                    jnp.asarray(ids), jnp.int32(1),
+                    jnp.asarray(pages_vec), self._rng)
+        raise ValueError(f"unknown serving program {name!r}")
+
+    def _prime(self, name, fn):
+        """Run `fn` once with _warm_args(name) and adopt the returned
+        page pool (the pool is donated in; every serving program
+        returns its new pages at result index 1). Writes land only in
+        the trash page and the RNG is not advanced, so a primed engine
+        generates token-for-token what an unprimed one would."""
+        out = fn(*self._warm_args(name))
+        self._pages = out[1]
+
+    def _warm_eager_ladder(self, norm):
+        """Pre-run the prefix-REGISTRATION path's eager ops: jnp.pad
+        at full prefill (bucket -> max_seq_len sidecar) and the
+        extension splice at a hit are eager XLA ops whose executables
+        key on shapes only (splice starts are dynamic operands) — run
+        every shape combo the warmed buckets can produce so a
+        registering wave never pays a backend compile mid-traffic."""
+        pre = self.max_seq_len
+        zero = jnp.zeros((1, pre, self.kv_heads, self.head_dim),
+                         jnp.float32)
+        for n in norm:
+            if n < pre:
+                jnp.pad(zero[:, :n],
+                        ((0, 0), (0, pre - n), (0, 0), (0, 0)))
+        for t in sorted(self._warmed_tail_buckets):
+            src = zero[:, :t]
+            for w in sorted({min(t, pre - jj * self.page_size)
+                             for jj in range(1, pre //
+                                             self.page_size)}):
+                jax.lax.dynamic_update_slice(
+                    zero, src if w == t else src[:, :w],
+                    (0, 0, 0, 0))
+
+    def _install_aot_program(self, name, call):
+        """Install a pre-compiled (jax.export-restored) serving
+        program under site `name`, replacing the build-on-first-use
+        traced one. The caller (jit.serving_artifact.load_artifact)
+        owns priming it and flipping the matching _warmed_* flag —
+        installation alone must not claim warmth."""
+        if name == "decode":
+            self._decode_fn = call
+        elif name == "spec_verify":
+            if self._spec is None:
+                raise ValueError(
+                    "spec_verify program on a spec-off engine")
+            self._spec_verify_fn = call
+        elif name.startswith("tail_prefill_"):
+            self._tail_prefill_fns[int(name.rsplit("_", 1)[1])] = call
+        elif name.startswith("prefill_"):
+            self._prefill_fns[int(name.rsplit("_", 1)[1])] = call
+        else:
+            raise ValueError(f"unknown serving program {name!r}")
 
     @property
     def warmed(self):
@@ -1078,6 +1135,9 @@ class ServingEngine:
              "status_counts": dict(self.status_counts),
              "warmed": self.warmed,
              "warmed_buckets": sorted(self._warmed_buckets),
+             # how this engine became serving-ready: traced warmup or
+             # an AOT artifact load (fleet_top's BOOT column)
+             "boot": dict(self.boot_info),
              "tenants_tracked": self.tenants.tracked,
              # the decode-determinism fingerprint: replayed traffic is
              # token-exact only when these (and the weights) match —
@@ -1167,6 +1227,7 @@ class ServingEngine:
 
         kw = {"donate_argnums": donate_argnums} \
             if (self.donate and donate_argnums) else {}
+        self._aot_programs[name] = (wrapped, kw)
         return self.tracer.jit(name, wrapped, **kw)
 
     def _layer_caches(self, pages, page_table, positions):

@@ -166,6 +166,7 @@ class FleetAutoscaler:
             else router.registry
         reg = self.registry
         self._m_events = {}
+        self._m_boots = {}
         self._m_flaps = reg.counter(
             "fleet_autoscale_flaps_total",
             help="scale decisions inside flap_window_s of the "
@@ -191,6 +192,14 @@ class FleetAutoscaler:
             self.registry, self._m_events, "fleet_autoscale_events_total",
             "autoscaler decisions/outcomes by direction and reason",
             direction=direction, reason=reason)
+
+    def _bootmode_counter(self, mode):
+        from .router import labeled_counter
+        return labeled_counter(
+            self.registry, self._m_boots, "fleet_boots_total",
+            "warm boots adopted into rotation, by boot path (aot = "
+            "restored from a serving artifact, traced = full Python "
+            "trace + compile)", mode=mode)
 
     # -- control loop ------------------------------------------------------
 
@@ -373,11 +382,18 @@ class FleetAutoscaler:
             self._boot_deadline = None
             self.state = "steady"
             boot_s = now - self._boot_started
+            # boot-path accounting: aot (restored from a serving
+            # artifact) vs traced — the autoscale_smoke latency
+            # assertion and the fleet_top BOOT column both read this
+            bi = snap.get("boot") or {}
+            mode = str(bi.get("mode") or "traced")
+            self._bootmode_counter(mode).inc()
             self._router_flight("fleet_scale_out", {
                 "replica": rep.name, "boot_s": round(boot_s, 6),
+                "boot_mode": mode,
                 "fleet_size": len(self._live())})
             self._note(now, "scaled_out", replica=rep.name,
-                       boot_s=round(boot_s, 6))
+                       boot_s=round(boot_s, 6), boot_mode=mode)
             events.append(("scaled_out", rep.name))
             return
         dead = not getattr(rep, "alive", True)

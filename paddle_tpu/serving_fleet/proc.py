@@ -140,6 +140,14 @@ class ProcReplica:
             listed); pass ``False`` to skip warm boot entirely — the
             heartbeat then honestly reports ``warmed: false`` and a
             supervisor's boot gate will not admit the replica;
+        ``aot_dir``: AOT serving-artifact store root
+            (``jit.serving_artifact.warm_boot``) — incarnation 1
+            traces and exports, respawns restore serialized programs
+            and pass the boot gate in seconds; any torn/stale/corrupt
+            artifact falls back loudly to the traced path
+            (``serve_aot_fallback_total{reason}``), never a wrong
+            program. Heartbeats carry ``boot`` (mode aot/traced +
+            wall) — ``fleet_top``'s BOOT column;
         ``sys_path``: entries prepended to the child's ``sys.path``
             (the repo root, a tests dir);
         ``poll_s`` / ``heartbeat_s``: child loop cadence;

@@ -131,6 +131,7 @@ class _Child:
             "sampling": h.get("sampling"),
             "prefix_cache": h.get("prefix_cache"),
             "spec": h.get("spec"),
+            "boot": h.get("boot"),
             "compile_counts": h["compile_counts"],
             "unexpected_retraces":
                 self.engine.tracer.unexpected_retraces(),
@@ -330,15 +331,24 @@ def main(argv=None):
         # warm boot: the spec'd prefill buckets plus (always, unless
         # warmup=False) the decode program — heartbeats report the
         # ENGINE's warmed flag, never an unconditional claim, so the
-        # supervisor's boot gate can't admit a cold replica
+        # supervisor's boot gate can't admit a cold replica. With an
+        # artifact store configured (spec aot_dir / PADDLE_TPU_AOT_DIR)
+        # the boot ladder prefers the AOT artifact — incarnation 1
+        # traces and exports, every respawn after it boots from
+        # serialized StableHLO in seconds; a torn/stale/corrupt
+        # artifact falls back loudly (serve_aot_fallback_total) to the
+        # traced path, so the gate can never admit a wrong program
         warm = spec.get("warmup")
         if warm is not False:
-            engine.warmup(buckets=warm or ())
+            from paddle_tpu.jit.serving_artifact import warm_boot
+            warm_boot(engine, buckets=warm or (),
+                      artifact_dir=spec.get("aot_dir"))
         child.warmed = bool(engine.warmed)
         child.emit({"t": "hello", "pid": os.getpid(),
                     "incarnation": args.incarnation,
                     "warmed": child.warmed,
                     "boot_s": round(time.monotonic() - t_boot, 6),
+                    "boot": dict(engine.boot_info),
                     "compile_counts": engine.compile_counts()})
         child.run()
     finally:

@@ -946,6 +946,7 @@ class FleetRouter:
                 "queued": snap.get("queued") if snap else None,
                 "running": snap.get("running") if snap else None,
                 "free_pages": snap.get("free_pages") if snap else None,
+                "boot": snap.get("boot") if snap else None,
                 "error": rep.error}
         # list() snapshots: health() also runs on metrics-exporter
         # HTTP threads, and the control thread may be mid-submit

@@ -137,6 +137,7 @@ class FleetSupervisor:
         self._m_respawn = {}
         self._m_bootfail = {}
         self._m_loops = {}
+        self._m_bootmode = {}
         self._m_boot = reg.histogram(
             "fleet_boot_seconds",
             help="respawn -> healthy warm-boot heartbeat (the boot "
@@ -163,6 +164,13 @@ class FleetSupervisor:
             self._m_bootfail, "fleet_boot_failures_total",
             "respawn attempts that died (exit-at-boot, gate timeout, "
             "spawn error)", replica=replica, reason=reason)
+
+    def _bootmode_counter(self, mode):
+        return self._labeled(
+            self._m_bootmode, "fleet_boots_total",
+            "warm boots adopted into rotation, by boot path (aot = "
+            "restored from a serving artifact, traced = full Python "
+            "trace + compile)", mode=mode)
 
     def _loop_counter(self, replica):
         return self._labeled(
@@ -311,6 +319,13 @@ class FleetSupervisor:
             # healthy warm boot: gate it back into rotation
             self._m_boot.observe(now - st.boot_started)
             self._respawn_counter(name).inc()
+            # boot-path accounting: did this respawn come up off an
+            # AOT serving artifact or the traced path? (heartbeats
+            # carry engine.boot_info — absent on pre-artifact builds,
+            # which counts as traced)
+            bi = snap.get("boot") or {}
+            self._bootmode_counter(
+                str(bi.get("mode") or "traced")).inc()
             self.router.reinstate(name)
             st.phase = "serving"
             st.streak = 0

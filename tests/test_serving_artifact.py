@@ -1,0 +1,628 @@
+"""AOT serving artifacts (jit/serving_artifact.py) — ISSUE 21.
+
+Pins the round-21 contracts:
+
+- an artifact-booted engine serves TOKEN-EXACT vs a traced-boot
+  engine over the same model instance (GPT + Llama/GQA, greedy +
+  seeded top-k, spec-armed), with ZERO post-load Python traces and
+  zero unexpected retraces;
+- the store is crash-safe end to end: blobs staged + checksummed,
+  directory renamed, COMPLETE marker strictly last — a simulated
+  crash mid-export leaves only unmarked debris the loader refuses;
+- the fallback ladder is LOUD and total: every torn / stale / corrupt
+  / wrong-device / expired case raises the exact ArtifactError reason
+  from ``load_artifact``, and ``warm_boot`` counts it in
+  ``serve_aot_fallback_total{reason}`` before serving traced — never
+  a wrong program, never a silent slow boot;
+- dormancy: no store configured (or the kill switch off) keeps the
+  engine's metric surface byte-identical to pre-artifact builds;
+- chaos: kill-mid-export, byte-flip, and stale-fingerprint fleets
+  come up serving token-exact with zero lost requests, and the boot
+  mode rides heartbeats into router health + fleet_top's BOOT column.
+"""
+import json
+import os
+import shutil
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.io.atomic import has_marker
+from paddle_tpu.jit.serving_artifact import (
+    ArtifactError, artifact_fingerprint, export_artifact,
+    load_artifact, warm_boot)
+from paddle_tpu.nlp.generation import generate
+from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+from paddle_tpu.nlp.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nlp.serving import ServingEngine
+
+NEW_TOK = 8
+LENS = (5, 8)
+
+
+@pytest.fixture(scope="module")
+def gpt_model():
+    paddle.seed(0)
+    m = GPTForCausalLM(_resolve_config("gpt-tiny"))
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def llama_model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=128, max_position_embeddings=128))
+    m.eval()
+    return m
+
+
+def _prompts(lens, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, vocab, (n,)).astype(np.int32) for n in lens]
+
+
+def _greedy_ref(model, prompts, new_tok):
+    out = []
+    for p in prompts:
+        ids = generate(model, jnp.asarray(p)[None, :],
+                       max_new_tokens=new_tok, temperature=0.0)
+        out.append(np.asarray(ids._value)[0, len(p):].tolist())
+    return out
+
+
+def _engine(model, **kw):
+    d = dict(max_slots=2, page_size=16, max_seq_len=64,
+             steps_per_dispatch=4)
+    d.update(kw)
+    return ServingEngine(model, **d)
+
+
+def _counter(reg, name, labels=None):
+    c = reg.get(name, labels)
+    return 0 if c is None else int(c.value)
+
+
+def _aot_series(reg):
+    return sorted(s.name for s in reg.series()
+                  if s.name.startswith("serve_aot"))
+
+
+def _art_dir(root):
+    arts = [os.path.join(root, n) for n in sorted(os.listdir(root))
+            if n.startswith("art-")]
+    assert arts, f"no artifact under {root}"
+    return arts[-1]
+
+
+def _copy_store(root, dst):
+    dst = str(dst)
+    shutil.copytree(root, dst)
+    return dst
+
+
+# -- one traced-boot GPT engine + its exported store, shared ----------------
+
+@pytest.fixture(scope="module")
+def gpt_store(gpt_model, tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("aot_store"))
+    eng = _engine(gpt_model)
+    eng.warmup(buckets=LENS, decode=True)
+    art = export_artifact(eng, root)
+    prompts = _prompts(LENS)
+    refs = eng.generate(prompts, max_new_tokens=NEW_TOK)
+    yield {"root": root, "artifact": art, "engine": eng,
+           "prompts": prompts, "refs": refs}
+    eng.close()
+
+
+# -- corruption recipes (applied to a private COPY of the store) ------------
+
+def _corrupt_unmarked(root):
+    os.remove(os.path.join(_art_dir(root), "COMPLETE"))
+
+
+def _corrupt_blob_missing(root):
+    os.remove(os.path.join(_art_dir(root), "decode.stablehlo"))
+
+
+def _corrupt_manifest(root):
+    with open(os.path.join(_art_dir(root), "manifest.json"), "w") as f:
+        f.write("{ not json")
+
+
+def _corrupt_byte_flip(root):
+    path = os.path.join(_art_dir(root), "decode.stablehlo")
+    with open(path, "rb") as f:
+        raw = bytearray(f.read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(raw)
+
+
+def _edit_manifest(root, fn):
+    mpath = os.path.join(_art_dir(root), "manifest.json")
+    with open(mpath) as f:
+        doc = json.load(f)
+    fn(doc)
+    with open(mpath, "w") as f:
+        json.dump(doc, f)
+
+
+def _corrupt_wrong_device(root):
+    _edit_manifest(root, lambda d: d["fingerprint"].update(
+        device={"platform": "tpu", "kind": "TPU v4"}))
+
+
+def _corrupt_stale_config(root):
+    # the post-config-change case: the store was exported for a
+    # different model architecture
+    _edit_manifest(root, lambda d: d["fingerprint"]["config"].update(
+        hidden_size=4096))
+
+
+def _corrupt_version(root):
+    _edit_manifest(root, lambda d: d.update(version=999))
+
+
+CORRUPTIONS = [
+    (_corrupt_unmarked, "torn"),
+    (_corrupt_blob_missing, "torn"),
+    (_corrupt_manifest, "bad_manifest"),
+    (_corrupt_byte_flip, "bad_checksum"),
+    (_corrupt_wrong_device, "wrong_device"),
+    (_corrupt_stale_config, "stale_fingerprint"),
+    (_corrupt_version, "stale_fingerprint"),
+]
+
+
+# -- export / store layout --------------------------------------------------
+
+class TestExport:
+    def test_store_layout_published_and_checksummed(self, gpt_store):
+        art = gpt_store["artifact"]
+        assert has_marker(art)
+        with open(os.path.join(art, "manifest.json")) as f:
+            manifest = json.load(f)
+        blobs = manifest["blobs"]
+        # the full warmed program set: one prefill bucket (5 and 8
+        # both normalize to bucket 8) + decode
+        assert "decode" in blobs
+        assert any(s.startswith("prefill_") for s in blobs)
+        import hashlib
+        for site, meta in blobs.items():
+            with open(os.path.join(art, meta["file"]), "rb") as f:
+                raw = f.read()
+            assert hashlib.sha256(raw).hexdigest() == meta["sha256"]
+            assert len(raw) == meta["bytes"]
+        # no staging debris after a clean publish
+        assert not [n for n in os.listdir(gpt_store["root"])
+                    if n.startswith(".stage-")]
+
+    def test_export_is_idempotent(self, gpt_store):
+        # same fingerprint + same sites -> the existing artifact, no
+        # second dir (a fleet sharing a store exports once)
+        again = export_artifact(gpt_store["engine"], gpt_store["root"])
+        assert again == gpt_store["artifact"]
+        assert len([n for n in os.listdir(gpt_store["root"])
+                    if n.startswith("art-")]) == 1
+
+    def test_export_requires_warmed_engine(self, gpt_model, tmp_path):
+        eng = _engine(gpt_model)
+        with pytest.raises(RuntimeError, match="warmed"):
+            export_artifact(eng, str(tmp_path))
+        eng.close()
+
+    def test_fingerprint_covers_the_load_bearing_fields(self,
+                                                        gpt_store):
+        fp = artifact_fingerprint(gpt_store["engine"])
+        for key in ("config", "cache_dtype", "page_size",
+                    "max_seq_len", "steps_per_dispatch", "sampling",
+                    "spec", "prefix", "jax", "jaxlib", "device"):
+            assert key in fp, key
+
+
+# -- the token-exactness matrix ---------------------------------------------
+
+class TestArtifactBootTokenExact:
+    def test_gpt_greedy_token_exact_zero_traces(self, gpt_model,
+                                                gpt_store):
+        eng = _engine(gpt_model)
+        info = warm_boot(eng, buckets=LENS,
+                         artifact_dir=gpt_store["root"])
+        assert info["mode"] == "aot"
+        assert info["artifact"] == os.path.basename(
+            gpt_store["artifact"])
+        assert _counter(eng.registry, "serve_aot_loads_total") == 1
+        assert _aot_series(eng.registry) == ["serve_aot_loads_total"]
+        assert eng.warmed
+        frozen = eng.compile_counts()
+        outs = eng.generate(gpt_store["prompts"],
+                            max_new_tokens=NEW_TOK)
+        # exact vs the traced-boot engine AND the dense reference
+        assert outs == gpt_store["refs"]
+        assert outs == _greedy_ref(gpt_model, gpt_store["prompts"],
+                                   NEW_TOK)
+        assert eng.compile_counts() == frozen
+        assert eng.tracer.unexpected_retraces() == 0
+        eng.close()
+
+    def test_gpt_topk_token_exact(self, gpt_model, tmp_path):
+        kw = dict(temperature=0.9, top_k=5, seed=7)
+        a = _engine(gpt_model, **kw)
+        a.warmup(buckets=LENS, decode=True)
+        root = str(tmp_path / "store")
+        export_artifact(a, root)
+        prompts = _prompts(LENS, seed=3)
+        refs = a.generate(prompts, max_new_tokens=NEW_TOK)
+        b = _engine(gpt_model, **kw)
+        assert warm_boot(b, buckets=LENS,
+                         artifact_dir=root)["mode"] == "aot"
+        frozen = b.compile_counts()
+        assert b.generate(prompts, max_new_tokens=NEW_TOK) == refs
+        assert b.compile_counts() == frozen
+        a.close()
+        b.close()
+
+    def test_llama_gqa_greedy_token_exact(self, llama_model, tmp_path):
+        a = _engine(llama_model)
+        a.warmup(buckets=LENS, decode=True)
+        root = str(tmp_path / "store")
+        export_artifact(a, root)
+        prompts = _prompts(LENS, seed=1)
+        refs = a.generate(prompts, max_new_tokens=NEW_TOK)
+        b = _engine(llama_model)
+        assert warm_boot(b, buckets=LENS,
+                         artifact_dir=root)["mode"] == "aot"
+        frozen = b.compile_counts()
+        assert b.generate(prompts, max_new_tokens=NEW_TOK) == refs
+        assert b.compile_counts() == frozen
+        assert b.tracer.unexpected_retraces() == 0
+        a.close()
+        b.close()
+
+    def test_spec_armed_artifact_round_trip(self, gpt_model, tmp_path):
+        kw = dict(spec_decode=True, spec_k=4, spec_draft="ngram")
+        a = _engine(gpt_model, **kw)
+        a.warmup(buckets=LENS, decode=True)
+        root = str(tmp_path / "store")
+        art = export_artifact(a, root)
+        with open(os.path.join(art, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert "spec_verify" in manifest["blobs"]
+        assert manifest["warmed"]["spec"]
+        prompts = _prompts(LENS, seed=2)
+        refs = a.generate(prompts, max_new_tokens=NEW_TOK)
+        b = _engine(gpt_model, **kw)
+        assert warm_boot(b, buckets=LENS,
+                         artifact_dir=root)["mode"] == "aot"
+        assert b._warmed_spec
+        assert b.generate(prompts, max_new_tokens=NEW_TOK) == refs
+        a.close()
+        b.close()
+
+    def test_bucket_top_up_is_traced_and_loud(self, gpt_model,
+                                              gpt_store):
+        # ask for a bucket the artifact does not carry: the loader
+        # installs what it has and warms the rest through the traced
+        # path — visible in compile_counts, never a wrong program
+        eng = _engine(gpt_model, max_seq_len=64)
+        info = warm_boot(eng, buckets=[*LENS, 17],
+                         artifact_dir=gpt_store["root"])
+        assert info["mode"] == "aot"
+        assert eng._bucket_for(17) in eng._warmed_buckets
+        prompts = _prompts((5, 17), seed=4)
+        refs = _greedy_ref(gpt_model, prompts, NEW_TOK)
+        assert eng.generate(prompts, max_new_tokens=NEW_TOK) == refs
+        eng.close()
+
+
+# -- load_artifact fallback matrix (no warmups — pure refusal paths) --------
+
+class TestLoadFallbackMatrix:
+    def test_missing_store(self, gpt_model, tmp_path):
+        eng = _engine(gpt_model)
+        with pytest.raises(ArtifactError) as ei:
+            load_artifact(eng, str(tmp_path / "nope"))
+        assert ei.value.reason == "missing"
+        eng.close()
+
+    def test_empty_store(self, gpt_model, tmp_path):
+        eng = _engine(gpt_model)
+        with pytest.raises(ArtifactError) as ei:
+            load_artifact(eng, str(tmp_path))
+        assert ei.value.reason == "missing"
+        eng.close()
+
+    @pytest.mark.parametrize(
+        "corrupt,reason", CORRUPTIONS,
+        ids=[f"{c.__name__[9:]}->{r}" for c, r in CORRUPTIONS])
+    def test_corruption_reasons(self, gpt_model, gpt_store, tmp_path,
+                                corrupt, reason):
+        root = _copy_store(gpt_store["root"], tmp_path / "store")
+        corrupt(root)
+        eng = _engine(gpt_model)
+        with pytest.raises(ArtifactError) as ei:
+            load_artifact(eng, root)
+        assert ei.value.reason == reason, str(ei.value)
+        # refusal before install: the engine is untouched
+        assert not eng.warmed
+        eng.close()
+
+    @pytest.mark.parametrize("kw,field", [
+        ({"steps_per_dispatch": 2}, "steps_per_dispatch"),
+        ({"page_size": 8}, "page_size"),
+        ({"max_seq_len": 48}, "max_seq_len"),
+        ({"cache_dtype": "bfloat16"}, "cache_dtype"),
+        ({"temperature": 0.9, "top_k": 5}, "sampling"),
+        ({"spec_decode": True, "spec_k": 4, "spec_draft": "ngram"},
+         "spec"),
+    ])
+    def test_stale_fingerprint_per_field(self, gpt_model, gpt_store,
+                                         kw, field):
+        # the live engine changed since export: every load-bearing
+        # field lands on stale_fingerprint and NAMES the field
+        eng = _engine(gpt_model, **kw)
+        with pytest.raises(ArtifactError) as ei:
+            load_artifact(eng, gpt_store["root"])
+        assert ei.value.reason == "stale_fingerprint"
+        assert field in str(ei.value)
+        eng.close()
+
+    def test_wrong_model_is_stale(self, llama_model, gpt_store):
+        eng = _engine(llama_model)
+        with pytest.raises(ArtifactError) as ei:
+            load_artifact(eng, gpt_store["root"])
+        assert ei.value.reason == "stale_fingerprint"
+        eng.close()
+
+    def test_expired_ttl(self, gpt_model, gpt_store):
+        eng = _engine(gpt_model)
+        with pytest.raises(ArtifactError) as ei:
+            load_artifact(eng, gpt_store["root"], ttl_s=0.0)
+        assert ei.value.reason == "expired"
+        eng.close()
+
+    def test_install_error_rolls_back_to_cold(self, gpt_model,
+                                              gpt_store, monkeypatch):
+        eng = _engine(gpt_model)
+
+        def boom(name, call):
+            raise RuntimeError("install boom")
+
+        monkeypatch.setattr(eng, "_install_aot_program", boom)
+        with pytest.raises(ArtifactError) as ei:
+            load_artifact(eng, gpt_store["root"])
+        assert ei.value.reason == "install_error"
+        # the program table is back to build-on-first-use: nothing
+        # half-installed can serve
+        assert not eng.warmed
+        assert not eng._warmed_buckets
+        eng.close()
+
+
+# -- warm_boot: the loud fallback + dormancy contracts ----------------------
+
+class TestWarmBootLadder:
+    @pytest.mark.parametrize(
+        "corrupt,reason", CORRUPTIONS,
+        ids=[f"{c.__name__[9:]}->{r}" for c, r in CORRUPTIONS])
+    def test_every_reason_is_counted(self, gpt_model, gpt_store,
+                                     tmp_path, monkeypatch, corrupt,
+                                     reason):
+        root = _copy_store(gpt_store["root"], tmp_path / "store")
+        corrupt(root)
+        eng = _engine(gpt_model)
+        calls = []
+        monkeypatch.setattr(eng, "warmup",
+                            lambda **kw: calls.append(kw))
+        info = warm_boot(eng, buckets=LENS, artifact_dir=root,
+                         export=False)
+        assert info["mode"] == "traced" and calls
+        assert _counter(eng.registry, "serve_aot_fallback_total",
+                        {"reason": reason}) == 1
+        assert eng.boot_info["mode"] == "traced"
+        eng.close()
+
+    def test_no_store_is_dormant(self, gpt_model, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_AOT_DIR", raising=False)
+        eng = _engine(gpt_model)
+        calls = []
+        monkeypatch.setattr(eng, "warmup",
+                            lambda **kw: calls.append(kw))
+        info = warm_boot(eng, buckets=LENS)
+        assert info["mode"] == "traced" and calls
+        # byte-identical metric surface: no serve_aot_* series at all
+        assert _aot_series(eng.registry) == []
+        eng.close()
+
+    def test_kill_switch_disables_everything(self, gpt_model,
+                                             gpt_store, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_AOT_ARTIFACTS", "0")
+        eng = _engine(gpt_model)
+        calls = []
+        monkeypatch.setattr(eng, "warmup",
+                            lambda **kw: calls.append(kw))
+        info = warm_boot(eng, buckets=LENS,
+                         artifact_dir=gpt_store["root"])
+        assert info["mode"] == "traced" and calls
+        assert _aot_series(eng.registry) == []
+        eng.close()
+
+    def test_env_dir_resolves_store(self, gpt_model, gpt_store,
+                                    monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_AOT_DIR", gpt_store["root"])
+        eng = _engine(gpt_model)
+        info = warm_boot(eng, buckets=LENS)
+        assert info["mode"] == "aot"
+        eng.close()
+
+    def test_export_failure_is_counted_not_fatal(self, gpt_model,
+                                                 tmp_path,
+                                                 monkeypatch):
+        # empty store -> missing fallback; the post-boot export then
+        # fails (warmup was stubbed, engine never warmed) — counted,
+        # boot survives
+        eng = _engine(gpt_model)
+        monkeypatch.setattr(eng, "warmup", lambda **kw: None)
+        info = warm_boot(eng, buckets=LENS,
+                         artifact_dir=str(tmp_path / "store"))
+        assert info["mode"] == "traced"
+        assert _counter(eng.registry, "serve_aot_fallback_total",
+                        {"reason": "missing"}) == 1
+        assert _counter(eng.registry,
+                        "serve_aot_export_failures_total") == 1
+        eng.close()
+
+    def test_boot_info_in_health(self, gpt_store):
+        h = gpt_store["engine"].health()
+        assert h["boot"] == gpt_store["engine"].boot_info
+
+
+# -- chaos: torn/stale/corrupt fleets still serve, zero lost ----------------
+
+@pytest.mark.chaos
+class TestArtifactChaos:
+    def test_crash_mid_export_boots_traced_then_republishes(
+            self, gpt_model, gpt_store, tmp_path):
+        """Kill-mid-export drill: the store holds only unmarked debris
+        (a staging dir + a renamed-but-unmarked artifact — the two
+        crash windows). Boot refuses it loudly, serves traced,
+        republishes; the NEXT boot rides the fast path."""
+        root = str(tmp_path / "store")
+        os.makedirs(root)
+        src = gpt_store["artifact"]
+        stage = os.path.join(root, ".stage-999-deadbeef-1")
+        shutil.copytree(src, stage)
+        os.remove(os.path.join(stage, "COMPLETE"))
+        unmarked = os.path.join(root, "art-deadbeef-1")
+        shutil.copytree(src, unmarked)
+        os.remove(os.path.join(unmarked, "COMPLETE"))
+
+        eng = _engine(gpt_model)
+        info = warm_boot(eng, buckets=LENS, artifact_dir=root)
+        assert info["mode"] == "traced"
+        assert _counter(eng.registry, "serve_aot_fallback_total",
+                        {"reason": "torn"}) == 1
+        # traced fallback serves token-exact
+        assert eng.generate(gpt_store["prompts"],
+                            max_new_tokens=NEW_TOK) \
+            == gpt_store["refs"]
+        # ...and republished: a marked artifact now exists
+        assert info["artifact"] is not None
+        assert has_marker(os.path.join(root, info["artifact"]))
+
+        b = _engine(gpt_model)
+        info2 = warm_boot(b, buckets=LENS, artifact_dir=root)
+        assert info2["mode"] == "aot"
+        assert b.generate(gpt_store["prompts"],
+                          max_new_tokens=NEW_TOK) == gpt_store["refs"]
+        eng.close()
+        b.close()
+
+    def test_fleet_mixed_boot_serves_token_exact_zero_lost(
+            self, gpt_model, gpt_store, tmp_path):
+        """A two-replica fleet: r0 artifact-booted, r1 booted off a
+        byte-flipped store (loud bad_checksum fallback). Every
+        request resolves exactly once, token-exact vs the traced
+        baseline — corruption costs boot time, never a token and
+        never a request."""
+        from paddle_tpu.serving_fleet import FleetRouter, \
+            InprocReplica
+        bad = _copy_store(gpt_store["root"], tmp_path / "bad")
+        _corrupt_byte_flip(bad)
+        e0 = _engine(gpt_model)
+        assert warm_boot(e0, buckets=LENS,
+                         artifact_dir=gpt_store["root"])["mode"] \
+            == "aot"
+        e1 = _engine(gpt_model)
+        assert warm_boot(e1, buckets=LENS, artifact_dir=bad,
+                         export=False)["mode"] == "traced"
+        assert _counter(e1.registry, "serve_aot_fallback_total",
+                        {"reason": "bad_checksum"}) == 1
+
+        router = FleetRouter([InprocReplica("r0", e0),
+                              InprocReplica("r1", e1)])
+        try:
+            wave = gpt_store["prompts"] * 3
+            rids = [router.submit(p, NEW_TOK) for p in wave]
+            by_rid = {r["id"]: r
+                      for r in router.run_to_completion()}
+            assert sorted(by_rid) == sorted(rids)
+            refs = gpt_store["refs"] * 3
+            assert all(by_rid[rid]["status"] == "ok"
+                       and by_rid[rid]["tokens"] == refs[i]
+                       for i, rid in enumerate(rids))
+            # the boot mode rides heartbeats into router health
+            deadline = time.monotonic() + 10
+            reps = {}
+            while time.monotonic() < deadline:
+                reps = router.health()["replicas"]
+                if all((reps[n] or {}).get("boot")
+                       for n in ("r0", "r1")):
+                    break
+                router.step()
+                time.sleep(0.01)
+            assert reps["r0"]["boot"]["mode"] == "aot"
+            assert reps["r1"]["boot"]["mode"] == "traced"
+        finally:
+            router.close()
+            e0.close()
+            e1.close()
+
+    def test_stale_fingerprint_after_config_change_reexports(
+            self, gpt_model, gpt_store, tmp_path):
+        """Config changed under a warm store: the next boot refuses
+        the old artifact (stale_fingerprint), serves traced, and
+        republishes under the NEW fingerprint — after which the
+        changed config boots aot too."""
+        root = _copy_store(gpt_store["root"], tmp_path / "store")
+        kw = dict(steps_per_dispatch=2)
+        eng = _engine(gpt_model, **kw)
+        info = warm_boot(eng, buckets=LENS, artifact_dir=root)
+        assert info["mode"] == "traced"
+        assert _counter(eng.registry, "serve_aot_fallback_total",
+                        {"reason": "stale_fingerprint"}) == 1
+        # dispatch schedule never changes tokens — the re-traced boot
+        # still serves the same streams
+        assert eng.generate(gpt_store["prompts"],
+                            max_new_tokens=NEW_TOK) \
+            == gpt_store["refs"]
+        assert info["artifact"] is not None
+        b = _engine(gpt_model, **kw)
+        assert warm_boot(b, buckets=LENS,
+                         artifact_dir=root)["mode"] == "aot"
+        eng.close()
+        b.close()
+
+
+# -- surfaces: fleet_top BOOT column ----------------------------------------
+
+class TestFleetTopBootColumn:
+    def test_render_boot_column(self):
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import importlib
+        ft = importlib.import_module("fleet_top")
+        frame = {"ts": 0, "source": "test", "rates": {}, "health": {
+            "replicas": {
+                "r0": {"state": "serving", "incarnation": 1,
+                       "boot": {"mode": "aot", "boot_s": 3.21,
+                                "artifact": "art-x-1"}},
+                "r1": {"state": "serving", "incarnation": 2,
+                       "boot": {"mode": "traced", "boot_s": 9.87,
+                                "artifact": None}},
+                # pre-artifact replica: no boot payload at all
+                "r2": {"state": "serving", "incarnation": 1}}}}
+        text = ft.render(frame)
+        assert "BOOT" in text
+        assert "aot 3.2s" in text
+        assert "traced 9.9s" in text

@@ -24,8 +24,13 @@ Shape (seeded, CPU-only, no tunnel window burned):
    engines from their adoption snapshot (a new replica takes traffic
    with zero new steady-state traces), zero unexpected retraces,
    ZERO flaps, ``scale_out``+``scale_in`` records in the journal
-   (``reconcile()["autoscale"]``), and parseable
-   ``flight_fleet_scale_out``/``flight_fleet_scale_in`` dumps;
+   (``reconcile()["autoscale"]``), parseable
+   ``flight_fleet_scale_out``/``flight_fleet_scale_in`` dumps, and —
+   r21 — the alert-to-serving latency bar: the base replica's traced
+   boot exports an AOT serving artifact, every autoscaler spawn boots
+   off it (``mode=aot``, counted in ``fleet_boots_total``), and every
+   AOT boot wall beats the traced-boot control measured on the same
+   drill;
 5. artifacts into $BENCH_TELEMETRY_DIR: ``metrics.json`` (fleet
    registry + recompile report — the validate_stages contract),
    ``health.json``, ``autoscale_events.json``, the journal dir and
@@ -89,15 +94,33 @@ def main(argv=None):
     g.close()
 
     engines = []
+    boots = []   # (wall_s, boot_info) per built engine — the AOT-vs-
+    #              traced alert-to-serving latency assertion's data
+    store = os.path.join(out_dir, "aot_store")
 
-    def build_engine():
+    def build_engine(aot=False):
         eng = ServingEngine(model, max_slots=2, page_size=16,
                             max_seq_len=64, steps_per_dispatch=4)
-        eng.warmup(buckets=sorted(set(WAVE_LENS)), decode=True)
+        t = time.monotonic()
+        if aot:
+            # the r21 scale-out spawn path: restore serialized
+            # programs from the artifact e0's traced boot exported
+            warm_boot(eng, buckets=sorted(set(WAVE_LENS)),
+                      artifact_dir=store)
+        else:
+            eng.warmup(buckets=sorted(set(WAVE_LENS)), decode=True)
+        boots.append((time.monotonic() - t, dict(eng.boot_info)))
         engines.append(eng)
         return eng
 
-    e0 = build_engine()
+    from paddle_tpu.jit.serving_artifact import export_artifact, \
+        warm_boot
+
+    # traced-boot CONTROL: e0 pays the full trace+compile wall, then
+    # exports the artifact every autoscaler spawn boots from
+    e0 = build_engine(aot=False)
+    traced_boot_s = boots[0][0]
+    export_artifact(e0, store)
     frozen0 = e0.compile_counts()
     slos = (SLObjective("ttft", "latency", target=0.99,
                         threshold_s=0.05),
@@ -110,7 +133,8 @@ def main(argv=None):
         history=True, history_interval_s=0.05, journal_dir=jdir,
         overload_target_ms=5000.0)
     asc = FleetAutoscaler(
-        router, lambda i: InprocReplica(f"as{i}", build_engine()),
+        router, lambda i: InprocReplica(f"as{i}",
+                                        build_engine(aot=True)),
         min_replicas=1, max_replicas=3,
         scale_out_cooldown_s=0.5, scale_in_cooldown_s=0.5,
         recovery_hold_s=0.75, boot_timeout_s=60.0,
@@ -172,6 +196,20 @@ def main(argv=None):
         e0.compile_counts() == frozen0 and spawned_ok
         and router.compile_report()["unexpected_retraces"] == 0)
 
+    # r21 alert-to-serving latency, asserted HARD: every autoscaler
+    # spawn must have booted off the AOT artifact (mode=aot, counted
+    # in fleet_boots_total{mode="aot"}) and every such boot must beat
+    # the traced-boot control wall measured on the SAME drill
+    aot_boots = [w for w, bi in boots[1:] if bi.get("mode") == "aot"]
+    checks["spawns_booted_aot"] = (
+        len(aot_boots) == len(boots) - 1 and len(boots) > 1)
+    mb = router.registry.get("fleet_boots_total", labels={"mode": "aot"})
+    checks["fleet_boots_aot_counted"] = (
+        mb is not None and int(mb.value) >= len(
+            [1 for _rep, fz in asc.spawned if fz is not None]) > 0)
+    checks["aot_boot_beats_traced"] = bool(aot_boots) and (
+        max(aot_boots) < traced_boot_s)
+
     # journal: the scale decisions must be durable + reconcilable
     try:
         records, _stats = replay(jdir)
@@ -218,6 +256,8 @@ def main(argv=None):
     print(json.dumps({"ok": ok, "checks": checks,
                       "requests": len(rids), "ok_results": ok_n,
                       "max_fleet_size": max_size,
+                      "traced_boot_s": round(traced_boot_s, 3),
+                      "aot_boot_s": [round(w, 3) for w in aot_boots],
                       "events": [list(e) for e in events],
                       "out_dir": out_dir}))
     return 0 if ok else 1

@@ -4,7 +4,8 @@ A terminal live view over a running FleetRouter's observability
 endpoints: one frame per interval showing the fleet headline (request
 rate, delivered tok/s, TTFT/queue-wait p99 from the history plane),
 SLO burn alerts + anomaly-sentinel excursions, the per-replica table
-(state, incarnation, queue/running, free pages, scrape age), the
+(state, incarnation, queue/running, free pages, scrape age, boot
+path aot/traced + wall clock), the
 AUTOSCALER panel (controller state + size bounds, degraded/brownout
 level with the clamped tenants, last decision + reason, per-replica
 role incl. booting/retiring members), the per-tenant heavy-hitter
@@ -155,13 +156,21 @@ def render(frame):
         reps = h.get("replicas") or {}
         if reps:
             out.append("  REPLICA     STATE     INC  Q/R    FREE_PG "
-                       "SCRAPE_AGE  FLAGS")
+                       "SCRAPE_AGE  BOOT         FLAGS")
             for name in sorted(reps):
                 row = reps[name]
                 flags = "".join(
                     f for f, on in (("L", row.get("lost")),
                                     ("Q", row.get("quarantined")))
                     if on) or "-"
+                # boot path + wall clock (r21): aot = restored from a
+                # serving artifact, traced = full trace + compile;
+                # pre-artifact replicas carry no boot dict at all
+                bi = row.get("boot") or {}
+                boot = "-" if not bi.get("mode") else (
+                    f"{bi['mode']}"
+                    + ("" if bi.get("boot_s") is None
+                       else f" {float(bi['boot_s']):.1f}s"))
                 out.append(
                     f"  {name:<11} {str(row.get('state')):<9} "
                     f"{str(row.get('incarnation')):<4} "
@@ -169,7 +178,7 @@ def render(frame):
                     f"{_fmt(row.get('running')):<4} "
                     f"{_fmt(row.get('free_pages')):<7} "
                     f"{_fmt(row.get('scrape_age_s'), 's'):<11} "
-                    f"{flags}")
+                    f"{boot:<12} {flags}")
     if h:
         asc = h.get("autoscale")
         ov = h.get("overload") or {}

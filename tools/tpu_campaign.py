@@ -257,6 +257,15 @@ STAGES = [
     # pre-traced by warmup), zero unexpected retraces.
     ("spec_smoke", [PY, "tools/spec_smoke.py"], 1800,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
+    # AOT serving-artifact boot probe (ISSUE 21, seeded): traced
+    # warmup control -> export_artifact -> warm_boot a second engine
+    # off the store. Asserts the artifact path was taken (mode=aot,
+    # zero fallbacks), token-exact generation vs the traced control,
+    # zero post-boot traces, and artifact boot wall strictly below
+    # traced. No platform pin: on the first live TPU window this IS
+    # the measured artifact-boot-vs-traced number (tunnel_watch rung).
+    ("aot_boot", [PY, "tools/aot_boot_probe.py"], 1800,
+     {"PYTHONHASHSEED": "0"}),
     ("bench_full", [PY, "bench.py"], 7200, {}),
     ("bench_resnet_s2d", [PY, "bench.py", "--model", "resnet50", "--s2d"],
      2400, {}),

@@ -428,13 +428,16 @@ _DUR01_DURABLE_FILES = {
     "paddle_tpu/observability/flightrec.py",
     "paddle_tpu/observability/history.py",
     "paddle_tpu/observability/trafficrec.py",
+    # the AOT serving-artifact store: torn StableHLO blobs or manifests
+    # feed straight into jax.export.deserialize at the next boot
+    "paddle_tpu/jit/serving_artifact.py",
 }
 _DUR01_EXEMPT = {
     # io/atomic.py IS the write-then-rename discipline
     "paddle_tpu/io/atomic.py",
 }
 _DUR01_TOKENS = ("journal", "wal-", "ckpt", "checkpoint", "flight_",
-                 "golden", ".complete")
+                 "golden", ".complete", ".stablehlo", "manifest")
 
 
 @_register(

@@ -77,8 +77,11 @@ def main():
         # ladder and the long tail of A/B stages. Kernel-arming stages
         # (bench_decode_flashk, bench_serve_flashk) stay LAST, after
         # their probes have bisected the paged/flash compile (r2 wedge).
+        # aot_boot rides just after the serving rungs: the first live
+        # window also prices artifact-boot vs traced-boot on real
+        # hardware (the r21 scale-out latency claim).
         default="bench_gpt13b_scan_cce,bench_full,"
-                "bench_serve_gpt,bench_serve_llama,bench_llama,"
+                "bench_serve_gpt,bench_serve_llama,aot_boot,bench_llama,"
                 "bench_resnet_nhwc,bench_resnet_nhwc_fused,"
                 "bench_gpt13b_scan,decode_probe,decode_probe_paged,"
                 "bench_decode,bench_decode_bf16kv,"

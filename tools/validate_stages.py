@@ -65,6 +65,10 @@ REQUIRED_STAGES = {
     # OFF, acceptance over floor, ON decode tok/s strictly above OFF,
     # zero new traces (CPU-only — ISSUE 20)
     "spec_smoke",
+    # AOT serving-artifact boot probe: artifact boot token-exact vs
+    # traced control, zero fallbacks, strictly faster (ISSUE 21; the
+    # tunnel ladder's artifact-boot-vs-traced rung)
+    "aot_boot",
 }
 
 
@@ -81,6 +85,7 @@ def _emits_metrics(cmd):
                                             "autoscale_smoke.py",
                                             "prefix_cache_smoke.py",
                                             "spec_smoke.py",
+                                            "aot_boot_probe.py",
                                             "test_fleet_serving.py",
                                             "test_fleet_recovery.py",
                                             "test_fleet_proc.py")
