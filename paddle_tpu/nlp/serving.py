@@ -220,7 +220,8 @@ class ServingEngine:
                  dispatch_retries=2, registry=None,
                  tenant_capacity=64, prefix_cache=None,
                  min_prefix_pages=None, prefix_max_entries=512,
-                 spec_decode=None, spec_k=None, spec_draft=None):
+                 spec_decode=None, spec_k=None, spec_draft=None,
+                 profile=None, profile_hz=None):
         if page_size % 8:
             raise ValueError(f"page_size must be a multiple of 8 "
                              f"(Mosaic sublane tiling), got {page_size}")
@@ -283,6 +284,11 @@ class ServingEngine:
         if spec_draft is None:
             spec_draft = os.environ.get("PADDLE_TPU_SPEC_DRAFT", "ngram")
         self.spec_draft = spec_draft
+        if profile is None:
+            profile = os.environ.get(
+                "PADDLE_TPU_PROFILE", "0").lower() in ("1", "true", "on")
+        self._profile_enabled = bool(profile)
+        self._profile_hz = profile_hz
 
         self._params, self._buffers = model.raw_state()
         self._pages = [alloc_pages(self.num_pages, self.page_size,
@@ -448,6 +454,18 @@ class ServingEngine:
         # observability.spans.export_chrome (docs/observability.md)
         from ..observability.spans import SpanRecorder
         self.spans = SpanRecorder(name="serving")
+        # continuous host sampling profiler (observability.contprof):
+        # armed via PADDLE_TPU_PROFILE / the profile ctor knob. A
+        # never-armed engine creates NO profiler object at all — the
+        # same dormancy contract prefix caching and spec decode keep,
+        # so legacy goldens stay byte-identical. Host-side only:
+        # profiling ON leaves compile counts frozen (chaos-asserted).
+        self.profiler = None
+        if self._profile_enabled:
+            from ..observability.contprof import ContinuousProfiler
+            self.profiler = ContinuousProfiler(
+                hz=self._profile_hz, registry=reg,
+                name="engine").start()
         self._exporter = None
         self._trace_counts = self.tracer._counts
         # AOT export surface: every compiled serving program's RAW
@@ -1037,10 +1055,19 @@ class ServingEngine:
         from ..observability.exporter import MetricsExporter
         if self._exporter is not None:
             self._exporter.close()
-        self._exporter = MetricsExporter(registry=self.registry,
-                                         port=port, host=host,
-                                         health_fn=self.health,
-                                         tenants_fn=self.tenants.report)
+        profile_fn = None
+        if self.profiler is not None:
+            profile_fn = lambda window: \
+                self.profiler.report(window_s=window)  # noqa: E731
+        self._exporter = MetricsExporter(
+            registry=self.registry, port=port, host=host,
+            health_fn=self.health,
+            # span-ring overflow is never silent: the /report doc
+            # carries each recorder's eviction count
+            report_fn=lambda: {"spans_evicted": {
+                self.spans.name: int(self.spans.evicted)}},
+            tenants_fn=self.tenants.report,
+            profile_fn=profile_fn)
         return self._exporter
 
     def close(self):
@@ -1081,6 +1108,8 @@ class ServingEngine:
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None
+        if self.profiler is not None:
+            self.profiler.stop()
         self.tracer.close()
         out, self._finished = self._finished, []
         return out
@@ -1097,6 +1126,11 @@ class ServingEngine:
                 ex.close()
             except Exception:  # noqa: BLE001 — finalizer safety
                 pass
+        pr = getattr(self, "profiler", None)
+        if pr is not None:
+            # signal only (the _watchdog convention): joining the
+            # sampler thread from a finalizer can deadlock shutdown
+            pr._stop.set()
         tr = getattr(self, "tracer", None)
         if tr is not None:
             # an engine retired without close() must not pin a live
@@ -1171,6 +1205,11 @@ class ServingEngine:
                              int(self._m_spec_dispatches.value),
                          "acceptance_rate":
                              round(acc / prop, 6) if prop else None}
+        if self.profiler is not None:
+            # bounded per-phase hotspot digest riding the heartbeat:
+            # the fleet router folds samples/dropped deltas into
+            # fleet_profile_* and rolls the tables up in health()
+            h["profile"] = self.profiler.digest()
         if self._watchdog is not None:
             h["watchdog"] = dict(self._watchdog.health(),
                                  wedge_count=int(self._m_wedges.value))
@@ -1728,7 +1767,8 @@ class ServingEngine:
         # order — admission order — is the only thing the stream
         # depends on, so replay and failover reproduce it exactly.
         self._rng, sub = jax.random.split(self._rng)
-        hit = self._prefix_lookup(req)
+        with self._phase("prefix_admit"):
+            hit = self._prefix_lookup(req)
         if hit is not None:
             tok, pages, shared, t_post = self._prefill_hit(
                 b, req, need_pages, hit, sub)
@@ -1788,13 +1828,14 @@ class ServingEngine:
 
         fn = self._prefill_fn(bucket)
         t_pre = time.perf_counter()
-        with self._watch(f"prefill_{bucket}"):
-            tok, new_pages, dense_kv = fn(
-                self._params, self._buffers, self._pages,
-                jnp.asarray(ids), jnp.int32(lp), jnp.asarray(pages_vec),
-                key)
-        self._pages = new_pages
-        tok = int(tok)  # host sync: the first token exists NOW
+        with self._phase(f"prefill_{bucket}"):
+            with self._watch(f"prefill_{bucket}"):
+                tok, new_pages, dense_kv = fn(
+                    self._params, self._buffers, self._pages,
+                    jnp.asarray(ids), jnp.int32(lp),
+                    jnp.asarray(pages_vec), key)
+            self._pages = new_pages
+            tok = int(tok)  # host sync: the first token exists NOW
         self._m_ttft.observe(time.monotonic() - req.submitted_at)
         # the int(tok) sync above bounds the span at real prefill work
         self.spans.add(f"prefill_{bucket}", t_pre, tid=f"req{req.rid}",
@@ -1824,7 +1865,8 @@ class ServingEngine:
                 out.append((k, v))
             return out
 
-        shared = self._prefix_register(req, pages, kv_dense)
+        with self._phase("prefix_admit"):
+            shared = self._prefix_register(req, pages, kv_dense)
         return tok, pages, shared, t_post
 
     def _prefill_hit(self, b, req, need_pages, hit, key):
@@ -1854,13 +1896,14 @@ class ServingEngine:
 
         fn = self._tail_prefill_fn(tb)
         t_pre = time.perf_counter()
-        with self._watch(f"tail_prefill_{tb}"):
-            tok, new_pages, tail_kv = fn(
-                self._params, self._buffers, self._pages, kpre, vpre,
-                jnp.asarray(ids), jnp.int32(cached), jnp.int32(tail),
-                jnp.asarray(pages_vec), key)
-        self._pages = new_pages
-        tok = int(tok)  # host sync: the first token exists NOW
+        with self._phase(f"prefill_{tb}"):
+            with self._watch(f"tail_prefill_{tb}"):
+                tok, new_pages, tail_kv = fn(
+                    self._params, self._buffers, self._pages, kpre,
+                    vpre, jnp.asarray(ids), jnp.int32(cached),
+                    jnp.int32(tail), jnp.asarray(pages_vec), key)
+            self._pages = new_pages
+            tok = int(tok)  # host sync: the first token exists NOW
         self._m_ttft.observe(time.monotonic() - req.submitted_at)
         self.spans.add(f"tail_prefill_{tb}", t_pre,
                        tid=f"req{req.rid}", cat="serve",
@@ -1902,7 +1945,8 @@ class ServingEngine:
                         for (ek, ev), (kt, vt)
                         in zip(entry.kv, tail_kv)]
 
-            shared |= self._prefix_register(req, pages, kv_dense)
+            with self._phase("prefix_admit"):
+                shared |= self._prefix_register(req, pages, kv_dense)
         return tok, pages, shared, t_post
 
     def _watch(self, op):
@@ -1913,7 +1957,26 @@ class ServingEngine:
             return contextlib.nullcontext()
         return self._watchdog.watch(op)
 
+    def _phase(self, name):
+        """Serving-phase marker for the continuous profiler
+        (observability.contprof) — nullcontext when no profiler is
+        armed, the _watch idiom. One GIL-atomic dict write per
+        boundary; the sampler tags every stack it takes from this
+        thread with the innermost open phase."""
+        import contextlib
+        if self.profiler is None:
+            return contextlib.nullcontext()
+        from ..observability import contprof
+        return contprof.phase(name)
+
     def _dispatch_decode(self):
+        # the phase covers the WHOLE dispatch — device call AND the
+        # host-side sync + slot bookkeeping after it (which the
+        # watchdog window deliberately excludes)
+        with self._phase("decode"):
+            self._dispatch_decode_impl()
+
+    def _dispatch_decode_impl(self):
         emitted_before = self._emitted.copy()
         t0 = time.perf_counter()
         if self._dev_sched is None:
@@ -1991,6 +2054,10 @@ class ServingEngine:
                 slot.out_tokens.extend(int(t) for t in toks[:n, b])
 
     def _dispatch_spec(self):
+        with self._phase("spec_verify"):
+            self._dispatch_spec_impl()
+
+    def _dispatch_spec_impl(self):
         """One speculative decode round: the proposer drafts spec_k
         tokens per slot, the folded verify program scores all spec_k+1
         positions in ONE dispatch, and the host commits the longest

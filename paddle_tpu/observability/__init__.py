@@ -4,14 +4,16 @@ spans, distributed tracing, SLO burn-rate accounting, crash flight
 recorder) — docs/observability.md.
 
 Layering: ``metrics``, ``telemetry``, ``exporter``, ``spans``,
-``dtrace``, ``slo``, ``flightrec``, ``history``, ``tenancy``,
-``trafficrec`` and ``sentinel`` are pure stdlib (importable from the
-jax-free bench orchestrator and worker processes); ``trace`` and
-``introspect`` import jax lazily inside the wrapping calls.
+``contprof``, ``dtrace``, ``slo``, ``flightrec``, ``history``,
+``tenancy``, ``trafficrec`` and ``sentinel`` are pure stdlib
+(importable from the jax-free bench orchestrator and worker
+processes); ``trace`` and ``introspect`` import jax lazily inside
+the wrapping calls.
 """
-from . import (dtrace, exporter, flightrec, history,  # noqa: F401
-               introspect, metrics, sentinel, slo, spans, telemetry,
-               tenancy, trace, trafficrec)
+from . import (contprof, dtrace, exporter, flightrec,  # noqa: F401
+               history, introspect, metrics, sentinel, slo, spans,
+               telemetry, tenancy, trace, trafficrec)
+from .contprof import ContinuousProfiler  # noqa: F401
 from .dtrace import TraceStore, get_store  # noqa: F401
 from .exporter import MetricsExporter, serve_metrics  # noqa: F401
 from .flightrec import FlightRecorder  # noqa: F401
@@ -38,7 +40,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "resolve_peak_flops", "HistoryStore", "AnomalySentinel",
            "SpaceSavingSketch", "TenantAccountant",
            "TrafficRecorder", "load_archive",
+           "ContinuousProfiler",
            "metrics", "telemetry", "trace",
-           "introspect", "exporter", "spans", "dtrace", "slo",
-           "flightrec", "history", "sentinel", "tenancy",
+           "introspect", "exporter", "spans", "contprof", "dtrace",
+           "slo", "flightrec", "history", "sentinel", "tenancy",
            "trafficrec"]

@@ -80,12 +80,29 @@ class MetricsExporter:
     tenants_fn: zero-arg callable serving ``/tenants`` (the
         TenantAccountant report: top-K heavy hitters + exact totals).
         None disables the endpoint.
+    profile_fn: one-arg callable serving ``/profile?window=S`` — the
+        continuous profiler's report (folded stacks + per-phase
+        digest) over the last S seconds (None = since start); return
+        None when no profiler is armed -> 404. None disables the
+        endpoint (ServingEngine/FleetRouter wire their
+        ContinuousProfiler here, the /traces attach-point pattern).
     host/port: bind address; port 0 = ephemeral (read .port after).
+
+    Every route observes its own wall time into the per-route
+    ``exporter_scrape_seconds`` histogram: a slow ``/metrics`` render
+    stretches the history plane's scrape cadence and skews rate()
+    windows, so scrape latency is itself a first-class series. The
+    ``/metrics`` route measures a throwaway render FIRST, observes it,
+    then serves a fresh render — so the served exposition already
+    contains the observation and stays byte-identical to a subsequent
+    in-process ``to_prometheus()`` (the telemetry_smoke parity
+    contract).
     """
 
     def __init__(self, registry=None, port=0, host="127.0.0.1",
                  health_fn=None, report_fn=None, traces_fn=None,
-                 history_fn=None, tenants_fn=None, requests_fn=None):
+                 history_fn=None, tenants_fn=None, requests_fn=None,
+                 profile_fn=None):
         if registry is None:
             from .metrics import get_registry
             registry = get_registry()
@@ -96,6 +113,8 @@ class MetricsExporter:
         self.history_fn = history_fn
         self.tenants_fn = tenants_fn
         self.requests_fn = requests_fn
+        self.profile_fn = profile_fn
+        self._scrape_hists = {}
         self._started = time.time()
         exporter = self
 
@@ -125,8 +144,16 @@ class MetricsExporter:
             def do_GET(self):  # noqa: N802 — http.server API
                 parts = self.path.split("?", 1)
                 path = parts[0].rstrip("/") or "/"
+                seg = "/" + path.split("/")[1] if path != "/" else "/"
+                t0 = time.perf_counter()
                 try:
                     if path == "/metrics":
+                        # double render: measure + observe FIRST, then
+                        # serve a fresh exposition that already holds
+                        # the observation (byte-parity contract above)
+                        exporter.registry.to_prometheus()
+                        exporter._observe_scrape(
+                            "/metrics", time.perf_counter() - t0)
                         self._send(200, exporter.registry.to_prometheus(),
                                    "text/plain; version=0.0.4; "
                                    "charset=utf-8")
@@ -176,6 +203,26 @@ class MetricsExporter:
                     elif exporter.tenants_fn is not None \
                             and path == "/tenants":
                         self._send_json(exporter.tenants_fn())
+                    elif exporter.profile_fn is not None \
+                            and path == "/profile":
+                        from urllib.parse import parse_qs
+                        params = {k: v[-1] for k, v in parse_qs(
+                            parts[1] if len(parts) > 1 else ""
+                            ).items()}
+                        window = None
+                        if params.get("window"):
+                            try:
+                                window = float(params["window"])
+                            except ValueError:
+                                window = None
+                        doc = exporter.profile_fn(window)
+                        if doc is None:
+                            self._send_json(
+                                {"error": "no profiler armed "
+                                          "(PADDLE_TPU_PROFILE=1)"},
+                                code=404)
+                        else:
+                            self._send_json(doc)
                     else:
                         endpoints = ["/metrics", "/healthz", "/report"]
                         if exporter.traces_fn is not None:
@@ -186,6 +233,8 @@ class MetricsExporter:
                             endpoints.append("/history")
                         if exporter.tenants_fn is not None:
                             endpoints.append("/tenants")
+                        if exporter.profile_fn is not None:
+                            endpoints.append("/profile")
                         self._send_json(
                             {"error": f"unknown path {path!r}",
                              "endpoints": endpoints}, code=404)
@@ -196,6 +245,10 @@ class MetricsExporter:
                                                   f"{e}"}, code=500)
                     except OSError:
                         pass
+                finally:
+                    if seg != "/metrics":
+                        exporter._observe_scrape(
+                            seg, time.perf_counter() - t0)
 
         Handler.protocol_version = "HTTP/1.1"
         # a close()d exporter's port rebinds immediately (no TIME_WAIT
@@ -214,6 +267,22 @@ class MetricsExporter:
     @property
     def url(self):
         return f"http://{self.host}:{self.port}"
+
+    def _observe_scrape(self, route, dur_s):
+        """Per-route scrape-latency self-metric. Never raises — a
+        telemetry bug must not turn a scrape into a 500."""
+        try:
+            h = self._scrape_hists.get(route)
+            if h is None:
+                h = self._scrape_hists[route] = self.registry.histogram(
+                    "exporter_scrape_seconds",
+                    help="wall seconds serving one exporter route "
+                         "(slow renders stretch scrape cadence and "
+                         "skew rate() windows)",
+                    labels={"route": route})
+            h.observe(dur_s)
+        except Exception:   # noqa: BLE001
+            pass
 
     def _health(self):
         doc = {"status": "ok", "ts": round(time.time(), 6),
@@ -263,11 +332,13 @@ class MetricsExporter:
 
 def serve_metrics(port=0, registry=None, host="127.0.0.1",
                   health_fn=None, report_fn=None, traces_fn=None,
-                  history_fn=None, tenants_fn=None, requests_fn=None):
+                  history_fn=None, tenants_fn=None, requests_fn=None,
+                  profile_fn=None):
     """Start a MetricsExporter (the one-call attach the docs show);
     returns it — read ``.port`` / ``.url``, call ``.close()``."""
     return MetricsExporter(registry=registry, port=port, host=host,
                            health_fn=health_fn, report_fn=report_fn,
                            traces_fn=traces_fn, history_fn=history_fn,
                            tenants_fn=tenants_fn,
-                           requests_fn=requests_fn)
+                           requests_fn=requests_fn,
+                           profile_fn=profile_fn)

@@ -59,6 +59,10 @@ _lock = threading.Lock()
 _sites = {}            # (tracer_name, site) -> capture dict
 _skipped = {}          # (tracer_name, site) -> reason str
 _introspecting = threading.local()
+# thread ids currently inside a replay, readable from OTHER threads:
+# the continuous profiler (contprof.py) skips them so an AOT replay
+# never pollutes a serving profile. set.add/discard are GIL-atomic.
+_introspecting_threads = set()
 
 
 def enabled():
@@ -192,6 +196,7 @@ def capture_site(tracer_name, site, jitted, args, kwargs, wall_s=0.0,
                              f"PADDLE_TPU_INTROSPECT_MAX_S budget")
         return None
     _introspecting.on = True
+    _introspecting_threads.add(threading.get_ident())
     try:
         compiled = jitted.lower(*args, **kwargs).compile()
         cost = normalize_cost(compiled.cost_analysis())
@@ -202,6 +207,7 @@ def capture_site(tracer_name, site, jitted, args, kwargs, wall_s=0.0,
         return None
     finally:
         _introspecting.on = False
+        _introspecting_threads.discard(threading.get_ident())
     entry = {"tracer": tracer_name, "site": site,
              "ts": round(time.time(), 6),
              "flops": (cost or {}).get("flops"),

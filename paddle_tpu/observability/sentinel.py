@@ -349,6 +349,15 @@ class AnomalySentinel:
                 extra["recent"] = self.history.query(
                     series, t0=ts - 4 * float(sig.get("window_s", 5.0)),
                     t1=ts, res="raw", limit=64)
+            # what the host was actually DOING when the signal tripped:
+            # the continuous profiler's last ~minute of folded stacks
+            # (None when no profiler is armed in this process); its
+            # absence must never cost the dump itself
+            try:
+                from . import contprof
+                extra["profile"] = contprof.current_profile()
+            except ImportError:  # standalone file-load (bench._obs_mod)
+                pass
             flightrec.dump("fleet_anomaly", extra=extra)
         except Exception:  # noqa: BLE001
             pass

@@ -77,6 +77,10 @@ class SpanRecorder:
         self._events = deque(maxlen=maxlen)
         self._lock = threading.Lock()
         self._lanes = {}           # lane name -> int tid
+        # ring-overflow accounting: "dropped is visible, never
+        # silent" — the count surfaces in export_chrome metadata and
+        # the exporter /report, like trace-store and capture drops
+        self.evicted = 0
 
     @staticmethod
     def now():
@@ -104,6 +108,8 @@ class SpanRecorder:
               "tid": tid, "args": dict(args or {})}
         with self._lock:
             self._lane(tid)
+            if len(self._events) == self._events.maxlen:
+                self.evicted += 1
             self._events.append(ev)
         return ev
 
@@ -116,6 +122,8 @@ class SpanRecorder:
               "tid": tid, "args": dict(args or {})}
         with self._lock:
             self._lane(tid)
+            if len(self._events) == self._events.maxlen:
+                self.evicted += 1
             self._events.append(ev)
         return ev
 
@@ -177,7 +185,10 @@ def export_chrome(path, recorders):
     for i, rec in enumerate(recorders):
         events.extend(rec.to_chrome(pid=i + 1))
     events.sort(key=lambda e: (e.get("ts", 0), e.get("ph") != "M"))
-    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    doc = {"traceEvents": events, "displayTimeUnit": "ms",
+           "metadata": {"evicted_spans": {
+               rec.name: int(getattr(rec, "evicted", 0))
+               for rec in recorders}}}
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     tmp = path + ".tmp"

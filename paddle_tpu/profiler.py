@@ -183,6 +183,33 @@ class Profiler:
     def steps(self):
         return self._steps
 
+    def export_flamegraph(self, path, window_s=None):
+        """Render the process's CONTINUOUS profile (the always-on host
+        sampling profiler, observability.contprof) as a self-contained
+        flamegraph HTML at ``path`` — the Profiler is the user-facing
+        surface, so the bridge lives here next to summary(). Falls
+        back to a regions-only flamegraph built from this profiler's
+        own timed aggregates when no continuous profiler is running
+        (one frame per region, weighted by total seconds in ms), so
+        the method always produces a viewable artifact. Returns the
+        path."""
+        from .observability import contprof
+        pr = contprof.active_profiler()
+        if pr is not None:
+            return pr.flamegraph_html(path, window_s=window_s,
+                                      title="paddle_tpu host profile")
+        tmp = contprof.ContinuousProfiler(name="regions")
+        with tmp._lock:
+            for n, s in self._events.items():
+                if n.startswith("__"):
+                    continue
+                w = max(int(s.total * 1e3), 1)  # weight = total ms
+                tmp._root[1]["region:" + n] = [w, {}]
+                tmp._nodes += 1
+                tmp.samples += w
+        return tmp.flamegraph_html(path,
+                                   title="paddle_tpu profiler regions")
+
 
 class RecordEvent:
     """ref: paddle.profiler.RecordEvent context manager."""

@@ -51,6 +51,7 @@ seam.
 from __future__ import annotations
 
 import collections
+import contextlib
 import os
 import time
 
@@ -261,7 +262,8 @@ class FleetRouter:
                  placement_weights=None,
                  overload_target_ms=2000.0, overload_interval_s=1.0,
                  brownout_max_new=4, brownout_levels=3,
-                 brownout_step_s=2.0):
+                 brownout_step_s=2.0,
+                 profile=None, profile_hz=None):
         self.replicas = {}
         self._clients = {}
         self._transport_retries = int(transport_retries)
@@ -526,6 +528,37 @@ class FleetRouter:
         self._spec_seen = {}     # name -> last folded spec stats
         self._m_spec_drafted = {}
         self._m_spec_acc = {}
+        # -- continuous profiling plane (observability.contprof): the
+        # router samples its OWN control loop (placement/journal
+        # phases) when armed, and folds every replica heartbeat's
+        # profile digest into a fleet hotspot rollup (health()) plus
+        # fleet_profile_* counters — the same restart-tolerant
+        # delta-fold discipline as the prefix/spec sections above.
+        self._m_profile = {
+            "samples": reg.counter(
+                "fleet_profile_samples_total",
+                help="host stack samples folded across replica "
+                     "continuous profilers (from heartbeats)"),
+            "dropped": reg.counter(
+                "fleet_profile_samples_dropped_total",
+                help="replica profile samples truncated at the "
+                     "profile-trie node bound — caps are never "
+                     "silent"),
+            "backoffs": reg.counter(
+                "fleet_profile_backoffs_total",
+                help="replica profiler Hz halvings taken to stay "
+                     "under the overhead cap")}
+        self._profile_seen = {}     # name -> last folded stat values
+        self._profile_digests = {}  # name -> last heartbeat digest
+        if profile is None:
+            profile = os.environ.get(
+                "PADDLE_TPU_PROFILE", "0").lower() in ("1", "true",
+                                                       "on")
+        self.profiler = None
+        if profile:
+            from ..observability.contprof import ContinuousProfiler
+            self.profiler = ContinuousProfiler(
+                hz=profile_hz, registry=reg, name="router").start()
 
     def _new_client(self, rep):
         seed = self._next_client_seed
@@ -965,7 +998,48 @@ class FleetRouter:
                 "autoscale": None if asc is None else asc.snapshot(),
                 "tenants": None if self.tenants is None else {
                     "tracked": self.tenants.tracked},
+                # fleet hotspot rollup off cached heartbeat digests
+                # (plus the router's own profiler when armed) — cheap
+                # dict folds only, same HTTP-thread discipline
+                "profile": self._profile_health(),
                 "compile_report": self.compile_report()}
+
+    def _profile_health(self):
+        """Fleet hotspot rollup for the health snapshot: per-phase
+        sample shares summed across the cached replica heartbeat
+        digests (_fold_profile keeps them fresh), merged top frames,
+        and per-replica host duty (HOST% = 100*(1-idle share) — how
+        much of the host's sampled time was NOT idle). Cached-read
+        only: health() also runs on HTTP threads."""
+        digests = dict(self._profile_digests)
+        if self.profiler is None and not digests:
+            return None
+        phases = {}
+        frames = {}
+        per_replica = {}
+        for name, dg in digests.items():
+            for ph, n in (dg.get("phases") or {}).items():
+                phases[ph] = phases.get(ph, 0) + int(n)
+            for rows in (dg.get("top") or {}).values():
+                for fr, n in rows:
+                    frames[fr] = frames.get(fr, 0) + int(n)
+            total = sum(int(n) for n in (dg.get("phases")
+                                         or {}).values())
+            idle = int((dg.get("phases") or {}).get("idle", 0))
+            per_replica[name] = {
+                "samples": int(dg.get("samples") or 0),
+                "dropped": int(dg.get("dropped") or 0),
+                "overhead_ratio": dg.get("overhead_ratio"),
+                "hz": dg.get("hz"),
+                "host_pct": (None if not total else
+                             round(100.0 * (1.0 - idle / total), 1))}
+        out = {"phases": phases,
+               "top": dict(sorted(frames.items(),
+                                  key=lambda kv: -kv[1])[:8]),
+               "replicas": per_replica}
+        if self.profiler is not None:
+            out["router"] = self.profiler.digest()
+        return out
 
     def _anomaly_health(self):
         """Sentinel rollup for the health snapshot — same shape and
@@ -1108,7 +1182,9 @@ class FleetRouter:
             history_fn=None if self.history is None
             else self._history_endpoint,
             tenants_fn=None if self.tenants is None
-            else self.tenants.report)
+            else self.tenants.report,
+            profile_fn=None if self.profiler is None
+            else (lambda window: self.profiler.report(window_s=window)))
         return self._exporter
 
     def _history_endpoint(self, params):
@@ -1168,6 +1244,8 @@ class FleetRouter:
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None
+        if self.profiler is not None:
+            self.profiler.stop()
 
     # -- control-plane internals --------------------------------------------
 
@@ -1542,6 +1620,29 @@ class FleetRouter:
                     else min(prev, delay)
                 self._fold_prefix(name, snap)
                 self._fold_spec(name, snap)
+                self._fold_profile(name, snap)
+
+    def _fold_profile(self, name, snap):
+        """Harvest one heartbeat's continuous-profiler digest: cache
+        the per-phase hotspot tables for the health() rollup and
+        delta-fold the engine-monotonic sample stats into the
+        fleet_profile_* counters (same restart tolerance as
+        _fold_spec — a backwards value means the engine restarted,
+        fold the new absolute, never a negative delta)."""
+        pf = snap.get("profile")
+        if not pf:
+            self._profile_seen.pop(name, None)
+            self._profile_digests.pop(name, None)
+            return
+        self._profile_digests[name] = pf
+        seen = self._profile_seen.setdefault(name, {})
+        for stat, ctr in self._m_profile.items():
+            v = int(pf.get(stat) or 0)
+            last = seen.get(stat, 0)
+            d = v - last if v >= last else v
+            seen[stat] = v
+            if d > 0:
+                ctr.inc(d)
 
     def _fold_spec(self, name, snap):
         """Harvest one heartbeat's speculative-decoding section into
@@ -1762,7 +1863,22 @@ class FleetRouter:
             args={"retries": client.stats.retries - retries0})
         return True, leg
 
+    def _phase(self, name):
+        """Serving-phase marker for the continuous profiler (no-op
+        nullcontext when the router is not armed): samples taken on
+        the control thread inside the block attribute to `name`."""
+        if self.profiler is None:
+            return contextlib.nullcontext()
+        from ..observability import contprof
+        return contprof.phase(name)
+
     def _place(self):
+        # thin phase wrapper: host stack samples taken while the
+        # placement loop runs attribute to the `placement` phase
+        with self._phase("placement"):
+            self._place_impl()
+
+    def _place_impl(self):
         if not self._queue or self._unscraped():
             return
         outstanding = self._outstanding()
@@ -2159,12 +2275,16 @@ class FleetRouter:
         and the fleet health rollup attached (never raises — a
         postmortem write must not take the router down)."""
         try:
-            from ..observability import flightrec
+            from ..observability import contprof, flightrec
             flightrec.note(tag, **{k: v for k, v in extra.items()
                                    if not isinstance(v, dict)})
             flightrec.dump(tag, extra=dict(
                 extra, fleet_registry=self._registry_snapshot(),
-                fleet_health=self.health()))
+                fleet_health=self.health(),
+                # what was the PROCESS actually doing when the
+                # anomaly tripped — the last ~minute of host stacks
+                # (None when no profiler is armed in-process)
+                profile=contprof.current_profile()))
         except Exception:  # noqa: BLE001
             pass
 
@@ -2181,6 +2301,12 @@ class FleetRouter:
         FIRST record, so submit() appends directly.) JournalCrash
         propagates — the router is dead at that write, which is the
         point of the seam."""
+        # profiler phase: journal fsync stalls show up as `journal`
+        # samples, not smeared into whatever phase enclosed the append
+        with self._phase("journal"):
+            return self._jappend_impl(kind, **fields)
+
+    def _jappend_impl(self, kind, **fields):
         if self._journal is None:
             return True
         if self._jbacklog:
@@ -2201,6 +2327,10 @@ class FleetRouter:
         unblocks the replica-side ack for its result."""
         if self._journal is None or not self._jbacklog:
             return
+        with self._phase("journal"):
+            self._flush_jbacklog_impl()
+
+    def _flush_jbacklog_impl(self):
         backlog, self._jbacklog = self._jbacklog, []
         for i, (kind, fields) in enumerate(backlog):
             try:

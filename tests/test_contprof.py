@@ -1,0 +1,631 @@
+"""Continuous profiling plane (ISSUE 22; observability/contprof.py).
+
+Pins the round-22 contracts (docs/observability.md "Continuous
+profiling"):
+
+- serving-phase markers are GIL-atomic thread-local tags: set/clear,
+  cross-thread reads, and re-entrant nesting (journal inside placement
+  restores placement on exit);
+- the sampler attributes a busy thread's stacks to its marked phase
+  and SKIPS threads inside an ``introspecting()`` AOT replay;
+- caps are never silent: the stack-trie node bound keeps the sample's
+  weight at the deepest existing node and counts the truncation, and
+  the overhead EWMA deterministically halves Hz above the 1% cap
+  (floor at min_hz, every step counted) — ``_note_duty`` is exercised
+  directly, no real sampling needed;
+- folded persistence is torn-tolerant at EVERY byte offset (a crash
+  mid-write loses at most the tail line, never raises) and the
+  flamegraph HTML's embedded JSON parses back out even when a frame
+  label contains ``</script>``;
+- profile ON leaves an engine's compile counts frozen, serves
+  ``/profile`` over the live exporter (which then self-times in
+  ``exporter_scrape_seconds``), and rides health(); a never-armed
+  engine creates NO profiler and registers NO profile_* series;
+- the router delta-folds heartbeat digests into fleet_profile_*
+  (restart-reset-safe, the _fold_spec idiom) and rolls hotspots up in
+  health()["profile"]; fleet_top renders the HOST% column off it;
+- span-ring overflow is counted and exported (export_chrome metadata);
+- tools/profile_diff.py gates share drift in BOTH directions and
+  fails vacuous comparisons;
+- Profiler.export_flamegraph bridges to the active continuous
+  profiler, falling back to a regions-only flame.
+"""
+import importlib
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+from paddle_tpu.nlp.serving import ServingEngine
+from paddle_tpu.observability import contprof
+from paddle_tpu.observability.contprof import ContinuousProfiler
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.observability.spans import SpanRecorder, export_chrome
+from paddle_tpu.serving_fleet import FleetRouter, InprocReplica
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+_SCRIPT_RE = re.compile(
+    r'<script id="profile-data" type="application/json">(.*?)</script>',
+    re.S)
+
+
+@pytest.fixture(scope="module")
+def gpt_model():
+    paddle.seed(0)
+    m = GPTForCausalLM(_resolve_config("gpt-tiny"))
+    m.eval()
+    return m
+
+
+def _prompts(lens, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (n,)).astype(np.int32) for n in lens]
+
+
+# -- phase markers ---------------------------------------------------------
+
+
+class TestPhaseMarkers:
+    def test_set_clear_and_default(self):
+        assert contprof.current_phase() is None
+        contprof.set_phase("decode")
+        try:
+            assert contprof.current_phase() == "decode"
+        finally:
+            contprof.set_phase(None)
+        assert contprof.current_phase() is None
+
+    def test_context_reentrant_restores_outer(self):
+        with contprof.phase("placement"):
+            assert contprof.current_phase() == "placement"
+            with contprof.phase("journal"):
+                assert contprof.current_phase() == "journal"
+            # the journal append inside placement goes BACK to
+            # placement, not to unmarked
+            assert contprof.current_phase() == "placement"
+        assert contprof.current_phase() is None
+
+    def test_cross_thread_read_by_tid(self):
+        ready = threading.Event()
+        release = threading.Event()
+        tid_box = []
+
+        def worker():
+            tid_box.append(threading.get_ident())
+            with contprof.phase("spec_verify"):
+                ready.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        assert ready.wait(5.0)
+        try:
+            # the sampler's exact read path: marker of ANOTHER thread
+            assert contprof.current_phase(tid_box[0]) == "spec_verify"
+        finally:
+            release.set()
+            t.join(5.0)
+        assert contprof.current_phase(tid_box[0]) is None
+
+
+# -- live sampler ----------------------------------------------------------
+
+
+def _busy(stop, phase_name):
+    with contprof.phase(phase_name):
+        while not stop.is_set():
+            sum(i * i for i in range(200))
+
+
+class TestSampler:
+    def test_busy_thread_attributed_to_phase(self):
+        pr = ContinuousProfiler(hz=200.0, name="t-sampler").start()
+        stop = threading.Event()
+        t = threading.Thread(target=_busy, args=(stop, "decode"),
+                             daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if pr.digest()["phases"].get("decode", 0) >= 3:
+                    break
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            t.join(5.0)
+            pr.stop()
+        dg = pr.digest()
+        assert dg["phases"].get("decode", 0) >= 3
+        # the digest carries a per-phase leaf table for decode
+        assert dg["top"]["decode"]
+        # and the folded stacks are phase-rooted and walk through the
+        # busy-loop's frame (the LEAF is often its inner genexpr — the
+        # trie holds the whole stack)
+        assert any(k.startswith("phase:decode;") and "_busy" in k
+                   for k in pr.fold())
+
+    def test_introspecting_thread_suppressed(self):
+        from paddle_tpu.observability import introspect
+        stop = threading.Event()
+        tid_box = []
+
+        def worker():
+            tid_box.append(threading.get_ident())
+            _busy(stop, "introtest")
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while not tid_box:
+            time.sleep(0.005)
+        # publish the worker as an AOT-replay thread the way
+        # introspecting() does BEFORE any sampling starts — the
+        # sampler must skip it entirely
+        introspect._introspecting_threads.add(tid_box[0])
+        pr = ContinuousProfiler(hz=200.0, name="t-intro").start()
+        try:
+            time.sleep(0.25)
+            assert pr.digest()["phases"].get("introtest", 0) == 0
+        finally:
+            introspect._introspecting_threads.discard(tid_box[0])
+            stop.set()
+            t.join(5.0)
+            pr.stop()
+
+
+# -- caps: trie bound + overhead backoff (deterministic, no threads) -------
+
+
+class TestCapsNeverSilent:
+    def test_trie_node_bound_counts_drops(self):
+        reg = MetricsRegistry()
+        pr = ContinuousProfiler(hz=19.0, registry=reg, name="t-bound",
+                                max_nodes=8)
+        with pr._lock:
+            for i in range(50):
+                pr._insert("decode", (f"m.f{i}", f"m.g{i}"))
+        assert pr.dropped > 0
+        assert int(reg.get("profile_samples_dropped_total").value) \
+            == pr.dropped
+        # truncation keeps the weight at the deepest existing node:
+        # every insert still lands somewhere
+        assert sum(pr.fold().values()) == 50
+
+    def test_overhead_backoff_halves_to_floor(self):
+        reg = MetricsRegistry()
+        pr = ContinuousProfiler(hz=16.0, registry=reg, name="t-duty",
+                                overhead_cap=0.01, min_hz=1.0)
+        period = 1.0 / 16.0
+        # one full-period sample seeds the EWMA at ratio 1.0 — way
+        # over the 1% cap: Hz halves, the ratio is halved with it
+        pr._note_duty(period)
+        assert pr.hz == 8.0
+        assert pr.backoffs == 1
+        assert pr.overhead_ratio == pytest.approx(0.5)
+        assert reg.get("profile_hz").value == 8.0
+        # keep feeding saturated samples: the ladder walks down but
+        # NEVER below min_hz, and every step is counted
+        for _ in range(32):
+            pr._note_duty(1.0)
+        assert pr.hz == 1.0
+        assert pr.backoffs == 4          # 16 -> 8 -> 4 -> 2 -> 1
+        assert int(reg.get("profile_backoffs_total").value) == 4
+        b = pr.backoffs
+        pr._note_duty(1.0)
+        assert pr.hz == 1.0 and pr.backoffs == b
+        # cheap samples decay the EWMA back under the cap
+        for _ in range(200):
+            pr._note_duty(0.0)
+        assert pr.overhead_ratio < pr.overhead_cap
+
+    def test_duty_gauge_tracks_ewma(self):
+        reg = MetricsRegistry()
+        pr = ContinuousProfiler(hz=16.0, registry=reg, name="t-g",
+                                overhead_cap=0.5)
+        pr._note_duty(0.25 / 16.0)       # ratio 0.25, under the cap
+        assert reg.get("profile_overhead_ratio").value \
+            == pytest.approx(pr.overhead_ratio)
+        assert pr.backoffs == 0 and pr.hz == 16.0
+
+
+# -- folded persistence ----------------------------------------------------
+
+
+def _populated(name="t-fold"):
+    pr = ContinuousProfiler(hz=19.0, name=name)
+    with pr._lock:
+        pr._insert("decode", ("mod.outer", "mod.inner"))
+        pr._insert("decode", ("mod.outer", "mod.inner"))
+        pr._insert("decode", ("mod.outer",))
+        pr._insert("prefill_32", ("mod.prefill",))
+        pr._insert("idle", ())
+        pr.samples = 5
+    return pr
+
+
+class TestFoldedPersistence:
+    def test_save_load_roundtrip(self, tmp_path):
+        pr = _populated()
+        p = str(tmp_path / "a.folded")
+        pr.save(p)
+        loaded = load_full = contprof.load_folded(p)
+        assert loaded == pr.fold()
+        assert load_full["phase:decode;mod.outer;mod.inner"] == 2
+
+    def test_torn_file_tolerated_at_every_byte(self, tmp_path):
+        pr = _populated()
+        p = str(tmp_path / "a.folded")
+        pr.save(p)
+        with open(p, "rb") as f:
+            data = f.read()
+        full = contprof.load_folded(p)
+        torn = str(tmp_path / "torn.folded")
+        for cut in range(len(data) + 1):
+            with open(torn, "wb") as f:
+                f.write(data[:cut])
+            got = contprof.load_folded(torn)   # must never raise
+            for stack, w in got.items():
+                assert stack in full
+                assert 0 < w <= full[stack]
+        # missing file is an empty profile, not an exception
+        assert contprof.load_folded(str(tmp_path / "nope")) == {}
+
+    def test_fold_shares_sum_to_one(self):
+        folded = _populated().fold()
+        phases, frames = contprof.fold_shares(folded)
+        assert sum(phases.values()) == pytest.approx(1.0)
+        assert sum(frames.values()) == pytest.approx(1.0)
+        assert phases["decode"] == pytest.approx(3 / 5)
+        # a pre-phase-tag profile reads as idle, not a crash
+        ph2, _ = contprof.fold_shares({"mod.f;mod.g": 4})
+        assert ph2 == {"idle": pytest.approx(1.0)}
+
+    def test_windowed_fold_uses_recent_ring(self):
+        pr = _populated()
+        key = ("phase:decode", "mod.outer")
+        now = 1000.0
+        pr._recent.append((now - 120.0, key))   # outside the window
+        pr._recent.append((now - 10.0, key))
+        pr._recent.append((now - 5.0, key))
+        win = pr.fold(window_s=60.0, now=now)
+        assert win == {"phase:decode;mod.outer": 2}
+
+
+# -- flamegraph ------------------------------------------------------------
+
+
+class TestFlamegraph:
+    def test_embedded_json_roundtrips_with_script_escape(self, tmp_path):
+        pr = _populated(name="t-flame")
+        with pr._lock:
+            # the label that would end the <script> block early if the
+            # payload weren't escaped
+            pr._insert("idle", ("evil</script>frame",))
+        p = str(tmp_path / "flame.html")
+        assert pr.flamegraph_html(p, title="t") == p
+        with open(p, "r", encoding="utf-8") as f:
+            html = f.read()
+        m = _SCRIPT_RE.search(html)
+        assert m, "embedded profile JSON block missing"
+        doc = json.loads(m.group(1))
+        assert doc["folded"] == pr.fold()
+        assert any("evil</script>frame" in k for k in doc["folded"])
+        # path=None returns the HTML text instead of writing
+        assert _SCRIPT_RE.search(pr.flamegraph_html())
+
+
+# -- active-profiler registry ----------------------------------------------
+
+
+class TestActiveRegistry:
+    def test_current_profile_attaches_and_clears(self):
+        assert contprof.active_profiler() is None
+        assert contprof.current_profile() is None
+        pr = ContinuousProfiler(hz=50.0, name="t-active").start()
+        try:
+            assert contprof.active_profiler() is pr
+            rep = contprof.current_profile(window_s=5.0)
+            assert rep is not None and "folded" in rep \
+                and rep["name"] == "t-active"
+        finally:
+            pr.stop()
+        assert contprof.active_profiler() is None
+        assert contprof.current_profile() is None
+
+
+# -- tools/profile_diff.py -------------------------------------------------
+
+
+def _write_folded(path, rows):
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("# contprof folded v1 name=test hz=19\n")
+        for stack, w in rows.items():
+            f.write(f"{stack} {w}\n")
+    return str(path)
+
+
+class TestProfileDiff:
+    @pytest.fixture(scope="class")
+    def pd(self):
+        return importlib.import_module("profile_diff")
+
+    def test_gate_trips_on_growth_and_collapse(self, pd, tmp_path,
+                                               capsys):
+        a = _write_folded(tmp_path / "a.folded",
+                          {"phase:decode;m.f": 50, "phase:idle;m.w": 50})
+        b = _write_folded(tmp_path / "b.folded",
+                          {"phase:decode;m.f": 80, "phase:idle;m.w": 20})
+        # A vs A: no drift, gate quiet
+        assert pd.main([a, a, "--fail-on", "phase:decode>+5%",
+                        "--quiet"]) == 0
+        rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rep["ok"] and not rep["vacuous"]
+        # +30pp decode growth trips >
+        assert pd.main([a, b, "--fail-on", "phase:decode>+5%",
+                        "--quiet"]) == 1
+        rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rep["failures"][0]["delta_pp"] == pytest.approx(30.0)
+        # the same motion reads as idle COLLAPSE through a < gate
+        assert pd.main([a, b, "--fail-on", "phase:idle<10%",
+                        "--quiet"]) == 1
+        # frame gates ride the leaf-frame table
+        assert pd.main([a, b, "--fail-on", "frame:m.f>+5%",
+                        "--quiet"]) == 1
+
+    def test_missing_key_reads_as_zero(self, pd, tmp_path, capsys):
+        a = _write_folded(tmp_path / "a2.folded", {"phase:idle;m.w": 10})
+        b = _write_folded(tmp_path / "b2.folded",
+                          {"phase:idle;m.w": 5,
+                           "phase:spec_verify;m.v": 5})
+        # a brand-new phase DOES trip a > gate (0% -> 50%)
+        assert pd.main([a, b, "--fail-on", "phase:spec_verify>+20%",
+                        "--quiet"]) == 1
+        capsys.readouterr()
+
+    def test_vacuous_comparison_fails(self, pd, tmp_path, capsys):
+        e = _write_folded(tmp_path / "e.folded", {})
+        assert pd.main([e, e, "--quiet"]) == 1
+        rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rep["vacuous"] and not rep["ok"]
+
+    def test_bad_spec_rejected(self, pd):
+        with pytest.raises(Exception):
+            pd.parse_spec("decode>+5%")     # missing phase:/frame: kind
+
+
+# -- engine integration ----------------------------------------------------
+
+
+class TestEngineIntegration:
+    def test_profiled_engine_frozen_compiles_and_endpoints(self,
+                                                           gpt_model):
+        prompts = _prompts((12, 14, 10, 13))
+        eng = ServingEngine(gpt_model, max_slots=2, page_size=16,
+                            max_seq_len=64, steps_per_dispatch=4,
+                            profile=True, profile_hz=97.0)
+        try:
+            assert eng.profiler is not None
+            assert eng.registry.get("profile_samples_total") is not None
+            eng.warmup(buckets=[len(p) for p in prompts], decode=True)
+            frozen = eng.compile_counts()
+            # deterministic phase witness: watch the dispatch thread's
+            # marker while generate() runs (immune to sampler Hz)
+            observed = set()
+            main_tid = threading.get_ident()
+            stop = threading.Event()
+
+            def watch():
+                while not stop.is_set():
+                    ph = contprof.current_phase(main_tid)
+                    if ph:
+                        observed.add(ph)
+                    time.sleep(0.001)
+
+            w = threading.Thread(target=watch, daemon=True)
+            w.start()
+            try:
+                outs = eng.generate(prompts, max_new_tokens=8)
+            finally:
+                stop.set()
+                w.join(5.0)
+            assert len(outs) == len(prompts)
+            # THE contract: profiling ON never touches compilation
+            assert eng.compile_counts() == frozen
+            assert "decode" in observed
+            assert any(p.startswith("prefill_") for p in observed)
+            h = eng.health()
+            assert h["profile"]["hz"] > 0
+            assert set(h["profile"]) >= {"samples", "phases", "top"}
+            # live endpoints: /profile renders, then /metrics carries
+            # the exporter's own scrape timing for that render
+            import urllib.request
+            ex = eng.serve_metrics(port=0)
+            base = f"http://127.0.0.1:{ex.port}"
+            with urllib.request.urlopen(base + "/profile?window=60",
+                                        timeout=10) as r:
+                prof = json.loads(r.read().decode("utf-8"))
+            assert prof["name"] == "engine" and "folded" in prof
+            assert prof["window_s"] == 60.0
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as r:
+                text = r.read().decode("utf-8")
+            assert "exporter_scrape_seconds" in text
+            assert "profile_hz" in text
+            pr = eng.profiler
+        finally:
+            eng.close()
+        assert not pr.running
+
+    def test_dormant_engine_has_no_profiler(self, gpt_model):
+        eng = ServingEngine(gpt_model, max_slots=1, page_size=16,
+                            max_seq_len=64)
+        try:
+            assert eng.profiler is None
+            assert eng.registry.get("profile_samples_total") is None
+            assert eng.registry.get("profile_overhead_ratio") is None
+            assert "profile" not in eng.health()
+        finally:
+            eng.close()
+
+
+# -- span-ring overflow accounting -----------------------------------------
+
+
+class TestSpansEviction:
+    def test_overflow_counted_and_exported(self, tmp_path):
+        rec = SpanRecorder(name="t-ring", maxlen=4)
+        t0 = rec.now()
+        for i in range(10):
+            rec.add(f"s{i}", t0, t0 + 0.001)
+        assert rec.evicted == 6
+        p = str(tmp_path / "trace.json")
+        export_chrome(p, [rec])
+        with open(p, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["metadata"]["evicted_spans"]["t-ring"] == 6
+
+
+# -- fleet rollup ----------------------------------------------------------
+
+
+def _digest_snap(samples=10, dropped=1, backoffs=2, decode=6, idle=4):
+    return {"profile": {
+        "samples": samples, "dropped": dropped, "backoffs": backoffs,
+        "overhead_ratio": 0.001, "hz": 19.0,
+        "phases": {"decode": decode, "idle": idle},
+        "top": {"decode": [["m.decode_step", decode]],
+                "idle": [["m.wait", idle]]}}}
+
+
+class TestFleetRollup:
+    def test_fold_restart_tolerance_and_health(self, gpt_model):
+        eng = ServingEngine(gpt_model, max_slots=1, page_size=16,
+                            max_seq_len=64)
+        router = FleetRouter([InprocReplica("r0", eng)])
+        try:
+            reg = router.registry
+
+            def c(name):
+                m = reg.get(name)
+                return 0 if m is None else int(m.value)
+
+            router._fold_profile("r0", _digest_snap(samples=10))
+            assert c("fleet_profile_samples_total") == 10
+            assert c("fleet_profile_samples_dropped_total") == 1
+            assert c("fleet_profile_backoffs_total") == 2
+            # monotonic growth folds the delta only
+            router._fold_profile("r0", _digest_snap(samples=14))
+            assert c("fleet_profile_samples_total") == 14
+            # a BACKWARDS value means the replica restarted: fold the
+            # new absolute, never a negative delta
+            router._fold_profile("r0", _digest_snap(samples=5))
+            assert c("fleet_profile_samples_total") == 19
+            h = router.health()["profile"]
+            assert h["phases"]["decode"] == 6
+            assert "m.decode_step" in h["top"]
+            assert h["replicas"]["r0"]["host_pct"] \
+                == pytest.approx(60.0)
+            # a heartbeat with no profile section clears the inventory;
+            # dormant router + no digests -> rollup reads None
+            router._fold_profile("r0", {})
+            assert "r0" not in router._profile_digests
+            assert router.profiler is None
+            assert router.health()["profile"] is None
+            assert "r0" not in router._profile_seen
+        finally:
+            router.close()
+            eng.close()
+
+    def test_armed_router_samples_its_own_loop(self, gpt_model):
+        eng = ServingEngine(gpt_model, max_slots=1, page_size=16,
+                            max_seq_len=64)
+        router = FleetRouter([InprocReplica("r0", eng)],
+                             profile=True, profile_hz=97.0)
+        try:
+            assert router.profiler is not None \
+                and router.profiler.running
+            h = router.health()["profile"]
+            assert h["router"]["hz"] > 0
+            pr = router.profiler
+        finally:
+            router.close()
+            eng.close()
+        assert not pr.running
+
+
+# -- fleet_top HOST% column ------------------------------------------------
+
+
+class TestFleetTopHostPct:
+    def test_render_host_pct_from_profile_rollup(self, tmp_path):
+        ft = importlib.import_module("fleet_top")
+        reg = MetricsRegistry()
+        reg.counter("fleet_tokens_out_total").inc(10)
+        from paddle_tpu.observability.history import HistoryStore
+        hs = HistoryStore(reg, interval_s=1.0)
+        for i in range(5):
+            hs.scrape(now=1_700_000_000.0 + i)
+        hs.save(str(tmp_path / "history_snapshot.json"))
+        with open(tmp_path / "health.json", "w") as f:
+            json.dump({
+                "queue_depth": 0, "pending": 0, "lost": [],
+                "replicas": {
+                    "r0": {"state": "serving", "incarnation": 1,
+                           "queued": 0, "running": 0, "free_pages": 9,
+                           "scrape_age_s": 0.01, "lost": False,
+                           "quarantined": False},
+                    "r1": {"state": "serving", "incarnation": 1,
+                           "queued": 0, "running": 0, "free_pages": 9,
+                           "scrape_age_s": 0.01, "lost": False,
+                           "quarantined": False}},
+                "profile": {
+                    "phases": {"decode": 6, "idle": 4},
+                    "top": {"m.decode_step": 6},
+                    "replicas": {"r0": {"host_pct": 42.5,
+                                        "samples": 10}}}}, f)
+        frame = ft.collect_snapshot(str(tmp_path))
+        text = ft.render(frame)
+        assert "HOST%" in text
+        assert "42.5" in text      # r0 rolls up a duty figure
+        # r1 has no profiler armed: renders "-", never crashes
+        r1_line = [ln for ln in text.splitlines()
+                   if ln.strip().startswith("r1")][0]
+        assert " - " in r1_line
+
+
+# -- Profiler.export_flamegraph bridge -------------------------------------
+
+
+class TestProfilerBridge:
+    def test_bridge_uses_active_continuous_profiler(self, tmp_path):
+        from paddle_tpu.profiler import Profiler
+        pr = ContinuousProfiler(hz=50.0, name="t-bridge").start()
+        try:
+            p = Profiler(registry=False)
+            out = p.export_flamegraph(str(tmp_path / "live.html"))
+            with open(out, "r", encoding="utf-8") as f:
+                doc = json.loads(_SCRIPT_RE.search(f.read()).group(1))
+            assert doc["name"] == "t-bridge"
+        finally:
+            pr.stop()
+
+    def test_regions_fallback_without_active_profiler(self, tmp_path,
+                                                      monkeypatch):
+        from paddle_tpu.profiler import Profiler
+        monkeypatch.setattr(contprof, "active_profiler", lambda: None)
+        p = Profiler(registry=False)
+        with p.record_event("my_region", sync=False):
+            time.sleep(0.002)
+        out = p.export_flamegraph(str(tmp_path / "regions.html"))
+        with open(out, "r", encoding="utf-8") as f:
+            doc = json.loads(_SCRIPT_RE.search(f.read()).group(1))
+        assert doc["name"] == "regions"
+        assert "region:my_region" in doc["folded"]

@@ -155,8 +155,13 @@ def render(frame):
                    f"alerts={alerting or 'none'}")
         reps = h.get("replicas") or {}
         if reps:
+            # HOST% (r22): 100*(1 - idle share) from the replica's
+            # continuous-profiler heartbeat digest — how much of the
+            # host's sampled wall time was real serving work; "-" for
+            # replicas with no profiler armed
+            prof = (h.get("profile") or {}).get("replicas") or {}
             out.append("  REPLICA     STATE     INC  Q/R    FREE_PG "
-                       "SCRAPE_AGE  BOOT         FLAGS")
+                       "SCRAPE_AGE  BOOT         HOST%  FLAGS")
             for name in sorted(reps):
                 row = reps[name]
                 flags = "".join(
@@ -171,6 +176,8 @@ def render(frame):
                     f"{bi['mode']}"
                     + ("" if bi.get("boot_s") is None
                        else f" {float(bi['boot_s']):.1f}s"))
+                hp = (prof.get(name) or {}).get("host_pct")
+                host = "-" if hp is None else f"{float(hp):.1f}"
                 out.append(
                     f"  {name:<11} {str(row.get('state')):<9} "
                     f"{str(row.get('incarnation')):<4} "
@@ -178,7 +185,7 @@ def render(frame):
                     f"{_fmt(row.get('running')):<4} "
                     f"{_fmt(row.get('free_pages')):<7} "
                     f"{_fmt(row.get('scrape_age_s'), 's'):<11} "
-                    f"{boot:<12} {flags}")
+                    f"{boot:<12} {host:<6} {flags}")
     if h:
         asc = h.get("autoscale")
         ov = h.get("overload") or {}

@@ -257,6 +257,16 @@ STAGES = [
     # pre-traced by warmup), zero unexpected retraces.
     ("spec_smoke", [PY, "tools/spec_smoke.py"], 1800,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
+    # continuous-profiling drill (ISSUE 22, CPU, seeded): a wave
+    # through a profiler-ARMED engine — compile counts frozen with
+    # profiling ON (the sampler is host-side only), serving-phase
+    # markers observed live on the dispatch path (decode + a prefill
+    # bucket), self-measured overhead at/under the 1% duty-cycle cap,
+    # /profile endpoint + flamegraph HTML render from the same run,
+    # and the profile_diff gate proven BOTH directions (clean-vs-clean
+    # passes, an injected decode busy-loop trips phase:decode>+10%).
+    ("profile_smoke", [PY, "tools/profile_smoke.py"], 1800,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
     # AOT serving-artifact boot probe (ISSUE 21, seeded): traced
     # warmup control -> export_artifact -> warm_boot a second engine
     # off the store. Asserts the artifact path was taken (mode=aot,
@@ -468,6 +478,17 @@ FLEET_CANARY_FAIL_ON = (
     # token-exactness still passes (speculation never changes tokens,
     # so only the acceptance counter can reveal a dead proposer).
     "fleet_spec_accepted_total<50%",
+    # continuous-profiling counters (ISSUE 22): the profiler gauges
+    # its OWN cost — a duty-cycle ratio above the golden's by >100%
+    # means the sampler got expensive (a stack-depth or thread-count
+    # explosion), and a truncated-sample STORM means the trie bound
+    # is eating the profile (both are observability regressions the
+    # flamegraph would silently hide). (Series skipped by
+    # metrics_diff until the golden is regenerated with a
+    # profiler-armed chaos suite — same bootstrap as the sentinel
+    # counters above.)
+    "profile_overhead_ratio>100%",
+    "profile_samples_dropped_total>200%",
 )
 
 # history gate (ISSUE 11): ONE archive, two instants, both directions

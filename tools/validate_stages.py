@@ -69,6 +69,11 @@ REQUIRED_STAGES = {
     # traced control, zero fallbacks, strictly faster (ISSUE 21; the
     # tunnel ladder's artifact-boot-vs-traced rung)
     "aot_boot",
+    # continuous-profiling drill: profiler-armed wave with frozen
+    # compile counts, phase attribution live, overhead under the 1%
+    # cap, and the profile_diff gate proven both directions (CPU-only
+    # — ISSUE 22)
+    "profile_smoke",
 }
 
 
@@ -85,6 +90,7 @@ def _emits_metrics(cmd):
                                             "autoscale_smoke.py",
                                             "prefix_cache_smoke.py",
                                             "spec_smoke.py",
+                                            "profile_smoke.py",
                                             "aot_boot_probe.py",
                                             "test_fleet_serving.py",
                                             "test_fleet_recovery.py",
@@ -142,7 +148,10 @@ def check_completed_stage_metrics():
 # per stage — flightrec's dump-dir fallback)
 FLIGHT_STAGES = {"chaos_smoke", "telemetry_smoke",
                  "fleet_recovery_smoke", "fleet_supervisor_smoke",
-                 "history_smoke", "autoscale_smoke"}
+                 "history_smoke", "autoscale_smoke",
+                 # the anomaly-evidence path end-to-end: its dump
+                 # carries the live profile (ISSUE 22)
+                 "profile_smoke"}
 
 
 def check_flight_dumps():
