@@ -693,7 +693,11 @@ def worker_serve(args, on_tpu):
                             use_flash=use_flash,
                             steps_per_dispatch=spd, donate=donate,
                             registry=rung_reg,
-                            spec_decode=bool(args.spec))
+                            spec_decode=bool(args.spec),
+                            # per-rung HBM attribution: the ladder's
+                            # peak per-segment numbers ride the same
+                            # registry merge as the latency shape
+                            mem_ledger=True)
         if args.spec:
             # the verify program only arms through warmup() (the
             # zero-recompile gate) — the wave-as-warmup below never
@@ -752,15 +756,30 @@ def worker_serve(args, on_tpu):
                            "proposed": sp.get("proposed"),
                            "accepted": sp.get("accepted"),
                            "acceptance_rate": sp.get("acceptance_rate")}
+        if eng.ledger is not None:
+            mdg = eng.ledger.digest()
+            row["mem"] = {
+                # peak (high-watermark) + per-segment attribution:
+                # THE capacity-planning numbers a rung exists to
+                # produce — how many bytes each batch/dtype point
+                # actually costs, split by owner
+                "high_watermark_bytes": mdg.get("high_watermark_bytes"),
+                "attributed_bytes": mdg.get("attributed_bytes"),
+                "unattributed_bytes": mdg.get("unattributed_bytes"),
+                "segments": mdg.get("segments"),
+                "used_ratio": mdg.get("used_ratio")}
         rows.append(row)
         try:
             _emit("serve_rung", model=kind, **row)
         except Exception as e:  # noqa: BLE001 — telemetry never kills a result
             log(f"telemetry emit failed: {e}")
         get_registry().merge(rung_reg.snapshot())
+        mem = row.get("mem") or {}
         log(f"serve {tag}: {row['tok_s']} tok/s decode "
             f"({row['wall_tok_s']} wall; {toks} toks), recompiles 0, "
-            f"p99 {((row['decode_tok_ms'] or {}).get('p99'))} ms/tok")
+            f"p99 {((row['decode_tok_ms'] or {}).get('p99'))} ms/tok, "
+            f"hbm peak {mem.get('high_watermark_bytes')} B "
+            f"(kv {((mem.get('segments') or {}).get('kv_pages'))})")
         del eng
     by_rung = {(r["batch"], r["cache_dtype"], r["flash"]): r["tok_s"]
                for r in rows}

@@ -347,6 +347,7 @@ def load_artifact(engine, root, ttl_s=None, buckets=()):
     blobs = manifest.get("blobs") or {}
     platform = jax.devices()[0].platform
     exps = {}
+    blob_bytes = 0
     for site, meta in sorted(blobs.items()):
         bpath = os.path.join(path, meta.get("file") or "")
         try:
@@ -373,6 +374,7 @@ def load_artifact(engine, root, ttl_s=None, buckets=()):
                 f"{name}: blob {site} lowered for {exp.platforms}, "
                 f"running on {platform}")
         exps[site] = (exp, tuple(meta.get("donate_argnums") or ()))
+        blob_bytes += len(raw)
 
     warmed = manifest.get("warmed") or {}
     try:
@@ -427,6 +429,12 @@ def load_artifact(engine, root, ttl_s=None, buckets=()):
         raise ArtifactError("install_error", str(e)) from e
     info = {"artifact": name, "sites": sorted(exps),
             "topped_up": missing}
+    if getattr(engine, "ledger", None) is not None:
+        # artifact restore seam: the deserialized executables' blob
+        # bytes land in the ledger's "other" segment (level, not a
+        # tracked token — a reload replaces, never accumulates)
+        engine.ledger.set_level("other", blob_bytes,
+                                label="serving_artifact")
     from ..observability import flightrec
     flightrec.note("serve_aot_load", **info)
     return info

@@ -437,6 +437,62 @@ class PrefixIndex:
                 "evictions": self.evictions,
                 "adopted_pages": self.adopted_pages}
 
+    def sidecar_bytes(self):
+        """Device bytes pinned by the dense K/V sidecars, deduplicated
+        by object identity (nested boundary entries of one prompt
+        share ONE sidecar — counting it per entry would overstate the
+        footprint by the nesting depth). The memory ledger's
+        prefix_sidecar level reads this."""
+        seen, total = set(), 0
+        for e in self._entries.values():
+            if e.kv is None or id(e.kv) in seen:
+                continue
+            seen.add(id(e.kv))
+            for k, v in e.kv:
+                total += int(getattr(k, "nbytes", 0) or 0)
+                total += int(getattr(v, "nbytes", 0) or 0)
+        return total
+
+    def audit(self, live_refs=None):
+        """Cross-check the index's two refcount maps against their
+        definitions — the release-on-failover leak detector the
+        memory ledger runs every sweep. Returns a list of problem
+        strings (empty = consistent); never raises.
+
+        Checks: ``_owners`` must equal per-page coverage recomputed
+        from the live entries; ``_rc`` pins must only exist on owned
+        pages and must be positive; and, when the engine passes
+        ``live_refs`` (page -> count of live slots mapping it via
+        slot.shared), ``_rc`` must match it exactly — a pin with no
+        live slot is a page that will never return to the free list,
+        a live slot without a pin is a page eviction can free under a
+        running request."""
+        problems = []
+        cover = {}
+        for e in self._entries.values():
+            for p in e.pages:
+                cover[p] = cover.get(p, 0) + 1
+        if cover != self._owners:
+            bad = {p for p in set(cover) | set(self._owners)
+                   if cover.get(p, 0) != self._owners.get(p, 0)}
+            problems.append(
+                f"owner counts diverge from entry coverage on pages "
+                f"{sorted(bad)[:8]}")
+        for p, n in self._rc.items():
+            if n <= 0:
+                problems.append(f"non-positive pin {n} on page {p}")
+            if p not in self._owners:
+                problems.append(f"pin on unowned page {p}")
+        if live_refs is not None:
+            live = {p: n for p, n in live_refs.items() if n > 0}
+            if live != self._rc:
+                bad = {p for p in set(live) | set(self._rc)
+                       if live.get(p, 0) != self._rc.get(p, 0)}
+                problems.append(
+                    f"slot pins diverge from live page-table "
+                    f"references on pages {sorted(bad)[:8]}")
+        return problems
+
     # -- lookup / refcounting ---------------------------------------------
 
     def match(self, fps):

@@ -210,6 +210,23 @@ class ServingEngine:
     spec_draft: 'ngram' (zero-weight prompt-lookup proposer — no second
         model) or a tiny GPT/Llama draft model instance sharing the
         tokenizer; None reads PADDLE_TPU_SPEC_DRAFT (default 'ngram').
+    mem_ledger: device-memory ledger (observability.memledger): typed
+        per-segment HBM attribution (kv_pages/prefix_sidecar/weights/
+        ...), ground-truth cross-check with an unattributed residual,
+        and headroom forecasting as engine_mem_* gauges. Default OFF;
+        None reads PADDLE_TPU_MEM_LEDGER. A never-armed engine
+        creates no ledger and registers no mem_* series (the profiler
+        dormancy contract). Host-side accounting only: arming it
+        leaves token streams and compile counts byte-identical.
+    mem_admission: 'advisory' (would_fit consults are counters only)
+        or 'hard' (submit() rejects a request whose full KV footprint
+        would not fit the forecast headroom with a typed
+        MemoryAdmissionError). None reads PADDLE_TPU_MEM_ADMISSION
+        (default advisory). Hard mode needs a known capacity.
+    mem_capacity_bytes: device-memory budget when the backend's
+        memory_stats() has no bytes_limit (CPU, capped deployments);
+        None reads PADDLE_TPU_MEM_CAPACITY_BYTES, else the ledger
+        learns it from the device or runs capacity-blind.
     """
 
     def __init__(self, model, *, max_slots=8, page_size=16,
@@ -221,7 +238,8 @@ class ServingEngine:
                  tenant_capacity=64, prefix_cache=None,
                  min_prefix_pages=None, prefix_max_entries=512,
                  spec_decode=None, spec_k=None, spec_draft=None,
-                 profile=None, profile_hz=None):
+                 profile=None, profile_hz=None, mem_ledger=None,
+                 mem_admission=None, mem_capacity_bytes=None):
         if page_size % 8:
             raise ValueError(f"page_size must be a multiple of 8 "
                              f"(Mosaic sublane tiling), got {page_size}")
@@ -289,6 +307,20 @@ class ServingEngine:
                 "PADDLE_TPU_PROFILE", "0").lower() in ("1", "true", "on")
         self._profile_enabled = bool(profile)
         self._profile_hz = profile_hz
+        from ..observability import memledger as _memledger
+        if mem_ledger is None:
+            mem_ledger = _memledger.mem_ledger_enabled_from_env()
+        self._mem_enabled = bool(mem_ledger)
+        self.mem_admission = (_memledger.mem_admission_from_env()
+                              if mem_admission is None
+                              else str(mem_admission))
+        if self.mem_admission not in _memledger.ADMISSION_MODES:
+            raise ValueError(
+                f"mem_admission {mem_admission!r}: expected "
+                f"{' | '.join(_memledger.ADMISSION_MODES)}")
+        if mem_capacity_bytes is None:
+            mem_capacity_bytes = _memledger.mem_capacity_from_env()
+        self._mem_capacity_bytes = mem_capacity_bytes
 
         self._params, self._buffers = model.raw_state()
         self._pages = [alloc_pages(self.num_pages, self.page_size,
@@ -466,6 +498,29 @@ class ServingEngine:
             self.profiler = ContinuousProfiler(
                 hz=self._profile_hz, registry=reg,
                 name="engine").start()
+        # device-memory ledger (observability.memledger): armed via
+        # PADDLE_TPU_MEM_LEDGER / the mem_ledger ctor knob, same
+        # dormancy contract as the profiler — a never-armed engine
+        # creates NO ledger and registers NO mem_* series. track/
+        # release are host dict arithmetic; the ground-truth sweep
+        # runs at health() cadence, never the dispatch hot path.
+        self.ledger = None
+        # per-page KV bytes (all layers, incl. int8 scale sidecars):
+        # the unit the admission hint prices a request in. Host attr
+        # walk over pool metadata, computed once.
+        self._page_bytes = (_memledger.nbytes_of(self._pages)
+                            // max(self.num_pages, 1))
+        if self._mem_enabled:
+            self.ledger = _memledger.MemoryLedger(
+                registry=reg, name="engine",
+                capacity_bytes=self._mem_capacity_bytes)
+            model_tag = type(model).__name__
+            self.ledger.track("weights", (self._params, self._buffers),
+                              label=f"model={model_tag}")
+            self.ledger.track(
+                "kv_pages", self._pages,
+                label=f"dtype={self.cache_dtype},model={model_tag}")
+            self.ledger.add_audit(self._mem_audit)
         self._exporter = None
         self._trace_counts = self.tracer._counts
         # AOT export surface: every compiled serving program's RAW
@@ -647,6 +702,20 @@ class ServingEngine:
                 f"{self.num_pages - 1} usable — it would wedge the "
                 "admission queue. Raise num_pages or shorten the "
                 "request.")
+        if self.ledger is not None and self.mem_admission == "hard":
+            # hard admission (PADDLE_TPU_MEM_ADMISSION=hard): reject
+            # a request whose full KV footprint would not fit the
+            # forecast headroom with a typed error NOW, instead of
+            # OOMing mid-decode. Conservative by design — judged
+            # against current headroom, not what draining requests
+            # may free (a kill switch, not a scheduler).
+            need_bytes = need_pages * self._page_bytes
+            if self.ledger.admission_check(need_bytes) is False:
+                from ..observability.memledger import \
+                    MemoryAdmissionError
+                raise MemoryAdmissionError(
+                    need_bytes, self.ledger.headroom_bytes(),
+                    self.ledger.capacity_bytes)
         deadline = None if deadline_ms is None \
             else time.monotonic() + float(deadline_ms) / 1e3
         rid = self._next_rid
@@ -1059,6 +1128,17 @@ class ServingEngine:
         if self.profiler is not None:
             profile_fn = lambda window: \
                 self.profiler.report(window_s=window)  # noqa: E731
+
+        def memory_fn(window):
+            # /memory is always routable on an engine exporter: an
+            # unarmed ledger answers a stub (HTTP 200) telling the
+            # scraper how to arm it, instead of a route-shaped 404
+            if self.ledger is not None:
+                return self.ledger.report(window_s=window)
+            return {"armed": False,
+                    "note": "no ledger armed "
+                            "(PADDLE_TPU_MEM_LEDGER=1 or "
+                            "mem_ledger=True)"}
         self._exporter = MetricsExporter(
             registry=self.registry, port=port, host=host,
             health_fn=self.health,
@@ -1067,7 +1147,8 @@ class ServingEngine:
             report_fn=lambda: {"spans_evicted": {
                 self.spans.name: int(self.spans.evicted)}},
             tenants_fn=self.tenants.report,
-            profile_fn=profile_fn)
+            profile_fn=profile_fn,
+            memory_fn=memory_fn)
         return self._exporter
 
     def close(self):
@@ -1110,6 +1191,8 @@ class ServingEngine:
             self._exporter = None
         if self.profiler is not None:
             self.profiler.stop()
+        if self.ledger is not None:
+            self.ledger.close()
         self.tracer.close()
         out, self._finished = self._finished, []
         return out
@@ -1210,6 +1293,13 @@ class ServingEngine:
             # the fleet router folds samples/dropped deltas into
             # fleet_profile_* and rolls the tables up in health()
             h["profile"] = self.profiler.digest()
+        if self.ledger is not None:
+            # typed segment totals + headroom forecast riding the
+            # heartbeat: the fleet router delta-folds the stats into
+            # fleet_mem_* and rolls MEM%/HEADROOM up for fleet_top.
+            # digest() sweeps (rate-limited) — health() cadence is
+            # exactly where the ground-truth cross-check belongs.
+            h["mem"] = self.ledger.digest()
         if self._watchdog is not None:
             h["watchdog"] = dict(self._watchdog.health(),
                                  wedge_count=int(self._m_wedges.value))
@@ -1679,12 +1769,21 @@ class ServingEngine:
                 freed = self.prefix.evict(need_pages - have)
                 if freed:
                     self._free_pages.extend(freed)
+                    self._mem_sync_prefix()
                     self.spans.instant(
                         "prefix_evict", tid="sched", cat="serve",
                         args={"pages": len(freed)})
                     have = len(self._free_pages)
                     short_pages = have < need_pages
             if free_slot is not None and not short_pages:
+                if self.ledger is not None:
+                    # advisory admission consult before page
+                    # allocation: counts checks and would-not-fit
+                    # verdicts (engine_mem_admission_*); hard mode
+                    # already screened at submit(), so admission
+                    # itself never blocks here
+                    self.ledger.admission_check(
+                        need_pages * self._page_bytes)
                 self._queue.popleft()
                 self._admit_one(free_slot, req, need_pages)
                 continue
@@ -1751,7 +1850,35 @@ class ServingEngine:
                                             pin=True)
         if freed:
             self._free_pages.extend(freed)
+        self._mem_sync_prefix()
         return adopted
+
+    def _mem_sync_prefix(self):
+        """Refresh the ledger's prefix_sidecar level from the index's
+        own sidecar inventory (the level channel: idempotent absolute
+        sets at the seams that mutate it, re-asserted by every sweep's
+        audit). No-op when either plane is dormant."""
+        if self.ledger is not None and self.prefix is not None:
+            self.ledger.set_level("prefix_sidecar",
+                                  self.prefix.sidecar_bytes())
+
+    def _mem_audit(self):
+        """The ledger's periodic sweep hook: cross-check prefix-index
+        refcounts against live page-table references (the release-on-
+        failover leak class) and re-sync the sidecar level. Returns
+        problem strings; sweep counts them into
+        engine_mem_audit_failures_total."""
+        if self.prefix is None:
+            return []
+        live = {}
+        for slot in self._slots:
+            if slot is None:
+                continue
+            for p in slot.shared:
+                live[p] = live.get(p, 0) + 1
+        problems = self.prefix.audit(live_refs=live)
+        self._mem_sync_prefix()
+        return problems
 
     def _admit_one(self, b, req, need_pages):
         req.queue_wait_s = time.monotonic() - req.submitted_at

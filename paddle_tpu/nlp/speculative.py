@@ -157,6 +157,15 @@ class DraftModelProposer:
         self._pages = [alloc_pages(1 + b * pps, ps, self.kv_heads,
                                    self.head_dim, "float32")
                        for _ in range(self.num_layers)]
+        if getattr(engine, "ledger", None) is not None:
+            # draft pool + draft weights land in the engine's memory
+            # ledger at the allocation seam (spec_draft_pool segment)
+            engine.ledger.track(
+                "spec_draft_pool", self._pages,
+                label=f"model={type(model).__name__}")
+            engine.ledger.track(
+                "weights", (self._params, self._buffers),
+                label=f"model={type(model).__name__},role=draft")
         self._table = np.arange(b * pps, dtype=np.int32) \
             .reshape(b, pps) + 1
         self._prefill_fns = {}

@@ -86,6 +86,13 @@ class MetricsExporter:
         None when no profiler is armed -> 404. None disables the
         endpoint (ServingEngine/FleetRouter wire their
         ContinuousProfiler here, the /traces attach-point pattern).
+    memory_fn: one-arg callable serving ``/memory?window=S`` — the
+        memory ledger's typed segment tree + headroom forecast (the
+        window arg is accepted for route symmetry; a ledger is a
+        level, not a ring). Return None -> 404; engines instead
+        answer a stub JSON ({"armed": false, ...}) when no ledger is
+        armed, so the route itself is always probeable. None disables
+        the endpoint.
     host/port: bind address; port 0 = ephemeral (read .port after).
 
     Every route observes its own wall time into the per-route
@@ -102,7 +109,7 @@ class MetricsExporter:
     def __init__(self, registry=None, port=0, host="127.0.0.1",
                  health_fn=None, report_fn=None, traces_fn=None,
                  history_fn=None, tenants_fn=None, requests_fn=None,
-                 profile_fn=None):
+                 profile_fn=None, memory_fn=None):
         if registry is None:
             from .metrics import get_registry
             registry = get_registry()
@@ -114,6 +121,7 @@ class MetricsExporter:
         self.tenants_fn = tenants_fn
         self.requests_fn = requests_fn
         self.profile_fn = profile_fn
+        self.memory_fn = memory_fn
         self._scrape_hists = {}
         self._started = time.time()
         exporter = self
@@ -223,6 +231,26 @@ class MetricsExporter:
                                 code=404)
                         else:
                             self._send_json(doc)
+                    elif exporter.memory_fn is not None \
+                            and path == "/memory":
+                        from urllib.parse import parse_qs
+                        params = {k: v[-1] for k, v in parse_qs(
+                            parts[1] if len(parts) > 1 else ""
+                            ).items()}
+                        window = None
+                        if params.get("window"):
+                            try:
+                                window = float(params["window"])
+                            except ValueError:
+                                window = None
+                        doc = exporter.memory_fn(window)
+                        if doc is None:
+                            self._send_json(
+                                {"error": "no ledger armed "
+                                          "(PADDLE_TPU_MEM_LEDGER=1)"},
+                                code=404)
+                        else:
+                            self._send_json(doc)
                     else:
                         endpoints = ["/metrics", "/healthz", "/report"]
                         if exporter.traces_fn is not None:
@@ -235,6 +263,8 @@ class MetricsExporter:
                             endpoints.append("/tenants")
                         if exporter.profile_fn is not None:
                             endpoints.append("/profile")
+                        if exporter.memory_fn is not None:
+                            endpoints.append("/memory")
                         self._send_json(
                             {"error": f"unknown path {path!r}",
                              "endpoints": endpoints}, code=404)

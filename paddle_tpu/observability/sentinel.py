@@ -65,6 +65,14 @@ def default_signals(window_s=5.0):
         {"name": "journal_errors", "kind": "rate",
          "series": "fleet_journal_errors_total", "window_s": w,
          "direction": "high"},
+        # device-memory pressure: the memory ledger's used-ratio
+        # gauge. Sustained growth out of the learned band (a leak, a
+        # runaway working set) trips the debounced flight dump with
+        # the segment tree attached; a flat series — even near full —
+        # is a steady state, not an anomaly.
+        {"name": "mem_used_ratio", "kind": "gauge",
+         "series": "engine_mem_hbm_used_ratio", "window_s": w,
+         "direction": "high"},
         {"name": "recompiles", "kind": "delta", "series": None},
     )
 
@@ -216,6 +224,18 @@ class AnomalySentinel:
             return self.history.rate(
                 sig["series"], float(sig.get("window_s", 5.0)),
                 now=now)
+        if kind == "gauge":
+            # latest raw sample of a plain gauge series inside the
+            # window (quantile_over_time is histogram-only); no data
+            # reads None — "no news", neither learns nor fires
+            w = float(sig.get("window_s", 5.0))
+            rows = self.history.query(sig["series"], t0=now - w,
+                                      t1=now, res="raw")
+            if not rows:
+                return None
+            last = rows[-1]
+            v = last.get("max", last.get("v"))
+            return None if v is None else float(v)
         if kind == "delta":
             if self.compile_fn is None:
                 return None
@@ -356,6 +376,14 @@ class AnomalySentinel:
             try:
                 from . import contprof
                 extra["profile"] = contprof.current_profile()
+            except ImportError:  # standalone file-load (bench._obs_mod)
+                pass
+            # ...and where device memory stood: the active memory
+            # ledger's segment tree + headroom forecast (None when no
+            # ledger is armed) — the mem_used_ratio signal's postmortem
+            try:
+                from . import memledger
+                extra["memory"] = memledger.current_memory()
             except ImportError:  # standalone file-load (bench._obs_mod)
                 pass
             flightrec.dump("fleet_anomaly", extra=extra)

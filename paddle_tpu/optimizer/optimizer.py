@@ -12,6 +12,7 @@ multi_precision=True keeps fp32 master weights when params are bf16/fp16
 """
 from __future__ import annotations
 
+import sys
 from typing import Callable, Dict, Optional
 
 import jax
@@ -192,6 +193,28 @@ class Optimizer:
             if k in new_p:
                 p._value = new_p[k]
         self._step_count += 1
+        self._mem_report(gdict)
+
+    def _mem_report(self, gdict):
+        """Level-set optimizer_state/grads bytes into the process's
+        active memory ledger, if one is armed. Guarded on the module
+        already being imported: a training loop with no ledger pays a
+        dict lookup, not an import, and never creates mem_* series
+        (the observability dormancy contract)."""
+        mod = sys.modules.get("paddle_tpu.observability.memledger")
+        if mod is None:
+            return
+        try:
+            led = mod.active_ledger()
+            if led is None:
+                return
+            led.set_level("optimizer_state",
+                          mod.nbytes_of(self._func_state),
+                          label=type(self).__name__)
+            led.set_level("grads", mod.nbytes_of(gdict),
+                          label=type(self).__name__)
+        except Exception:  # noqa: BLE001 — accounting must never
+            pass           # take a training step down
 
     def _apply_group_sharded_placement(self, params=None):
         """GroupSharded/ZeRO in the eager loop (ref: the reference's primary

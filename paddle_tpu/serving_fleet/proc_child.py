@@ -131,6 +131,7 @@ class _Child:
             "sampling": h.get("sampling"),
             "prefix_cache": h.get("prefix_cache"),
             "spec": h.get("spec"),
+            "mem": h.get("mem"),
             "boot": h.get("boot"),
             "compile_counts": h["compile_counts"],
             "unexpected_retraces":

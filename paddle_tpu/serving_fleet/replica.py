@@ -385,6 +385,7 @@ class InprocReplica:
                 "sampling": h.get("sampling"),
                 "prefix_cache": h.get("prefix_cache"),
                 "spec": h.get("spec"),
+                "mem": h.get("mem"),
                 "boot": h.get("boot"),
                 "compile_counts": h["compile_counts"]}
         with self._health_lock:

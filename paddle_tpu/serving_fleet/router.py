@@ -286,7 +286,7 @@ class FleetRouter:
         self.placement_weights = {
             "free_pages": 1.0, "queued": 8.0, "running": 2.0,
             "queue_wait_p99_s": 50.0, "outstanding": 4.0,
-            "prefix_affinity": 0.0}
+            "prefix_affinity": 0.0, "mem_headroom": 0.0}
         if placement_weights:
             unknown = set(placement_weights) - set(
                 self.placement_weights)
@@ -550,6 +550,39 @@ class FleetRouter:
                      "under the overhead cap")}
         self._profile_seen = {}     # name -> last folded stat values
         self._profile_digests = {}  # name -> last heartbeat digest
+        # -- device-memory plane (observability.memledger): replica
+        # heartbeats carry the ledger digest; stats delta-fold into
+        # fleet_mem_* counters, the latest digests feed the
+        # MEM%/HEADROOM rollup (health() / fleet_top) and the
+        # mem_headroom placement term. The unattributed gauge is the
+        # fleet canary's leak tripwire (worst replica wins).
+        self._m_mem = {
+            "tracked_allocs": reg.counter(
+                "fleet_mem_tracked_allocs_total",
+                help="allocations attributed through replica memory "
+                     "ledgers (folded from heartbeats)"),
+            "released_allocs": reg.counter(
+                "fleet_mem_released_allocs_total",
+                help="tracked allocations released across the fleet"),
+            "admission_checks": reg.counter(
+                "fleet_mem_admission_checks_total",
+                help="would_fit admission hints consulted across the "
+                     "fleet"),
+            "admission_rejections": reg.counter(
+                "fleet_mem_admission_rejections_total",
+                help="admissions replica ledgers judged would not "
+                     "fit the forecast headroom"),
+            "audit_failures": reg.counter(
+                "fleet_mem_audit_failures_total",
+                help="ledger sweep audit problems across the fleet "
+                     "(prefix refcount divergence and kin)")}
+        self._m_mem_unattr = reg.gauge(
+            "fleet_mem_unattributed_bytes",
+            help="largest per-replica unattributed device-memory "
+                 "residual (the leak canary: attribution drift is "
+                 "visible fleet-wide, never silent)")
+        self._mem_seen = {}      # name -> last folded stat values
+        self._mem_digests = {}   # name -> last heartbeat mem digest
         if profile is None:
             profile = os.environ.get(
                 "PADDLE_TPU_PROFILE", "0").lower() in ("1", "true",
@@ -1002,7 +1035,43 @@ class FleetRouter:
                 # (plus the router's own profiler when armed) — cheap
                 # dict folds only, same HTTP-thread discipline
                 "profile": self._profile_health(),
+                # device-memory rollup off cached heartbeat ledger
+                # digests (_fold_mem keeps them fresh) — same
+                # cheap-dict-read discipline
+                "mem": self._mem_health(),
                 "compile_report": self.compile_report()}
+
+    def _mem_health(self):
+        """Fleet device-memory rollup for the health snapshot:
+        per-replica used/headroom/residual off the cached heartbeat
+        ledger digests, plus fleet-merged segment totals. Cached-read
+        only (health() also runs on HTTP threads); None when no
+        replica has an armed ledger — the dormancy contract reaches
+        the fleet rollup too."""
+        digests = dict(self._mem_digests)
+        if not digests:
+            return None
+        segments = {}
+        per_replica = {}
+        worst_unattr = 0
+        for name, dg in digests.items():
+            for seg, n in (dg.get("segments") or {}).items():
+                segments[seg] = segments.get(seg, 0) + int(n)
+            un = dg.get("unattributed_bytes")
+            if un is not None:
+                worst_unattr = max(worst_unattr, int(un))
+            per_replica[name] = {
+                "used_bytes": dg.get("used_bytes"),
+                "used_ratio": dg.get("used_ratio"),
+                "headroom_bytes": dg.get("headroom_bytes"),
+                "unattributed_bytes": un,
+                "growth_bytes_per_s": dg.get("growth_bytes_per_s"),
+                "residual_alarm": bool(dg.get("residual_alarm")),
+                "audit_problems": list(dg.get("audit_problems")
+                                       or [])}
+        return {"segments": segments,
+                "unattributed_bytes_max": worst_unattr,
+                "replicas": per_replica}
 
     def _profile_health(self):
         """Fleet hotspot rollup for the health snapshot: per-phase
@@ -1184,7 +1253,15 @@ class FleetRouter:
             tenants_fn=None if self.tenants is None
             else self.tenants.report,
             profile_fn=None if self.profiler is None
-            else (lambda window: self.profiler.report(window_s=window)))
+            else (lambda window: self.profiler.report(window_s=window)),
+            # /memory on the router serves the fleet rollup (cached
+            # heartbeat ledger digests); a ledger-less fleet answers
+            # the same stub shape an unarmed engine does
+            memory_fn=lambda window: (
+                self._mem_health()
+                or {"armed": False,
+                    "note": "no replica ledger armed "
+                            "(PADDLE_TPU_MEM_LEDGER=1)"}))
         return self._exporter
 
     def _history_endpoint(self, params):
@@ -1621,6 +1698,7 @@ class FleetRouter:
                 self._fold_prefix(name, snap)
                 self._fold_spec(name, snap)
                 self._fold_profile(name, snap)
+                self._fold_mem(name, snap)
 
     def _fold_profile(self, name, snap):
         """Harvest one heartbeat's continuous-profiler digest: cache
@@ -1638,6 +1716,33 @@ class FleetRouter:
         seen = self._profile_seen.setdefault(name, {})
         for stat, ctr in self._m_profile.items():
             v = int(pf.get(stat) or 0)
+            last = seen.get(stat, 0)
+            d = v - last if v >= last else v
+            seen[stat] = v
+            if d > 0:
+                ctr.inc(d)
+
+    def _fold_mem(self, name, snap):
+        """Harvest one heartbeat's memory-ledger digest: cache it for
+        the health() rollup + the mem_headroom placement term, push
+        the worst per-replica unattributed residual into the canary
+        gauge, and delta-fold the engine-monotonic ledger stats into
+        fleet_mem_* (the _fold_profile restart-tolerance discipline:
+        a backwards value means the engine restarted — fold the new
+        absolute, never a negative delta)."""
+        mem = snap.get("mem")
+        if not mem:
+            self._mem_seen.pop(name, None)
+            self._mem_digests.pop(name, None)
+            return
+        self._mem_digests[name] = mem
+        worst = max((int(dg.get("unattributed_bytes") or 0)
+                     for dg in self._mem_digests.values()), default=0)
+        self._m_mem_unattr.set(worst)
+        seen = self._mem_seen.setdefault(name, {})
+        stats = mem.get("stats") or {}
+        for stat, ctr in self._m_mem.items():
+            v = int(stats.get(stat) or 0)
             last = seen.get(stat, 0)
             d = v - last if v >= last else v
             seen[stat] = v
@@ -1757,6 +1862,7 @@ class FleetRouter:
         before)."""
         w = self.placement_weights
         aff_w = w["prefix_affinity"]
+        mem_w = w["mem_headroom"]
         best, best_key = None, None
         for name, snap in self._serving_candidates():
             if name in exclude:
@@ -1771,6 +1877,15 @@ class FleetRouter:
                      - w["outstanding"] * outstanding.get(name, 0))
             if aff_w and pending is not None:
                 score += aff_w * self._affinity_pages(pending, name)
+            if mem_w:
+                # forecast device headroom off the cached heartbeat
+                # ledger digest (MB so the weight's scale matches the
+                # page-count terms); replicas without an armed ledger
+                # contribute 0 — unknown headroom is not a penalty
+                dg = self._mem_digests.get(name) or {}
+                hr = dg.get("headroom_bytes")
+                if hr is not None:
+                    score += mem_w * (float(hr) / 1e6)
             key = (score, name)
             if best_key is None or score > best_key[0] \
                     or (score == best_key[0] and name < best_key[1]):
@@ -2275,7 +2390,7 @@ class FleetRouter:
         and the fleet health rollup attached (never raises — a
         postmortem write must not take the router down)."""
         try:
-            from ..observability import contprof, flightrec
+            from ..observability import contprof, flightrec, memledger
             flightrec.note(tag, **{k: v for k, v in extra.items()
                                    if not isinstance(v, dict)})
             flightrec.dump(tag, extra=dict(
@@ -2284,7 +2399,10 @@ class FleetRouter:
                 # what was the PROCESS actually doing when the
                 # anomaly tripped — the last ~minute of host stacks
                 # (None when no profiler is armed in-process)
-                profile=contprof.current_profile()))
+                profile=contprof.current_profile(),
+                # and where device memory stood: the active ledger's
+                # segment tree + headroom (None when none is armed)
+                memory=memledger.current_memory()))
         except Exception:  # noqa: BLE001
             pass
 

@@ -1,0 +1,705 @@
+"""Device-memory ledger plane (HBM ledger round;
+observability/memledger.py).
+
+Pins the round's contracts (docs/observability.md "Device memory"):
+
+- two attribution channels: ``track``/``track_bytes`` tokens for
+  owner-managed buffers (idempotent ``release``), ``set_level``
+  absolute levels for recomputed inventories; unknown tags fold into
+  ``other`` LABELED with the tag — a misspelled seam stays visible;
+- conservation: typed segments + the ``unattributed_bytes`` residual
+  equal ground truth within 1% across a full serve wave — prefill,
+  prefix-cache hits, speculative decode, and a fleet failover — with
+  compile counts frozen (accounting is host-side dict arithmetic);
+- the residual alarm trips on a MiB-scale untracked allocation and
+  stays quiet on noise under the ``max(1 MiB, 0.5*baseline)`` slack;
+- headroom forecasting: high-watermark + EWMA growth +
+  ``seconds_to_exhaustion``; ``would_fit`` is None when
+  capacity-blind, and admission is advisory-by-default /
+  typed-rejection in ``PADDLE_TPU_MEM_ADMISSION=hard`` mode;
+- ``PrefixIndex.audit()`` cross-checks refcounts against the live
+  page table and the ledger surfaces problems without raising;
+- a never-armed engine creates NO ledger and registers NO ``mem_*``
+  series (the spec-decode dormancy contract);
+- ``/memory`` renders the armed segment tree live (and a stub when
+  unarmed), self-timed in ``exporter_scrape_seconds``;
+- the sentinel's gauge-kind ``mem_used_ratio`` signal trips on a
+  used-ratio step out of the learned band and stays quiet on flat;
+- the router delta-folds heartbeat digests into ``fleet_mem_*``
+  (restart-reset-safe), publishes the fleet-max residual, rolls up
+  health()["mem"], and scores the ``placement.mem_headroom`` term
+  (weight 0 = byte-identical placement); fleet_top renders
+  MEM%/HEADROOM off the rollup;
+- tools/mem_diff.py gates per-segment drift in BOTH directions and
+  fails vacuous comparisons;
+- the optimizer seam level-sets ``optimizer_state``/``grads`` into
+  the active ledger after ``step()``.
+"""
+import importlib
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nlp.gpt import GPTForCausalLM, _resolve_config
+from paddle_tpu.nlp.serving import ServingEngine
+from paddle_tpu.observability import memledger
+from paddle_tpu.observability.history import HistoryStore
+from paddle_tpu.observability.memledger import (MemoryAdmissionError,
+                                               MemoryLedger, nbytes_of)
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.observability.sentinel import (AnomalySentinel,
+                                               default_signals)
+from paddle_tpu.resilience import faults
+from paddle_tpu.serving_fleet import FleetRouter, InprocReplica
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+T0 = 1_700_000_000.0
+
+
+@pytest.fixture(scope="module")
+def gpt_model():
+    paddle.seed(0)
+    m = GPTForCausalLM(_resolve_config("gpt-tiny"))
+    m.eval()
+    return m
+
+
+def _prompts(lens, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, vocab, (n,)).astype(np.int32) for n in lens]
+
+
+def _shared_wave(seed=0):
+    """Four requests over two distinct prompts: the repeats are
+    guaranteed prefix-cache hits once the firsts registered."""
+    base = _prompts((24, 20), seed=seed)
+    return [base[0], base[1], base[0], base[1]]
+
+
+def _armed(model, **kw):
+    kw.setdefault("mem_ledger", True)
+    kw.setdefault("mem_capacity_bytes", 1 << 30)
+    kw.setdefault("steps_per_dispatch", 4)
+    return ServingEngine(model, max_slots=2, page_size=16,
+                         max_seq_len=64, **kw)
+
+
+# -- ledger core -----------------------------------------------------------
+
+
+class TestLedgerCore:
+    def test_track_release_roundtrip_idempotent(self):
+        led = MemoryLedger(ground_truth_fn=lambda: (0, None))
+        try:
+            t = led.track_bytes("kv_pages", 1000, label="dtype=f32")
+            t2 = led.track_bytes("weights", 500)
+            assert led.attributed_bytes() == 1500
+            assert led.release(t) == 1000
+            # releasing a dead token is a no-op, never a crash
+            assert led.release(t) == 0
+            assert led.attributed_bytes() == 500
+            s = led.stats()
+            assert s["tracked_allocs"] == 2
+            assert s["released_allocs"] == 1
+            assert led.release(t2) == 500
+        finally:
+            led.close()
+
+    def test_set_level_overwrites_and_clears(self):
+        led = MemoryLedger(ground_truth_fn=lambda: (0, None))
+        try:
+            led.set_level("prefix_sidecar", 4096)
+            led.set_level("prefix_sidecar", 8192)   # absolute, not +=
+            assert led.segments()["prefix_sidecar"] == 8192
+            led.set_level("prefix_sidecar", 0)      # 0 clears the row
+            assert "prefix_sidecar" not in led.segments()
+        finally:
+            led.close()
+
+    def test_unknown_tag_folds_to_other_with_label(self):
+        led = MemoryLedger(ground_truth_fn=lambda: (0, None))
+        try:
+            led.track_bytes("kv_pgaes", 777)        # the typo'd seam
+            tree = led.segment_tree()
+            assert led.segments()["other"] == 777
+            # ...but the tag survives as a label, so the misspelling
+            # is visible in the tree, never silently absorbed
+            assert "kv_pgaes" in tree["other"]["labels"]
+        finally:
+            led.close()
+
+    def test_nbytes_of_walks_and_dedups(self):
+        a = np.zeros((8, 8), np.float32)            # 256 B
+        b = np.zeros(16, np.int8)                   # 16 B
+        assert nbytes_of(a) == 256
+        assert nbytes_of({"x": a, "y": [b, (b,)]}) == 272, \
+            "the same buffer reachable twice must count once"
+
+    def test_conservation_against_injected_ground_truth(self):
+        gt = {"v": 0}
+        led = MemoryLedger(ground_truth_fn=lambda: (gt["v"], None))
+        try:
+            led.track_bytes("weights", 1000)
+            gt["v"] = 1004
+            led.sweep(force=True)
+            c = led.conservation(tolerance=0.01)
+            assert c["ok"] and c["unattributed_bytes"] == 4
+            # under-attribution lands in the residual, VISIBLY — the
+            # identity still holds (that is what the residual is for)
+            gt["v"] = 1100
+            led.sweep(force=True)
+            c = led.conservation(tolerance=0.01)
+            assert c["ok"] and c["unattributed_bytes"] == 100
+            # OVER-attribution — a seam counting bytes the device no
+            # longer holds — is the bug class that breaks the books
+            gt["v"] = 800
+            led.sweep(force=True)
+            assert not led.conservation(tolerance=0.01)["ok"]
+        finally:
+            led.close()
+
+    def test_residual_alarm_slack_floor_then_trip(self):
+        gt = {"v": 1000}
+        led = MemoryLedger(ground_truth_fn=lambda: (gt["v"], None))
+        try:
+            led.mark_baseline()
+            # sub-floor growth (well under 1 MiB) is noise, not a leak
+            gt["v"] += 700
+            led.sweep(force=True)
+            assert not led.residual_alarm
+            # a MiB-scale untracked allocation is the leak signature
+            gt["v"] += 2 << 20
+            led.sweep(force=True)
+            assert led.residual_alarm
+        finally:
+            led.close()
+
+    def test_would_fit_none_when_capacity_blind(self):
+        led = MemoryLedger(ground_truth_fn=lambda: (0, None))
+        try:
+            assert led.would_fit(1 << 20) is None
+            # capacity-blind admission_check must not reject
+            assert led.admission_check(1 << 20) is not False
+        finally:
+            led.close()
+
+    def test_admission_check_counts_and_verdicts(self):
+        reg = MetricsRegistry()
+        led = MemoryLedger(registry=reg, capacity_bytes=10_000,
+                           ground_truth_fn=lambda: (0, None))
+        try:
+            led.track_bytes("kv_pages", 9_000)
+            assert led.would_fit(500) is True
+            assert led.admission_check(500) is True
+            assert led.would_fit(5_000) is False
+            assert led.admission_check(5_000) is False
+            s = led.stats()
+            assert s["admission_checks"] == 2
+            assert s["admission_rejections"] == 1
+            assert int(reg.get(
+                "engine_mem_admission_rejections_total").value) == 1
+        finally:
+            led.close()
+
+    def test_growth_forecast_and_seconds_to_exhaustion(self):
+        gt = {"v": 0}
+        led = MemoryLedger(capacity_bytes=10_000_000,
+                           ground_truth_fn=lambda: (gt["v"], None))
+        try:
+            for i in range(6):
+                gt["v"] = 1_000_000 * (i + 1)   # +1 MB per second
+                led.sweep(force=True, now=T0 + i)
+            dg = led.digest(sweep=False)
+            assert dg["growth_bytes_per_s"] == pytest.approx(
+                1_000_000, rel=0.5)
+            tte = led.seconds_to_exhaustion()
+            assert tte is not None and 1.0 < tte < 30.0
+            assert dg["high_watermark_bytes"] == 6_000_000
+        finally:
+            led.close()
+
+    def test_snapshot_save_load_and_torn_tail(self, tmp_path):
+        led = MemoryLedger(capacity_bytes=1 << 20,
+                           ground_truth_fn=lambda: (2048, None))
+        p = str(tmp_path / "mem.json")
+        try:
+            led.track_bytes("kv_pages", 2048, label="dtype=f32")
+            led.save(p)
+        finally:
+            led.close()
+        doc = memledger.load_snapshot(p)
+        assert doc["memledger"] == 1
+        assert doc["digest"]["segments"]["kv_pages"] == 2048
+        raw = open(p, "rb").read()
+        for cut in (0, 1, len(raw) // 2, len(raw) - 1):
+            with open(p, "wb") as f:
+                f.write(raw[:cut])
+            assert memledger.load_snapshot(p) == {}, \
+                "a torn snapshot must read as empty, never raise"
+
+    def test_active_ledger_registry_lifecycle(self):
+        assert memledger.active_ledger() is None
+        assert memledger.current_memory() is None
+        led = MemoryLedger(name="t-active",
+                           ground_truth_fn=lambda: (0, None))
+        try:
+            led.track_bytes("weights", 64)
+            assert memledger.active_ledger() is led
+            rep = memledger.current_memory()
+            assert rep is not None and rep["name"] == "t-active"
+            assert rep["tree"]["weights"]["bytes"] == 64
+        finally:
+            led.close()
+        assert memledger.active_ledger() is None
+        assert memledger.current_memory() is None
+
+
+# -- prefix refcount audit -------------------------------------------------
+
+
+class TestPrefixRefcountAudit:
+    def test_prefix_refcount_audit(self, gpt_model):
+        """Clean engine audits clean; a corrupted refcount (the bug
+        class: a COW splice that forgot its pin) is DETECTED, counted,
+        and never raises out of the sweep."""
+        eng = _armed(gpt_model)
+        try:
+            eng.warmup(buckets=[24, 20], decode=True)
+            eng.generate(_shared_wave(), max_new_tokens=8)
+            assert eng.prefix.stats()["hits"] > 0
+            assert eng._mem_audit() == []
+            # corrupt a refcount behind the index's back: a phantom
+            # pin on an owned page — the page that would never return
+            # to the free list
+            page = next(iter(eng.prefix._owners))
+            eng.prefix._rc[page] = eng.prefix._rc.get(page, 0) + 1
+            problems = eng._mem_audit()
+            assert problems and any(str(page) in p for p in problems)
+            # the sweep surfaces it as a counter + bounded note list,
+            # never an exception
+            eng.ledger.sweep(force=True)
+            assert eng.ledger.stats()["audit_failures"] >= 1
+            assert eng.ledger.audit_problems
+            del eng.prefix._rc[page]
+            assert eng._mem_audit() == []
+        finally:
+            eng.close()
+
+
+# -- engine integration ----------------------------------------------------
+
+
+class TestEngineIntegration:
+    def test_full_wave_conservation_frozen_compiles(self, gpt_model):
+        """The acceptance drill: prefill + prefix hits + speculative
+        decode through a ledger-armed engine — conservation within
+        1%, every seam's segment populated, compile counts frozen."""
+        eng = _armed(gpt_model, spec_decode=True, steps_per_dispatch=1)
+        try:
+            eng.warmup(buckets=[24, 20], decode=True)
+            frozen = eng.compile_counts()
+            outs = eng.generate(_shared_wave(), max_new_tokens=8)
+            assert len(outs) == 4
+            assert eng.compile_counts() == frozen, \
+                "memory accounting must never touch the trace plane"
+            assert eng.tracer.unexpected_retraces() == 0
+            c = eng.ledger.conservation(tolerance=0.01)
+            assert c["ok"], f"conservation broken: {c}"
+            segs = eng.ledger.segments()
+            assert segs["kv_pages"] > 0 and segs["weights"] > 0
+            assert segs["prefix_sidecar"] > 0
+            tree = eng.ledger.segment_tree()
+            assert any("dtype=" in lb
+                       for lb in tree["kv_pages"]["labels"])
+            s = eng.ledger.stats()
+            assert s["admission_checks"] >= 4
+            h = eng.health()
+            assert h["mem"]["attributed_bytes"] == segs_total(segs)
+            assert h["mem"]["residual_alarm"] is False
+        finally:
+            eng.close()
+
+    def test_dormant_engine_has_no_ledger_no_series(self, gpt_model):
+        eng = ServingEngine(gpt_model, max_slots=1, page_size=16,
+                            max_seq_len=64)
+        try:
+            assert eng.ledger is None
+            assert eng.registry.get("engine_mem_attributed_bytes") \
+                is None
+            assert eng.registry.get(
+                "engine_mem_admission_checks_total") is None
+            assert "mem" not in eng.health()
+        finally:
+            eng.close()
+
+    def test_hard_admission_rejects_typed(self, gpt_model):
+        eng = _armed(gpt_model, mem_admission="hard",
+                     mem_capacity_bytes=1)
+        try:
+            with pytest.raises(MemoryAdmissionError) as ei:
+                eng.submit(_prompts((24,))[0], max_new_tokens=4)
+            assert ei.value.need_bytes > 0
+            assert ei.value.headroom_bytes == 0
+            assert eng.ledger.stats()["admission_rejections"] >= 1
+        finally:
+            eng.close()
+
+    def test_advisory_mode_counts_and_serves(self, gpt_model):
+        # same impossible budget, default mode: the wave completes,
+        # the rejections land in the counter — advisory means ADVICE
+        eng = _armed(gpt_model, mem_capacity_bytes=1)
+        try:
+            eng.warmup(buckets=[24, 20], decode=True)
+            outs = eng.generate(_shared_wave(), max_new_tokens=4)
+            assert len(outs) == 4 and all(len(t) for t in outs)
+            assert eng.ledger.stats()["admission_rejections"] >= 4
+        finally:
+            eng.close()
+
+    def test_bad_admission_mode_rejected_loudly(self, gpt_model):
+        with pytest.raises(ValueError):
+            _armed(gpt_model, mem_admission="advisry")
+
+    def test_memory_endpoint_armed_stub_and_catalogue(self, gpt_model):
+        eng = _armed(gpt_model)
+        try:
+            eng.warmup(buckets=[24], decode=True)
+            eng.generate(_prompts((24,)), max_new_tokens=4)
+            ex = eng.serve_metrics(port=0)
+            base = f"http://127.0.0.1:{ex.port}"
+            with urllib.request.urlopen(base + "/memory?window=30",
+                                        timeout=10) as r:
+                doc = json.loads(r.read().decode())
+            assert doc["armed"] is True
+            assert doc["tree"]["kv_pages"]["bytes"] > 0
+            assert doc["conservation"]["ok"]
+            # the 404 catalogue advertises the route
+            try:
+                urllib.request.urlopen(base + "/nope", timeout=10)
+            except urllib.error.HTTPError as e:
+                lost = json.loads(e.read().decode())
+            assert "/memory" in lost["endpoints"]
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as r:
+                prom = r.read().decode()
+            assert "engine_mem_attributed_bytes" in prom
+            assert 'exporter_scrape_seconds' in prom \
+                and 'route="/memory"' in prom
+        finally:
+            eng.close()
+        # unarmed: the route stays probeable and answers a stub
+        eng2 = ServingEngine(gpt_model, max_slots=1, page_size=16,
+                             max_seq_len=64)
+        try:
+            ex2 = eng2.serve_metrics(port=0)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{ex2.port}/memory",
+                    timeout=10) as r:
+                stub = json.loads(r.read().decode())
+            assert stub["armed"] is False and "note" in stub
+        finally:
+            eng2.close()
+
+
+def segs_total(segs):
+    return sum(int(v) for v in segs.values())
+
+
+# -- optimizer seam --------------------------------------------------------
+
+
+class TestOptimizerSeam:
+    def test_step_levels_optimizer_state_and_grads(self):
+        led = MemoryLedger(name="t-opt",
+                           ground_truth_fn=lambda: (0, None))
+        try:
+            paddle.seed(0)
+            layer = paddle.nn.Linear(4, 4)
+            layer.train()
+            opt = paddle.optimizer.Momentum(
+                learning_rate=0.1, momentum=0.9,
+                parameters=layer.parameters())
+            x = paddle.to_tensor(
+                np.random.default_rng(0).standard_normal(
+                    (2, 4)).astype(np.float32))
+            loss = layer(x).sum()
+            loss.backward()
+            opt.step()
+            segs = led.segments()
+            assert segs.get("optimizer_state", 0) > 0, \
+                "momentum slots must land in the segment tree"
+            assert segs.get("grads", 0) > 0
+            lbl = led.segment_tree()["optimizer_state"]["labels"]
+            assert any("Momentum" in k for k in lbl)
+        finally:
+            led.close()
+
+
+# -- sentinel gauge signal -------------------------------------------------
+
+
+class TestSentinelMemSignal:
+    def _sig(self):
+        sig = [s for s in default_signals()
+               if s["name"] == "mem_used_ratio"][0]
+        return dict(sig, window_s=2.0)
+
+    def test_ratio_step_trips_flat_does_not(self):
+        # flat-then-step: a leak pushing used-ratio out of the
+        # learned band must fire...
+        reg = MetricsRegistry()
+        g = reg.gauge("engine_mem_hbm_used_ratio")
+        hs = HistoryStore(reg, interval_s=1.0)
+        for i in range(60):
+            g.set(0.92 if i >= 45 else 0.50)
+            hs.scrape(now=T0 + i)
+        firings = AnomalySentinel.replay(
+            hs, signals=[self._sig()], warmup=8, min_consecutive=2)
+        assert [f["signal"] for f in firings] == ["mem_used_ratio"]
+        # ...while a flat series — even NEAR FULL — is a steady
+        # state, not an anomaly (the alarm pages on motion, not level)
+        reg2 = MetricsRegistry()
+        g2 = reg2.gauge("engine_mem_hbm_used_ratio")
+        hs2 = HistoryStore(reg2, interval_s=1.0)
+        for i in range(60):
+            g2.set(0.93)
+            hs2.scrape(now=T0 + i)
+        assert AnomalySentinel.replay(
+            hs2, signals=[self._sig()], warmup=8,
+            min_consecutive=2) == []
+
+
+# -- fleet rollup + placement ----------------------------------------------
+
+
+def _mem_snap(tracked=10, released=4, checks=6, rejections=1,
+              audit=0, unattributed=2048, headroom=1 << 20):
+    return {"mem": {
+        "attributed_bytes": 10_000, "unattributed_bytes": unattributed,
+        "used_bytes": 10_000 + unattributed, "capacity_bytes": 1 << 22,
+        "used_ratio": 0.5, "headroom_bytes": headroom,
+        "high_watermark_bytes": 12_000, "growth_bytes_per_s": 0.0,
+        "residual_alarm": False, "audit_problems": [],
+        "segments": {"kv_pages": 8_000, "weights": 2_000},
+        "stats": {"tracked_allocs": tracked,
+                  "released_allocs": released,
+                  "admission_checks": checks,
+                  "admission_rejections": rejections,
+                  "audit_failures": audit}}}
+
+
+class TestFleetMem:
+    def test_fold_restart_tolerance_and_rollup(self, gpt_model):
+        eng = ServingEngine(gpt_model, max_slots=1, page_size=16,
+                            max_seq_len=64)
+        router = FleetRouter([InprocReplica("r0", eng)])
+        try:
+            reg = router.registry
+
+            def c(name):
+                m = reg.get(name)
+                return 0 if m is None else int(m.value)
+
+            router._fold_mem("r0", _mem_snap(tracked=10))
+            assert c("fleet_mem_tracked_allocs_total") == 10
+            assert c("fleet_mem_released_allocs_total") == 4
+            assert c("fleet_mem_admission_checks_total") == 6
+            assert c("fleet_mem_admission_rejections_total") == 1
+            # monotonic growth folds the delta only
+            router._fold_mem("r0", _mem_snap(tracked=14))
+            assert c("fleet_mem_tracked_allocs_total") == 14
+            # a BACKWARDS value = replica restart: fold the new
+            # absolute, never a negative delta
+            router._fold_mem("r0", _mem_snap(tracked=5))
+            assert c("fleet_mem_tracked_allocs_total") == 19
+            # fleet residual gauge is the MAX across replica digests
+            assert int(reg.get(
+                "fleet_mem_unattributed_bytes").value) == 2048
+            h = router.health()["mem"]
+            assert h["replicas"]["r0"]["headroom_bytes"] == 1 << 20
+            assert h["segments"]["kv_pages"] == 8_000
+            assert h["unattributed_bytes_max"] == 2048
+            # a heartbeat with no mem section clears the inventory;
+            # no digests -> rollup reads None
+            router._fold_mem("r0", {})
+            assert "r0" not in router._mem_digests
+            assert router.health()["mem"] is None
+            assert "r0" not in router._mem_seen
+        finally:
+            router.close()
+            eng.close()
+
+    def test_placement_headroom_term_weight_gated(self, gpt_model):
+        engines = [ServingEngine(gpt_model, max_slots=1, page_size=16,
+                                 max_seq_len=64) for _ in range(2)]
+        reps = [InprocReplica(f"r{i}", e)
+                for i, e in enumerate(engines)]
+        router = FleetRouter(reps)
+        try:
+            # deterministic candidates: identical stubbed health
+            # snapshots (live scrapes are rate-limited and racy), and
+            # a no-op fold so the background scrape can't clear the
+            # injected digests (these engines have no ledger)
+            snap = {"state": "serving", "free_pages": 4,
+                    "queued": 0, "running": 0}
+            router._last_scrape = {"r0": dict(snap), "r1": dict(snap)}
+            router._fold_mem = lambda name, snap: None
+            # identical engines: r1 forecasts 64 MB more headroom
+            router._mem_digests = {
+                "r0": {"headroom_bytes": 1 << 20},
+                "r1": {"headroom_bytes": 65 << 20}}
+            # weight 0 (default): the term is skipped entirely,
+            # placement unchanged -> deterministic name tie-break
+            assert router.placement_weights["mem_headroom"] == 0.0
+            assert router._pick_replica({}) == "r0"
+            router.placement_weights["mem_headroom"] = 1.0
+            assert router._pick_replica({}) == "r1", \
+                "a nonzero weight must prefer the forecast headroom"
+            # a replica with no armed ledger scores 0, not a penalty
+            router._mem_digests = {"r1": {"headroom_bytes": None}}
+            assert router._pick_replica({}) == "r0"
+        finally:
+            router.close()
+            for e in engines:
+                e.close()
+
+    def test_failover_conservation_with_ledgers_armed(self, gpt_model):
+        """Crash a replica mid-wave with ledgers armed everywhere:
+        every request completes, compile counts stay frozen, and the
+        SURVIVOR's ledger still conserves — failover re-admission
+        must not strand attributed bytes."""
+        engines = [_armed(gpt_model) for _ in range(2)]
+        for e in engines:
+            e.warmup(buckets=[24, 20], decode=True)
+        frozen = [e.compile_counts() for e in engines]
+        reps = [InprocReplica(f"r{i}", e)
+                for i, e in enumerate(engines)]
+        router = FleetRouter(reps)
+        try:
+            outs = router.generate(_shared_wave(),
+                                   max_new_tokens=8)
+            assert all(len(t) for t in outs)
+            with faults.scenario(("replica_crash", {"replica": "r1"})):
+                outs = router.generate(_shared_wave(seed=1),
+                                       max_new_tokens=8)
+            assert all(len(t) for t in outs)
+            assert reps[1].state == "dead"
+            assert engines[0].compile_counts() == frozen[0]
+            c = engines[0].ledger.conservation(tolerance=0.01)
+            assert c["ok"], f"survivor conservation broken: {c}"
+            # the router folded nonzero ledger activity off heartbeats
+            h = router.health()["mem"]
+            assert h is not None and "r0" in h["replicas"]
+        finally:
+            router.close()
+            for e in engines:
+                e.close()
+
+
+# -- fleet_top columns -----------------------------------------------------
+
+
+class TestFleetTopMemColumns:
+    def test_render_mem_and_headroom(self, tmp_path):
+        ft = importlib.import_module("fleet_top")
+        reg = MetricsRegistry()
+        reg.counter("fleet_tokens_out_total").inc(10)
+        hs = HistoryStore(reg, interval_s=1.0)
+        for i in range(5):
+            hs.scrape(now=T0 + i)
+        hs.save(str(tmp_path / "history_snapshot.json"))
+        base = {"state": "serving", "incarnation": 1, "queued": 0,
+                "running": 0, "free_pages": 9, "scrape_age_s": 0.01,
+                "lost": False, "quarantined": False}
+        with open(tmp_path / "health.json", "w") as f:
+            json.dump({
+                "queue_depth": 0, "pending": 0, "lost": [],
+                "replicas": {"r0": dict(base), "r1": dict(base)},
+                "mem": {
+                    "replicas": {"r0": {"used_ratio": 0.425,
+                                        "headroom_bytes": 512 << 20,
+                                        "residual_alarm": True}},
+                    "segments": {"kv_pages": 1024},
+                    "unattributed_bytes_max": 0}}, f)
+        frame = ft.collect_snapshot(str(tmp_path))
+        text = ft.render(frame)
+        assert "MEM%" in text and "HEADROOM" in text
+        r0 = [ln for ln in text.splitlines()
+              if ln.strip().startswith("r0")][0]
+        assert "42.5" in r0 and "512.0M" in r0
+        assert "M" in r0.split()[-1], \
+            "residual alarm must raise the M flag"
+        # r1 has no ledger armed: renders "-", never crashes
+        r1 = [ln for ln in text.splitlines()
+              if ln.strip().startswith("r1")][0]
+        assert " - " in r1
+
+
+# -- tools/mem_diff.py -----------------------------------------------------
+
+
+def _write_snap(path, segments, unattributed=0):
+    att = sum(segments.values())
+    doc = {"memledger": 1, "name": "t",
+           "digest": {"segments": segments, "attributed_bytes": att,
+                      "unattributed_bytes": unattributed},
+           "tree": {}}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+class TestMemDiff:
+    @pytest.fixture(scope="class")
+    def md(self):
+        return importlib.import_module("mem_diff")
+
+    def test_gate_both_directions(self, md, tmp_path, capsys):
+        a = _write_snap(tmp_path / "a.json",
+                        {"kv_pages": 1000, "weights": 500},
+                        unattributed=100)
+        b = _write_snap(tmp_path / "b.json",
+                        {"kv_pages": 1000, "weights": 100},
+                        unattributed=400)
+        assert md.main([a, a, "--quiet", "--fail-on",
+                        "segment:unattributed>+50%"]) == 0
+        rep = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert rep["ok"] and not rep["vacuous"]
+        # +300% residual growth trips >
+        assert md.main([a, b, "--quiet", "--fail-on",
+                        "segment:unattributed>+50%"]) == 1
+        rep = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert rep["failures"][0]["delta_pct"] == pytest.approx(300.0)
+        # the weights collapse reads through a < gate
+        assert md.main([a, b, "--quiet", "--fail-on",
+                        "segment:weights<-50%"]) == 1
+        capsys.readouterr()
+
+    def test_new_segment_reads_as_max_drift(self, md, tmp_path,
+                                            capsys):
+        a = _write_snap(tmp_path / "a2.json", {"kv_pages": 100})
+        b = _write_snap(tmp_path / "b2.json",
+                        {"kv_pages": 100, "spec_draft_pool": 50})
+        assert md.main([a, b, "--quiet", "--fail-on",
+                        "segment:spec_draft_pool>+50%"]) == 1
+        capsys.readouterr()
+
+    def test_vacuous_comparison_fails(self, md, tmp_path, capsys):
+        e = _write_snap(tmp_path / "e.json", {})
+        assert md.main([e, e, "--quiet"]) == 1
+        rep = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert rep["vacuous"] and not rep["ok"]
+
+    def test_bad_spec_rejected(self, md):
+        with pytest.raises(Exception):
+            md.parse_spec("unattributed>+50%")   # missing segment:

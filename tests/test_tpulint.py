@@ -607,7 +607,8 @@ class TestRepoIsClean:
             for f in fresh)
         assert rep["files_scanned"] > 150
         assert set(rep["rules_run"]) == {"TRC01", "TRC02", "DUR01",
-                                         "CON01", "OBS01", "DOC01"}
+                                         "CON01", "OBS01", "DOC01",
+                                         "MEM01"}
 
     def test_committed_baseline_has_no_dead_entries(self):
         rep = run_lint(root=str(REPO), baseline=load_baseline())

@@ -59,6 +59,20 @@ def _fmt(v, unit="", nd=3):
     return f"{v}{unit}"
 
 
+def _fmt_bytes(n):
+    """1536 -> '1.5K', 3<<30 -> '3.0G' (the HEADROOM column's unit)."""
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "-"
+    for suffix in ("B", "K", "M", "G", "T"):
+        if abs(n) < 1024.0 or suffix == "T":
+            return f"{n:.1f}{suffix}" if suffix != "B" \
+                else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}T"
+
+
 def collect_live(base):
     """One frame's data off a live router exporter."""
     health = _get(base + "/healthz")
@@ -160,8 +174,14 @@ def render(frame):
             # host's sampled wall time was real serving work; "-" for
             # replicas with no profiler armed
             prof = (h.get("profile") or {}).get("replicas") or {}
+            # MEM%/HEADROOM (r23): device-memory used ratio and
+            # forecast free bytes from the replica's memory-ledger
+            # heartbeat digest; "-" for replicas with no ledger armed
+            # (or capacity-blind backends)
+            mem = (h.get("mem") or {}).get("replicas") or {}
             out.append("  REPLICA     STATE     INC  Q/R    FREE_PG "
-                       "SCRAPE_AGE  BOOT         HOST%  FLAGS")
+                       "SCRAPE_AGE  BOOT         HOST%  MEM%   "
+                       "HEADROOM  FLAGS")
             for name in sorted(reps):
                 row = reps[name]
                 flags = "".join(
@@ -178,6 +198,14 @@ def render(frame):
                        else f" {float(bi['boot_s']):.1f}s"))
                 hp = (prof.get(name) or {}).get("host_pct")
                 host = "-" if hp is None else f"{float(hp):.1f}"
+                mrow = mem.get(name) or {}
+                mr = mrow.get("used_ratio")
+                memp = "-" if mr is None else f"{100.0 * float(mr):.1f}"
+                hr = mrow.get("headroom_bytes")
+                head = "-" if hr is None else _fmt_bytes(hr)
+                if mrow.get("residual_alarm"):
+                    flags = (flags.replace("-", "") or "") + "M" \
+                        if flags != "-" else "M"
                 out.append(
                     f"  {name:<11} {str(row.get('state')):<9} "
                     f"{str(row.get('incarnation')):<4} "
@@ -185,7 +213,8 @@ def render(frame):
                     f"{_fmt(row.get('running')):<4} "
                     f"{_fmt(row.get('free_pages')):<7} "
                     f"{_fmt(row.get('scrape_age_s'), 's'):<11} "
-                    f"{boot:<12} {host:<6} {flags}")
+                    f"{boot:<12} {host:<6} {memp:<6} "
+                    f"{head:<9} {flags}")
     if h:
         asc = h.get("autoscale")
         ov = h.get("overload") or {}

@@ -267,6 +267,19 @@ STAGES = [
     # passes, an injected decode busy-loop trips phase:decode>+10%).
     ("profile_smoke", [PY, "tools/profile_smoke.py"], 1800,
      {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
+    # device-memory ledger drill (HBM ledger round, CPU, seeded): a
+    # prefix-hitting wave through a ledger-ARMED engine — compile
+    # counts frozen with accounting ON (track/release is host-side
+    # dict arithmetic), typed segments + unattributed residual
+    # conserve against ground truth within 1%, /memory endpoint +
+    # engine_mem_* gauges render live, the residual alarm stays QUIET
+    # on the clean wave, and the leak drill (an untracked device page
+    # block + pages popped off the free list, never returned) must
+    # trip BOTH the residual alarm and the mem_diff gate
+    # (clean-vs-clean passes, clean-vs-leaked fails
+    # segment:unattributed>+50%).
+    ("mem_smoke", [PY, "tools/mem_smoke.py"], 1800,
+     {"JAX_PLATFORMS": "cpu", "PYTHONHASHSEED": "0"}),
     # AOT serving-artifact boot probe (ISSUE 21, seeded): traced
     # warmup control -> export_artifact -> warm_boot a second engine
     # off the store. Asserts the artifact path was taken (mode=aot,
@@ -489,6 +502,15 @@ FLEET_CANARY_FAIL_ON = (
     # counters above.)
     "profile_overhead_ratio>100%",
     "profile_samples_dropped_total>200%",
+    # device-memory ledger gauge (HBM ledger round): the fleet-max
+    # unattributed residual growing >200% past the golden means
+    # replicas are allocating device memory the segment tree cannot
+    # name — the exact drift the ledger exists to catch, surfaced at
+    # the fleet rollup before any single replica OOMs. (Series
+    # skipped by metrics_diff until the golden is regenerated with a
+    # ledger-armed chaos suite — same bootstrap as the sentinel
+    # counters above.)
+    "fleet_mem_unattributed_bytes>200%",
 )
 
 # history gate (ISSUE 11): ONE archive, two instants, both directions

@@ -12,6 +12,9 @@ rule to the CHANGES.md incident that motivated it):
                            the owning lock
 - OBS01 json-validity      telemetry json.dump(s) without the
                            non-finite-safe (allow_nan=False) discipline
+- MEM01 untracked-alloc    alloc_pages sites with no memory-ledger
+                           pairing (track/track_bytes/set_level) in
+                           the same function
 - DOC01 catalogue-drift    emitted fleet_* metrics / PADDLE_TPU_* knobs
                            vs the committed doc tables, both directions
 
@@ -612,6 +615,65 @@ def _obs01(ctx):
                 f"{d} without allow_nan=False on a telemetry path — "
                 f"non-finite floats would emit invalid JSON; use the "
                 f"allow_nan=False + _finite() fallback discipline"))
+    return out
+
+
+# -- MEM01: untracked device allocation -------------------------------------
+
+# a ledger pairing is any call whose attribute leaf is one of these —
+# track/track_bytes for owner-managed buffers, set_level for
+# recomputed inventories (the two attribution channels)
+_MEM01_PAIRING = {"track", "track_bytes", "set_level"}
+_MEM01_EXEMPT = {
+    # the allocator's own home: defines alloc_pages, never consumes it
+    "paddle_tpu/nlp/paged_cache.py",
+}
+
+
+def _mem01_scope(ctx, node):
+    """Innermost enclosing function of ``node`` (module tree when
+    top-level) — the scope a pairing call must appear in."""
+    parents = ctx.parents()
+    cur = parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(id(cur))
+    return ctx.tree
+
+
+@_register(
+    "MEM01", "untracked-alloc",
+    "alloc_pages(...) with no memory-ledger pairing (a .track / "
+    ".track_bytes / .set_level call) in the same function — device "
+    "bytes the segment tree cannot name land in unattributed_bytes "
+    "and eventually trip the residual alarm with no owner to blame. "
+    "Pair the allocation in the same function (dormant engines: "
+    "guard on `ledger is not None`, the serving/speculative seam "
+    "pattern), or baseline a deliberate exception with a reason.")
+def _mem01(ctx):
+    if ctx.path in _MEM01_EXEMPT:
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func) or ""
+        if d.split(".")[-1] != "alloc_pages":
+            continue
+        scope = _mem01_scope(ctx, node)
+        paired = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr in _MEM01_PAIRING
+            for n in ast.walk(scope))
+        if not paired:
+            out.append(ctx.finding(
+                "MEM01", node, d,
+                "alloc_pages call with no ledger pairing "
+                "(track/track_bytes/set_level) in the same function "
+                "— the block's bytes are invisible to the memory "
+                "ledger's segment tree"))
     return out
 
 

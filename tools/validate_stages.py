@@ -74,6 +74,11 @@ REQUIRED_STAGES = {
     # cap, and the profile_diff gate proven both directions (CPU-only
     # — ISSUE 22)
     "profile_smoke",
+    # device-memory ledger drill: ledger-armed wave with frozen
+    # compile counts, typed-segment conservation within 1%, the
+    # residual alarm + mem_diff gate proven both directions via an
+    # injected untracked leak (CPU-only — HBM ledger round)
+    "mem_smoke",
 }
 
 
@@ -91,6 +96,7 @@ def _emits_metrics(cmd):
                                             "prefix_cache_smoke.py",
                                             "spec_smoke.py",
                                             "profile_smoke.py",
+                                            "mem_smoke.py",
                                             "aot_boot_probe.py",
                                             "test_fleet_serving.py",
                                             "test_fleet_recovery.py",
@@ -151,7 +157,10 @@ FLIGHT_STAGES = {"chaos_smoke", "telemetry_smoke",
                  "history_smoke", "autoscale_smoke",
                  # the anomaly-evidence path end-to-end: its dump
                  # carries the live profile (ISSUE 22)
-                 "profile_smoke"}
+                 "profile_smoke",
+                 # likewise: its dump carries the live segment tree
+                 # (HBM ledger round)
+                 "mem_smoke"}
 
 
 def check_flight_dumps():
